@@ -1,0 +1,24 @@
+"""Test configuration: force JAX onto a virtual 8-device CPU mesh so all
+sharding/collective paths are exercised without TPU hardware, and keep the
+native fake backend selected by default."""
+
+import os
+
+# Must be set before jax is imported anywhere in the test session.
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Select the in-process fake chip backend for tpu_dra.native (SURVEY §7.3).
+os.environ.setdefault("TPU_DRA_TPUINFO_BACKEND", "fake")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_feature_gates():
+    """Feature gates are process-global (like the reference's package-level
+    Features); reset overrides between tests."""
+    from tpu_dra.infra import featuregates
+    featuregates.Features.reset()
+    yield
+    featuregates.Features.reset()

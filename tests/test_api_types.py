@@ -1,0 +1,233 @@
+"""L6 API layer tests.
+
+Mirrors the reference's api/nvidia.com/resource/v1beta1/sharing_test.go
+(MPS limit normalization tables) plus strict/non-strict decode behavior
+(api.go:50-55) that the reference only exercises implicitly.
+"""
+
+import pytest
+
+from tpu_dra.api import (
+    StrictDecoder, NonstrictDecoder, DecodeError,
+    TpuConfig, ComputeDomain, ComputeDomainChannelConfig,
+    API_VERSION,
+)
+from tpu_dra.api.types import (
+    MultiprocessPerDeviceHbmLimit, TimeSlicingConfig, ValidationError,
+    TpuSharing, TimeSlicingStrategy, MultiprocessStrategy, MultiprocessConfig,
+)
+from tpu_dra.infra import featuregates
+from tpu_dra.infra.quantity import Quantity
+
+
+def tpu_config_doc(extra=None, sharing=None):
+    doc = {"apiVersion": API_VERSION, "kind": "TpuConfig"}
+    if sharing is not None:
+        doc["sharing"] = sharing
+    if extra:
+        doc.update(extra)
+    return doc
+
+
+class TestDecoders:
+    def test_strict_rejects_unknown_field(self):
+        with pytest.raises(DecodeError, match="unknown field"):
+            StrictDecoder.decode(tpu_config_doc(extra={"bogus": 1}))
+
+    def test_nonstrict_drops_unknown_field(self):
+        cfg = NonstrictDecoder.decode(tpu_config_doc(extra={"bogus": 1}))
+        assert isinstance(cfg, TpuConfig)
+
+    def test_unknown_kind(self):
+        with pytest.raises(DecodeError, match="no kind"):
+            StrictDecoder.decode({"apiVersion": API_VERSION, "kind": "Nope"})
+
+    def test_unknown_group(self):
+        with pytest.raises(DecodeError, match="no kind"):
+            StrictDecoder.decode({"apiVersion": "other/v1", "kind": "TpuConfig"})
+
+    def test_nested_strict(self):
+        doc = tpu_config_doc(sharing={"strategy": "TimeSlicing", "oops": True})
+        with pytest.raises(DecodeError, match="unknown field"):
+            StrictDecoder.decode(doc)
+        cfg = NonstrictDecoder.decode(doc)
+        assert cfg.sharing.strategy == "TimeSlicing"
+
+    @pytest.mark.parametrize("sharing", ["TimeSlicing", 5, ["x"], True])
+    def test_malformed_nested_type_is_decode_error(self, sharing):
+        """Malformed nested values must surface as DecodeError, not
+        AttributeError/TypeError — these decoders face untrusted input."""
+        with pytest.raises(DecodeError):
+            StrictDecoder.decode(tpu_config_doc(sharing=sharing))
+        with pytest.raises(DecodeError):
+            NonstrictDecoder.decode(tpu_config_doc(sharing=sharing))
+
+    def test_roundtrip(self):
+        doc = tpu_config_doc(sharing={
+            "strategy": "TimeSlicing", "timeSlicingConfig": {"interval": "Long"}})
+        cfg = StrictDecoder.decode(doc)
+        assert cfg.to_dict()["sharing"]["timeSlicingConfig"]["interval"] == "Long"
+
+
+class TestTpuConfig:
+    def test_default_no_gates(self):
+        cfg = TpuConfig.default()
+        assert cfg.sharing is None
+        cfg.normalize()
+        cfg.validate()
+
+    def test_default_with_timeslicing_gate(self):
+        featuregates.Features.set_from_string("TimeSlicingSettings=true")
+        cfg = TpuConfig.default()
+        assert cfg.sharing.strategy == TimeSlicingStrategy
+        assert cfg.sharing.time_slicing_config.interval == "Default"
+
+    def test_normalize_fills_interval(self):
+        featuregates.Features.set_from_string("TimeSlicingSettings=true")
+        cfg = TpuConfig(sharing=TpuSharing(strategy=TimeSlicingStrategy))
+        cfg.normalize()
+        assert cfg.sharing.time_slicing_config.interval == "Default"
+
+    def test_timeslicing_config_requires_gate(self):
+        cfg = TpuConfig(sharing=TpuSharing(
+            strategy=TimeSlicingStrategy,
+            time_slicing_config=TimeSlicingConfig("Short")))
+        with pytest.raises(ValidationError, match="feature gate"):
+            cfg.validate()
+
+    def test_multiprocess_requires_gate(self):
+        cfg = TpuConfig(sharing=TpuSharing(strategy=MultiprocessStrategy))
+        with pytest.raises(ValidationError, match="MultiprocessSupport"):
+            cfg.validate()
+
+    def test_bad_interval(self):
+        featuregates.Features.set_from_string("TimeSlicingSettings=true")
+        cfg = TpuConfig(sharing=TpuSharing(
+            strategy=TimeSlicingStrategy,
+            time_slicing_config=TimeSlicingConfig("Sometimes")))
+        with pytest.raises(ValidationError, match="interval"):
+            cfg.validate()
+
+    def test_mixed_strategy_config_rejected(self):
+        featuregates.Features.set_from_string(
+            "TimeSlicingSettings=true,MultiprocessSupport=true")
+        cfg = TpuConfig(sharing=TpuSharing(
+            strategy=MultiprocessStrategy,
+            time_slicing_config=TimeSlicingConfig()))
+        with pytest.raises(ValidationError, match="timeSlicingConfig"):
+            cfg.validate()
+
+
+class TestMultiprocessHbmLimits:
+    """Table tests in the spirit of sharing_test.go (MPS pinned-memory
+    normalization)."""
+
+    UUIDS = ["tpu-v5e-0", "tpu-v5e-1"]
+    INDICES = {"tpu-v5e-0": 0, "tpu-v5e-1": 1}
+
+    def test_default_applies_to_all(self):
+        lim = MultiprocessPerDeviceHbmLimit({"default": "4Gi"})
+        out = lim.normalize(self.UUIDS, self.INDICES, None)
+        assert out == {u: 4 * 1024**3 for u in self.UUIDS}
+
+    def test_per_uuid_overrides_default(self):
+        lim = MultiprocessPerDeviceHbmLimit({"default": "4Gi", "tpu-v5e-1": "1Gi"})
+        out = lim.normalize(self.UUIDS, self.INDICES, None)
+        assert out["tpu-v5e-0"] == 4 * 1024**3
+        assert out["tpu-v5e-1"] == 1024**3
+
+    def test_index_key_translated(self):
+        lim = MultiprocessPerDeviceHbmLimit({"0": "2Gi"})
+        out = lim.normalize(self.UUIDS, self.INDICES, None)
+        assert out == {"tpu-v5e-0": 2 * 1024**3}
+
+    def test_config_level_default_fallback(self):
+        lim = MultiprocessPerDeviceHbmLimit({})
+        out = lim.normalize(self.UUIDS, self.INDICES, "512Mi")
+        assert out == {u: 512 * 1024**2 for u in self.UUIDS}
+
+    def test_unknown_device_rejected(self):
+        lim = MultiprocessPerDeviceHbmLimit({"not-a-chip": "1Gi"})
+        with pytest.raises(ValidationError, match="not part of this claim"):
+            lim.normalize(self.UUIDS, self.INDICES, None)
+
+    def test_bad_quantity(self):
+        lim = MultiprocessPerDeviceHbmLimit({"default": "many"})
+        with pytest.raises(ValidationError):
+            lim.validate()
+
+    def test_active_cores_percentage_bounds(self):
+        featuregates.Features.set_from_string("MultiprocessSupport=true")
+        cfg = MultiprocessConfig(default_active_cores_percentage=101)
+        with pytest.raises(ValidationError, match="ActiveCoresPercentage"):
+            cfg.validate()
+        MultiprocessConfig(default_active_cores_percentage=50).validate()
+
+
+class TestQuantity:
+    @pytest.mark.parametrize("text,val", [
+        ("1Ki", 1024), ("4Gi", 4 * 1024**3), ("1k", 1000),
+        ("1.5Gi", int(1.5 * 1024**3)), ("100", 100), ("2M", 2_000_000),
+    ])
+    def test_parse(self, text, val):
+        assert Quantity(text).value == val
+
+    def test_invalid(self):
+        with pytest.raises(ValueError):
+            Quantity("abc")
+
+
+class TestComputeDomain:
+    def make(self, **spec_over):
+        doc = {
+            "apiVersion": API_VERSION, "kind": "ComputeDomain",
+            "metadata": {"name": "cd", "namespace": "ns", "uid": "u-1"},
+            "spec": {"numNodes": 0,
+                     "channel": {"resourceClaimTemplate": {"name": "rct"},
+                                 "allocationMode": "Single"}},
+        }
+        doc["spec"].update(spec_over)
+        return StrictDecoder.decode(doc)
+
+    def test_decode_validate(self):
+        cd = self.make()
+        cd.normalize()
+        cd.validate()
+        assert cd.uid == "u-1" and cd.namespace == "ns"
+
+    def test_missing_channel(self):
+        cd = self.make(channel=None)
+        with pytest.raises(ValidationError, match="channel"):
+            cd.validate()
+
+    def test_bad_allocation_mode(self):
+        cd = self.make(channel={"resourceClaimTemplate": {"name": "rct"},
+                                "allocationMode": "Some"})
+        with pytest.raises(ValidationError, match="allocationMode"):
+            cd.validate()
+
+    def test_negative_num_nodes(self):
+        cd = self.make(numNodes=-1)
+        with pytest.raises(ValidationError, match="numNodes"):
+            cd.validate()
+
+    def test_status_roundtrip_with_nodes(self):
+        doc = self.make().to_dict()
+        doc["status"] = {"status": "Ready", "nodes": [
+            {"name": "n0", "ipAddress": "10.0.0.1", "sliceID": "s0",
+             "index": 0, "status": "Ready"}]}
+        cd = NonstrictDecoder.decode(doc)
+        assert cd.status.nodes[0].slice_id == "s0"
+        assert cd.status.nodes[0].status == "Ready"
+
+
+class TestChannelConfig:
+    def test_validate(self):
+        cfg = ComputeDomainChannelConfig(domain_id="u-1")
+        cfg.normalize()
+        cfg.validate()
+        assert cfg.allocation_mode == "Single"
+
+    def test_missing_domain(self):
+        with pytest.raises(ValidationError, match="domainID"):
+            ComputeDomainChannelConfig().validate()

@@ -1,0 +1,73 @@
+"""Feature gate tests (reference: pkg/featuregates/featuregates_test.go —
+defaults, string parsing, unknown-gate errors, lock-to-default)."""
+
+import pytest
+
+from tpu_dra.infra.featuregates import (
+    FeatureGate, FeatureSpec, VersionedSpecs, Features,
+    TimeSlicingSettings, MultiprocessSupport, SliceDaemonsWithDNSNames,
+    PassthroughSupport, TPUDeviceHealthCheck,
+)
+
+
+class TestDefaults:
+    @pytest.mark.parametrize("gate,expected", [
+        (TimeSlicingSettings, False),
+        (MultiprocessSupport, False),
+        (SliceDaemonsWithDNSNames, True),
+        (PassthroughSupport, False),
+        (TPUDeviceHealthCheck, True),
+    ])
+    def test_default(self, gate, expected):
+        assert Features.enabled(gate) is expected
+
+
+class TestParsing:
+    def test_set_from_string(self):
+        Features.set_from_string("TimeSlicingSettings=true, MultiprocessSupport=true")
+        assert Features.enabled(TimeSlicingSettings)
+        assert Features.enabled(MultiprocessSupport)
+
+    def test_disable_default_on(self):
+        Features.set_from_string("SliceDaemonsWithDNSNames=false")
+        assert not Features.enabled(SliceDaemonsWithDNSNames)
+
+    def test_unknown_gate(self):
+        with pytest.raises(ValueError, match="unknown feature gate"):
+            Features.set_from_string("NotAGate=true")
+
+    def test_partial_failure_is_atomic(self):
+        with pytest.raises(ValueError):
+            Features.set_from_string("TimeSlicingSettings=true,Bogus=true")
+        assert not Features.enabled(TimeSlicingSettings)
+
+    def test_bad_boolean(self):
+        with pytest.raises(ValueError):
+            Features.set_from_string("TimeSlicingSettings=yes")
+
+    def test_missing_equals(self):
+        with pytest.raises(ValueError):
+            Features.set_from_string("TimeSlicingSettings")
+
+    def test_roundtrip_string(self):
+        Features.set_from_string("TimeSlicingSettings=true")
+        s = Features.as_string()
+        g = FeatureGate()
+        g.set_from_string(s)
+        assert g.snapshot() == Features.snapshot()
+
+
+class TestLockToDefault:
+    def test_locked(self):
+        g = FeatureGate({"Locked": VersionedSpecs((
+            ("0.1.0", FeatureSpec(default=True, lock_to_default=True, pre_release="GA")),))})
+        with pytest.raises(ValueError, match="locked"):
+            g.set_from_map({"Locked": False})
+        g.set_from_map({"Locked": True})  # same as default: allowed
+        assert g.enabled("Locked")
+
+    def test_duplicate_registration(self):
+        g = FeatureGate()
+        with pytest.raises(ValueError, match="already registered"):
+            g.add(TimeSlicingSettings, VersionedSpecs((
+                ("0.2.0", FeatureSpec(default=True)),)))

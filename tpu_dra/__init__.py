@@ -1,0 +1,32 @@
+"""tpu_dra — a TPU-native Kubernetes Dynamic Resource Allocation driver.
+
+A ground-up rebuild of the capabilities of NVIDIA's k8s-dra-driver-gpu
+(reference: /root/reference) for TPU pods:
+
+- chip discovery via a native C++ ``libtpuinfo`` over ``/dev/accel*`` and
+  ``/sys/class/accel`` (replaces NVML/go-nvlib cgo enumeration),
+- CDI injection of ``/dev/accelN`` + ``TPU_VISIBLE_CHIPS``/libtpu env
+  (replaces nvidia-container-toolkit CDI specs),
+- TPU-core subslicing (replaces dynamic MIG partitioning),
+- time-sliced / multiprocess chip sharing (replaces time-slicing / MPS),
+- ICI-connected slice provisioning via the ComputeDomain controller/daemon
+  pair (replaces IMEX-channel Multi-Node-NVLink orchestration).
+
+Layer map (see SURVEY.md §1):
+
+- ``tpu_dra.api``         — L6 config kinds + ComputeDomain CRD
+- ``tpu_dra.k8s``         — client/informer machinery (replaces client-go +
+  generated clientset/informers/listers of pkg/nvidia.com)
+- ``tpu_dra.infra``       — L5 workqueue/flock/featuregates/flags
+- ``tpu_dra.native``      — L0 bindings to the C++ libtpuinfo
+- ``tpu_dra.cdi``         — L1 container integration
+- ``tpu_dra.kubeletplugin`` — L3 DRA gRPC plugin framework
+- ``tpu_dra.tpuplugin``   — L2/L3 TPU kubelet plugin (gpu-kubelet-plugin analog)
+- ``tpu_dra.cdplugin``    — ComputeDomain kubelet plugin
+- ``tpu_dra.cdcontroller`` — L4 cluster controller
+- ``tpu_dra.cddaemon``    — L4b per-node slice daemon wrapper
+- ``tpu_dra.webhook``     — validating admission webhook
+- ``tpu_dra.workloads``   — JAX workloads driven by driver-provisioned slices
+"""
+
+from tpu_dra.version import __version__  # noqa: F401

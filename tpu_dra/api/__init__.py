@@ -1,0 +1,22 @@
+"""L6 API group ``resource.tpu.dev/v1beta1``.
+
+Reference: api/nvidia.com/resource/v1beta1 — opaque per-claim config kinds,
+sharing types, the ComputeDomain CRD, and strict/non-strict decoders.
+"""
+
+from tpu_dra.api.types import (  # noqa: F401
+    GROUP, VERSION, API_VERSION,
+    TPU_DRIVER_NAME, COMPUTE_DOMAIN_DRIVER_NAME,
+    TpuConfig, SubsliceConfig, PassthroughConfig,
+    ComputeDomainChannelConfig, ComputeDomainDaemonConfig,
+    TpuSharing, TimeSlicingConfig, MultiprocessConfig,
+    TimeSlicingStrategy, MultiprocessStrategy,
+    MultiprocessPerDeviceHbmLimit,
+    ComputeDomain, ComputeDomainSpec, ComputeDomainChannelSpec,
+    ComputeDomainResourceClaimTemplate, ComputeDomainStatus, ComputeDomainNode,
+    COMPUTE_DOMAIN_STATUS_READY, COMPUTE_DOMAIN_STATUS_NOT_READY,
+    ALLOCATION_MODE_SINGLE, ALLOCATION_MODE_ALL,
+)
+from tpu_dra.api.scheme import (  # noqa: F401
+    StrictDecoder, NonstrictDecoder, Scheme, DecodeError,
+)

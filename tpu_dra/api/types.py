@@ -1,0 +1,620 @@
+"""API group ``resource.tpu.dev/v1beta1``: opaque config kinds + ComputeDomain.
+
+TPU-native re-design of api/nvidia.com/resource/v1beta1 (reference):
+
+- ``GpuConfig``        -> ``TpuConfig``        (gpuconfig.go:29-89)
+- ``MigDeviceConfig``  -> ``SubsliceConfig``   (migconfig.go:28-77) — a TPU
+  chip exposes TensorCore subslices instead of MIG GPU instances.
+- ``VfioDeviceConfig`` -> ``PassthroughConfig`` (vfiodeviceconfig.go:28-54)
+- ``ComputeDomainChannelConfig`` / ``ComputeDomainDaemonConfig``
+  (computedomainconfig.go:30-105) — unchanged shape: they carry the domain
+  UID (and allocation mode) from the controller-stamped ResourceClaimTemplate
+  into the node-side prepare path.
+- Sharing (sharing.go:28-273): ``TimeSlicing`` is kept (libtpu programs are
+  time-multiplexed per-chip by the accel driver); ``MPS`` becomes
+  ``Multiprocess`` — concurrent libtpu processes on one chip with per-process
+  HBM limits and a TensorCore percentage, the TPU analog of MPS
+  active-thread-percentage / pinned-device-memory limits.
+- ``ComputeDomain`` CRD (computedomain.go:37-139): same spec/status machine;
+  the per-node ``cliqueID`` (NVLink partition id) becomes ``sliceID`` (the
+  ICI-slice identity: hosts with equal sliceID are ICI-reachable; hosts with
+  different sliceIDs coexist in one domain and talk over DCN — the
+  heterogeneous-CD analog).
+
+All types implement ``normalize()`` and ``validate()`` (api.go:40-46
+``Interface``), are (de)serialized via ``from_dict(strict=...)`` /
+``to_dict()``, and are registered with the scheme in
+``tpu_dra.api.scheme``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from tpu_dra.infra import featuregates
+from tpu_dra.infra.quantity import Quantity
+
+GROUP = "resource.tpu.dev"
+VERSION = "v1beta1"
+API_VERSION = f"{GROUP}/{VERSION}"
+
+# DRA driver names (reference: gpu.nvidia.com / compute-domain.nvidia.com).
+TPU_DRIVER_NAME = "tpu.dev"
+COMPUTE_DOMAIN_DRIVER_NAME = "compute-domain.tpu.dev"
+
+TPU_CONFIG_KIND = "TpuConfig"
+SUBSLICE_CONFIG_KIND = "SubsliceConfig"
+PASSTHROUGH_CONFIG_KIND = "PassthroughConfig"
+COMPUTE_DOMAIN_CHANNEL_CONFIG_KIND = "ComputeDomainChannelConfig"
+COMPUTE_DOMAIN_DAEMON_CONFIG_KIND = "ComputeDomainDaemonConfig"
+COMPUTE_DOMAIN_KIND = "ComputeDomain"
+
+COMPUTE_DOMAIN_STATUS_READY = "Ready"
+COMPUTE_DOMAIN_STATUS_NOT_READY = "NotReady"
+ALLOCATION_MODE_SINGLE = "Single"
+ALLOCATION_MODE_ALL = "All"
+
+# Sharing strategies (sharing.go TimeSlicingStrategy / MpsStrategy analogs).
+TimeSlicingStrategy = "TimeSlicing"
+MultiprocessStrategy = "Multiprocess"
+
+# Time-slice intervals (sharing.go: Default/Short/Medium/Long -> 0..3; the
+# int is what the node-side time-slice manager programs into the accel
+# driver's scheduler, mirroring `nvidia-smi compute-policy --set-timeslice`).
+TIME_SLICE_INTERVALS = {"Default": 0, "Short": 1, "Medium": 2, "Long": 3}
+DEFAULT_TIME_SLICE = "Default"
+
+
+class ValidationError(ValueError):
+    pass
+
+
+def _unknown_fields(data: Dict[str, Any], allowed: set, strict: bool, path: str):
+    _require_type(data, dict, path)
+    if not strict:
+        return
+    unknown = set(data) - allowed
+    if unknown:
+        raise ValidationError(
+            f"strict decoding error: unknown field(s) {sorted(unknown)} in {path}")
+
+
+def _require_type(val, typ, path: str):
+    if not isinstance(val, typ):
+        raise ValidationError(f"{path}: expected {typ.__name__}, got {type(val).__name__}")
+    return val
+
+
+# ---------------------------------------------------------------------------
+# Sharing
+# ---------------------------------------------------------------------------
+
+@dataclass
+class TimeSlicingConfig:
+    """Per-chip program time-slice length (sharing.go:86-118 analog)."""
+    interval: str = DEFAULT_TIME_SLICE
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any], strict: bool, path: str = "timeSlicingConfig"):
+        _unknown_fields(data, {"interval"}, strict, path)
+        return cls(interval=data.get("interval", DEFAULT_TIME_SLICE))
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"interval": self.interval}
+
+    def validate(self):
+        if self.interval not in TIME_SLICE_INTERVALS:
+            raise ValidationError(
+                f"unknown time-slice interval: {self.interval!r} "
+                f"(must be one of {sorted(TIME_SLICE_INTERVALS)})")
+
+    def int_value(self) -> int:
+        return TIME_SLICE_INTERVALS[self.interval]
+
+
+@dataclass
+class MultiprocessPerDeviceHbmLimit:
+    """Map of device selector -> HBM byte limit for one multiprocess tenant.
+
+    Analog of MpsPerDevicePinnedMemoryLimit (sharing.go:176-273). Keys are
+    chip UUIDs, chip indices (stringified ints), or ``"default"``; values are
+    k8s quantities. ``normalize()`` resolves the map against the actual
+    devices of a claim: explicit per-device entries win over ``default``.
+    """
+    limits: Dict[str, str] = field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any], strict: bool, path: str):
+        _require_type(data, dict, path)
+        return cls(limits=dict(data))
+
+    def to_dict(self) -> Dict[str, str]:
+        return dict(self.limits)
+
+    def validate(self):
+        for key, raw in self.limits.items():
+            try:
+                Quantity(raw)
+            except ValueError as e:
+                raise ValidationError(f"perDeviceHbmLimit[{key}]: {e}") from e
+
+    def normalize(self, uuids: List[str], indices: Dict[str, int],
+                  default_limit: Optional[str]) -> Dict[str, int]:
+        """Resolve to {uuid: bytes} for the given claim devices.
+
+        Mirrors MpsPerDevicePinnedMemoryLimit.Normalize (sharing.go:217-273):
+        index keys are translated to UUIDs, "default" (or the config-level
+        default limit) fills every unlisted device.
+        """
+        resolved: Dict[str, int] = {}
+        default = self.limits.get("default", default_limit)
+        if default is not None:
+            for uuid in uuids:
+                resolved[uuid] = Quantity(default).value
+        index_to_uuid = {str(i): u for u, i in indices.items()}
+        for key, raw in self.limits.items():
+            if key == "default":
+                continue
+            uuid = index_to_uuid.get(key, key)
+            if uuid not in uuids:
+                raise ValidationError(
+                    f"perDeviceHbmLimit: device {key!r} is not part of this claim")
+            resolved[uuid] = Quantity(raw).value
+        return resolved
+
+
+@dataclass
+class MultiprocessConfig:
+    """Concurrent libtpu processes on one chip (MpsConfig analog,
+    sharing.go:120-174). ``activeCoresPercentage`` caps the share of
+    TensorCores a tenant may occupy (active-thread-percentage analog);
+    HBM limits become per-process premapped-HBM caps exported as
+    ``TPU_HBM_LIMIT_BYTES`` by the multiprocess manager."""
+    default_active_cores_percentage: Optional[int] = None
+    default_hbm_limit: Optional[str] = None
+    per_device_hbm_limit: Optional[MultiprocessPerDeviceHbmLimit] = None
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any], strict: bool, path: str = "multiprocessConfig"):
+        allowed = {"defaultActiveCoresPercentage", "defaultHbmLimit", "perDeviceHbmLimit"}
+        _unknown_fields(data, allowed, strict, path)
+        per_dev = None
+        if "perDeviceHbmLimit" in data:
+            per_dev = MultiprocessPerDeviceHbmLimit.from_dict(
+                data["perDeviceHbmLimit"], strict, f"{path}.perDeviceHbmLimit")
+        return cls(
+            default_active_cores_percentage=data.get("defaultActiveCoresPercentage"),
+            default_hbm_limit=data.get("defaultHbmLimit"),
+            per_device_hbm_limit=per_dev,
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        if self.default_active_cores_percentage is not None:
+            out["defaultActiveCoresPercentage"] = self.default_active_cores_percentage
+        if self.default_hbm_limit is not None:
+            out["defaultHbmLimit"] = self.default_hbm_limit
+        if self.per_device_hbm_limit is not None:
+            out["perDeviceHbmLimit"] = self.per_device_hbm_limit.to_dict()
+        return out
+
+    def validate(self):
+        pct = self.default_active_cores_percentage
+        if pct is not None and not (0 < pct <= 100):
+            raise ValidationError(
+                f"defaultActiveCoresPercentage must be in (0, 100], got {pct}")
+        if self.default_hbm_limit is not None:
+            try:
+                Quantity(self.default_hbm_limit)
+            except ValueError as e:
+                raise ValidationError(f"defaultHbmLimit: {e}") from e
+        if self.per_device_hbm_limit is not None:
+            self.per_device_hbm_limit.validate()
+
+
+@dataclass
+class TpuSharing:
+    """Sharing strategy selector (GpuSharing analog, sharing.go:28-84)."""
+    strategy: str = TimeSlicingStrategy
+    time_slicing_config: Optional[TimeSlicingConfig] = None
+    multiprocess_config: Optional[MultiprocessConfig] = None
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any], strict: bool, path: str = "sharing"):
+        allowed = {"strategy", "timeSlicingConfig", "multiprocessConfig"}
+        _unknown_fields(data, allowed, strict, path)
+        ts = mp = None
+        if "timeSlicingConfig" in data and data["timeSlicingConfig"] is not None:
+            ts = TimeSlicingConfig.from_dict(
+                data["timeSlicingConfig"], strict, f"{path}.timeSlicingConfig")
+        if "multiprocessConfig" in data and data["multiprocessConfig"] is not None:
+            mp = MultiprocessConfig.from_dict(
+                data["multiprocessConfig"], strict, f"{path}.multiprocessConfig")
+        return cls(strategy=data.get("strategy", ""), time_slicing_config=ts,
+                   multiprocess_config=mp)
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"strategy": self.strategy}
+        if self.time_slicing_config is not None:
+            out["timeSlicingConfig"] = self.time_slicing_config.to_dict()
+        if self.multiprocess_config is not None:
+            out["multiprocessConfig"] = self.multiprocess_config.to_dict()
+        return out
+
+    def validate(self):
+        """Gate-aware validation (validate.go:27-76)."""
+        if self.strategy == TimeSlicingStrategy:
+            if self.multiprocess_config is not None:
+                raise ValidationError(
+                    "multiprocessConfig set with TimeSlicing strategy")
+            if not featuregates.enabled(featuregates.TimeSlicingSettings):
+                if self.time_slicing_config is not None:
+                    raise ValidationError(
+                        "timeSlicingConfig requires the TimeSlicingSettings feature gate")
+                return
+            if self.time_slicing_config is not None:
+                self.time_slicing_config.validate()
+        elif self.strategy == MultiprocessStrategy:
+            if not featuregates.enabled(featuregates.MultiprocessSupport):
+                raise ValidationError(
+                    "Multiprocess sharing requires the MultiprocessSupport feature gate")
+            if self.time_slicing_config is not None:
+                raise ValidationError(
+                    "timeSlicingConfig set with Multiprocess strategy")
+            if self.multiprocess_config is not None:
+                self.multiprocess_config.validate()
+        else:
+            raise ValidationError(f"unknown sharing strategy: {self.strategy!r}")
+
+    def is_time_slicing(self) -> bool:
+        return self.strategy == TimeSlicingStrategy
+
+    def is_multiprocess(self) -> bool:
+        return self.strategy == MultiprocessStrategy
+
+
+# ---------------------------------------------------------------------------
+# Opaque config kinds
+# ---------------------------------------------------------------------------
+
+class _ConfigBase:
+    KIND = ""
+
+    def type_meta(self) -> Dict[str, str]:
+        return {"apiVersion": API_VERSION, "kind": self.KIND}
+
+
+@dataclass
+class TpuConfig(_ConfigBase):
+    """Per-claim config for a whole TPU chip (GpuConfig analog,
+    gpuconfig.go:29-89)."""
+    KIND = TPU_CONFIG_KIND
+    sharing: Optional[TpuSharing] = None
+
+    @classmethod
+    def default(cls) -> "TpuConfig":
+        cfg = cls()
+        if featuregates.enabled(featuregates.TimeSlicingSettings):
+            cfg.sharing = TpuSharing(
+                strategy=TimeSlicingStrategy,
+                time_slicing_config=TimeSlicingConfig(interval=DEFAULT_TIME_SLICE))
+        return cfg
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any], strict: bool = True):
+        _unknown_fields(data, {"apiVersion", "kind", "sharing"}, strict, self_path(cls))
+        sharing = None
+        if data.get("sharing") is not None:
+            sharing = TpuSharing.from_dict(data["sharing"], strict, "sharing")
+        return cls(sharing=sharing)
+
+    def to_dict(self) -> Dict[str, Any]:
+        out = self.type_meta()
+        if self.sharing is not None:
+            out["sharing"] = self.sharing.to_dict()
+        return out
+
+    def normalize(self):
+        """Fill implied defaults (gpuconfig.go Normalize :52-77)."""
+        if self.sharing is None:
+            if not featuregates.enabled(featuregates.TimeSlicingSettings):
+                return
+            self.sharing = TpuSharing(strategy=TimeSlicingStrategy)
+        if featuregates.enabled(featuregates.TimeSlicingSettings):
+            if (self.sharing.strategy == TimeSlicingStrategy
+                    and self.sharing.time_slicing_config is None):
+                self.sharing.time_slicing_config = TimeSlicingConfig(DEFAULT_TIME_SLICE)
+        if featuregates.enabled(featuregates.MultiprocessSupport):
+            if (self.sharing.strategy == MultiprocessStrategy
+                    and self.sharing.multiprocess_config is None):
+                self.sharing.multiprocess_config = MultiprocessConfig()
+
+    def validate(self):
+        if self.sharing is not None:
+            self.sharing.validate()
+
+
+@dataclass
+class SubsliceConfig(_ConfigBase):
+    """Per-claim config for a TensorCore subslice of a chip (MigDeviceConfig
+    analog, migconfig.go:28-77). The subslice *shape* is chosen by the
+    scheduler via device selection (subslice devices are advertised like MIG
+    profiles); this config only carries sharing settings for it."""
+    KIND = SUBSLICE_CONFIG_KIND
+    sharing: Optional[TpuSharing] = None
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any], strict: bool = True):
+        _unknown_fields(data, {"apiVersion", "kind", "sharing"}, strict, self_path(cls))
+        sharing = None
+        if data.get("sharing") is not None:
+            sharing = TpuSharing.from_dict(data["sharing"], strict, "sharing")
+        return cls(sharing=sharing)
+
+    def to_dict(self) -> Dict[str, Any]:
+        out = self.type_meta()
+        if self.sharing is not None:
+            out["sharing"] = self.sharing.to_dict()
+        return out
+
+    def normalize(self):
+        if self.sharing is None:
+            if not featuregates.enabled(featuregates.TimeSlicingSettings):
+                return
+            self.sharing = TpuSharing(strategy=TimeSlicingStrategy)
+        if featuregates.enabled(featuregates.TimeSlicingSettings):
+            if (self.sharing.strategy == TimeSlicingStrategy
+                    and self.sharing.time_slicing_config is None):
+                self.sharing.time_slicing_config = TimeSlicingConfig(DEFAULT_TIME_SLICE)
+        if featuregates.enabled(featuregates.MultiprocessSupport):
+            if (self.sharing.strategy == MultiprocessStrategy
+                    and self.sharing.multiprocess_config is None):
+                self.sharing.multiprocess_config = MultiprocessConfig()
+
+    def validate(self):
+        if self.sharing is not None:
+            self.sharing.validate()
+
+
+@dataclass
+class PassthroughConfig(_ConfigBase):
+    """Whole-device VM passthrough marker (VfioDeviceConfig analog,
+    vfiodeviceconfig.go:28-54): no fields; selecting it routes prepare
+    through the vfio bind path. Feature-gated by PassthroughSupport."""
+    KIND = PASSTHROUGH_CONFIG_KIND
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any], strict: bool = True):
+        _unknown_fields(data, {"apiVersion", "kind"}, strict, self_path(cls))
+        return cls()
+
+    def to_dict(self) -> Dict[str, Any]:
+        return self.type_meta()
+
+    def normalize(self):
+        pass
+
+    def validate(self):
+        if not featuregates.enabled(featuregates.PassthroughSupport):
+            raise ValidationError(
+                "PassthroughConfig requires the PassthroughSupport feature gate")
+
+
+@dataclass
+class ComputeDomainChannelConfig(_ConfigBase):
+    """Carried by the workload ResourceClaimTemplate the controller stamps
+    per ComputeDomain (computedomainconfig.go:30-66)."""
+    KIND = COMPUTE_DOMAIN_CHANNEL_CONFIG_KIND
+    domain_id: str = ""
+    allocation_mode: str = ALLOCATION_MODE_SINGLE
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any], strict: bool = True):
+        _unknown_fields(data, {"apiVersion", "kind", "domainID", "allocationMode"},
+                        strict, self_path(cls))
+        return cls(domain_id=data.get("domainID", ""),
+                   allocation_mode=data.get("allocationMode", ALLOCATION_MODE_SINGLE))
+
+    def to_dict(self) -> Dict[str, Any]:
+        out = self.type_meta()
+        out["domainID"] = self.domain_id
+        out["allocationMode"] = self.allocation_mode
+        return out
+
+    def normalize(self):
+        if not self.allocation_mode:
+            self.allocation_mode = ALLOCATION_MODE_SINGLE
+
+    def validate(self):
+        if not self.domain_id:
+            raise ValidationError("domainID must be set")
+        if self.allocation_mode not in (ALLOCATION_MODE_SINGLE, ALLOCATION_MODE_ALL):
+            raise ValidationError(
+                f"allocationMode must be Single or All, got {self.allocation_mode!r}")
+
+
+@dataclass
+class ComputeDomainDaemonConfig(_ConfigBase):
+    """Carried by the daemon ResourceClaimTemplate (computedomainconfig.go:68-105)."""
+    KIND = COMPUTE_DOMAIN_DAEMON_CONFIG_KIND
+    domain_id: str = ""
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any], strict: bool = True):
+        _unknown_fields(data, {"apiVersion", "kind", "domainID"}, strict, self_path(cls))
+        return cls(domain_id=data.get("domainID", ""))
+
+    def to_dict(self) -> Dict[str, Any]:
+        out = self.type_meta()
+        out["domainID"] = self.domain_id
+        return out
+
+    def normalize(self):
+        pass
+
+    def validate(self):
+        if not self.domain_id:
+            raise ValidationError("domainID must be set")
+
+
+# ---------------------------------------------------------------------------
+# ComputeDomain CRD
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ComputeDomainResourceClaimTemplate:
+    name: str = ""
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any], strict: bool, path: str):
+        _unknown_fields(data, {"name"}, strict, path)
+        return cls(name=data.get("name", ""))
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"name": self.name}
+
+
+@dataclass
+class ComputeDomainChannelSpec:
+    resource_claim_template: ComputeDomainResourceClaimTemplate = field(
+        default_factory=ComputeDomainResourceClaimTemplate)
+    allocation_mode: str = ALLOCATION_MODE_SINGLE
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any], strict: bool, path: str = "spec.channel"):
+        _unknown_fields(data, {"resourceClaimTemplate", "allocationMode"}, strict, path)
+        rct = ComputeDomainResourceClaimTemplate.from_dict(
+            data.get("resourceClaimTemplate", {}), strict, f"{path}.resourceClaimTemplate")
+        return cls(resource_claim_template=rct,
+                   allocation_mode=data.get("allocationMode", ALLOCATION_MODE_SINGLE))
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"resourceClaimTemplate": self.resource_claim_template.to_dict(),
+                "allocationMode": self.allocation_mode}
+
+
+@dataclass
+class ComputeDomainSpec:
+    """Spec is immutable after creation (CEL ``self == oldSelf``,
+    computedomain.go:59; enforced by the CRD manifest in tpu_dra.api.crd).
+
+    ``numNodes`` keeps the reference's deprecated semantics
+    (computedomain.go:63-88): with SliceDaemonsWithDNSNames (default) it only
+    drives the global Ready status; daemons start eagerly and workload pods
+    release as soon as their local daemon is ready."""
+    num_nodes: int = 0
+    channel: Optional[ComputeDomainChannelSpec] = None
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any], strict: bool, path: str = "spec"):
+        _unknown_fields(data, {"numNodes", "channel"}, strict, path)
+        channel = None
+        if data.get("channel") is not None:
+            channel = ComputeDomainChannelSpec.from_dict(data["channel"], strict)
+        return cls(num_nodes=data.get("numNodes", 0), channel=channel)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"numNodes": self.num_nodes,
+                "channel": self.channel.to_dict() if self.channel else None}
+
+
+@dataclass
+class ComputeDomainNode:
+    """One node registered into the domain (computedomain.go:117-139).
+
+    ``slice_id`` replaces cliqueID: it identifies the ICI slice (NVLink
+    clique analog) this host belongs to. (slice_id, index) is unique; the
+    index pins the host's stable DNS name within its slice. An empty
+    slice_id marks a DCN-only participant (heterogeneous domain,
+    cd-daemon main.go:205-213)."""
+    name: str = ""
+    ip_address: str = ""
+    slice_id: str = ""
+    index: int = 0
+    status: str = COMPUTE_DOMAIN_STATUS_NOT_READY
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any], strict: bool, path: str):
+        _unknown_fields(data, {"name", "ipAddress", "sliceID", "index", "status"},
+                        strict, path)
+        return cls(name=data.get("name", ""), ip_address=data.get("ipAddress", ""),
+                   slice_id=data.get("sliceID", ""), index=data.get("index", 0),
+                   status=data.get("status", COMPUTE_DOMAIN_STATUS_NOT_READY))
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"name": self.name, "ipAddress": self.ip_address,
+                "sliceID": self.slice_id, "index": self.index, "status": self.status}
+
+
+@dataclass
+class ComputeDomainStatus:
+    status: str = COMPUTE_DOMAIN_STATUS_NOT_READY
+    nodes: List[ComputeDomainNode] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any], strict: bool, path: str = "status"):
+        _unknown_fields(data, {"status", "nodes"}, strict, path)
+        nodes = [ComputeDomainNode.from_dict(n, strict, f"{path}.nodes[{i}]")
+                 for i, n in enumerate(data.get("nodes") or [])]
+        return cls(status=data.get("status", COMPUTE_DOMAIN_STATUS_NOT_READY), nodes=nodes)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"status": self.status, "nodes": [n.to_dict() for n in self.nodes]}
+
+
+@dataclass
+class ComputeDomain(_ConfigBase):
+    """The ComputeDomain CR (computedomain.go:37-56): prepares a set of nodes
+    to run a multi-node workload over ICI/DCN."""
+    KIND = COMPUTE_DOMAIN_KIND
+    metadata: Dict[str, Any] = field(default_factory=dict)
+    spec: ComputeDomainSpec = field(default_factory=ComputeDomainSpec)
+    status: ComputeDomainStatus = field(default_factory=ComputeDomainStatus)
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any], strict: bool = True):
+        _unknown_fields(data, {"apiVersion", "kind", "metadata", "spec", "status"},
+                        strict, self_path(cls))
+        spec = ComputeDomainSpec.from_dict(data.get("spec") or {}, strict)
+        status = ComputeDomainStatus.from_dict(data.get("status") or {}, strict)
+        return cls(metadata=dict(data.get("metadata") or {}), spec=spec, status=status)
+
+    def to_dict(self) -> Dict[str, Any]:
+        out = self.type_meta()
+        out["metadata"] = self.metadata
+        out["spec"] = self.spec.to_dict()
+        out["status"] = self.status.to_dict()
+        return out
+
+    def normalize(self):
+        if self.spec.channel is not None and not self.spec.channel.allocation_mode:
+            self.spec.channel.allocation_mode = ALLOCATION_MODE_SINGLE
+
+    def validate(self):
+        if self.spec.num_nodes < 0:
+            raise ValidationError("spec.numNodes must be >= 0")
+        if self.spec.channel is None:
+            raise ValidationError("spec.channel must be set")
+        if not self.spec.channel.resource_claim_template.name:
+            raise ValidationError("spec.channel.resourceClaimTemplate.name must be set")
+        if self.spec.channel.allocation_mode not in (
+                ALLOCATION_MODE_SINGLE, ALLOCATION_MODE_ALL):
+            raise ValidationError(
+                "spec.channel.allocationMode must be Single or All")
+
+    @property
+    def uid(self) -> str:
+        return self.metadata.get("uid", "")
+
+    @property
+    def name(self) -> str:
+        return self.metadata.get("name", "")
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.get("namespace", "")
+
+
+def self_path(cls) -> str:
+    return getattr(cls, "KIND", cls.__name__)

@@ -1,0 +1,1 @@
+"""L5 shared infrastructure (reference: pkg/ + internal/common)."""

@@ -1,0 +1,22 @@
+"""Version info (reference: internal/info/version.go — ldflags-stamped)."""
+
+import os
+import subprocess
+
+__version__ = "0.1.0"
+
+
+def git_commit() -> str:
+    """Best-effort commit hash, resolved at call time rather than link time
+    (the reference stamps this via Go ldflags; we have no link step)."""
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=5,
+        )
+        if out.returncode == 0:
+            return out.stdout.strip()
+    except Exception:
+        pass
+    return "unknown"
