@@ -1,0 +1,108 @@
+/* libtpuinfo — native TPU chip discovery, topology, settings and health.
+ *
+ * TPU-native replacement for the reference driver's NVML surface
+ * (k8s-dra-driver-gpu: cmd/gpu-kubelet-plugin/nvlib.go:59-61,134-183 device
+ * enumeration; device_health.go:79-117 health events; compute-domain
+ * nvlib.go:196-234 fabric/clique info). Where NVML speaks to the GPU driver
+ * via cgo + ioctls, libtpuinfo reads the accel driver's ABI:
+ *   <root>/dev/accel<N>                          chip char devices
+ *   <root>/sys/class/accel/accel<N>/device/...   per-chip attributes
+ *   <root>/sys/class/accel/health_events         appended event records
+ *
+ * The filesystem root is injectable (tpuinfo_init) so the complete library —
+ * not a mock of it — runs against a synthetic tree in tests and in
+ * clusters without TPUs (SURVEY.md §7.3: the fake-able hardware seam).
+ *
+ * All strings are NUL-terminated, fixed-size, UTF-8. All functions return
+ * TPUINFO_OK (0) on success or a negative tpuinfo_status error.
+ */
+
+#ifndef TPUINFO_H_
+#define TPUINFO_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define TPUINFO_MAX_STR 96
+#define TPUINFO_MAX_CHIPS 64
+
+typedef enum {
+  TPUINFO_OK = 0,
+  TPUINFO_ERR_NOT_FOUND = -1,
+  TPUINFO_ERR_IO = -2,
+  TPUINFO_ERR_INVALID = -3,
+  TPUINFO_ERR_TIMEOUT = -4,
+  TPUINFO_ERR_UNSUPPORTED = -5,
+} tpuinfo_status;
+
+/* TPU generations (analog of GPU arch / CUDA compute capability). */
+typedef enum {
+  TPUINFO_GEN_UNKNOWN = 0,
+  TPUINFO_GEN_V4 = 4,
+  TPUINFO_GEN_V5E = 50,
+  TPUINFO_GEN_V5P = 51,
+  TPUINFO_GEN_V6E = 60,
+} tpuinfo_generation;
+
+typedef struct {
+  int32_t index;              /* /dev/accel<index> minor */
+  char uuid[TPUINFO_MAX_STR]; /* stable chip identity */
+  tpuinfo_generation generation;
+  char generation_name[16];   /* "v4", "v5e", "v5p", "v6e" */
+  int32_t tensorcore_count;   /* TensorCores on this chip (subslice units) */
+  int64_t hbm_bytes;          /* HBM capacity */
+  char pci_address[32];       /* domain:bus:dev.fn */
+  char driver_version[32];    /* accel driver version */
+  /* ICI topology: the (cliqueID, coords) analog. Hosts sharing slice_id are
+   * ICI-reachable (one provisioned slice); worker_index is the stable index
+   * of this host within the slice (TPU_WORKER_ID source). Empty slice_id
+   * means the chip is not part of a provisioned multi-host slice. */
+  char slice_id[TPUINFO_MAX_STR];
+  int32_t worker_index;
+  int32_t coord_x, coord_y, coord_z; /* chip coords within the slice mesh */
+  int32_t healthy;            /* 1 = healthy, 0 = unhealthy */
+} tpuinfo_chip;
+
+typedef struct {
+  int32_t chip_index;  /* -1: affects all chips on the host */
+  int32_t code;        /* driver-specific event code (Xid analog) */
+  char kind[32];       /* "hbm_ecc", "ici_link_down", "thermal", ... */
+  char description[TPUINFO_MAX_STR];
+} tpuinfo_event;
+
+typedef struct tpuinfo_ctx tpuinfo_ctx;
+
+/* Open a context against a filesystem root ("" or NULL => "/"). */
+tpuinfo_status tpuinfo_init(const char* root, tpuinfo_ctx** out);
+void tpuinfo_shutdown(tpuinfo_ctx* ctx);
+
+const char* tpuinfo_version(void);
+const char* tpuinfo_status_string(tpuinfo_status s);
+
+/* Enumeration. */
+tpuinfo_status tpuinfo_chip_count(tpuinfo_ctx* ctx, int32_t* out);
+tpuinfo_status tpuinfo_get_chip(tpuinfo_ctx* ctx, int32_t index, tpuinfo_chip* out);
+
+/* Runtime settings (nvidia-smi compute-policy / compute-mode analog).
+ * Writes <root>/sys/class/accel/accel<N>/device/timeslice_us etc. */
+tpuinfo_status tpuinfo_set_timeslice(tpuinfo_ctx* ctx, int32_t index, int32_t interval_us);
+tpuinfo_status tpuinfo_get_timeslice(tpuinfo_ctx* ctx, int32_t index, int32_t* out);
+/* exclusive: 1 => one process may open the chip (EXCLUSIVE_PROCESS analog) */
+tpuinfo_status tpuinfo_set_exclusive_mode(tpuinfo_ctx* ctx, int32_t index, int32_t exclusive);
+
+/* Health events: tail-reads appended records from
+ * <root>/sys/class/accel/health_events ("<chip> <code> <kind> <desc...>").
+ * Blocks up to timeout_ms; returns TPUINFO_ERR_TIMEOUT when none arrived
+ * (the NVML eventSet.Wait(5000) loop analog, device_health.go:146-204). */
+tpuinfo_status tpuinfo_wait_health_event(tpuinfo_ctx* ctx, int32_t timeout_ms,
+                                         tpuinfo_event* out);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* TPUINFO_H_ */

@@ -1,0 +1,91 @@
+// tpuctl — per-chip runtime settings CLI.
+//
+// The exec seam replacing the reference's `nvidia-smi compute-policy
+// --set-timeslice` / `-c EXCLUSIVE_PROCESS` invocations
+// (cmd/gpu-kubelet-plugin/nvlib.go:564-601): the kubelet plugin's sharing
+// managers exec this binary so runtime settings changes are auditable and
+// restartable independent of the plugin process.
+//
+// Usage:
+//   tpuctl list                              enumerate chips (one per line)
+//   tpuctl set-timeslice <chip> <usec>       program program-scheduler slice
+//   tpuctl get-timeslice <chip>
+//   tpuctl set-exclusive <chip> <0|1>        (non-)exclusive process mode
+//   tpuctl version
+//
+// The filesystem root honors TPUINFO_SYSFS_ROOT for tests/fakes.
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+#include "tpuinfo.h"
+
+namespace {
+
+int Fail(tpuinfo_status st, const char* what) {
+  fprintf(stderr, "tpuctl: %s: %s\n", what, tpuinfo_status_string(st));
+  return 1;
+}
+
+int CmdList(tpuinfo_ctx* ctx) {
+  int32_t n = 0;
+  tpuinfo_status st = tpuinfo_chip_count(ctx, &n);
+  if (st != TPUINFO_OK) return Fail(st, "chip_count");
+  // Header matches field order consumers parse; keep stable.
+  printf("index\tuuid\tgen\tcores\thbm_bytes\tpci\tslice_id\tworker\tcoords\thealthy\n");
+  // Indices may be sparse; scan the index space, skipping holes.
+  int32_t printed = 0;
+  for (int32_t idx = 0; idx < TPUINFO_MAX_CHIPS && printed < n; ++idx) {
+    tpuinfo_chip c;
+    if (tpuinfo_get_chip(ctx, idx, &c) != TPUINFO_OK) continue;
+    printf("%d\t%s\t%s\t%d\t%lld\t%s\t%s\t%d\t%d,%d,%d\t%d\n", c.index, c.uuid,
+           c.generation_name, c.tensorcore_count, (long long)c.hbm_bytes,
+           c.pci_address, c.slice_id, c.worker_index, c.coord_x, c.coord_y,
+           c.coord_z, c.healthy);
+    ++printed;
+  }
+  return 0;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: tpuctl <list|set-timeslice|get-timeslice|set-exclusive|version> ...\n");
+    return 2;
+  }
+  if (strcmp(argv[1], "version") == 0) {
+    printf("tpuctl %s\n", tpuinfo_version());
+    return 0;
+  }
+
+  const char* root = getenv("TPUINFO_SYSFS_ROOT");
+  tpuinfo_ctx* ctx = nullptr;
+  tpuinfo_status st = tpuinfo_init(root, &ctx);
+  if (st != TPUINFO_OK) return Fail(st, "init");
+
+  int rc = 2;
+  if (strcmp(argv[1], "list") == 0) {
+    rc = CmdList(ctx);
+  } else if (strcmp(argv[1], "set-timeslice") == 0 && argc == 4) {
+    st = tpuinfo_set_timeslice(ctx, atoi(argv[2]), atoi(argv[3]));
+    rc = (st == TPUINFO_OK) ? 0 : Fail(st, "set-timeslice");
+  } else if (strcmp(argv[1], "get-timeslice") == 0 && argc == 3) {
+    int32_t v = 0;
+    st = tpuinfo_get_timeslice(ctx, atoi(argv[2]), &v);
+    if (st == TPUINFO_OK) {
+      printf("%d\n", v);
+      rc = 0;
+    } else {
+      rc = Fail(st, "get-timeslice");
+    }
+  } else if (strcmp(argv[1], "set-exclusive") == 0 && argc == 4) {
+    st = tpuinfo_set_exclusive_mode(ctx, atoi(argv[2]), atoi(argv[3]));
+    rc = (st == TPUINFO_OK) ? 0 : Fail(st, "set-exclusive");
+  } else {
+    fprintf(stderr, "tpuctl: unknown or malformed command '%s'\n", argv[1]);
+  }
+  tpuinfo_shutdown(ctx);
+  return rc;
+}

@@ -1,0 +1,278 @@
+// libtpuinfo implementation. See include/tpuinfo.h for the ABI contract and
+// the mapping to the reference driver's NVML usage.
+
+#include "tpuinfo.h"
+
+#include <dirent.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <poll.h>
+#include <string.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cstdio>
+#include <cstdlib>
+#include <fstream>
+#include <mutex>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr const char* kVersion = "0.1.0";
+
+struct GenSpec {
+  tpuinfo_generation gen;
+  const char* name;
+  int32_t cores;
+  int64_t hbm_bytes;
+};
+
+// Generation table: TensorCores per chip and HBM capacity.
+// v4: 2 cores / 32 GiB; v5e: 1 core / 16 GiB; v5p: 2 cores / 95 GiB;
+// v6e (Trillium): 1 core / 32 GiB.
+const GenSpec kGenTable[] = {
+    {TPUINFO_GEN_V4, "v4", 2, 32LL << 30},
+    {TPUINFO_GEN_V5E, "v5e", 1, 16LL << 30},
+    {TPUINFO_GEN_V5P, "v5p", 2, 95LL << 30},
+    {TPUINFO_GEN_V6E, "v6e", 1, 32LL << 30},
+};
+
+const GenSpec* LookupGen(const std::string& name) {
+  for (const auto& g : kGenTable) {
+    if (name == g.name) return &g;
+  }
+  return nullptr;
+}
+
+bool ReadFileTrimmed(const std::string& path, std::string* out) {
+  std::ifstream f(path);
+  if (!f.good()) return false;
+  std::stringstream ss;
+  ss << f.rdbuf();
+  std::string s = ss.str();
+  while (!s.empty() && (s.back() == '\n' || s.back() == ' ' || s.back() == '\t'))
+    s.pop_back();
+  *out = s;
+  return true;
+}
+
+bool WriteFile(const std::string& path, const std::string& content) {
+  std::ofstream f(path, std::ios::trunc);
+  if (!f.good()) return false;
+  f << content;
+  return f.good();
+}
+
+void CopyStr(char* dst, size_t cap, const std::string& src) {
+  snprintf(dst, cap, "%s", src.c_str());
+}
+
+}  // namespace
+
+struct tpuinfo_ctx {
+  std::string root;              // filesystem root ("" => "/")
+  std::string accel_class;       // <root>/sys/class/accel
+  std::vector<int32_t> indices;  // discovered chip indices, sorted
+  off_t events_offset = 0;       // tail position in health_events
+  std::mutex mu;
+
+  std::string DevPath(int32_t idx) const {
+    return root + "/dev/accel" + std::to_string(idx);
+  }
+  std::string ChipDir(int32_t idx) const {
+    return accel_class + "/accel" + std::to_string(idx) + "/device";
+  }
+};
+
+extern "C" {
+
+const char* tpuinfo_version(void) { return kVersion; }
+
+const char* tpuinfo_status_string(tpuinfo_status s) {
+  switch (s) {
+    case TPUINFO_OK: return "ok";
+    case TPUINFO_ERR_NOT_FOUND: return "not found";
+    case TPUINFO_ERR_IO: return "i/o error";
+    case TPUINFO_ERR_INVALID: return "invalid argument";
+    case TPUINFO_ERR_TIMEOUT: return "timeout";
+    case TPUINFO_ERR_UNSUPPORTED: return "unsupported";
+  }
+  return "unknown";
+}
+
+tpuinfo_status tpuinfo_init(const char* root, tpuinfo_ctx** out) {
+  if (out == nullptr) return TPUINFO_ERR_INVALID;
+  auto* ctx = new tpuinfo_ctx();
+  ctx->root = (root == nullptr || root[0] == '\0') ? "" : std::string(root);
+  // Normalize: strip one trailing slash so path joins are uniform.
+  if (!ctx->root.empty() && ctx->root.back() == '/') ctx->root.pop_back();
+  ctx->accel_class = ctx->root + "/sys/class/accel";
+
+  DIR* d = opendir(ctx->accel_class.c_str());
+  if (d == nullptr) {
+    delete ctx;
+    return TPUINFO_ERR_NOT_FOUND;
+  }
+  struct dirent* ent;
+  while ((ent = readdir(d)) != nullptr) {
+    const char* name = ent->d_name;
+    if (strncmp(name, "accel", 5) != 0) continue;
+    char* endp = nullptr;
+    long idx = strtol(name + 5, &endp, 10);
+    if (endp == name + 5 || *endp != '\0') continue;
+    // A chip is real only if its char device exists too (the kubelet plugin
+    // must never advertise a chip a container cannot be handed).
+    struct stat st;
+    if (stat(ctx->DevPath((int32_t)idx).c_str(), &st) != 0) continue;
+    ctx->indices.push_back((int32_t)idx);
+  }
+  closedir(d);
+  std::sort(ctx->indices.begin(), ctx->indices.end());
+  // Start tailing health events at the current end: events predating driver
+  // startup are stale (mirrors registering for NVML events at startup).
+  struct stat st;
+  if (stat((ctx->accel_class + "/health_events").c_str(), &st) == 0) {
+    ctx->events_offset = st.st_size;
+  }
+  *out = ctx;
+  return TPUINFO_OK;
+}
+
+void tpuinfo_shutdown(tpuinfo_ctx* ctx) { delete ctx; }
+
+tpuinfo_status tpuinfo_chip_count(tpuinfo_ctx* ctx, int32_t* out) {
+  if (ctx == nullptr || out == nullptr) return TPUINFO_ERR_INVALID;
+  *out = (int32_t)ctx->indices.size();
+  return TPUINFO_OK;
+}
+
+tpuinfo_status tpuinfo_get_chip(tpuinfo_ctx* ctx, int32_t index, tpuinfo_chip* out) {
+  if (ctx == nullptr || out == nullptr) return TPUINFO_ERR_INVALID;
+  bool known = false;
+  for (int32_t i : ctx->indices) known = known || (i == index);
+  if (!known) return TPUINFO_ERR_NOT_FOUND;
+
+  memset(out, 0, sizeof(*out));
+  out->index = index;
+  const std::string dir = ctx->ChipDir(index);
+
+  std::string gen_name;
+  if (!ReadFileTrimmed(dir + "/generation", &gen_name)) return TPUINFO_ERR_IO;
+  const GenSpec* spec = LookupGen(gen_name);
+  out->generation = spec ? spec->gen : TPUINFO_GEN_UNKNOWN;
+  CopyStr(out->generation_name, sizeof(out->generation_name), gen_name);
+
+  std::string s;
+  if (ReadFileTrimmed(dir + "/uuid", &s)) {
+    CopyStr(out->uuid, sizeof(out->uuid), s);
+  } else {
+    // Synthesized stable identity when the driver exposes none.
+    CopyStr(out->uuid, sizeof(out->uuid),
+            "tpu-" + gen_name + "-" + std::to_string(index));
+  }
+  out->tensorcore_count = spec ? spec->cores : 1;
+  if (ReadFileTrimmed(dir + "/tensorcore_count", &s))
+    out->tensorcore_count = (int32_t)strtol(s.c_str(), nullptr, 10);
+  out->hbm_bytes = spec ? spec->hbm_bytes : 0;
+  if (ReadFileTrimmed(dir + "/hbm_bytes", &s))
+    out->hbm_bytes = strtoll(s.c_str(), nullptr, 10);
+  if (ReadFileTrimmed(dir + "/pci_address", &s))
+    CopyStr(out->pci_address, sizeof(out->pci_address), s);
+  if (ReadFileTrimmed(dir + "/driver_version", &s))
+    CopyStr(out->driver_version, sizeof(out->driver_version), s);
+  else
+    CopyStr(out->driver_version, sizeof(out->driver_version), "unknown");
+
+  // Topology block (cliqueID/fabric-info analog, cd-plugin nvlib.go:187-258).
+  if (ReadFileTrimmed(dir + "/topology/slice_id", &s))
+    CopyStr(out->slice_id, sizeof(out->slice_id), s);
+  if (ReadFileTrimmed(dir + "/topology/worker_index", &s))
+    out->worker_index = (int32_t)strtol(s.c_str(), nullptr, 10);
+  if (ReadFileTrimmed(dir + "/topology/coords", &s)) {
+    // "x,y,z"
+    sscanf(s.c_str(), "%d,%d,%d", &out->coord_x, &out->coord_y, &out->coord_z);
+  }
+
+  out->healthy = 1;
+  if (ReadFileTrimmed(dir + "/health", &s) && s != "ok" && s != "healthy")
+    out->healthy = 0;
+  return TPUINFO_OK;
+}
+
+tpuinfo_status tpuinfo_set_timeslice(tpuinfo_ctx* ctx, int32_t index,
+                                     int32_t interval_us) {
+  if (ctx == nullptr || interval_us < 0) return TPUINFO_ERR_INVALID;
+  tpuinfo_chip chip;
+  tpuinfo_status st = tpuinfo_get_chip(ctx, index, &chip);
+  if (st != TPUINFO_OK) return st;
+  if (!WriteFile(ctx->ChipDir(index) + "/timeslice_us",
+                 std::to_string(interval_us)))
+    return TPUINFO_ERR_IO;
+  return TPUINFO_OK;
+}
+
+tpuinfo_status tpuinfo_get_timeslice(tpuinfo_ctx* ctx, int32_t index, int32_t* out) {
+  if (ctx == nullptr || out == nullptr) return TPUINFO_ERR_INVALID;
+  std::string s;
+  if (!ReadFileTrimmed(ctx->ChipDir(index) + "/timeslice_us", &s))
+    return TPUINFO_ERR_NOT_FOUND;
+  *out = (int32_t)strtol(s.c_str(), nullptr, 10);
+  return TPUINFO_OK;
+}
+
+tpuinfo_status tpuinfo_set_exclusive_mode(tpuinfo_ctx* ctx, int32_t index,
+                                          int32_t exclusive) {
+  if (ctx == nullptr) return TPUINFO_ERR_INVALID;
+  tpuinfo_chip chip;
+  tpuinfo_status st = tpuinfo_get_chip(ctx, index, &chip);
+  if (st != TPUINFO_OK) return st;
+  if (!WriteFile(ctx->ChipDir(index) + "/exclusive_mode",
+                 exclusive ? "1" : "0"))
+    return TPUINFO_ERR_IO;
+  return TPUINFO_OK;
+}
+
+tpuinfo_status tpuinfo_wait_health_event(tpuinfo_ctx* ctx, int32_t timeout_ms,
+                                         tpuinfo_event* out) {
+  if (ctx == nullptr || out == nullptr) return TPUINFO_ERR_INVALID;
+  std::lock_guard<std::mutex> lock(ctx->mu);
+  const std::string path = ctx->accel_class + "/health_events";
+  const int poll_step_ms = 20;
+  int waited = 0;
+  for (;;) {
+    struct stat st;
+    if (stat(path.c_str(), &st) == 0 && st.st_size > ctx->events_offset) {
+      std::ifstream f(path);
+      if (!f.good()) return TPUINFO_ERR_IO;
+      f.seekg(ctx->events_offset);
+      std::string line;
+      while (std::getline(f, line)) {
+        ctx->events_offset += (off_t)line.size() + 1;
+        if (line.empty()) continue;
+        // "<chip> <code> <kind> <description...>"
+        std::istringstream ls(line);
+        int chip_index = -1, code = 0;
+        std::string kind, desc;
+        ls >> chip_index >> code >> kind;
+        std::getline(ls, desc);
+        if (!desc.empty() && desc[0] == ' ') desc.erase(0, 1);
+        memset(out, 0, sizeof(*out));
+        out->chip_index = chip_index;
+        out->code = code;
+        CopyStr(out->kind, sizeof(out->kind), kind);
+        CopyStr(out->description, sizeof(out->description), desc);
+        return TPUINFO_OK;
+      }
+    }
+    if (waited >= timeout_ms) return TPUINFO_ERR_TIMEOUT;
+    usleep(poll_step_ms * 1000);
+    waited += poll_step_ms;
+  }
+}
+
+}  // extern "C"

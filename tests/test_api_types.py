@@ -95,9 +95,24 @@ class TestTpuConfig:
         with pytest.raises(ValidationError, match="feature gate"):
             cfg.validate()
 
+    def test_strategy_invalid_when_gate_off(self):
+        """validate.go:26-34: a gated-off strategy is an unknown strategy."""
+        cfg = TpuConfig(sharing=TpuSharing(strategy=TimeSlicingStrategy))
+        with pytest.raises(ValidationError, match="unknown TPU sharing strategy"):
+            cfg.validate()
+
+    def test_malformed_metadata_is_decode_error(self):
+        with pytest.raises(DecodeError):
+            StrictDecoder.decode({"apiVersion": API_VERSION,
+                                  "kind": "ComputeDomain", "metadata": 5})
+        with pytest.raises(DecodeError):
+            StrictDecoder.decode({"apiVersion": API_VERSION,
+                                  "kind": "ComputeDomain",
+                                  "status": {"nodes": 5}})
+
     def test_multiprocess_requires_gate(self):
         cfg = TpuConfig(sharing=TpuSharing(strategy=MultiprocessStrategy))
-        with pytest.raises(ValidationError, match="MultiprocessSupport"):
+        with pytest.raises(ValidationError, match="unknown TPU sharing strategy"):
             cfg.validate()
 
     def test_bad_interval(self):

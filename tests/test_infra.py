@@ -1,0 +1,271 @@
+"""L5 infra tests (reference: pkg/workqueue/workqueue_test.go enqueue/retry
+semantics, pkg/flock usage, plus metrics/flags/debug behaviors the reference
+covers via e2e)."""
+
+import os
+import signal
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from tpu_dra.infra import debug
+from tpu_dra.infra.flock import Flock, FlockTimeout
+from tpu_dra.infra.metrics import Counter, Histogram, MetricsServer, Registry
+from tpu_dra.infra.workqueue import (
+    BucketRateLimiter, ExponentialFailureRateLimiter, JitterRateLimiter,
+    MaxOfRateLimiter, WorkQueue,
+)
+
+
+class TestRateLimiters:
+    def test_exponential_growth_and_forget(self):
+        rl = ExponentialFailureRateLimiter(0.01, 0.05)
+        delays = [rl.when(1) for _ in range(4)]
+        assert delays == [0.01, 0.02, 0.04, 0.05]
+        assert rl.num_requeues(1) == 4
+        rl.forget(1)
+        assert rl.when(1) == 0.01
+
+    def test_per_item_isolation(self):
+        rl = ExponentialFailureRateLimiter(0.01, 1.0)
+        rl.when(1)
+        rl.when(1)
+        assert rl.when(2) == 0.01
+
+    def test_bucket_burst_then_throttle(self):
+        rl = BucketRateLimiter(qps=100, burst=2)
+        assert rl.when(1) == 0.0
+        assert rl.when(2) == 0.0
+        assert rl.when(3) > 0.0
+
+    def test_max_of(self):
+        rl = MaxOfRateLimiter(ExponentialFailureRateLimiter(0.5, 1.0),
+                              BucketRateLimiter(qps=1000, burst=1000))
+        assert rl.when(1) == 0.5
+
+    def test_jitter_bounds(self):
+        rl = JitterRateLimiter(ExponentialFailureRateLimiter(1.0, 1.0), 0.5)
+        for _ in range(50):
+            d = rl.when(99)
+            rl.forget(99)
+            assert 0.75 <= d <= 1.25
+
+    def test_jitter_factor_validation(self):
+        with pytest.raises(ValueError):
+            JitterRateLimiter(ExponentialFailureRateLimiter(1, 1), 1.0)
+
+
+class FastRL(ExponentialFailureRateLimiter):
+    def __init__(self):
+        super().__init__(0.001, 0.005)
+
+
+class TestWorkQueue:
+    def test_success_runs_once(self):
+        q = WorkQueue(FastRL())
+        done = threading.Event()
+        calls = []
+        q.enqueue("obj", lambda o: (calls.append(o), done.set()), key="k")
+        t = q.run_in_thread()
+        assert done.wait(2)
+        q.shutdown()
+        t.join(2)
+        assert calls == ["obj"]
+
+    def test_retry_until_success(self):
+        q = WorkQueue(FastRL())
+        done = threading.Event()
+        attempts = []
+
+        def cb(obj):
+            attempts.append(obj)
+            if len(attempts) < 3:
+                raise RuntimeError("not yet")
+            done.set()
+
+        q.enqueue("x", cb, key="k")
+        t = q.run_in_thread()
+        assert done.wait(2)
+        q.shutdown()
+        t.join(2)
+        assert len(attempts) == 3
+
+    def test_supersede_forgets_failed_older_item(self):
+        """workqueue.go:173-189: a failed item is not retried once a newer
+        item under the same key exists."""
+        q = WorkQueue(FastRL())
+        first_failed = threading.Event()
+        second_done = threading.Event()
+        calls = []
+
+        def first(obj):
+            calls.append("first")
+            first_failed.set()
+            raise RuntimeError("fail forever")
+
+        def second(obj):
+            # Wait until first has failed at least once before succeeding.
+            first_failed.wait(2)
+            calls.append("second")
+            second_done.set()
+
+        q.enqueue("a", first, key="k")
+        q.enqueue("b", second, key="k")
+        t = q.run_in_thread()
+        assert second_done.wait(2)
+        time.sleep(0.1)  # give any (wrong) retries a chance to run
+        q.shutdown()
+        t.join(2)
+        assert calls.count("second") == 1
+        assert calls.count("first") <= 2  # at most one retry already in flight
+
+    def test_keyless_items_always_retry(self):
+        q = WorkQueue(FastRL())
+        done = threading.Event()
+        n = []
+
+        def cb(obj):
+            n.append(1)
+            if len(n) < 2:
+                raise RuntimeError("once more")
+            done.set()
+
+        q.enqueue("x", cb)  # no key
+        t = q.run_in_thread()
+        assert done.wait(2)
+        q.shutdown()
+        t.join(2)
+
+
+class TestFlock:
+    def test_acquire_release(self, tmp_path):
+        lock = Flock(str(tmp_path / "l"))
+        with lock:
+            assert os.path.exists(lock.path)
+
+    def test_contention_times_out(self, tmp_path):
+        """A second process holding the flock blocks us until timeout."""
+        path = str(tmp_path / "l")
+        import subprocess
+        import sys
+        holder = subprocess.Popen(
+            [sys.executable, "-c",
+             "import fcntl,os,sys,time;"
+             f"fd=os.open({path!r}, os.O_CREAT|os.O_RDWR);"
+             "fcntl.flock(fd, fcntl.LOCK_EX);"
+             "print('held', flush=True); time.sleep(30)"],
+            stdout=subprocess.PIPE, text=True)
+        try:
+            assert holder.stdout.readline().strip() == "held"
+            lock = Flock(path, poll_interval=0.02)
+            t0 = time.monotonic()
+            with pytest.raises(FlockTimeout):
+                lock.acquire(timeout=0.3)
+            assert time.monotonic() - t0 >= 0.3
+        finally:
+            holder.kill()
+            holder.wait()
+
+    def test_cancel(self, tmp_path):
+        path = str(tmp_path / "l")
+        import subprocess
+        import sys
+        holder = subprocess.Popen(
+            [sys.executable, "-c",
+             "import fcntl,os,time;"
+             f"fd=os.open({path!r}, os.O_CREAT|os.O_RDWR);"
+             "fcntl.flock(fd, fcntl.LOCK_EX);"
+             "print('held', flush=True); time.sleep(30)"],
+            stdout=subprocess.PIPE, text=True)
+        try:
+            assert holder.stdout.readline().strip() == "held"
+            cancel = threading.Event()
+            lock = Flock(path, poll_interval=0.02)
+            threading.Timer(0.1, cancel.set).start()
+            with pytest.raises(FlockTimeout, match="cancelled"):
+                lock.acquire(timeout=5.0, cancel=cancel)
+        finally:
+            holder.kill()
+            holder.wait()
+
+
+class TestMetrics:
+    def test_counter_and_labels(self):
+        r = Registry()
+        c = r.counter("tpu_dra_test_total", "help")
+        c.inc()
+        c.inc(2, labels={"op": "prepare"})
+        text = r.expose()
+        assert "tpu_dra_test_total 1.0" in text
+        assert 'tpu_dra_test_total{op="prepare"} 2.0' in text
+
+    def test_histogram_percentile(self):
+        h = Histogram("h", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.05, 0.5, 5.0):
+            h.observe(v)
+        assert h.percentile(0.5) == 0.1
+        assert h.percentile(0.99) == 10.0
+
+    def test_http_exposition(self):
+        r = Registry()
+        r.counter("up_test").inc()
+        srv = MetricsServer(port=0, registry=r)
+        srv.start()
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics", timeout=5).read().decode()
+            assert "up_test 1.0" in body
+            health = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/healthz", timeout=5).read()
+            assert health == b"ok"
+        finally:
+            srv.stop()
+
+
+class TestDebug:
+    def test_dump_stacks(self, tmp_path):
+        p = str(tmp_path / "stacks")
+        debug.dump_stacks(p)
+        content = open(p).read()
+        assert "MainThread" in content
+
+    def test_sigusr2_handler(self, tmp_path):
+        """test_basics.bats:89-100 analog: signal produces a stack dump."""
+        p = str(tmp_path / "stacks")
+        debug.start_debug_signal_handlers(p)
+        os.kill(os.getpid(), signal.SIGUSR2)
+        time.sleep(0.2)
+        assert os.path.exists(p)
+        signal.signal(signal.SIGUSR2, signal.SIG_DFL)
+
+
+class TestFlags:
+    def test_env_mirror_and_required(self, monkeypatch):
+        from tpu_dra.infra.flags import Flag, FlagSet
+        monkeypatch.setenv("TEST_NODE_NAME", "node-7")
+        fs = FlagSet("t", [Flag(name="node-name", env="TEST_NODE_NAME", required=True),
+                           Flag(name="port", env="TEST_PORT", default=8080, type=int)])
+        ns = fs.parse([])
+        assert ns.node_name == "node-7"
+        assert ns.port == 8080
+
+    def test_cli_overrides_env(self, monkeypatch):
+        from tpu_dra.infra.flags import Flag, FlagSet
+        monkeypatch.setenv("TEST_NODE_NAME", "from-env")
+        fs = FlagSet("t", [Flag(name="node-name", env="TEST_NODE_NAME")])
+        ns = fs.parse(["--node-name", "from-cli"])
+        assert ns.node_name == "from-cli"
+
+    def test_required_missing(self):
+        from tpu_dra.infra.flags import Flag, FlagSet
+        fs = FlagSet("t", [Flag(name="node-name", env="NO_SUCH_ENV_VAR_SET", required=True)])
+        with pytest.raises(SystemExit):
+            fs.parse([])
+
+    def test_bool_env_coercion(self, monkeypatch):
+        from tpu_dra.infra.flags import Flag, FlagSet
+        monkeypatch.setenv("TEST_JSON", "true")
+        fs = FlagSet("t", [Flag(name="log-json", env="TEST_JSON", default=False, type=bool)])
+        assert fs.parse([]).log_json is True

@@ -1,0 +1,155 @@
+"""L0 native layer tests: the C++ libtpuinfo against a synthetic sysfs tree
+(the fake-able hardware seam, SURVEY §7.3) plus the in-process FakeBackend,
+asserting both present identical chip models."""
+
+import os
+import subprocess
+
+import pytest
+
+from tpu_dra.native import (
+    Chip, FakeBackend, HealthEvent, NativeBackend, make_fake_sysfs,
+)
+from tpu_dra.native.tpuinfo import append_health_event, default_fake_chips
+
+NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "native")
+LIB = os.path.abspath(os.path.join(NATIVE_DIR, "build", "libtpuinfo.so"))
+TPUCTL = os.path.abspath(os.path.join(NATIVE_DIR, "build", "tpuctl"))
+
+
+@pytest.fixture(scope="session")
+def native_build():
+    if not os.path.exists(LIB):
+        subprocess.run(["make", "-C", os.path.abspath(NATIVE_DIR)], check=True,
+                       capture_output=True)
+    return LIB
+
+
+@pytest.fixture
+def sysfs(tmp_path):
+    chips = default_fake_chips(count=4, generation="v5e", slice_id="slice-A")
+    return str(tmp_path), chips, make_fake_sysfs(str(tmp_path), chips)
+
+
+class TestNativeBackend:
+    def test_enumeration(self, native_build, sysfs):
+        root, chips, _ = sysfs
+        be = NativeBackend(sysfs_root=root, lib_path=native_build)
+        got = be.chips()
+        assert len(got) == 4
+        for want, have in zip(chips, got):
+            assert have.uuid == want.uuid
+            assert have.generation == "v5e"
+            assert have.tensorcore_count == 1
+            assert have.hbm_bytes == 16 << 30
+            assert have.slice_id == "slice-A"
+            assert have.coords == want.coords
+            assert have.healthy
+        be.close()
+
+    def test_chip_requires_dev_node(self, native_build, tmp_path):
+        """A chip without its /dev/accelN char device must not be advertised."""
+        chips = default_fake_chips(count=2)
+        make_fake_sysfs(str(tmp_path), chips)
+        os.unlink(tmp_path / "dev" / "accel1")
+        be = NativeBackend(sysfs_root=str(tmp_path), lib_path=native_build)
+        assert [c.index for c in be.chips()] == [0]
+        be.close()
+
+    def test_missing_root(self, native_build, tmp_path):
+        with pytest.raises(RuntimeError, match="not found"):
+            NativeBackend(sysfs_root=str(tmp_path / "nope"), lib_path=native_build)
+
+    def test_timeslice_roundtrip(self, native_build, sysfs):
+        root, _, _ = sysfs
+        be = NativeBackend(sysfs_root=root, lib_path=native_build)
+        assert be.get_timeslice(0) is None
+        be.set_timeslice(0, 5000)
+        assert be.get_timeslice(0) == 5000
+        with pytest.raises(RuntimeError, match="not found"):
+            be.set_timeslice(99, 1)
+        be.close()
+
+    def test_exclusive_mode(self, native_build, sysfs):
+        root, _, _ = sysfs
+        be = NativeBackend(sysfs_root=root, lib_path=native_build)
+        be.set_exclusive_mode(1, True)
+        content = open(os.path.join(
+            root, "sys/class/accel/accel1/device/exclusive_mode")).read()
+        assert content == "1"
+        be.close()
+
+    def test_health_event_tail(self, native_build, sysfs):
+        root, _, _ = sysfs
+        be = NativeBackend(sysfs_root=root, lib_path=native_build)
+        assert be.wait_health_event(0.05) is None
+        append_health_event(root, HealthEvent(2, 48, "hbm_ecc", "double-bit error"))
+        ev = be.wait_health_event(2.0)
+        assert ev == HealthEvent(2, 48, "hbm_ecc", "double-bit error")
+        # Offset advances: no replay.
+        assert be.wait_health_event(0.05) is None
+        be.close()
+
+    def test_unhealthy_chip_reported(self, native_build, tmp_path):
+        chips = [Chip(index=0, uuid="u0", generation="v5e", tensorcore_count=1,
+                      hbm_bytes=1, healthy=False)]
+        make_fake_sysfs(str(tmp_path), chips)
+        be = NativeBackend(sysfs_root=str(tmp_path), lib_path=native_build)
+        assert be.chips()[0].healthy is False
+        be.close()
+
+
+class TestTpuctl:
+    def test_list(self, native_build, sysfs):
+        root, _, _ = sysfs
+        out = subprocess.run([TPUCTL, "list"], capture_output=True, text=True,
+                             env={**os.environ, "TPUINFO_SYSFS_ROOT": root})
+        assert out.returncode == 0, out.stderr
+        lines = out.stdout.strip().splitlines()
+        assert len(lines) == 5  # header + 4 chips
+        assert lines[1].split("\t")[1] == "tpu-v5e-00-fake"
+
+    def test_set_timeslice_cli(self, native_build, sysfs):
+        root, _, _ = sysfs
+        env = {**os.environ, "TPUINFO_SYSFS_ROOT": root}
+        assert subprocess.run([TPUCTL, "set-timeslice", "0", "2000"],
+                              env=env).returncode == 0
+        out = subprocess.run([TPUCTL, "get-timeslice", "0"], env=env,
+                             capture_output=True, text=True)
+        assert out.stdout.strip() == "2000"
+
+    def test_bad_command(self, native_build, sysfs):
+        root, _, _ = sysfs
+        env = {**os.environ, "TPUINFO_SYSFS_ROOT": root}
+        assert subprocess.run([TPUCTL, "frobnicate"], env=env,
+                              capture_output=True).returncode == 2
+
+
+class TestFakeBackend:
+    def test_parity_with_native_model(self):
+        be = FakeBackend(default_fake_chips(2, "v5p"))
+        chips = be.chips()
+        assert chips[0].tensorcore_count == 2
+        assert chips[0].hbm_bytes == 95 << 30
+
+    def test_settings(self):
+        be = FakeBackend()
+        be.set_timeslice(0, 100)
+        assert be.get_timeslice(0) == 100
+        with pytest.raises(KeyError):
+            be.set_timeslice(99, 1)
+
+    def test_health_injection_marks_unhealthy(self):
+        be = FakeBackend()
+        be.inject_health_event(HealthEvent(1, 7, "ici_link_down", "link down"))
+        ev = be.wait_health_event(1.0)
+        assert ev.kind == "ici_link_down"
+        assert be.get_chip(1).healthy is False
+        assert be.get_chip(0).healthy is True
+
+    def test_env_configuration(self, monkeypatch):
+        monkeypatch.setenv("TPU_DRA_FAKE_CHIPS", "8")
+        monkeypatch.setenv("TPU_DRA_FAKE_GENERATION", "v4")
+        be = FakeBackend()
+        assert len(be.chips()) == 8
+        assert be.chips()[0].generation == "v4"

@@ -243,29 +243,27 @@ class TpuSharing:
         return out
 
     def validate(self):
-        """Gate-aware validation (validate.go:27-76)."""
-        if self.strategy == TimeSlicingStrategy:
+        """Gate-aware validation (validate.go:26-95): a strategy is only
+        valid while its feature gate is enabled — a gated-off strategy is
+        'unknown', exactly as the reference treats it."""
+        if (self.strategy == TimeSlicingStrategy
+                and featuregates.enabled(featuregates.TimeSlicingSettings)):
             if self.multiprocess_config is not None:
                 raise ValidationError(
                     "multiprocessConfig set with TimeSlicing strategy")
-            if not featuregates.enabled(featuregates.TimeSlicingSettings):
-                if self.time_slicing_config is not None:
-                    raise ValidationError(
-                        "timeSlicingConfig requires the TimeSlicingSettings feature gate")
-                return
             if self.time_slicing_config is not None:
                 self.time_slicing_config.validate()
-        elif self.strategy == MultiprocessStrategy:
-            if not featuregates.enabled(featuregates.MultiprocessSupport):
-                raise ValidationError(
-                    "Multiprocess sharing requires the MultiprocessSupport feature gate")
+        elif (self.strategy == MultiprocessStrategy
+                and featuregates.enabled(featuregates.MultiprocessSupport)):
             if self.time_slicing_config is not None:
                 raise ValidationError(
                     "timeSlicingConfig set with Multiprocess strategy")
             if self.multiprocess_config is not None:
                 self.multiprocess_config.validate()
         else:
-            raise ValidationError(f"unknown sharing strategy: {self.strategy!r}")
+            raise ValidationError(
+                f"unknown TPU sharing strategy: {self.strategy!r} "
+                "(is its feature gate enabled?)")
 
     def is_time_slicing(self) -> bool:
         return self.strategy == TimeSlicingStrategy
@@ -286,20 +284,11 @@ class _ConfigBase:
 
 
 @dataclass
-class TpuConfig(_ConfigBase):
-    """Per-claim config for a whole TPU chip (GpuConfig analog,
-    gpuconfig.go:29-89)."""
-    KIND = TPU_CONFIG_KIND
+class _SharingConfigBase(_ConfigBase):
+    """Shared machinery for the two sharing-carrying config kinds; the
+    reference duplicates this between GpuConfig and MigDeviceConfig
+    (gpuconfig.go:52-77 / migconfig.go:52-70)."""
     sharing: Optional[TpuSharing] = None
-
-    @classmethod
-    def default(cls) -> "TpuConfig":
-        cfg = cls()
-        if featuregates.enabled(featuregates.TimeSlicingSettings):
-            cfg.sharing = TpuSharing(
-                strategy=TimeSlicingStrategy,
-                time_slicing_config=TimeSlicingConfig(interval=DEFAULT_TIME_SLICE))
-        return cfg
 
     @classmethod
     def from_dict(cls, data: Dict[str, Any], strict: bool = True):
@@ -336,45 +325,28 @@ class TpuConfig(_ConfigBase):
 
 
 @dataclass
-class SubsliceConfig(_ConfigBase):
+class TpuConfig(_SharingConfigBase):
+    """Per-claim config for a whole TPU chip (GpuConfig analog,
+    gpuconfig.go:29-89)."""
+    KIND = TPU_CONFIG_KIND
+
+    @classmethod
+    def default(cls) -> "TpuConfig":
+        cfg = cls()
+        if featuregates.enabled(featuregates.TimeSlicingSettings):
+            cfg.sharing = TpuSharing(
+                strategy=TimeSlicingStrategy,
+                time_slicing_config=TimeSlicingConfig(interval=DEFAULT_TIME_SLICE))
+        return cfg
+
+
+@dataclass
+class SubsliceConfig(_SharingConfigBase):
     """Per-claim config for a TensorCore subslice of a chip (MigDeviceConfig
     analog, migconfig.go:28-77). The subslice *shape* is chosen by the
     scheduler via device selection (subslice devices are advertised like MIG
     profiles); this config only carries sharing settings for it."""
     KIND = SUBSLICE_CONFIG_KIND
-    sharing: Optional[TpuSharing] = None
-
-    @classmethod
-    def from_dict(cls, data: Dict[str, Any], strict: bool = True):
-        _unknown_fields(data, {"apiVersion", "kind", "sharing"}, strict, self_path(cls))
-        sharing = None
-        if data.get("sharing") is not None:
-            sharing = TpuSharing.from_dict(data["sharing"], strict, "sharing")
-        return cls(sharing=sharing)
-
-    def to_dict(self) -> Dict[str, Any]:
-        out = self.type_meta()
-        if self.sharing is not None:
-            out["sharing"] = self.sharing.to_dict()
-        return out
-
-    def normalize(self):
-        if self.sharing is None:
-            if not featuregates.enabled(featuregates.TimeSlicingSettings):
-                return
-            self.sharing = TpuSharing(strategy=TimeSlicingStrategy)
-        if featuregates.enabled(featuregates.TimeSlicingSettings):
-            if (self.sharing.strategy == TimeSlicingStrategy
-                    and self.sharing.time_slicing_config is None):
-                self.sharing.time_slicing_config = TimeSlicingConfig(DEFAULT_TIME_SLICE)
-        if featuregates.enabled(featuregates.MultiprocessSupport):
-            if (self.sharing.strategy == MultiprocessStrategy
-                    and self.sharing.multiprocess_config is None):
-                self.sharing.multiprocess_config = MultiprocessConfig()
-
-    def validate(self):
-        if self.sharing is not None:
-            self.sharing.validate()
 
 
 @dataclass
@@ -555,8 +527,10 @@ class ComputeDomainStatus:
     @classmethod
     def from_dict(cls, data: Dict[str, Any], strict: bool, path: str = "status"):
         _unknown_fields(data, {"status", "nodes"}, strict, path)
+        raw_nodes = data.get("nodes") or []
+        _require_type(raw_nodes, list, f"{path}.nodes")
         nodes = [ComputeDomainNode.from_dict(n, strict, f"{path}.nodes[{i}]")
-                 for i, n in enumerate(data.get("nodes") or [])]
+                 for i, n in enumerate(raw_nodes)]
         return cls(status=data.get("status", COMPUTE_DOMAIN_STATUS_NOT_READY), nodes=nodes)
 
     def to_dict(self) -> Dict[str, Any]:
@@ -576,9 +550,11 @@ class ComputeDomain(_ConfigBase):
     def from_dict(cls, data: Dict[str, Any], strict: bool = True):
         _unknown_fields(data, {"apiVersion", "kind", "metadata", "spec", "status"},
                         strict, self_path(cls))
+        metadata = data.get("metadata") or {}
+        _require_type(metadata, dict, "metadata")
         spec = ComputeDomainSpec.from_dict(data.get("spec") or {}, strict)
         status = ComputeDomainStatus.from_dict(data.get("status") or {}, strict)
-        return cls(metadata=dict(data.get("metadata") or {}), spec=spec, status=status)
+        return cls(metadata=dict(metadata), spec=spec, status=status)
 
     def to_dict(self) -> Dict[str, Any]:
         out = self.type_meta()

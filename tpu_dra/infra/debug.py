@@ -1,0 +1,42 @@
+"""Debug signal handlers.
+
+Reference: internal/common/util.go:30-73 — SIGUSR2 dumps all goroutine
+stacks to /tmp/goroutine-stacks.dump in every binary (verified by
+tests/bats/test_basics.bats:89-100). Python analog: dump every thread's
+stack to /tmp/thread-stacks.dump.
+"""
+
+from __future__ import annotations
+
+import faulthandler
+import signal
+import sys
+import threading
+import traceback
+
+STACK_DUMP_PATH = "/tmp/thread-stacks.dump"
+
+
+def dump_stacks(path: str = STACK_DUMP_PATH) -> str:
+    frames = sys._current_frames()
+    names = {t.ident: t.name for t in threading.enumerate()}
+    with open(path, "w") as f:
+        for ident, frame in frames.items():
+            f.write(f"--- thread {names.get(ident, '?')} ({ident}) ---\n")
+            traceback.print_stack(frame, file=f)
+            f.write("\n")
+    return path
+
+
+def start_debug_signal_handlers(path: str = STACK_DUMP_PATH) -> None:
+    """Install SIGUSR2 -> stack dump. Also arms faulthandler for hard
+    crashes. Only callable from the main thread (signal API restriction)."""
+    faulthandler.enable()
+
+    def _handler(signum, frame):
+        try:
+            dump_stacks(path)
+        except Exception:
+            pass
+
+    signal.signal(signal.SIGUSR2, _handler)

@@ -1,0 +1,78 @@
+"""Polling non-blocking flock(2) with timeout and cancellation.
+
+Reference: pkg/flock/flock.go:28-133. Guards prepare/unprepare node-globally:
+during a rolling driver upgrade two plugin pods briefly coexist on one node
+and must never interleave Prepare/Unprepare (driver.go:166-215 acquires this
+around every claim operation). Non-blocking + poll (rather than a blocking
+flock) keeps the timeout and cancel semantics portable.
+"""
+
+from __future__ import annotations
+
+import errno
+import fcntl
+import os
+import threading
+import time
+from typing import Optional
+
+
+class FlockTimeout(TimeoutError):
+    pass
+
+
+class Flock:
+    def __init__(self, path: str, poll_interval: float = 0.1):
+        self._path = path
+        self._poll = poll_interval
+        self._fd: Optional[int] = None
+        self._tlock = threading.Lock()  # in-process serialization
+
+    @property
+    def path(self) -> str:
+        return self._path
+
+    def acquire(self, timeout: float = 10.0,
+                cancel: Optional[threading.Event] = None) -> None:
+        """Acquire or raise FlockTimeout. Re-opens the file each attempt so a
+        deleted lock file doesn't wedge us holding a stale inode."""
+        deadline = time.monotonic() + timeout
+        if not self._tlock.acquire(timeout=timeout):
+            raise FlockTimeout(f"in-process lock on {self._path} not acquired "
+                               f"within {timeout}s")
+        try:
+            while True:
+                if cancel is not None and cancel.is_set():
+                    raise FlockTimeout(f"lock acquisition on {self._path} cancelled")
+                fd = os.open(self._path, os.O_CREAT | os.O_RDWR, 0o644)
+                try:
+                    fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                    self._fd = fd
+                    return
+                except OSError as e:
+                    os.close(fd)
+                    if e.errno not in (errno.EAGAIN, errno.EACCES):
+                        raise
+                if time.monotonic() >= deadline:
+                    raise FlockTimeout(
+                        f"flock on {self._path} not acquired within {timeout}s")
+                time.sleep(self._poll)
+        except BaseException:
+            self._tlock.release()
+            raise
+
+    def release(self) -> None:
+        if self._fd is not None:
+            try:
+                fcntl.flock(self._fd, fcntl.LOCK_UN)
+            finally:
+                os.close(self._fd)
+                self._fd = None
+        self._tlock.release()
+
+    def __enter__(self) -> "Flock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()

@@ -1,0 +1,203 @@
+"""Minimal Prometheus-compatible metrics registry + HTTP exposition.
+
+Reference: cmd/compute-domain-controller/main.go:243-290 — an HTTP endpoint
+serving Prometheus metrics (client-go/workqueue/restclient collectors via
+legacyregistry) and pprof profiles behind --http-endpoint/--pprof-path.
+Python analog: counters/gauges/histograms with label support, text
+exposition format, and a background http.server that also serves the
+thread-stack dump at the pprof path.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+from tpu_dra.infra import debug
+
+
+class _Metric:
+    def __init__(self, name: str, help_text: str, kind: str):
+        self.name = name
+        self.help = help_text
+        self.kind = kind
+        self._values: Dict[Tuple[Tuple[str, str], ...], float] = {}
+        self._lock = threading.Lock()
+
+    def _key(self, labels: Optional[Dict[str, str]]):
+        return tuple(sorted((labels or {}).items()))
+
+    def expose(self) -> List[str]:
+        with self._lock:
+            lines = [f"# HELP {self.name} {self.help}",
+                     f"# TYPE {self.name} {self.kind}"]
+            for key, val in sorted(self._values.items()):
+                if key:
+                    lbl = ",".join(f'{k}="{v}"' for k, v in key)
+                    lines.append(f"{self.name}{{{lbl}}} {val}")
+                else:
+                    lines.append(f"{self.name} {val}")
+            return lines
+
+
+class Counter(_Metric):
+    def __init__(self, name: str, help_text: str = ""):
+        super().__init__(name, help_text, "counter")
+
+    def inc(self, amount: float = 1.0, labels: Optional[Dict[str, str]] = None):
+        with self._lock:
+            k = self._key(labels)
+            self._values[k] = self._values.get(k, 0.0) + amount
+
+
+class Gauge(_Metric):
+    def __init__(self, name: str, help_text: str = ""):
+        super().__init__(name, help_text, "gauge")
+
+    def set(self, value: float, labels: Optional[Dict[str, str]] = None):
+        with self._lock:
+            self._values[self._key(labels)] = value
+
+
+class Histogram(_Metric):
+    """Fixed-bucket histogram; exposes _bucket/_sum/_count series."""
+
+    DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                       1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+    def __init__(self, name: str, help_text: str = "", buckets=None):
+        super().__init__(name, help_text, "histogram")
+        self._buckets = tuple(buckets or self.DEFAULT_BUCKETS)
+        self._counts = [0] * (len(self._buckets) + 1)
+        self._sum = 0.0
+        self._n = 0
+
+    def observe(self, value: float):
+        with self._lock:
+            self._sum += value
+            self._n += 1
+            for i, b in enumerate(self._buckets):
+                if value <= b:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    def expose(self) -> List[str]:
+        with self._lock:
+            lines = [f"# HELP {self.name} {self.help}",
+                     f"# TYPE {self.name} histogram"]
+            cum = 0
+            for b, c in zip(self._buckets, self._counts):
+                cum += c
+                lines.append(f'{self.name}_bucket{{le="{b}"}} {cum}')
+            lines.append(f'{self.name}_bucket{{le="+Inf"}} {self._n}')
+            lines.append(f"{self.name}_sum {self._sum}")
+            lines.append(f"{self.name}_count {self._n}")
+            return lines
+
+    def percentile(self, q: float) -> float:
+        """Approximate percentile from bucket upper bounds (for bench/report)."""
+        with self._lock:
+            if self._n == 0:
+                return 0.0
+            target = q * self._n
+            cum = 0
+            for b, c in zip(self._buckets, self._counts):
+                cum += c
+                if cum >= target:
+                    return b
+            return float("inf")
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: List[_Metric] = []
+        self._lock = threading.Lock()
+
+    def register(self, metric: _Metric) -> _Metric:
+        with self._lock:
+            self._metrics.append(metric)
+        return metric
+
+    def counter(self, name: str, help_text: str = "") -> Counter:
+        return self.register(Counter(name, help_text))  # type: ignore[return-value]
+
+    def gauge(self, name: str, help_text: str = "") -> Gauge:
+        return self.register(Gauge(name, help_text))  # type: ignore[return-value]
+
+    def histogram(self, name: str, help_text: str = "", buckets=None) -> Histogram:
+        return self.register(Histogram(name, help_text, buckets))  # type: ignore[return-value]
+
+    def expose(self) -> str:
+        with self._lock:
+            out: List[str] = []
+            for m in self._metrics:
+                out.extend(m.expose())
+            return "\n".join(out) + "\n"
+
+
+DefaultRegistry = Registry()
+
+
+class MetricsServer:
+    """Serves /metrics (text exposition) and /debug/stacks (pprof analog)."""
+
+    def __init__(self, addr: str = "127.0.0.1", port: int = 0,
+                 registry: Registry = DefaultRegistry):
+        registry_ref = registry
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                if self.path == "/metrics":
+                    body = registry_ref.expose().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain; version=0.0.4")
+                elif self.path == "/debug/stacks":
+                    path = debug.dump_stacks()
+                    body = open(path, "rb").read()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                elif self.path == "/healthz":
+                    body = b"ok"
+                    self.send_response(200)
+                else:
+                    body = b"not found"
+                    self.send_response(404)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass
+
+        self._server = ThreadingHTTPServer((addr, port), Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True, name="metrics-http")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class Timer:
+    """Context manager observing elapsed seconds into a Histogram."""
+
+    def __init__(self, hist: Histogram):
+        self._hist = hist
+
+    def __enter__(self):
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc):
+        self._hist.observe(time.monotonic() - self._t0)

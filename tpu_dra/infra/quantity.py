@@ -14,6 +14,7 @@ _BINARY = {"Ki": 1024, "Mi": 1024**2, "Gi": 1024**3,
            "Ti": 1024**4, "Pi": 1024**5, "Ei": 1024**6}
 _DECIMAL = {"k": 10**3, "M": 10**6, "G": 10**9,
             "T": 10**12, "P": 10**15, "E": 10**18, "m": Fraction(1, 1000)}
+_SUFFIXES = tuple(sorted(list(_BINARY) + list(_DECIMAL), key=len, reverse=True))
 
 
 class Quantity:
@@ -28,7 +29,7 @@ class Quantity:
         if not s:
             raise ValueError("empty quantity")
         suffix = ""
-        for cand in sorted(list(_BINARY) + list(_DECIMAL), key=len, reverse=True):
+        for cand in _SUFFIXES:
             if s.endswith(cand):
                 suffix = cand
                 s = s[: -len(cand)]

@@ -1,0 +1,255 @@
+"""Rate-limited work queue with per-key latest-wins retry semantics.
+
+Reference: pkg/workqueue/workqueue.go:31-197 and jitterlimiter.go:32-67.
+Retryable reconcile callbacks are enqueued with a key; when a newer item is
+enqueued under the same key, a *failed* older item is forgotten instead of
+retried (supersede, workqueue.go:173-189). Rate limiting combines per-item
+exponential backoff with a global token bucket (DefaultPrepUnprepRateLimiter)
+or adds relative jitter (DefaultCDDaemonRateLimiter) so a fleet of daemons
+doesn't thundering-herd the API server.
+
+The implementation is a threaded delay queue rather than a port of
+client-go; semantics (AddRateLimited / Forget / NumRequeues / supersede)
+are preserved.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+
+# ---------------------------------------------------------------------------
+# Rate limiters
+# ---------------------------------------------------------------------------
+
+class RateLimiter:
+    def when(self, item_id: int) -> float:
+        """Seconds to wait before (re)processing this item."""
+        raise NotImplementedError
+
+    def forget(self, item_id: int) -> None:
+        pass
+
+    def num_requeues(self, item_id: int) -> int:
+        return 0
+
+
+class ExponentialFailureRateLimiter(RateLimiter):
+    """Per-item exponential backoff: base * 2^failures, capped."""
+
+    def __init__(self, base_delay: float, max_delay: float):
+        self._base = base_delay
+        self._max = max_delay
+        self._failures: Dict[int, int] = {}
+        self._lock = threading.Lock()
+
+    def when(self, item_id: int) -> float:
+        with self._lock:
+            n = self._failures.get(item_id, 0)
+            self._failures[item_id] = n + 1
+        return min(self._base * (2 ** n), self._max)
+
+    def forget(self, item_id: int) -> None:
+        with self._lock:
+            self._failures.pop(item_id, None)
+
+    def num_requeues(self, item_id: int) -> int:
+        with self._lock:
+            return self._failures.get(item_id, 0)
+
+
+class BucketRateLimiter(RateLimiter):
+    """Global token bucket (golang.org/x/time/rate analog): `qps` refills/s,
+    `burst` capacity; when() reserves a token and returns the wait."""
+
+    def __init__(self, qps: float, burst: int):
+        self._qps = qps
+        self._burst = burst
+        self._tokens = float(burst)
+        self._last = time.monotonic()
+        self._lock = threading.Lock()
+
+    def when(self, item_id: int) -> float:
+        with self._lock:
+            now = time.monotonic()
+            self._tokens = min(self._burst, self._tokens + (now - self._last) * self._qps)
+            self._last = now
+            self._tokens -= 1.0
+            if self._tokens >= 0:
+                return 0.0
+            return -self._tokens / self._qps
+
+
+class MaxOfRateLimiter(RateLimiter):
+    """Pick the longest delay among limiters (workqueue.go:52-66)."""
+
+    def __init__(self, *limiters: RateLimiter):
+        self._limiters = limiters
+
+    def when(self, item_id: int) -> float:
+        return max(l.when(item_id) for l in self._limiters)
+
+    def forget(self, item_id: int) -> None:
+        for l in self._limiters:
+            l.forget(item_id)
+
+    def num_requeues(self, item_id: int) -> int:
+        return max(l.num_requeues(item_id) for l in self._limiters)
+
+
+class JitterRateLimiter(RateLimiter):
+    """Wrap an inner limiter with +/- factor/2 relative jitter
+    (jitterlimiter.go:32-67)."""
+
+    def __init__(self, inner: RateLimiter, factor: float):
+        if factor >= 1.0:
+            raise ValueError("jitter factor must be < 1.0")
+        self._inner = inner
+        self._factor = factor
+
+    def when(self, item_id: int) -> float:
+        d = self._inner.when(item_id)
+        return max(0.0, d + d * self._factor * (random.random() - 0.5))
+
+    def forget(self, item_id: int) -> None:
+        self._inner.forget(item_id)
+
+    def num_requeues(self, item_id: int) -> int:
+        return self._inner.num_requeues(item_id)
+
+
+def default_prep_unprep_rate_limiter() -> RateLimiter:
+    """250ms–3s per-item expo + global 5/s bucket with burst 10
+    (workqueue.go DefaultPrepUnprepRateLimiter)."""
+    return MaxOfRateLimiter(
+        ExponentialFailureRateLimiter(0.250, 3.0),
+        BucketRateLimiter(qps=5, burst=10),
+    )
+
+
+def default_cd_daemon_rate_limiter() -> RateLimiter:
+    """5ms–6s expo with 0.5 relative jitter (DefaultCDDaemonRateLimiter)."""
+    return JitterRateLimiter(ExponentialFailureRateLimiter(0.005, 6.0), 0.5)
+
+
+def default_controller_rate_limiter() -> RateLimiter:
+    """client-go DefaultTypedControllerRateLimiter analog: 5ms–1000s expo +
+    10/s bucket with burst 100."""
+    return MaxOfRateLimiter(
+        ExponentialFailureRateLimiter(0.005, 1000.0),
+        BucketRateLimiter(qps=10, burst=100),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Work queue
+# ---------------------------------------------------------------------------
+
+@dataclass
+class WorkItem:
+    key: str
+    obj: Any
+    callback: Callable[[Any], None]
+    item_id: int = field(default_factory=itertools.count().__next__)
+
+
+class WorkQueue:
+    """Threaded delay queue; run() processes items until shutdown().
+
+    Failed callbacks (those that raise) are re-enqueued rate-limited unless a
+    newer item with the same key has been enqueued since — then the failure
+    is forgotten ("latest wins", workqueue.go:173-189). Exceptions raised by
+    callbacks are treated as expected retryable errors in an eventually
+    consistent system and not re-raised.
+    """
+
+    def __init__(self, rate_limiter: Optional[RateLimiter] = None,
+                 log: Optional[Callable[[str], None]] = None):
+        self._rl = rate_limiter or default_controller_rate_limiter()
+        self._heap: list = []  # (ready_at, seq, WorkItem)
+        self._seq = itertools.count()
+        self._cond = threading.Condition()
+        self._active_ops: Dict[str, WorkItem] = {}
+        self._shutdown = False
+        self._log = log or (lambda msg: None)
+
+    # -- producers ----------------------------------------------------------
+
+    def enqueue(self, obj: Any, callback: Callable[[Any], None], key: str = "") -> None:
+        item = WorkItem(key=key, obj=obj, callback=callback)
+        with self._cond:
+            if key:
+                self._active_ops[key] = item
+            self._push_locked(item)
+            self._cond.notify()
+
+    def _push_locked(self, item: WorkItem) -> None:
+        delay = self._rl.when(item.item_id)
+        heapq.heappush(self._heap, (time.monotonic() + delay, next(self._seq), item))
+
+    # -- consumer -----------------------------------------------------------
+
+    def run(self, stop_event: Optional[threading.Event] = None) -> None:
+        """Process items until shutdown() (or stop_event set)."""
+        while True:
+            item = self._get(stop_event)
+            if item is None:
+                return
+            self._process(item)
+
+    def run_in_thread(self) -> threading.Thread:
+        t = threading.Thread(target=self.run, daemon=True, name="workqueue")
+        t.start()
+        return t
+
+    def shutdown(self) -> None:
+        with self._cond:
+            self._shutdown = True
+            self._cond.notify_all()
+
+    def _get(self, stop_event: Optional[threading.Event]) -> Optional[WorkItem]:
+        with self._cond:
+            while True:
+                if self._shutdown or (stop_event is not None and stop_event.is_set()):
+                    return None
+                if self._heap:
+                    ready_at, _, item = self._heap[0]
+                    now = time.monotonic()
+                    if ready_at <= now:
+                        heapq.heappop(self._heap)
+                        return item
+                    self._cond.wait(timeout=min(ready_at - now, 0.5))
+                else:
+                    self._cond.wait(timeout=0.5)
+
+    def _process(self, item: WorkItem) -> None:
+        attempts = self._rl.num_requeues(item.item_id)
+        try:
+            item.callback(item.obj)
+        except Exception as e:  # noqa: BLE001 — retryable by contract
+            self._log(f"reconcile: {e} (attempt {attempts})")
+            with self._cond:
+                current = self._active_ops.get(item.key)
+                if item.key and current is not None and current is not item:
+                    self._log(f"not re-enqueueing '{item.key}': superseded")
+                    self._rl.forget(item.item_id)
+                else:
+                    self._push_locked(item)
+                    self._cond.notify()
+            return
+        with self._cond:
+            if item.key and self._active_ops.get(item.key) is item:
+                del self._active_ops[item.key]
+            self._rl.forget(item.item_id)
+
+    # -- introspection ------------------------------------------------------
+
+    def __len__(self) -> int:
+        with self._cond:
+            return len(self._heap)

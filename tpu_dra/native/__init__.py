@@ -1,0 +1,6 @@
+"""L0 native-layer bindings (reference: cgo go-nvml usage in nvlib.go)."""
+
+from tpu_dra.native.tpuinfo import (  # noqa: F401
+    Chip, HealthEvent, TpuInfoBackend, NativeBackend, FakeBackend,
+    get_backend, make_fake_sysfs, GEN_SPECS,
+)

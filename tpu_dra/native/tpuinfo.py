@@ -1,0 +1,382 @@
+"""Python binding for libtpuinfo + in-process fake backend.
+
+Reference mapping: this module is the seam the reference reaches through cgo
+go-nvml (cmd/gpu-kubelet-plugin/nvlib.go:46-183 `deviceLib`), re-designed so
+every upper layer can run against a hardware-free backend:
+
+- ``NativeBackend`` — ctypes binding to the C++ ``libtpuinfo.so`` (which
+  itself accepts an injectable filesystem root, so even the native path is
+  testable against a synthetic sysfs tree).
+- ``FakeBackend`` — pure-Python, in-process, programmable chips + health
+  event injection; selected with ``TPU_DRA_TPUINFO_BACKEND=fake``.
+
+Both implement ``TpuInfoBackend``. ``get_backend()`` picks by env.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import queue
+import threading
+from dataclasses import dataclass, field, replace
+from typing import Dict, List, Optional, Tuple
+
+# Generation table mirrored from native/src/tpuinfo.cc kGenTable.
+GEN_SPECS: Dict[str, Tuple[int, int]] = {
+    # name -> (tensorcore_count, hbm_bytes)
+    "v4": (2, 32 << 30),
+    "v5e": (1, 16 << 30),
+    "v5p": (2, 95 << 30),
+    "v6e": (1, 32 << 30),
+}
+
+
+@dataclass(frozen=True)
+class Chip:
+    """One TPU chip (GpuInfo analog, nvlib.go:261-385)."""
+    index: int
+    uuid: str
+    generation: str
+    tensorcore_count: int
+    hbm_bytes: int
+    pci_address: str = ""
+    driver_version: str = "unknown"
+    slice_id: str = ""
+    worker_index: int = 0
+    coords: Tuple[int, int, int] = (0, 0, 0)
+    healthy: bool = True
+
+    @property
+    def dev_path(self) -> str:
+        return f"/dev/accel{self.index}"
+
+
+@dataclass(frozen=True)
+class HealthEvent:
+    """Accel-driver health event (NVML Xid/ECC event analog,
+    device_health.go:36-117). chip_index == -1 addresses all chips."""
+    chip_index: int
+    code: int
+    kind: str
+    description: str = ""
+
+
+class TpuInfoBackend:
+    def chips(self) -> List[Chip]:
+        raise NotImplementedError
+
+    def get_chip(self, index: int) -> Chip:
+        for c in self.chips():
+            if c.index == index:
+                return c
+        raise KeyError(f"no chip with index {index}")
+
+    def set_timeslice(self, index: int, interval_us: int) -> None:
+        raise NotImplementedError
+
+    def get_timeslice(self, index: int) -> Optional[int]:
+        raise NotImplementedError
+
+    def set_exclusive_mode(self, index: int, exclusive: bool) -> None:
+        raise NotImplementedError
+
+    def wait_health_event(self, timeout: float) -> Optional[HealthEvent]:
+        """Block up to `timeout` seconds; None on timeout."""
+        raise NotImplementedError
+
+    def driver_version(self) -> str:
+        chips = self.chips()
+        return chips[0].driver_version if chips else "unknown"
+
+    def close(self) -> None:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Native backend (ctypes -> libtpuinfo.so)
+# ---------------------------------------------------------------------------
+
+_MAX_STR = 96
+
+
+class _CChip(ctypes.Structure):
+    _fields_ = [
+        ("index", ctypes.c_int32),
+        ("uuid", ctypes.c_char * _MAX_STR),
+        ("generation", ctypes.c_int32),
+        ("generation_name", ctypes.c_char * 16),
+        ("tensorcore_count", ctypes.c_int32),
+        ("hbm_bytes", ctypes.c_int64),
+        ("pci_address", ctypes.c_char * 32),
+        ("driver_version", ctypes.c_char * 32),
+        ("slice_id", ctypes.c_char * _MAX_STR),
+        ("worker_index", ctypes.c_int32),
+        ("coord_x", ctypes.c_int32),
+        ("coord_y", ctypes.c_int32),
+        ("coord_z", ctypes.c_int32),
+        ("healthy", ctypes.c_int32),
+    ]
+
+
+class _CEvent(ctypes.Structure):
+    _fields_ = [
+        ("chip_index", ctypes.c_int32),
+        ("code", ctypes.c_int32),
+        ("kind", ctypes.c_char * 32),
+        ("description", ctypes.c_char * _MAX_STR),
+    ]
+
+
+def _default_lib_path() -> str:
+    here = os.path.dirname(os.path.abspath(__file__))
+    candidates = [
+        os.environ.get("TPU_DRA_LIBTPUINFO", ""),
+        os.path.join(here, "..", "..", "native", "build", "libtpuinfo.so"),
+        "/usr/local/lib/libtpuinfo.so",
+        "/usr/lib/libtpuinfo.so",
+    ]
+    for c in candidates:
+        if c and os.path.exists(c):
+            return os.path.abspath(c)
+    raise FileNotFoundError(
+        "libtpuinfo.so not found; build with `make -C native` or set "
+        "TPU_DRA_LIBTPUINFO")
+
+
+class NativeBackend(TpuInfoBackend):
+    """Binding to the C++ library. The reference's driver-root resolution
+    (root.go:26-110 locating libnvidia-ml.so.1 under a configurable host
+    root) maps to the lib-path candidates + TPU_DRA_LIBTPUINFO override."""
+
+    _TIMEOUT_STATUS = -4  # TPUINFO_ERR_TIMEOUT
+    _NOT_FOUND_STATUS = -1
+
+    def __init__(self, sysfs_root: str = "", lib_path: Optional[str] = None):
+        self._lib = ctypes.CDLL(lib_path or _default_lib_path())
+        self._lib.tpuinfo_init.argtypes = [ctypes.c_char_p, ctypes.POINTER(ctypes.c_void_p)]
+        self._lib.tpuinfo_get_chip.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, ctypes.POINTER(_CChip)]
+        self._lib.tpuinfo_chip_count.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32)]
+        self._lib.tpuinfo_wait_health_event.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, ctypes.POINTER(_CEvent)]
+        self._lib.tpuinfo_set_timeslice.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32]
+        self._lib.tpuinfo_get_timeslice.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, ctypes.POINTER(ctypes.c_int32)]
+        self._lib.tpuinfo_set_exclusive_mode.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32]
+        self._lib.tpuinfo_status_string.restype = ctypes.c_char_p
+        self._lib.tpuinfo_status_string.argtypes = [ctypes.c_int32]
+
+        ctx = ctypes.c_void_p()
+        st = self._lib.tpuinfo_init(sysfs_root.encode(), ctypes.byref(ctx))
+        if st != 0:
+            raise RuntimeError(f"tpuinfo_init({sysfs_root!r}): {self._strerror(st)}")
+        self._ctx = ctx
+
+    def _strerror(self, st: int) -> str:
+        return self._lib.tpuinfo_status_string(st).decode()
+
+    def _check(self, st: int, what: str) -> None:
+        if st != 0:
+            raise RuntimeError(f"{what}: {self._strerror(st)}")
+
+    def chips(self) -> List[Chip]:
+        n = ctypes.c_int32()
+        self._check(self._lib.tpuinfo_chip_count(self._ctx, ctypes.byref(n)),
+                    "tpuinfo_chip_count")
+        out: List[Chip] = []
+        idx = 0
+        scanned = 0
+        while scanned < n.value and idx < 4096:
+            c = _CChip()
+            st = self._lib.tpuinfo_get_chip(self._ctx, idx, ctypes.byref(c))
+            if st == 0:
+                out.append(Chip(
+                    index=c.index,
+                    uuid=c.uuid.decode(),
+                    generation=c.generation_name.decode(),
+                    tensorcore_count=c.tensorcore_count,
+                    hbm_bytes=c.hbm_bytes,
+                    pci_address=c.pci_address.decode(),
+                    driver_version=c.driver_version.decode(),
+                    slice_id=c.slice_id.decode(),
+                    worker_index=c.worker_index,
+                    coords=(c.coord_x, c.coord_y, c.coord_z),
+                    healthy=bool(c.healthy),
+                ))
+                scanned += 1
+            elif st != self._NOT_FOUND_STATUS:
+                self._check(st, f"tpuinfo_get_chip({idx})")
+            idx += 1
+        return out
+
+    def set_timeslice(self, index: int, interval_us: int) -> None:
+        self._check(self._lib.tpuinfo_set_timeslice(self._ctx, index, interval_us),
+                    f"tpuinfo_set_timeslice({index})")
+
+    def get_timeslice(self, index: int) -> Optional[int]:
+        v = ctypes.c_int32()
+        st = self._lib.tpuinfo_get_timeslice(self._ctx, index, ctypes.byref(v))
+        if st == self._NOT_FOUND_STATUS:
+            return None
+        self._check(st, f"tpuinfo_get_timeslice({index})")
+        return v.value
+
+    def set_exclusive_mode(self, index: int, exclusive: bool) -> None:
+        self._check(self._lib.tpuinfo_set_exclusive_mode(
+            self._ctx, index, 1 if exclusive else 0),
+            f"tpuinfo_set_exclusive_mode({index})")
+
+    def wait_health_event(self, timeout: float) -> Optional[HealthEvent]:
+        ev = _CEvent()
+        st = self._lib.tpuinfo_wait_health_event(
+            self._ctx, int(timeout * 1000), ctypes.byref(ev))
+        if st == self._TIMEOUT_STATUS:
+            return None
+        self._check(st, "tpuinfo_wait_health_event")
+        return HealthEvent(chip_index=ev.chip_index, code=ev.code,
+                           kind=ev.kind.decode(), description=ev.description.decode())
+
+    def close(self) -> None:
+        if getattr(self, "_ctx", None):
+            self._lib.tpuinfo_shutdown(self._ctx)
+            self._ctx = None
+
+
+# ---------------------------------------------------------------------------
+# Fake backend
+# ---------------------------------------------------------------------------
+
+def default_fake_chips(count: int = 4, generation: str = "v5e",
+                       slice_id: str = "", worker_index: int = 0) -> List[Chip]:
+    cores, hbm = GEN_SPECS[generation]
+    return [
+        Chip(index=i, uuid=f"tpu-{generation}-{i:02d}-fake", generation=generation,
+             tensorcore_count=cores, hbm_bytes=hbm,
+             pci_address=f"0000:0{i}:00.0", driver_version="1.0.0-fake",
+             slice_id=slice_id, worker_index=worker_index,
+             coords=(i % 2, i // 2, 0))
+        for i in range(count)
+    ]
+
+
+class FakeBackend(TpuInfoBackend):
+    """In-process fake: programmable chips, settings recorded, health events
+    injectable. This is the unit-test seam the reference lacks (SURVEY §4.1:
+    'no unit tests for device_state/nvlib/cdi — the TPU build should do
+    better here')."""
+
+    def __init__(self, chips: Optional[List[Chip]] = None):
+        if chips is None:
+            count = int(os.environ.get("TPU_DRA_FAKE_CHIPS", "4"))
+            gen = os.environ.get("TPU_DRA_FAKE_GENERATION", "v5e")
+            slice_id = os.environ.get("TPU_DRA_FAKE_SLICE_ID", "")
+            worker = int(os.environ.get("TPU_DRA_FAKE_WORKER_INDEX", "0"))
+            chips = default_fake_chips(count, gen, slice_id, worker)
+        self._chips: Dict[int, Chip] = {c.index: c for c in chips}
+        self.timeslices: Dict[int, int] = {}
+        self.exclusive: Dict[int, bool] = {}
+        self._events: "queue.Queue[HealthEvent]" = queue.Queue()
+        self._lock = threading.Lock()
+
+    def chips(self) -> List[Chip]:
+        with self._lock:
+            return [self._chips[i] for i in sorted(self._chips)]
+
+    def set_timeslice(self, index: int, interval_us: int) -> None:
+        self.get_chip(index)
+        self.timeslices[index] = interval_us
+
+    def get_timeslice(self, index: int) -> Optional[int]:
+        return self.timeslices.get(index)
+
+    def set_exclusive_mode(self, index: int, exclusive: bool) -> None:
+        self.get_chip(index)
+        self.exclusive[index] = exclusive
+
+    def wait_health_event(self, timeout: float) -> Optional[HealthEvent]:
+        try:
+            return self._events.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    # -- test hooks ---------------------------------------------------------
+
+    def inject_health_event(self, event: HealthEvent) -> None:
+        self._events.put(event)
+        if event.kind not in ("info",):
+            with self._lock:
+                for idx in ([event.chip_index] if event.chip_index >= 0
+                            else list(self._chips)):
+                    if idx in self._chips:
+                        self._chips[idx] = replace(self._chips[idx], healthy=False)
+
+    def set_chip(self, chip: Chip) -> None:
+        with self._lock:
+            self._chips[chip.index] = chip
+
+    def remove_chip(self, index: int) -> None:
+        with self._lock:
+            self._chips.pop(index, None)
+
+
+# ---------------------------------------------------------------------------
+# Fake sysfs materialization (drives the *native* lib + tpuctl in tests/CI)
+# ---------------------------------------------------------------------------
+
+def make_fake_sysfs(root: str, chips: List[Chip]) -> str:
+    """Write the accel driver's filesystem ABI for the given chips under
+    `root` (the kind-cluster / CI analog of SURVEY §4.2's simulated accel
+    device directory)."""
+    os.makedirs(os.path.join(root, "dev"), exist_ok=True)
+    class_dir = os.path.join(root, "sys", "class", "accel")
+    os.makedirs(class_dir, exist_ok=True)
+    for chip in chips:
+        # Char device stand-in (a regular file: stat() is what's checked).
+        open(os.path.join(root, "dev", f"accel{chip.index}"), "w").close()
+        dev = os.path.join(class_dir, f"accel{chip.index}", "device")
+        topo = os.path.join(dev, "topology")
+        os.makedirs(topo, exist_ok=True)
+        writes = {
+            os.path.join(dev, "generation"): chip.generation,
+            os.path.join(dev, "uuid"): chip.uuid,
+            os.path.join(dev, "tensorcore_count"): str(chip.tensorcore_count),
+            os.path.join(dev, "hbm_bytes"): str(chip.hbm_bytes),
+            os.path.join(dev, "pci_address"): chip.pci_address,
+            os.path.join(dev, "driver_version"): chip.driver_version,
+            os.path.join(dev, "health"): "ok" if chip.healthy else "failed",
+            os.path.join(topo, "slice_id"): chip.slice_id,
+            os.path.join(topo, "worker_index"): str(chip.worker_index),
+            os.path.join(topo, "coords"): ",".join(map(str, chip.coords)),
+        }
+        for path, content in writes.items():
+            with open(path, "w") as f:
+                f.write(content + "\n")
+    # Health events file exists (empty) so tailing starts cleanly.
+    open(os.path.join(class_dir, "health_events"), "a").close()
+    return root
+
+
+def append_health_event(root: str, event: HealthEvent) -> None:
+    """Append an event record to the fake sysfs tree (native-path injection)."""
+    path = os.path.join(root, "sys", "class", "accel", "health_events")
+    with open(path, "a") as f:
+        f.write(f"{event.chip_index} {event.code} {event.kind} {event.description}\n")
+
+
+def get_backend() -> TpuInfoBackend:
+    """Select backend by TPU_DRA_TPUINFO_BACKEND: 'fake' (default when no
+    accel devices present), 'native'."""
+    choice = os.environ.get("TPU_DRA_TPUINFO_BACKEND", "auto")
+    if choice == "fake":
+        return FakeBackend()
+    if choice == "native":
+        return NativeBackend(sysfs_root=os.environ.get("TPUINFO_SYSFS_ROOT", ""))
+    # auto: native when a real accel class dir exists, else fake
+    root = os.environ.get("TPUINFO_SYSFS_ROOT", "")
+    if os.path.isdir(os.path.join(root or "/", "sys", "class", "accel")):
+        return NativeBackend(sysfs_root=root)
+    return FakeBackend()
