@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Regenerate checked-in protobuf gencode (the check-generate analog of the
+# reference's Makefile:104-163 codegen targets).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+protoc -Itpu_dra/kubeletplugin/protos \
+  --python_out=tpu_dra/kubeletplugin/gen \
+  tpu_dra/kubeletplugin/protos/dra_v1.proto \
+  tpu_dra/kubeletplugin/protos/pluginregistration.proto
+echo "generated into tpu_dra/kubeletplugin/gen"

@@ -1,0 +1,241 @@
+"""k8s machinery tests: fake API server semantics (watch, finalizers,
+resourceVersion conflicts, status subresource), label selectors, informer
+cache/indexes/mutation-cache."""
+
+import threading
+import time
+
+import pytest
+
+from tpu_dra.k8s import (
+    COMPUTEDOMAINS, ConflictError, FakeCluster, GVR, Informer, NODES,
+    NotFoundError, PODS, label_selector_matches,
+)
+from tpu_dra.k8s.informer import label_index, uid_index
+
+
+def pod(name, ns="default", labels=None, finalizers=None):
+    obj = {"apiVersion": "v1", "kind": "Pod",
+           "metadata": {"name": name, "namespace": ns}}
+    if labels:
+        obj["metadata"]["labels"] = labels
+    if finalizers:
+        obj["metadata"]["finalizers"] = finalizers
+    return obj
+
+
+class TestLabelSelector:
+    @pytest.mark.parametrize("sel,labels,want", [
+        ("a=b", {"a": "b"}, True),
+        ("a=b", {"a": "c"}, False),
+        ("a=b,c=d", {"a": "b", "c": "d"}, True),
+        ("a=b,c=d", {"a": "b"}, False),
+        ("a", {"a": "anything"}, True),
+        ("a", {}, False),
+        ("a!=b", {"a": "b"}, False),
+        ("a!=b", {"a": "c"}, True),
+        ("a!=b", {}, True),
+        ("", {"x": "y"}, True),
+        (None, {}, True),
+    ])
+    def test_match(self, sel, labels, want):
+        assert label_selector_matches(sel, labels) is want
+
+
+class TestFakeCluster:
+    def test_crud(self):
+        c = FakeCluster()
+        created = c.create(PODS, pod("p1"))
+        assert created["metadata"]["uid"]
+        assert created["metadata"]["resourceVersion"]
+        got = c.get(PODS, "p1", "default")
+        assert got["metadata"]["name"] == "p1"
+        got["spec"] = {"nodeName": "n1"}
+        updated = c.update(PODS, got)
+        assert updated["spec"]["nodeName"] == "n1"
+        c.delete(PODS, "p1", "default")
+        with pytest.raises(NotFoundError):
+            c.get(PODS, "p1", "default")
+
+    def test_generate_name(self):
+        c = FakeCluster()
+        obj = {"metadata": {"generateName": "claim-", "namespace": "ns"}}
+        from tpu_dra.k8s import RESOURCECLAIMS
+        created = c.create(RESOURCECLAIMS, obj)
+        assert created["metadata"]["name"].startswith("claim-")
+
+    def test_resource_version_conflict(self):
+        c = FakeCluster()
+        c.create(PODS, pod("p1"))
+        a = c.get(PODS, "p1", "default")
+        b = c.get(PODS, "p1", "default")
+        a["metadata"]["labels"] = {"x": "1"}
+        c.update(PODS, a)
+        b["metadata"]["labels"] = {"x": "2"}
+        with pytest.raises(ConflictError):
+            c.update(PODS, b)
+
+    def test_finalizer_blocks_deletion(self):
+        """The CD teardown flow (computedomain.go:237-271) depends on:
+        delete sets deletionTimestamp, object persists until finalizers
+        cleared, then it vanishes."""
+        c = FakeCluster()
+        c.create(COMPUTEDOMAINS, {
+            "metadata": {"name": "cd", "namespace": "ns",
+                         "finalizers": ["resource.tpu.dev/cd"]}})
+        c.delete(COMPUTEDOMAINS, "cd", "ns")
+        obj = c.get(COMPUTEDOMAINS, "cd", "ns")
+        assert obj["metadata"]["deletionTimestamp"]
+        obj["metadata"]["finalizers"] = []
+        c.update(COMPUTEDOMAINS, obj)
+        with pytest.raises(NotFoundError):
+            c.get(COMPUTEDOMAINS, "cd", "ns")
+
+    def test_status_subresource_isolation(self):
+        c = FakeCluster()
+        c.create(COMPUTEDOMAINS, {"metadata": {"name": "cd", "namespace": "ns"},
+                                  "spec": {"numNodes": 2}, "status": {"status": "NotReady"}})
+        # update_status only touches status
+        obj = c.get(COMPUTEDOMAINS, "cd", "ns")
+        obj["status"] = {"status": "Ready"}
+        obj["spec"] = {"numNodes": 99}  # must be ignored by update_status
+        c.update_status(COMPUTEDOMAINS, obj)
+        after = c.get(COMPUTEDOMAINS, "cd", "ns")
+        assert after["status"]["status"] == "Ready"
+        assert after["spec"]["numNodes"] == 2
+        # plain update must not clobber status
+        after["spec"]["numNodes"] = 3
+        after["status"] = {"status": "Bogus"}
+        c.update(COMPUTEDOMAINS, after)
+        final = c.get(COMPUTEDOMAINS, "cd", "ns")
+        assert final["spec"]["numNodes"] == 3
+        assert final["status"]["status"] == "Ready"
+
+    def test_list_label_selector_and_all_namespaces(self):
+        c = FakeCluster()
+        c.create(PODS, pod("a", ns="ns1", labels={"app": "x"}))
+        c.create(PODS, pod("b", ns="ns2", labels={"app": "x"}))
+        c.create(PODS, pod("c", ns="ns1", labels={"app": "y"}))
+        assert len(c.list(PODS, namespace="ns1")) == 2
+        assert len(c.list(PODS, label_selector="app=x")) == 2
+        assert len(c.list(PODS, namespace="ns1", label_selector="app=x")) == 1
+
+    def test_watch_stream(self):
+        c = FakeCluster()
+        stop = threading.Event()
+        events = []
+
+        def consume():
+            for evt in c.watch(PODS, namespace="default", stop=stop):
+                events.append(evt)
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        time.sleep(0.05)
+        c.create(PODS, pod("w1", labels={"a": "b"}))
+        obj = c.get(PODS, "w1", "default")
+        obj["metadata"]["labels"] = {"a": "c"}
+        c.update(PODS, obj)
+        c.delete(PODS, "w1", "default")
+        assert c.wait_for(lambda: len(events) >= 3)
+        stop.set()
+        t.join(2)
+        assert [e[0] for e in events[:3]] == ["ADDED", "MODIFIED", "DELETED"]
+
+    def test_watch_label_filter(self):
+        c = FakeCluster()
+        stop = threading.Event()
+        events = []
+
+        def consume():
+            for evt in c.watch(PODS, label_selector="want=yes", stop=stop):
+                events.append(evt)
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        time.sleep(0.05)
+        c.create(PODS, pod("no", labels={"want": "no"}))
+        c.create(PODS, pod("yes", labels={"want": "yes"}))
+        assert c.wait_for(lambda: len(events) == 1)
+        stop.set()
+        t.join(2)
+        assert events[0][1]["metadata"]["name"] == "yes"
+
+    def test_reactor_error_injection(self):
+        c = FakeCluster()
+
+        def fail_create(verb, gvr, obj):
+            if verb == "create":
+                raise ConflictError("injected")
+            return obj
+
+        c.reactors.append(fail_create)
+        with pytest.raises(ConflictError, match="injected"):
+            c.create(PODS, pod("p"))
+
+    def test_non_namespaced(self):
+        c = FakeCluster()
+        c.create(NODES, {"metadata": {"name": "node-1"}})
+        assert c.get(NODES, "node-1")["metadata"]["name"] == "node-1"
+
+
+class TestInformer:
+    def test_sync_handlers_and_lister(self):
+        c = FakeCluster()
+        c.create(PODS, pod("pre", labels={"app": "t"}))
+        inf = Informer(c, PODS, namespace="default")
+        adds, updates, deletes = [], [], []
+        inf.on_add(lambda o: adds.append(o["metadata"]["name"]))
+        inf.on_update(lambda old, new: updates.append(new["metadata"]["name"]))
+        inf.on_delete(lambda o: deletes.append(o["metadata"]["name"]))
+        inf.start()
+        assert inf.wait_for_sync()
+        assert adds == ["pre"]
+        c.create(PODS, pod("live"))
+        assert c.wait_for(lambda: "live" in adds)
+        obj = c.get(PODS, "live", "default")
+        obj["metadata"]["labels"] = {"x": "1"}
+        c.update(PODS, obj)
+        assert c.wait_for(lambda: updates == ["live"])
+        c.delete(PODS, "live", "default")
+        assert c.wait_for(lambda: deletes == ["live"])
+        assert inf.lister.get("pre", "default") is not None
+        assert inf.lister.get("live", "default") is None
+        inf.stop()
+
+    def test_uid_and_label_index(self):
+        c = FakeCluster()
+        created = c.create(PODS, pod("p1", labels={"cd-uid": "u-42"}))
+        inf = Informer(c, PODS)
+        inf.add_indexer("uid", uid_index)
+        inf.add_indexer("cd", label_index("cd-uid"))
+        inf.start()
+        assert inf.wait_for_sync()
+        assert inf.get_by_index("uid", created["metadata"]["uid"])[0][
+            "metadata"]["name"] == "p1"
+        assert len(inf.get_by_index("cd", "u-42")) == 1
+        assert inf.get_by_index("cd", "nope") == []
+        inf.stop()
+
+    def test_mutation_cache(self):
+        c = FakeCluster()
+        inf = Informer(c, PODS)
+        inf.start()
+        assert inf.wait_for_sync()
+        inf.stop()  # watch is down: only the mutation cache can see this
+        created = c.create(PODS, pod("own-write"))
+        inf.update_cache(created)
+        assert inf.lister.get("own-write", "default") is not None
+
+    def test_field_filter(self):
+        """Name-filtered informer (cd-daemon controller.go name filter)."""
+        c = FakeCluster()
+        inf = Informer(c, PODS, field_filter=lambda o: o["metadata"]["name"] == "mine")
+        inf.start()
+        assert inf.wait_for_sync()
+        c.create(PODS, pod("mine"))
+        c.create(PODS, pod("other"))
+        assert c.wait_for(lambda: inf.lister.get("mine", "default") is not None)
+        time.sleep(0.05)
+        assert inf.lister.get("other", "default") is None
+        inf.stop()

@@ -59,10 +59,13 @@ ALLOCATION_MODE_ALL = "All"
 TimeSlicingStrategy = "TimeSlicing"
 MultiprocessStrategy = "Multiprocess"
 
-# Time-slice intervals (sharing.go: Default/Short/Medium/Long -> 0..3; the
-# int is what the node-side time-slice manager programs into the accel
-# driver's scheduler, mirroring `nvidia-smi compute-policy --set-timeslice`).
-TIME_SLICE_INTERVALS = {"Default": 0, "Short": 1, "Medium": 2, "Long": 3}
+# Time-slice intervals (sharing.go: Default/Short/Medium/Long). The value is
+# the program-scheduler quantum in microseconds that the node-side manager
+# programs into the accel driver (the `nvidia-smi compute-policy
+# --set-timeslice` analog); "Default" (0) resets to the driver default.
+# Single source of truth — the sharing manager indexes this same map.
+TIME_SLICE_INTERVALS = {"Default": 0, "Short": 1000, "Medium": 5000,
+                        "Long": 20000}
 DEFAULT_TIME_SLICE = "Default"
 
 
@@ -109,7 +112,7 @@ class TimeSlicingConfig:
                 f"unknown time-slice interval: {self.interval!r} "
                 f"(must be one of {sorted(TIME_SLICE_INTERVALS)})")
 
-    def int_value(self) -> int:
+    def interval_us(self) -> int:
         return TIME_SLICE_INTERVALS[self.interval]
 
 

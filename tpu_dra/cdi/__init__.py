@@ -1,0 +1,3 @@
+"""L1 container integration via CDI (reference: cmd/gpu-kubelet-plugin/cdi.go)."""
+
+from tpu_dra.cdi.handler import CDIHandler, CDI_VENDOR, CDI_CLASS_CHIP, CDI_CLASS_CLAIM  # noqa: F401

@@ -1,0 +1,169 @@
+"""CDI spec generation for TPU chips.
+
+Reference: cmd/gpu-kubelet-plugin/cdi.go:72-386. The reference writes two
+kinds of specs into /var/run/cdi for the container runtime to apply:
+
+- one "standard" per-node spec (class ``chip`` here, ``device`` there)
+  with the per-device edits — device nodes, driver library mounts — built
+  by nvidia-container-toolkit's nvcdi (CreateStandardDeviceSpecFile
+  :170-294), and
+- one transient per-claim spec (class ``claim``) carrying claim-scoped
+  edits: sharing env, MPS pipe mounts (CreateClaimSpecFile :296-335).
+
+The TPU translation is deliberately simpler (SURVEY §2.9): a container
+needs ``/dev/accelN`` + ``/dev/vfio`` device nodes, the libtpu shared
+library (mounted from a configurable driver root), and env:
+``TPU_VISIBLE_CHIPS`` (chip selection), ``TPU_PROCESS_BOUNDS`` /
+``TPU_CHIPS_PER_PROCESS_BOUNDS`` (topology), plus per-claim sharing /
+ComputeDomain coordination env. There is no hook binary; the reference's
+``NVIDIA_VISIBLE_DEVICES=void`` guard (cdi.go forcing the toolkit's
+injection off) maps to ``TPU_SKIP_MDS_QUERY`` and explicit env-only
+control.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+from tpu_dra.native.tpuinfo import Chip
+
+CDI_VERSION = "0.5.0"
+CDI_VENDOR = "k8s.tpu.dev"
+CDI_CLASS_CHIP = "chip"
+CDI_CLASS_CLAIM = "claim"
+
+CDI_KIND_CHIP = f"{CDI_VENDOR}/{CDI_CLASS_CHIP}"
+CDI_KIND_CLAIM = f"{CDI_VENDOR}/{CDI_CLASS_CLAIM}"
+
+
+class CDIHandler:
+    """Writes CDI specs to `cdi_root` (host /var/run/cdi, flag-configurable
+    like CDI_ROOT in main.go:96-102)."""
+
+    def __init__(self, cdi_root: str, driver_root: str = "/",
+                 libtpu_path: Optional[str] = None, dev_root: str = "/"):
+        self._cdi_root = cdi_root
+        self._driver_root = driver_root.rstrip("/") or "/"
+        self._dev_root = dev_root.rstrip("/") or "/"
+        # libtpu discovery under the driver root (root.go:26-69
+        # getDriverLibraryPath analog).
+        self._libtpu_path = libtpu_path or self._find_libtpu()
+        os.makedirs(cdi_root, exist_ok=True)
+
+    def _find_libtpu(self) -> Optional[str]:
+        for cand in ("lib/libtpu.so", "usr/lib/libtpu.so",
+                     "usr/local/lib/libtpu.so",
+                     "usr/local/lib/python3/dist-packages/libtpu/libtpu.so"):
+            path = os.path.join(self._driver_root, cand)
+            if os.path.exists(path):
+                return path
+        return None
+
+    # -- spec paths ---------------------------------------------------------
+
+    def _standard_spec_path(self) -> str:
+        return os.path.join(self._cdi_root, f"{CDI_VENDOR}-{CDI_CLASS_CHIP}.json")
+
+    def _claim_spec_path(self, claim_uid: str) -> str:
+        return os.path.join(self._cdi_root,
+                            f"{CDI_VENDOR}-{CDI_CLASS_CLAIM}_{claim_uid}.json")
+
+    # -- device ids ---------------------------------------------------------
+
+    def get_standard_device(self, chip_uuid: str) -> str:
+        """Fully-qualified CDI id for a chip (GetStandardDevice analog)."""
+        return f"{CDI_KIND_CHIP}={chip_uuid}"
+
+    def get_claim_device(self, claim_uid: str) -> str:
+        return f"{CDI_KIND_CLAIM}={claim_uid}"
+
+    # -- spec generation ----------------------------------------------------
+
+    def create_standard_device_spec_file(self, chips: List[Chip]) -> str:
+        """Per-node spec: one CDI device per chip with its /dev/accelN node
+        and the libtpu mount (CreateStandardDeviceSpecFile analog)."""
+        devices = []
+        for chip in chips:
+            edits: Dict = {
+                "deviceNodes": [{
+                    "path": chip.dev_path,
+                    "hostPath": os.path.join(self._dev_root,
+                                             chip.dev_path.lstrip("/")),
+                }],
+                "env": [
+                    f"TPU_CHIP_{chip.index}_UUID={chip.uuid}",
+                ],
+            }
+            devices.append({"name": chip.uuid, "containerEdits": edits})
+
+        container_edits: Dict = {
+            # Applied once per container using any chip device: mount libtpu
+            # and neutralize ambient device injection (the
+            # NVIDIA_VISIBLE_DEVICES=void analog).
+            "env": ["TPU_SKIP_MDS_QUERY=true"],
+        }
+        if self._libtpu_path:
+            container_edits["mounts"] = [{
+                "hostPath": self._libtpu_path,
+                "containerPath": "/lib/libtpu.so",
+                "options": ["ro", "nosuid", "nodev", "bind"],
+            }]
+
+        spec = {
+            "cdiVersion": CDI_VERSION,
+            "kind": CDI_KIND_CHIP,
+            "devices": devices,
+            "containerEdits": container_edits,
+        }
+        path = self._standard_spec_path()
+        _atomic_write_json(path, spec)
+        return path
+
+    def create_claim_spec_file(self, claim_uid: str,
+                               env: Dict[str, str],
+                               mounts: Optional[List[Dict]] = None,
+                               device_nodes: Optional[List[Dict]] = None) -> str:
+        """Transient per-claim spec carrying claim-scoped edits — sharing
+        env, ComputeDomain coordination env, multiprocess mounts
+        (CreateClaimSpecFile analog)."""
+        edits: Dict = {"env": [f"{k}={v}" for k, v in sorted(env.items())]}
+        if mounts:
+            edits["mounts"] = mounts
+        if device_nodes:
+            edits["deviceNodes"] = device_nodes
+        spec = {
+            "cdiVersion": CDI_VERSION,
+            "kind": CDI_KIND_CLAIM,
+            "devices": [{"name": claim_uid, "containerEdits": edits}],
+        }
+        path = self._claim_spec_path(claim_uid)
+        _atomic_write_json(path, spec)
+        return path
+
+    def delete_claim_spec_file(self, claim_uid: str) -> None:
+        try:
+            os.unlink(self._claim_spec_path(claim_uid))
+        except FileNotFoundError:
+            pass
+
+    def read_spec(self, path: str) -> Dict:
+        with open(path) as f:
+            return json.load(f)
+
+
+def _atomic_write_json(path: str, doc: Dict) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+    os.replace(tmp, path)
+
+
+def visible_chips_env(chip_indices: List[int]) -> Dict[str, str]:
+    """The core TPU selection env consumed by libtpu/JAX."""
+    return {
+        "TPU_VISIBLE_CHIPS": ",".join(str(i) for i in sorted(chip_indices)),
+        "TPU_CHIPS_PER_PROCESS_BOUNDS": f"{len(chip_indices)},1,1",
+        "TPU_PROCESS_BOUNDS": "1,1,1",
+    }

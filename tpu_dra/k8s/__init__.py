@@ -1,0 +1,20 @@
+"""Kubernetes client machinery.
+
+Replaces what the reference pulls from client-go plus its generated
+clientset/informers/listers (pkg/nvidia.com, SURVEY §2.2): a typed-enough
+REST client over stdlib HTTP, list+watch informers with indexers, and an
+in-memory fake API server with real watch/finalizer semantics for tests
+(the fake-clientset analog).
+"""
+
+from tpu_dra.k8s.client import (  # noqa: F401
+    ApiClient, ApiError, ConflictError, NotFoundError, GVR, HttpApiClient,
+    label_selector_matches,
+)
+from tpu_dra.k8s.resources import (  # noqa: F401
+    PODS, NODES, DAEMONSETS, DEPLOYMENTS, RESOURCECLAIMS,
+    RESOURCECLAIMTEMPLATES, RESOURCESLICES, DEVICECLASSES, COMPUTEDOMAINS,
+    new_object_meta,
+)
+from tpu_dra.k8s.fake import FakeCluster  # noqa: F401
+from tpu_dra.k8s.informer import Informer, Lister  # noqa: F401

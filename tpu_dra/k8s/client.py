@@ -1,0 +1,269 @@
+"""Kubernetes REST client over stdlib HTTP.
+
+Replaces client-go's rest.Config/ClientSets (reference:
+pkg/flags/kubeclient.go:33-147 builds Core/Nvidia/Resource clientsets from
+either kubeconfig or in-cluster config). Objects are plain dicts
+("unstructured"); typed behavior lives in the API layer.
+
+Supports: CRUD + status subresource, JSON merge-patch, list with
+label/field selectors, and streaming watch (chunked JSON lines), with
+in-cluster service-account config discovery.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import ssl
+import threading
+import urllib.parse
+import urllib.request
+from dataclasses import dataclass
+from typing import Any, Dict, Generator, List, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class GVR:
+    """Group/version/resource coordinate; group '' = core."""
+    group: str
+    version: str
+    plural: str
+    namespaced: bool = True
+
+    def path(self, namespace: Optional[str] = None, name: Optional[str] = None,
+             subresource: Optional[str] = None) -> str:
+        base = f"/api/{self.version}" if not self.group else f"/apis/{self.group}/{self.version}"
+        parts = [base]
+        if self.namespaced and namespace:
+            parts.append(f"namespaces/{namespace}")
+        parts.append(self.plural)
+        if name:
+            parts.append(name)
+        if subresource:
+            parts.append(subresource)
+        return "/".join(parts)
+
+    @property
+    def key(self) -> str:
+        return f"{self.group or 'core'}/{self.version}/{self.plural}"
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(f"{status}: {message}")
+        self.status = status
+        self.message = message
+
+
+class NotFoundError(ApiError):
+    def __init__(self, message: str = "not found"):
+        super().__init__(404, message)
+
+
+class ConflictError(ApiError):
+    def __init__(self, message: str = "conflict"):
+        super().__init__(409, message)
+
+
+class AlreadyExistsError(ApiError):
+    def __init__(self, message: str = "already exists"):
+        super().__init__(409, message)
+
+
+def parse_label_selector(selector: str) -> List[Tuple[str, Optional[str]]]:
+    """Parse 'k=v,k2,k3!=x' into [(key, value|None)] (None = exists).
+    '!=' terms are represented as (key, ('!=', value))."""
+    terms: List[Tuple[str, Any]] = []
+    for part in filter(None, (p.strip() for p in (selector or "").split(","))):
+        if "!=" in part:
+            k, _, v = part.partition("!=")
+            terms.append((k.strip(), ("!=", v.strip())))
+        elif "=" in part:
+            k, _, v = part.partition("=")
+            terms.append((k.strip().rstrip("="), v.strip()))
+        else:
+            terms.append((part, None))
+    return terms
+
+
+def label_selector_matches(selector: Optional[str], labels: Dict[str, str]) -> bool:
+    if not selector:
+        return True
+    for key, want in parse_label_selector(selector):
+        if want is None:
+            if key not in labels:
+                return False
+        elif isinstance(want, tuple):
+            if labels.get(key) == want[1]:
+                return False
+        elif labels.get(key) != want:
+            return False
+    return True
+
+
+class ApiClient:
+    """Abstract client surface shared by HttpApiClient and FakeCluster."""
+
+    def get(self, gvr: GVR, name: str, namespace: Optional[str] = None) -> Dict:
+        raise NotImplementedError
+
+    def list(self, gvr: GVR, namespace: Optional[str] = None,
+             label_selector: Optional[str] = None) -> List[Dict]:
+        raise NotImplementedError
+
+    def create(self, gvr: GVR, obj: Dict, namespace: Optional[str] = None) -> Dict:
+        raise NotImplementedError
+
+    def update(self, gvr: GVR, obj: Dict, namespace: Optional[str] = None) -> Dict:
+        raise NotImplementedError
+
+    def update_status(self, gvr: GVR, obj: Dict, namespace: Optional[str] = None) -> Dict:
+        raise NotImplementedError
+
+    def patch(self, gvr: GVR, name: str, patch: Dict,
+              namespace: Optional[str] = None) -> Dict:
+        """JSON merge-patch (RFC 7386)."""
+        raise NotImplementedError
+
+    def delete(self, gvr: GVR, name: str, namespace: Optional[str] = None) -> None:
+        raise NotImplementedError
+
+    def watch(self, gvr: GVR, namespace: Optional[str] = None,
+              label_selector: Optional[str] = None,
+              resource_version: Optional[str] = None,
+              stop: Optional[threading.Event] = None,
+              ) -> Generator[Tuple[str, Dict], None, None]:
+        """Yield (event_type, object): ADDED/MODIFIED/DELETED/BOOKMARK."""
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+
+IN_CLUSTER_TOKEN = "/var/run/secrets/kubernetes.io/serviceaccount/token"  # noqa: S105
+IN_CLUSTER_CA = "/var/run/secrets/kubernetes.io/serviceaccount/ca.crt"
+IN_CLUSTER_NS = "/var/run/secrets/kubernetes.io/serviceaccount/namespace"
+
+
+class HttpApiClient(ApiClient):
+    """Stdlib-HTTP client. Config resolution mirrors KubeClientConfig
+    (kubeclient.go): explicit base URL flag > in-cluster env
+    (KUBERNETES_SERVICE_HOST + service account files)."""
+
+    def __init__(self, base_url: Optional[str] = None,
+                 token: Optional[str] = None, ca_file: Optional[str] = None,
+                 insecure: bool = False, timeout: float = 30.0):
+        if base_url is None:
+            host = os.environ.get("KUBERNETES_SERVICE_HOST")
+            port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            if not host:
+                raise ValueError(
+                    "no API server URL given and not running in-cluster")
+            base_url = f"https://{host}:{port}"
+            if token is None and os.path.exists(IN_CLUSTER_TOKEN):
+                token = open(IN_CLUSTER_TOKEN).read().strip()
+            if ca_file is None and os.path.exists(IN_CLUSTER_CA):
+                ca_file = IN_CLUSTER_CA
+        self._base = base_url.rstrip("/")
+        self._token = token
+        self._timeout = timeout
+        if self._base.startswith("https"):
+            if insecure:
+                self._ssl = ssl._create_unverified_context()  # noqa: S323
+            else:
+                self._ssl = ssl.create_default_context(cafile=ca_file)
+        else:
+            self._ssl = None
+
+    # -- plumbing -----------------------------------------------------------
+
+    def _request(self, method: str, path: str, body: Optional[Dict] = None,
+                 query: Optional[Dict[str, str]] = None,
+                 content_type: str = "application/json") -> Dict:
+        url = self._base + path
+        if query:
+            url += "?" + urllib.parse.urlencode(query)
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Accept", "application/json")
+        if data is not None:
+            req.add_header("Content-Type", content_type)
+        if self._token:
+            req.add_header("Authorization", f"Bearer {self._token}")
+        try:
+            with urllib.request.urlopen(req, timeout=self._timeout,
+                                        context=self._ssl) as resp:
+                payload = resp.read()
+                return json.loads(payload) if payload else {}
+        except urllib.error.HTTPError as e:
+            msg = e.read().decode(errors="replace")
+            if e.code == 404:
+                raise NotFoundError(msg) from e
+            if e.code == 409:
+                raise ConflictError(msg) from e
+            raise ApiError(e.code, msg) from e
+
+    # -- verbs --------------------------------------------------------------
+
+    def get(self, gvr, name, namespace=None):
+        return self._request("GET", gvr.path(namespace, name))
+
+    def list(self, gvr, namespace=None, label_selector=None):
+        query = {}
+        if label_selector:
+            query["labelSelector"] = label_selector
+        out = self._request("GET", gvr.path(namespace), query=query or None)
+        return out.get("items", [])
+
+    def create(self, gvr, obj, namespace=None):
+        ns = namespace or obj.get("metadata", {}).get("namespace")
+        return self._request("POST", gvr.path(ns), body=obj)
+
+    def update(self, gvr, obj, namespace=None):
+        meta = obj.get("metadata", {})
+        ns = namespace or meta.get("namespace")
+        return self._request("PUT", gvr.path(ns, meta["name"]), body=obj)
+
+    def update_status(self, gvr, obj, namespace=None):
+        meta = obj.get("metadata", {})
+        ns = namespace or meta.get("namespace")
+        return self._request("PUT", gvr.path(ns, meta["name"], "status"), body=obj)
+
+    def patch(self, gvr, name, patch, namespace=None):
+        return self._request("PATCH", gvr.path(namespace, name), body=patch,
+                             content_type="application/merge-patch+json")
+
+    def delete(self, gvr, name, namespace=None):
+        try:
+            self._request("DELETE", gvr.path(namespace, name))
+        except NotFoundError:
+            pass
+
+    def watch(self, gvr, namespace=None, label_selector=None,
+              resource_version=None, stop=None):
+        query = {"watch": "true"}
+        if label_selector:
+            query["labelSelector"] = label_selector
+        if resource_version:
+            query["resourceVersion"] = resource_version
+        url = self._base + gvr.path(namespace) + "?" + urllib.parse.urlencode(query)
+        req = urllib.request.Request(url)
+        if self._token:
+            req.add_header("Authorization", f"Bearer {self._token}")
+        with urllib.request.urlopen(req, timeout=self._timeout,
+                                    context=self._ssl) as resp:
+            buf = b""
+            while stop is None or not stop.is_set():
+                try:
+                    chunk = resp.read1(65536)
+                except socket.timeout:
+                    continue
+                if not chunk:
+                    return
+                buf += chunk
+                while b"\n" in buf:
+                    line, _, buf = buf.partition(b"\n")
+                    if not line.strip():
+                        continue
+                    evt = json.loads(line)
+                    yield evt.get("type", ""), evt.get("object", {})

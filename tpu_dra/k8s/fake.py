@@ -1,0 +1,249 @@
+"""In-memory fake Kubernetes API server.
+
+The analog of the reference's generated fake clientset
+(pkg/nvidia.com/clientset/versioned/fake) — but covering every group the
+driver touches, with watch streams, resourceVersion bumping, finalizer-aware
+deletion, and optimistic-concurrency conflicts, so controller logic can be
+tested against realistic apiserver semantics without a cluster.
+"""
+
+from __future__ import annotations
+
+import copy
+import itertools
+import queue
+import threading
+import uuid
+from typing import Dict, Generator, List, Optional, Tuple
+
+from tpu_dra.k8s.client import (
+    AlreadyExistsError, ApiClient, ConflictError, GVR, NotFoundError,
+    label_selector_matches,
+)
+from tpu_dra.k8s.resources import now_rfc3339
+
+
+class _Watcher:
+    def __init__(self, gvr_key: str, namespace: Optional[str],
+                 selector: Optional[str]):
+        self.gvr_key = gvr_key
+        self.namespace = namespace
+        self.selector = selector
+        self.events: "queue.Queue[Tuple[str, Dict]]" = queue.Queue()
+        self.closed = False
+
+
+class FakeCluster(ApiClient):
+    """Thread-safe in-memory object store implementing the ApiClient surface."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        # (gvr.key, namespace or "") -> name -> object
+        self._store: Dict[Tuple[str, str], Dict[str, Dict]] = {}
+        self._rv = itertools.count(1)
+        self._watchers: List[_Watcher] = []
+        # Hooks for tests: callables (verb, gvr, obj) -> obj|None run before
+        # the verb; raising simulates apiserver errors (webhook analog).
+        self.reactors = []
+
+    # -- helpers ------------------------------------------------------------
+
+    def _ns_key(self, gvr: GVR, namespace: Optional[str], obj: Optional[Dict] = None
+                ) -> Tuple[str, str]:
+        ns = ""
+        if gvr.namespaced:
+            ns = namespace or (obj or {}).get("metadata", {}).get("namespace") or "default"
+        return (gvr.key, ns)
+
+    def _bump(self, obj: Dict) -> None:
+        obj.setdefault("metadata", {})["resourceVersion"] = str(next(self._rv))
+
+    def _emit(self, gvr: GVR, ns: str, event_type: str, obj: Dict) -> None:
+        labels = obj.get("metadata", {}).get("labels", {}) or {}
+        for w in list(self._watchers):
+            if w.closed or w.gvr_key != gvr.key:
+                continue
+            if w.namespace and gvr.namespaced and w.namespace != ns:
+                continue
+            if not label_selector_matches(w.selector, labels):
+                continue
+            w.events.put((event_type, copy.deepcopy(obj)))
+
+    def _run_reactors(self, verb: str, gvr: GVR, obj: Optional[Dict]):
+        for r in self.reactors:
+            out = r(verb, gvr, obj)
+            if out is not None:
+                obj = out
+        return obj
+
+    # -- verbs --------------------------------------------------------------
+
+    def get(self, gvr, name, namespace=None):
+        with self._lock:
+            objs = self._store.get(self._ns_key(gvr, namespace), {})
+            if name not in objs:
+                raise NotFoundError(f"{gvr.plural}/{name}")
+            return copy.deepcopy(objs[name])
+
+    def list(self, gvr, namespace=None, label_selector=None):
+        with self._lock:
+            if gvr.namespaced and namespace is None:
+                buckets = [v for (k, _ns), v in self._store.items() if k == gvr.key]
+            else:
+                buckets = [self._store.get(self._ns_key(gvr, namespace), {})]
+            out = []
+            for bucket in buckets:
+                for obj in bucket.values():
+                    labels = obj.get("metadata", {}).get("labels", {}) or {}
+                    if label_selector_matches(label_selector, labels):
+                        out.append(copy.deepcopy(obj))
+            out.sort(key=lambda o: (o["metadata"].get("namespace", ""),
+                                    o["metadata"]["name"]))
+            return out
+
+    def create(self, gvr, obj, namespace=None):
+        with self._lock:
+            obj = copy.deepcopy(obj)
+            obj = self._run_reactors("create", gvr, obj)
+            meta = obj.setdefault("metadata", {})
+            # generateName support (ResourceClaims from templates use it).
+            if "name" not in meta and meta.get("generateName"):
+                meta["name"] = meta["generateName"] + uuid.uuid4().hex[:6]
+            key = self._ns_key(gvr, namespace, obj)
+            if gvr.namespaced:
+                meta.setdefault("namespace", key[1])
+            bucket = self._store.setdefault(key, {})
+            if meta["name"] in bucket:
+                raise AlreadyExistsError(f"{gvr.plural}/{meta['name']}")
+            meta.setdefault("uid", str(uuid.uuid4()))
+            meta.setdefault("creationTimestamp", now_rfc3339())
+            self._bump(obj)
+            bucket[meta["name"]] = obj
+            self._emit(gvr, key[1], "ADDED", obj)
+            return copy.deepcopy(obj)
+
+    def _update_impl(self, gvr, obj, namespace, subresource: Optional[str]):
+        with self._lock:
+            obj = copy.deepcopy(obj)
+            obj = self._run_reactors("update", gvr, obj)
+            meta = obj.get("metadata", {})
+            key = self._ns_key(gvr, namespace, obj)
+            bucket = self._store.get(key, {})
+            name = meta.get("name", "")
+            if name not in bucket:
+                raise NotFoundError(f"{gvr.plural}/{name}")
+            current = bucket[name]
+            want_rv = meta.get("resourceVersion")
+            if want_rv and want_rv != current["metadata"].get("resourceVersion"):
+                raise ConflictError(
+                    f"{gvr.plural}/{name}: resourceVersion mismatch")
+            if subresource == "status":
+                merged = copy.deepcopy(current)
+                merged["status"] = copy.deepcopy(obj.get("status"))
+            else:
+                merged = obj
+                # status subresource: spec-updates do not touch status
+                if "status" in current and gvr.key in _STATUS_SUBRESOURCE:
+                    merged["status"] = copy.deepcopy(current["status"])
+                # preserve immutable server-side fields
+                merged["metadata"]["uid"] = current["metadata"].get("uid")
+                merged["metadata"].setdefault(
+                    "creationTimestamp", current["metadata"].get("creationTimestamp"))
+                if "deletionTimestamp" in current["metadata"]:
+                    merged["metadata"]["deletionTimestamp"] = \
+                        current["metadata"]["deletionTimestamp"]
+            self._bump(merged)
+            bucket[name] = merged
+            self._emit(gvr, key[1], "MODIFIED", merged)
+            # Finalizer-aware GC: a deleting object whose finalizers emptied
+            # out is removed (apiserver behavior the CD teardown relies on).
+            if (merged["metadata"].get("deletionTimestamp")
+                    and not merged["metadata"].get("finalizers")):
+                del bucket[name]
+                self._emit(gvr, key[1], "DELETED", merged)
+            return copy.deepcopy(merged)
+
+    def update(self, gvr, obj, namespace=None):
+        return self._update_impl(gvr, obj, namespace, None)
+
+    def update_status(self, gvr, obj, namespace=None):
+        return self._update_impl(gvr, obj, namespace, "status")
+
+    def patch(self, gvr, name, patch, namespace=None):
+        with self._lock:
+            current = self.get(gvr, name, namespace)
+            merged = _merge_patch(current, patch)
+            merged["metadata"]["name"] = name
+            return self._update_impl(gvr, merged, namespace, None)
+
+    def delete(self, gvr, name, namespace=None):
+        with self._lock:
+            self._run_reactors("delete", gvr, None)
+            key = self._ns_key(gvr, namespace)
+            bucket = self._store.get(key, {})
+            if name not in bucket:
+                return
+            obj = bucket[name]
+            finalizers = obj.get("metadata", {}).get("finalizers") or []
+            if finalizers:
+                if not obj["metadata"].get("deletionTimestamp"):
+                    obj["metadata"]["deletionTimestamp"] = now_rfc3339()
+                    self._bump(obj)
+                    self._emit(gvr, key[1], "MODIFIED", obj)
+                return
+            del bucket[name]
+            self._emit(gvr, key[1], "DELETED", obj)
+
+    def watch(self, gvr, namespace=None, label_selector=None,
+              resource_version=None, stop=None
+              ) -> Generator[Tuple[str, Dict], None, None]:
+        w = _Watcher(gvr.key, namespace if gvr.namespaced else None, label_selector)
+        with self._lock:
+            self._watchers.append(w)
+        try:
+            while stop is None or not stop.is_set():
+                try:
+                    yield w.events.get(timeout=0.1)
+                except queue.Empty:
+                    continue
+        finally:
+            w.closed = True
+            with self._lock:
+                if w in self._watchers:
+                    self._watchers.remove(w)
+
+    # -- test conveniences --------------------------------------------------
+
+    def wait_for(self, predicate, timeout: float = 5.0, interval: float = 0.02) -> bool:
+        import time
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if predicate():
+                return True
+            time.sleep(interval)
+        return predicate()
+
+
+# GVR keys whose status is a separate subresource (spec updates don't clobber
+# status). Our CRD declares the status subresource like the reference's.
+_STATUS_SUBRESOURCE = {
+    "resource.tpu.dev/v1beta1/computedomains",
+    "apps/v1/daemonsets",
+    "apps/v1/deployments",
+    "core/v1/pods",
+    "core/v1/nodes",
+    "resource.k8s.io/v1/resourceclaims",
+}
+
+
+def _merge_patch(target: Dict, patch: Dict) -> Dict:
+    """RFC 7386 JSON merge-patch."""
+    if not isinstance(patch, dict):
+        return copy.deepcopy(patch)
+    out = copy.deepcopy(target) if isinstance(target, dict) else {}
+    for k, v in patch.items():
+        if v is None:
+            out.pop(k, None)
+        else:
+            out[k] = _merge_patch(out.get(k), v)
+    return out

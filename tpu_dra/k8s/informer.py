@@ -1,0 +1,214 @@
+"""List+watch informer with indexers and a mutation cache.
+
+Replaces the generated informers/listers of pkg/nvidia.com plus the
+controller patterns built on them: uid indexers (cd-controller
+indexers.go:30-80), label indexers (computeDomainLabel), and the mutation
+cache the DaemonSet manager uses to see its own writes
+(daemonset.go mutation cache).
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+from typing import Callable, Dict, List, Optional
+
+from tpu_dra.k8s.client import ApiClient, GVR
+
+
+def meta_namespace_key(obj: Dict) -> str:
+    meta = obj.get("metadata", {})
+    ns = meta.get("namespace", "")
+    return f"{ns}/{meta['name']}" if ns else meta["name"]
+
+
+def uid_index(obj: Dict) -> List[str]:
+    uid = obj.get("metadata", {}).get("uid")
+    return [uid] if uid else []
+
+
+def label_index(label: str) -> Callable[[Dict], List[str]]:
+    def fn(obj: Dict) -> List[str]:
+        val = (obj.get("metadata", {}).get("labels") or {}).get(label)
+        return [val] if val else []
+    return fn
+
+
+class Lister:
+    """Read access to an informer's cache (the lister analog)."""
+
+    def __init__(self, store: Dict[str, Dict], lock: threading.RLock):
+        self._store = store
+        self._lock = lock
+
+    def get(self, name: str, namespace: str = "") -> Optional[Dict]:
+        key = f"{namespace}/{name}" if namespace else name
+        with self._lock:
+            obj = self._store.get(key)
+            return copy.deepcopy(obj) if obj else None
+
+    def list(self) -> List[Dict]:
+        with self._lock:
+            return [copy.deepcopy(o) for o in self._store.values()]
+
+
+class Informer:
+    """Single-resource informer. Handlers run on the watch thread; keep them
+    quick and enqueue real work to a WorkQueue (the reference's pattern)."""
+
+    def __init__(self, client: ApiClient, gvr: GVR,
+                 namespace: Optional[str] = None,
+                 label_selector: Optional[str] = None,
+                 field_filter: Optional[Callable[[Dict], bool]] = None):
+        self._client = client
+        self._gvr = gvr
+        self._namespace = namespace
+        self._selector = label_selector
+        self._field_filter = field_filter
+        self._store: Dict[str, Dict] = {}
+        self._lock = threading.RLock()
+        self._indexers: Dict[str, Callable[[Dict], List[str]]] = {}
+        self._indices: Dict[str, Dict[str, Dict[str, Dict]]] = {}
+        self._add_handlers: List[Callable[[Dict], None]] = []
+        self._update_handlers: List[Callable[[Dict, Dict], None]] = []
+        self._delete_handlers: List[Callable[[Dict], None]] = []
+        self._synced = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.lister = Lister(self._store, self._lock)
+
+    # -- configuration (before start) ---------------------------------------
+
+    def add_indexer(self, name: str, fn: Callable[[Dict], List[str]]) -> None:
+        self._indexers[name] = fn
+        self._indices[name] = {}
+
+    def on_add(self, fn: Callable[[Dict], None]) -> None:
+        self._add_handlers.append(fn)
+
+    def on_update(self, fn: Callable[[Dict, Dict], None]) -> None:
+        self._update_handlers.append(fn)
+
+    def on_delete(self, fn: Callable[[Dict], None]) -> None:
+        self._delete_handlers.append(fn)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"informer-{self._gvr.plural}")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def wait_for_sync(self, timeout: float = 10.0) -> bool:
+        return self._synced.wait(timeout)
+
+    # -- cache access -------------------------------------------------------
+
+    def get_by_index(self, index: str, value: str) -> List[Dict]:
+        with self._lock:
+            return [copy.deepcopy(o)
+                    for o in self._indices.get(index, {}).get(value, {}).values()]
+
+    def update_cache(self, obj: Dict) -> None:
+        """Mutation cache: record our own write so the next read sees it
+        even before the watch event lands (daemonset.go mutation cache)."""
+        if self._accepts(obj):
+            with self._lock:
+                self._set(obj)
+
+    # -- internals ----------------------------------------------------------
+
+    def _accepts(self, obj: Dict) -> bool:
+        return self._field_filter is None or self._field_filter(obj)
+
+    def _set(self, obj: Dict) -> Optional[Dict]:
+        key = meta_namespace_key(obj)
+        old = self._store.get(key)
+        self._store[key] = obj
+        self._reindex(key, old, obj)
+        return old
+
+    def _remove(self, obj: Dict) -> Optional[Dict]:
+        key = meta_namespace_key(obj)
+        old = self._store.pop(key, None)
+        self._reindex(key, old, None)
+        return old
+
+    def _reindex(self, key: str, old: Optional[Dict], new: Optional[Dict]) -> None:
+        for name, fn in self._indexers.items():
+            idx = self._indices[name]
+            if old is not None:
+                for val in fn(old):
+                    idx.get(val, {}).pop(key, None)
+                    if val in idx and not idx[val]:
+                        del idx[val]
+            if new is not None:
+                for val in fn(new):
+                    idx.setdefault(val, {})[key] = new
+
+    def _dispatch(self, handlers, *args) -> None:
+        for h in handlers:
+            try:
+                h(*copy.deepcopy(args))
+            except Exception:  # noqa: BLE001 — a broken handler must not kill the watch
+                import traceback
+                traceback.print_exc()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._list_and_watch()
+            except Exception:  # noqa: BLE001 — relist on any stream failure
+                if self._stop.is_set():
+                    return
+                self._stop.wait(1.0)
+
+    def _list_and_watch(self) -> None:
+        objs = self._client.list(self._gvr, namespace=self._namespace,
+                                 label_selector=self._selector)
+        with self._lock:
+            seen = set()
+            for obj in objs:
+                if not self._accepts(obj):
+                    continue
+                seen.add(meta_namespace_key(obj))
+                self._set(obj)
+            for key in [k for k in self._store if k not in seen]:
+                gone = self._store[key]
+                self._remove(gone)
+                self._dispatch(self._delete_handlers, gone)
+        for obj in objs:
+            if self._accepts(obj):
+                self._dispatch(self._add_handlers, obj)
+        self._synced.set()
+
+        for event_type, obj in self._client.watch(
+                self._gvr, namespace=self._namespace,
+                label_selector=self._selector, stop=self._stop):
+            if self._stop.is_set():
+                return
+            if not self._accepts(obj):
+                continue
+            if event_type == "ADDED":
+                with self._lock:
+                    old = self._set(obj)
+                if old is None:
+                    self._dispatch(self._add_handlers, obj)
+                else:
+                    self._dispatch(self._update_handlers, old, obj)
+            elif event_type == "MODIFIED":
+                with self._lock:
+                    old = self._set(obj)
+                if old is None:
+                    self._dispatch(self._add_handlers, obj)
+                else:
+                    self._dispatch(self._update_handlers, old, obj)
+            elif event_type == "DELETED":
+                with self._lock:
+                    self._remove(obj)
+                self._dispatch(self._delete_handlers, obj)

@@ -1,0 +1,61 @@
+"""Well-known GVR coordinates + object helpers.
+
+The resource.k8s.io group is the DRA API the reference drives through
+k8s.io/dynamic-resource-allocation (driver.go:73-82); apps/core are used by
+the CD controller for DaemonSets/Deployments/Pods/Nodes; resource.tpu.dev
+is this driver's CRD group (ComputeDomain).
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import Dict, Optional
+
+from tpu_dra.k8s.client import GVR
+
+PODS = GVR("", "v1", "pods")
+NODES = GVR("", "v1", "nodes", namespaced=False)
+EVENTS = GVR("", "v1", "events")
+DAEMONSETS = GVR("apps", "v1", "daemonsets")
+DEPLOYMENTS = GVR("apps", "v1", "deployments")
+
+RESOURCECLAIMS = GVR("resource.k8s.io", "v1", "resourceclaims")
+RESOURCECLAIMTEMPLATES = GVR("resource.k8s.io", "v1", "resourceclaimtemplates")
+RESOURCESLICES = GVR("resource.k8s.io", "v1", "resourceslices", namespaced=False)
+DEVICECLASSES = GVR("resource.k8s.io", "v1", "deviceclasses", namespaced=False)
+
+COMPUTEDOMAINS = GVR("resource.tpu.dev", "v1beta1", "computedomains")
+
+
+def new_object_meta(name: str, namespace: Optional[str] = None,
+                    labels: Optional[Dict[str, str]] = None,
+                    annotations: Optional[Dict[str, str]] = None,
+                    owner: Optional[Dict] = None) -> Dict:
+    meta: Dict = {"name": name}
+    if namespace:
+        meta["namespace"] = namespace
+    if labels:
+        meta["labels"] = dict(labels)
+    if annotations:
+        meta["annotations"] = dict(annotations)
+    if owner:
+        meta["ownerReferences"] = [owner]
+    return meta
+
+
+def owner_reference(obj: Dict, controller: bool = True,
+                    block_owner_deletion: bool = True) -> Dict:
+    meta = obj["metadata"]
+    return {
+        "apiVersion": obj.get("apiVersion", ""),
+        "kind": obj.get("kind", ""),
+        "name": meta["name"],
+        "uid": meta.get("uid", ""),
+        "controller": controller,
+        "blockOwnerDeletion": block_owner_deletion,
+    }
+
+
+def now_rfc3339() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%SZ")

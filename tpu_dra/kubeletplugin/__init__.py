@@ -1,0 +1,8 @@
+"""L3 DRA kubelet-plugin framework (the k8s.io/dynamic-resource-allocation
+kubeletplugin.Helper analog the reference builds its drivers on,
+driver.go:73-82)."""
+
+from tpu_dra.kubeletplugin.server import (  # noqa: F401
+    DRAPluginServer, DriverCallbacks, PreparedDevice, PrepareResult,
+    build_resource_slice,
+)

@@ -1,0 +1,234 @@
+"""DRA plugin gRPC server + registration + ResourceSlice publishing.
+
+Reference: the kubeletplugin.Helper from k8s.io/dynamic-resource-allocation
+that cmd/*/driver.go:73-82 builds on. It:
+
+- serves the DRAPlugin service (NodePrepareResources/NodeUnprepareResources)
+  on a unix socket under the plugin dir,
+- serves the Registration service on a socket under the kubelet plugin
+  registry so kubelet's plugin watcher discovers the driver,
+- publishes ResourceSlices describing this node's devices to the API
+  server (PublishResources, driver.go:217-235).
+
+The gRPC services are registered with hand-rolled method handlers (we
+generate message gencode with protoc but service stubs by hand — grpc_tools
+is not available in this environment).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from concurrent import futures
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+import grpc
+
+from tpu_dra.kubeletplugin.gen import dra_v1_pb2 as dra
+from tpu_dra.kubeletplugin.gen import pluginregistration_pb2 as reg
+from tpu_dra.k8s import ApiClient, RESOURCESLICES
+
+
+@dataclass
+class PreparedDevice:
+    """One device result returned to kubelet (dra.v1 Device)."""
+    pool_name: str
+    device_name: str
+    cdi_device_ids: List[str] = field(default_factory=list)
+    request_names: List[str] = field(default_factory=list)
+
+
+@dataclass
+class PrepareResult:
+    devices: List[PreparedDevice] = field(default_factory=list)
+    error: str = ""
+
+
+@dataclass
+class Claim:
+    uid: str
+    name: str
+    namespace: str
+
+
+class DriverCallbacks:
+    """Implemented by each driver (gpu/cd kubelet plugin device states)."""
+
+    def prepare_claims(self, claims: List[Claim]) -> Dict[str, PrepareResult]:
+        raise NotImplementedError
+
+    def unprepare_claims(self, claims: List[Claim]) -> Dict[str, str]:
+        """uid -> error string ('' = success)."""
+        raise NotImplementedError
+
+
+def _dra_service(callbacks: DriverCallbacks) -> grpc.GenericRpcHandler:
+    def node_prepare(request: dra.NodePrepareResourcesRequest, context):
+        claims = [Claim(uid=c.uid, name=c.name, namespace=c.namespace)
+                  for c in request.claims]
+        results = callbacks.prepare_claims(claims)
+        resp = dra.NodePrepareResourcesResponse()
+        for uid, res in results.items():
+            out = dra.NodePrepareResourceResponse()
+            if res.error:
+                out.error = res.error
+            else:
+                for d in res.devices:
+                    dev = out.devices.add()
+                    dev.pool_name = d.pool_name
+                    dev.device_name = d.device_name
+                    dev.cdi_device_ids.extend(d.cdi_device_ids)
+                    dev.request_names.extend(d.request_names)
+            resp.claims[uid].CopyFrom(out)
+        return resp
+
+    def node_unprepare(request: dra.NodeUnprepareResourcesRequest, context):
+        claims = [Claim(uid=c.uid, name=c.name, namespace=c.namespace)
+                  for c in request.claims]
+        errors = callbacks.unprepare_claims(claims)
+        resp = dra.NodeUnprepareResourcesResponse()
+        for uid, err in errors.items():
+            out = dra.NodeUnprepareResourceResponse()
+            if err:
+                out.error = err
+            resp.claims[uid].CopyFrom(out)
+        return resp
+
+    handlers = {
+        "NodePrepareResources": grpc.unary_unary_rpc_method_handler(
+            node_prepare,
+            request_deserializer=dra.NodePrepareResourcesRequest.FromString,
+            response_serializer=dra.NodePrepareResourcesResponse.SerializeToString),
+        "NodeUnprepareResources": grpc.unary_unary_rpc_method_handler(
+            node_unprepare,
+            request_deserializer=dra.NodeUnprepareResourcesRequest.FromString,
+            response_serializer=dra.NodeUnprepareResourcesResponse.SerializeToString),
+    }
+    return grpc.method_handlers_generic_handler(
+        "k8s.io.kubelet.pkg.apis.dra.v1.DRAPlugin", handlers)
+
+
+def _registration_service(driver_name: str, endpoint: str,
+                          on_status: Optional[Callable[[bool, str], None]] = None
+                          ) -> grpc.GenericRpcHandler:
+    def get_info(request: reg.InfoRequest, context):
+        return reg.PluginInfo(type="DRAPlugin", name=driver_name,
+                              endpoint=endpoint, supported_versions=["v1"])
+
+    def notify(request: reg.RegistrationStatus, context):
+        if on_status:
+            on_status(request.plugin_registered, request.error)
+        return reg.RegistrationStatusResponse()
+
+    handlers = {
+        "GetInfo": grpc.unary_unary_rpc_method_handler(
+            get_info,
+            request_deserializer=reg.InfoRequest.FromString,
+            response_serializer=reg.PluginInfo.SerializeToString),
+        "NotifyRegistrationStatus": grpc.unary_unary_rpc_method_handler(
+            notify,
+            request_deserializer=reg.RegistrationStatus.FromString,
+            response_serializer=reg.RegistrationStatusResponse.SerializeToString),
+    }
+    return grpc.method_handlers_generic_handler("pluginregistration.Registration",
+                                                handlers)
+
+
+class DRAPluginServer:
+    """Hosts the DRA + Registration services on unix sockets.
+
+    plugin_dir:   /var/lib/kubelet/plugins/<driver>/   (dra.sock lives here)
+    registry_dir: /var/lib/kubelet/plugins_registry/   (watcher socket)
+    """
+
+    def __init__(self, driver_name: str, node_name: str,
+                 callbacks: DriverCallbacks,
+                 plugin_dir: str, registry_dir: Optional[str] = None):
+        self.driver_name = driver_name
+        self.node_name = node_name
+        self._callbacks = callbacks
+        self._plugin_dir = plugin_dir
+        self._registry_dir = registry_dir
+        os.makedirs(plugin_dir, exist_ok=True)
+        if registry_dir:
+            os.makedirs(registry_dir, exist_ok=True)
+        self.dra_socket = os.path.join(plugin_dir, "dra.sock")
+        self.registration_registered = threading.Event()
+        self._server: Optional[grpc.Server] = None
+        self._reg_server: Optional[grpc.Server] = None
+
+    def start(self) -> None:
+        for sock in [self.dra_socket]:
+            if os.path.exists(sock):
+                os.unlink(sock)
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=8),
+            handlers=[_dra_service(self._callbacks)])
+        self._server.add_insecure_port(f"unix://{self.dra_socket}")
+        self._server.start()
+
+        if self._registry_dir:
+            reg_sock = os.path.join(
+                self._registry_dir, f"{self.driver_name}-reg.sock")
+            if os.path.exists(reg_sock):
+                os.unlink(reg_sock)
+            self._reg_server = grpc.server(
+                futures.ThreadPoolExecutor(max_workers=2),
+                handlers=[_registration_service(
+                    self.driver_name, self.dra_socket,
+                    on_status=lambda ok, err: (
+                        self.registration_registered.set() if ok else None))])
+            self._reg_server.add_insecure_port(f"unix://{reg_sock}")
+            self._reg_server.start()
+            self.registration_socket = reg_sock
+
+    def stop(self, grace: float = 2.0) -> None:
+        if self._server:
+            self._server.stop(grace).wait()
+        if self._reg_server:
+            self._reg_server.stop(grace).wait()
+
+
+# ---------------------------------------------------------------------------
+# ResourceSlice publishing
+# ---------------------------------------------------------------------------
+
+def build_resource_slice(driver_name: str, node_name: str,
+                         devices: List[Dict], pool_generation: int = 1) -> Dict:
+    """Render a resource.k8s.io/v1 ResourceSlice for this node's devices
+    (publishResources, driver.go:217-235). `devices` entries are
+    {name, attributes, capacity} dicts produced by the device model."""
+    return {
+        "apiVersion": "resource.k8s.io/v1",
+        "kind": "ResourceSlice",
+        "metadata": {
+            "name": f"{node_name}-{driver_name}",
+            "ownerReferences": [],
+        },
+        "spec": {
+            "driver": driver_name,
+            "nodeName": node_name,
+            "pool": {
+                "name": node_name,
+                "generation": pool_generation,
+                "resourceSliceCount": 1,
+            },
+            "devices": devices,
+        },
+    }
+
+
+def publish_resources(client: ApiClient, driver_name: str, node_name: str,
+                      devices: List[Dict], pool_generation: int = 1) -> Dict:
+    """Create-or-update this node's ResourceSlice."""
+    slice_obj = build_resource_slice(driver_name, node_name, devices,
+                                     pool_generation)
+    from tpu_dra.k8s.client import NotFoundError
+    try:
+        current = client.get(RESOURCESLICES, slice_obj["metadata"]["name"])
+        slice_obj["metadata"]["resourceVersion"] = \
+            current["metadata"].get("resourceVersion")
+        return client.update(RESOURCESLICES, slice_obj)
+    except NotFoundError:
+        return client.create(RESOURCESLICES, slice_obj)

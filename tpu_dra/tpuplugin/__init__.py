@@ -1,0 +1,7 @@
+"""TPU kubelet plugin (reference: cmd/gpu-kubelet-plugin, 4,869 LoC Go).
+
+Publishes this node's TPU chips (and TensorCore subslices) as ResourceSlice
+devices, and prepares/unprepares allocated ResourceClaims: CDI spec
+injection, sharing config (time-slicing / multiprocess), checkpointing,
+health monitoring.
+"""

@@ -1,0 +1,143 @@
+"""Versioned, checksummed node-local checkpoint.
+
+Reference: cmd/gpu-kubelet-plugin/checkpoint.go:10-122 + checkpointv.go:9-81
+— a JSON checkpoint written through the kubelet checkpointmanager with
+embedded checksums, versioned V1/V2 with bidirectional conversion so the
+driver can be up- and downgraded without losing claim state
+(exercised by tests/bats/test_cd_updowngrade.bats). Claim states
+``PrepareStarted``/``PrepareCompleted`` make Prepare idempotent and crash
+recovery safe (device_state.go:147-273).
+
+V1 layout (older drivers): {"preparedClaims": {uid: {devices: [...]}}} — no
+state field; presence implies completed.
+V2 layout: {"preparedClaims": {uid: {state, claim: {name, namespace},
+devices: [...]}}}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zlib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+PREPARE_STARTED = "PrepareStarted"
+PREPARE_COMPLETED = "PrepareCompleted"
+
+
+class CheckpointError(Exception):
+    pass
+
+
+@dataclass
+class PreparedClaim:
+    uid: str
+    state: str = PREPARE_STARTED
+    name: str = ""
+    namespace: str = ""
+    # Opaque per-driver device records (device names, cdi ids, config...)
+    devices: List[Dict] = field(default_factory=list)
+
+    def to_v2(self) -> Dict:
+        return {"state": self.state,
+                "claim": {"name": self.name, "namespace": self.namespace},
+                "devices": self.devices}
+
+    @classmethod
+    def from_v2(cls, uid: str, doc: Dict) -> "PreparedClaim":
+        claim = doc.get("claim") or {}
+        return cls(uid=uid, state=doc.get("state", PREPARE_COMPLETED),
+                   name=claim.get("name", ""), namespace=claim.get("namespace", ""),
+                   devices=list(doc.get("devices") or []))
+
+
+@dataclass
+class Checkpoint:
+    claims: Dict[str, PreparedClaim] = field(default_factory=dict)
+
+    # -- versioned encodings ------------------------------------------------
+
+    def to_v2_doc(self) -> Dict:
+        return {
+            "version": "v2",
+            "preparedClaims": {uid: c.to_v2() for uid, c in self.claims.items()},
+        }
+
+    def to_v1_doc(self) -> Dict:
+        """Downgrade view: V1 had no state machine — only completed claims
+        are representable (checkpointv.go GetV1 analog)."""
+        return {
+            "version": "v1",
+            "preparedClaims": {
+                uid: {"devices": c.devices}
+                for uid, c in self.claims.items() if c.state == PREPARE_COMPLETED
+            },
+        }
+
+    @classmethod
+    def from_doc(cls, doc: Dict) -> "Checkpoint":
+        """Accept any known version and convert to latest
+        (Checkpoint.ToLatestVersion analog)."""
+        version = doc.get("version", "v1")
+        prepared = doc.get("preparedClaims") or {}
+        cp = cls()
+        if version == "v1":
+            for uid, entry in prepared.items():
+                cp.claims[uid] = PreparedClaim(
+                    uid=uid, state=PREPARE_COMPLETED,
+                    devices=list(entry.get("devices") or []))
+        elif version == "v2":
+            for uid, entry in prepared.items():
+                cp.claims[uid] = PreparedClaim.from_v2(uid, entry)
+        else:
+            raise CheckpointError(f"unknown checkpoint version {version!r}")
+        return cp
+
+
+class CheckpointManager:
+    """Atomic file persistence with crc32 integrity (the kubelet
+    checkpointmanager-with-checksum analog)."""
+
+    def __init__(self, directory: str, filename: str = "checkpoint.json"):
+        os.makedirs(directory, exist_ok=True)
+        self._path = os.path.join(directory, filename)
+
+    @property
+    def path(self) -> str:
+        return self._path
+
+    def store(self, cp: Checkpoint, version: str = "v2") -> None:
+        doc = cp.to_v1_doc() if version == "v1" else cp.to_v2_doc()
+        payload = json.dumps(doc, sort_keys=True, separators=(",", ":"))
+        envelope = {"checksum": zlib.crc32(payload.encode()), "data": doc}
+        tmp = self._path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(envelope, f, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._path)
+
+    def load(self) -> Optional[Checkpoint]:
+        """None when no checkpoint exists (first start)."""
+        try:
+            with open(self._path) as f:
+                envelope = json.load(f)
+        except FileNotFoundError:
+            return None
+        except json.JSONDecodeError as e:
+            raise CheckpointError(f"corrupt checkpoint {self._path}: {e}") from e
+        doc = envelope.get("data")
+        if doc is None:
+            raise CheckpointError(f"checkpoint {self._path} missing data")
+        payload = json.dumps(doc, sort_keys=True, separators=(",", ":"))
+        if zlib.crc32(payload.encode()) != envelope.get("checksum"):
+            raise CheckpointError(f"checkpoint {self._path} checksum mismatch")
+        return Checkpoint.from_doc(doc)
+
+    def load_or_init(self) -> Checkpoint:
+        cp = self.load()
+        if cp is None:
+            cp = Checkpoint()
+            self.store(cp)
+        return cp

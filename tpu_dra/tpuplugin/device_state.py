@@ -1,0 +1,386 @@
+"""Node device state: checkpointed, idempotent Prepare/Unprepare.
+
+Reference: cmd/gpu-kubelet-plugin/device_state.go —
+``Prepare`` (:147-216): checkpoint-read for idempotency, write
+PrepareStarted, prepare devices, write claim CDI spec, write
+PrepareCompleted. ``Unprepare`` (:218-273) reverses it.
+``prepareDevices`` (:302-469) resolves opaque configs with precedence
+(class < claim, later-in-list > earlier, device-specific > catch-all),
+normalizes/validates them, groups allocation results per config and applies
+sharing (``applySharingConfig`` :567-615).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from tpu_dra.api import scheme as apischeme
+from tpu_dra.api import types as apitypes
+from tpu_dra.cdi.handler import CDIHandler, visible_chips_env
+from tpu_dra.infra import featuregates
+from tpu_dra.kubeletplugin.server import PreparedDevice, PrepareResult
+from tpu_dra.native.tpuinfo import Chip, TpuInfoBackend
+from tpu_dra.tpuplugin import deviceinfo
+from tpu_dra.tpuplugin.checkpoint import (
+    Checkpoint, CheckpointManager, PREPARE_COMPLETED, PREPARE_STARTED,
+    PreparedClaim,
+)
+from tpu_dra.tpuplugin.sharing import MultiprocessManager, TimeSlicingManager
+
+
+class PrepareError(Exception):
+    pass
+
+
+def _config_compatible(cfg: object, dev_type: str) -> bool:
+    if isinstance(cfg, apitypes.SubsliceConfig):
+        return dev_type == deviceinfo.DEVICE_TYPE_SUBSLICE
+    if isinstance(cfg, (apitypes.TpuConfig, apitypes.PassthroughConfig)):
+        return dev_type == deviceinfo.DEVICE_TYPE_CHIP
+    return False
+
+
+def _core_ranges(cores: set) -> str:
+    """Render a core index set as merged 'a-b' ranges: {0,1,3} -> '0-1,3-3'."""
+    out = []
+    run_start = prev = None
+    for c in sorted(cores):
+        if prev is None:
+            run_start = prev = c
+        elif c == prev + 1:
+            prev = c
+        else:
+            out.append(f"{run_start}-{prev}")
+            run_start = prev = c
+    if prev is not None:
+        out.append(f"{run_start}-{prev}")
+    return ",".join(out)
+
+
+def _prepared_device_from_record(record: Dict) -> PreparedDevice:
+    """Rehydrate the kubelet-facing device from a checkpoint record."""
+    return PreparedDevice(
+        pool_name=record.get("pool", ""),
+        device_name=record.get("device", ""),
+        cdi_device_ids=list(record.get("cdi_ids") or []),
+        request_names=[record["request"]] if record.get("request") else [])
+
+
+@dataclass
+class _ConfigResult:
+    """One opaque config + the allocation results it applies to
+    (the configResultsMap of prepareDevices :337-380)."""
+    config: object
+    source: str  # FromClass | FromClaim | default
+    results: List[Dict] = field(default_factory=list)
+
+
+class DeviceState:
+    def __init__(self, *, backend: TpuInfoBackend, cdi: CDIHandler,
+                 checkpoints: CheckpointManager, driver_name: str,
+                 node_name: str,
+                 ts_manager: Optional[TimeSlicingManager] = None,
+                 mp_manager: Optional[MultiprocessManager] = None,
+                 include_subslices: bool = True):
+        self._backend = backend
+        self._cdi = cdi
+        self._ckpt_mgr = checkpoints
+        self._driver_name = driver_name
+        self._node_name = node_name
+        self._ts_manager = ts_manager
+        self._mp_manager = mp_manager
+        self._lock = threading.Lock()
+        self.allocatable = deviceinfo.enumerate_allocatable(
+            backend.chips(), include_subslices=include_subslices)
+        self._unhealthy_uuids: set = set()
+        # Standard per-node CDI spec is written once at startup
+        # (NewDeviceState analog, device_state.go:59-145).
+        self._cdi.create_standard_device_spec_file(backend.chips())
+        self._checkpoint = self._ckpt_mgr.load_or_init()
+
+    # ------------------------------------------------------------------
+    # Prepare
+    # ------------------------------------------------------------------
+
+    def prepare(self, claim: Dict) -> PrepareResult:
+        """claim: a resource.k8s.io/v1 ResourceClaim object (dict)."""
+        uid = claim["metadata"]["uid"]
+        with self._lock:
+            existing = self._checkpoint.claims.get(uid)
+            if existing is not None and existing.state == PREPARE_COMPLETED:
+                return PrepareResult(devices=[
+                    _prepared_device_from_record(r) for r in existing.devices])
+
+            # Record intent before touching hardware (crash consistency).
+            self._checkpoint.claims[uid] = PreparedClaim(
+                uid=uid, state=PREPARE_STARTED,
+                name=claim["metadata"].get("name", ""),
+                namespace=claim["metadata"].get("namespace", ""))
+            self._ckpt_mgr.store(self._checkpoint)
+
+            records: List[Dict] = []
+            try:
+                self._prepare_devices(claim, records)
+            except Exception as e:  # noqa: BLE001 — report as claim error
+                # Leave PrepareStarted with whatever was already applied
+                # recorded, so a later unprepare (or GC of an abandoned
+                # claim) can roll back the side effects — exclusive mode,
+                # multiprocess daemons, time slices.
+                self._checkpoint.claims[uid].devices = records
+                self._ckpt_mgr.store(self._checkpoint)
+                return PrepareResult(error=f"prepare devices: {e}")
+
+            self._checkpoint.claims[uid].devices = records
+            self._checkpoint.claims[uid].state = PREPARE_COMPLETED
+            self._ckpt_mgr.store(self._checkpoint)
+            return PrepareResult(devices=[
+                _prepared_device_from_record(r) for r in records])
+
+    def _prepare_devices(self, claim: Dict, records: List[Dict]) -> None:
+        """Appends to `records` incrementally so the caller can persist
+        partial progress if a later step throws (crash/failure rollback)."""
+        uid = claim["metadata"]["uid"]
+        allocation = ((claim.get("status") or {}).get("allocation") or {})
+        results = [r for r in (allocation.get("devices") or {}).get("results", [])
+                   if r.get("driver") == self._driver_name]
+        if not results:
+            raise PrepareError("claim has no allocation results for this driver")
+
+        config_results = self._resolve_configs(allocation, results)
+
+        chip_indices: set = set()
+        subslice_cores: Dict[int, set] = {}
+        subslice_hbm_total = 0
+        claim_env: Dict[str, str] = {}
+        claim_mounts: List[Dict] = []
+
+        for cr in config_results:
+            group_chips = self._chips_for_results(cr.results)
+            # Record intent BEFORE applying side effects: if sharing setup
+            # fails halfway, unprepare can still reset from these records.
+            for result in cr.results:
+                dev = self.allocatable[result["device"]]
+                records.append({
+                    "type": dev.type,
+                    "device": dev.name,
+                    "request": result.get("request", ""),
+                    "chip_index": dev.chip.index,
+                    "chip_uuid": dev.chip.uuid,
+                    "pool": self._node_name,
+                    "config": cr.config.to_dict(),
+                    "cdi_ids": [self._cdi.get_standard_device(dev.chip.uuid),
+                                self._cdi.get_claim_device(uid)],
+                })
+
+            sharing_env = self._apply_sharing_config(uid, cr, group_chips)
+            claim_env.update(sharing_env.get("env", {}))
+            claim_mounts.extend(sharing_env.get("mounts", []))
+
+            for result in cr.results:
+                dev = self.allocatable[result["device"]]
+                chip_indices.add(dev.chip.index)
+                if dev.type == deviceinfo.DEVICE_TYPE_SUBSLICE:
+                    ss = dev.subslice
+                    subslice_cores.setdefault(dev.chip.index, set()).update(
+                        range(ss.core_start, ss.core_start + ss.core_count))
+                    subslice_hbm_total += ss.hbm_bytes
+                if isinstance(cr.config, apitypes.PassthroughConfig):
+                    self._backend.set_exclusive_mode(dev.chip.index, True)
+                    claim_env["TPU_PASSTHROUGH"] = "true"
+
+        if subslice_cores:
+            # Aggregate across all subslices of the claim. Single-chip claims
+            # get the scalar var; multi-chip subslice claims get per-chip vars.
+            if len(subslice_cores) == 1:
+                (cores,) = subslice_cores.values()
+                claim_env["TPU_SUBSLICE_CORES"] = _core_ranges(cores)
+            else:
+                for idx, cores in sorted(subslice_cores.items()):
+                    claim_env[f"TPU_SUBSLICE_CORES_{idx}"] = _core_ranges(cores)
+            claim_env["TPU_HBM_LIMIT_BYTES"] = str(subslice_hbm_total)
+
+        claim_env.update(visible_chips_env(sorted(chip_indices)))
+        self._cdi.create_claim_spec_file(uid, claim_env, mounts=claim_mounts or None)
+
+    def _chips_for_results(self, results: List[Dict]) -> List[Chip]:
+        chips: Dict[int, Chip] = {}
+        for result in results:
+            dev = self.allocatable.get(result["device"])
+            if dev is None:
+                raise PrepareError(
+                    f"allocated device {result['device']!r} is not on this node")
+            chips[dev.chip.index] = dev.chip
+        return [chips[i] for i in sorted(chips)]
+
+    # -- opaque config resolution -------------------------------------------
+
+    def _resolve_configs(self, allocation: Dict,
+                         results: List[Dict]) -> List[_ConfigResult]:
+        """GetOpaqueDeviceConfigs + config->results mapping
+        (device_state.go:337-380, 646-699)."""
+        configs = self._decode_opaque_configs(allocation)
+        out: List[_ConfigResult] = []
+        for result in results:
+            dev = self.allocatable.get(result["device"])
+            dev_type = dev.type if dev else deviceinfo.DEVICE_TYPE_CHIP
+            chosen: Optional[Tuple[int, object, str]] = None
+            for rank, (source, requests, cfg) in enumerate(configs):
+                if requests and result.get("request") not in requests:
+                    continue
+                # Config kind must match the device type (device_state.go
+                # :352-378): a request-targeted mismatch is an error, a
+                # catch-all config of the wrong kind is skipped.
+                if not _config_compatible(cfg, dev_type):
+                    if requests:
+                        raise PrepareError(
+                            f"config kind {type(cfg).KIND} does not apply to "
+                            f"{dev_type} device {result['device']!r}")
+                    continue
+                # Later entries win; FromClaim outranks FromClass because
+                # claim configs are appended after class configs.
+                chosen = (rank, cfg, source)
+            if chosen is None:
+                cfg = self._default_config(result)
+                source = "default"
+            else:
+                _, cfg, source = chosen
+            cfg.normalize()
+            cfg.validate()
+            for cr in out:
+                if cr.config.to_dict() == cfg.to_dict() and cr.source == source:
+                    cr.results.append(result)
+                    break
+            else:
+                out.append(_ConfigResult(config=cfg, source=source,
+                                         results=[result]))
+        return out
+
+    def _decode_opaque_configs(self, allocation: Dict):
+        """Returns [(source, requests, config)] ordered FromClass-first so
+        list order encodes precedence (GetOpaqueDeviceConfigs :646-699)."""
+        entries = (allocation.get("devices") or {}).get("config", []) or []
+        ordered = ([e for e in entries if e.get("source") == "FromClass"]
+                   + [e for e in entries if e.get("source") != "FromClass"])
+        decoded = []
+        for entry in ordered:
+            opaque = entry.get("opaque") or {}
+            if opaque.get("driver") != self._driver_name:
+                continue
+            try:
+                cfg = apischeme.StrictDecoder.decode(opaque.get("parameters", {}))
+            except apischeme.DecodeError as e:
+                raise PrepareError(f"invalid opaque config: {e}") from e
+            decoded.append((entry.get("source", ""),
+                            list(entry.get("requests") or []), cfg))
+        return decoded
+
+    def _default_config(self, result: Dict):
+        dev = self.allocatable.get(result["device"])
+        if dev is not None and dev.type == deviceinfo.DEVICE_TYPE_SUBSLICE:
+            return apitypes.SubsliceConfig()
+        return apitypes.TpuConfig.default()
+
+    # -- sharing -------------------------------------------------------------
+
+    def _apply_sharing_config(self, claim_uid: str, cr: _ConfigResult,
+                              chips: List[Chip]) -> Dict:
+        """applySharingConfig analog (device_state.go:567-615): returns CDI
+        edit contributions {env, mounts}."""
+        sharing = getattr(cr.config, "sharing", None)
+        if sharing is None:
+            return {}
+        if sharing.is_time_slicing():
+            if not featuregates.enabled(featuregates.TimeSlicingSettings):
+                return {}
+            if self._ts_manager is None:
+                raise PrepareError("time-slicing requested but manager disabled")
+            self._ts_manager.set_timeslice(
+                chips, sharing.time_slicing_config
+                or apitypes.TimeSlicingConfig())
+            return {"env": {"TPU_SHARING_STRATEGY": "time-slicing"}}
+        if sharing.is_multiprocess():
+            if self._mp_manager is None:
+                raise PrepareError("multiprocess requested but manager disabled")
+            daemon = self._mp_manager.start(
+                claim_uid, chips,
+                sharing.multiprocess_config or apitypes.MultiprocessConfig())
+            edits = daemon.cdi_edits()
+            edits.setdefault("env", {})["TPU_SHARING_STRATEGY"] = "multiprocess"
+            return edits
+        return {}
+
+    # ------------------------------------------------------------------
+    # Unprepare
+    # ------------------------------------------------------------------
+
+    def unprepare(self, claim_uid: str) -> Optional[str]:
+        """Returns error string or None (idempotent: unknown claim is a
+        no-op success, device_state.go:218-273)."""
+        with self._lock:
+            prepared = self._checkpoint.claims.get(claim_uid)
+            if prepared is None:
+                return None
+            try:
+                self._unprepare_devices(claim_uid, prepared)
+            except Exception as e:  # noqa: BLE001
+                return f"unprepare devices: {e}"
+            self._cdi.delete_claim_spec_file(claim_uid)
+            del self._checkpoint.claims[claim_uid]
+            self._ckpt_mgr.store(self._checkpoint)
+            return None
+
+    def _unprepare_devices(self, claim_uid: str, prepared: PreparedClaim) -> None:
+        chips: Dict[int, Chip] = {}
+        strategies = set()
+        passthrough_chips = []
+        for record in prepared.devices:
+            try:
+                chip = self._backend.get_chip(record["chip_index"])
+            except KeyError:
+                continue  # chip vanished; nothing to reset
+            chips[chip.index] = chip
+            cfg = record.get("config") or {}
+            sharing = cfg.get("sharing") or {}
+            if sharing.get("strategy"):
+                strategies.add(sharing["strategy"])
+            if cfg.get("kind") == apitypes.PASSTHROUGH_CONFIG_KIND:
+                passthrough_chips.append(chip)
+        chip_list = [chips[i] for i in sorted(chips)]
+        if apitypes.MultiprocessStrategy in strategies and self._mp_manager:
+            self._mp_manager.stop(claim_uid, chip_list)
+        if apitypes.TimeSlicingStrategy in strategies and self._ts_manager:
+            self._ts_manager.reset(chip_list)
+        for chip in passthrough_chips:
+            self._backend.set_exclusive_mode(chip.index, False)
+
+    # ------------------------------------------------------------------
+    # Health / inventory
+    # ------------------------------------------------------------------
+
+    def mark_unhealthy(self, chip_index: int) -> List[str]:
+        """Mark all devices backed by the chip unhealthy; returns affected
+        device names (UpdateDeviceHealthStatus analog,
+        device_state.go:701-715)."""
+        affected = []
+        for name, dev in self.allocatable.items():
+            if dev.chip.index == chip_index:
+                self._unhealthy_uuids.add(dev.chip.uuid)
+                affected.append(name)
+        return affected
+
+    def healthy_devices(self) -> List[Dict]:
+        """resourceapi device list excluding unhealthy chips (the republish
+        path drops yanked devices, driver.go:283-293)."""
+        return [dev.to_resource_api()
+                for name, dev in sorted(self.allocatable.items())
+                if dev.chip.uuid not in self._unhealthy_uuids]
+
+    def prepared_claim_uids(self) -> List[str]:
+        with self._lock:
+            return list(self._checkpoint.claims)
+
+    def checkpoint_snapshot(self) -> Checkpoint:
+        with self._lock:
+            return self._checkpoint

@@ -1,0 +1,140 @@
+"""Allocatable device model: TPU chips and TensorCore subslices.
+
+Reference: cmd/gpu-kubelet-plugin/deviceinfo.go:40-253 + allocatable.go —
+``AllocatableDevice`` is a tagged union (Gpu | Mig | Vfio) rendered into a
+``resourceapi.Device`` with attributes and capacity. TPU translation:
+
+- ``chip``     — a whole TPU chip (/dev/accelN). GPU analog.
+- ``subslice`` — a contiguous TensorCore range of a chip; the MIG analog.
+  Unlike MIG, a TPU subslice is purely logical (no char-dev per instance,
+  SURVEY §2.9): prepare renders it as env restricting the container's
+  libtpu to a core range and an HBM share. Like the reference's
+  enumerateAllPossibleDevices (nvlib.go:134-183), every possible placement
+  is advertised; the scheduler picks one.
+- passthrough is a prepare-time mode on a chip (PassthroughConfig), not a
+  distinct advertised device — mirroring how VFIO devices piggyback on the
+  GPU device with a config marker.
+
+Device names are DNS-label safe: ``chip-3``, ``chip-3-ss-1c-0`` (chip 3,
+1-core subslice, placement 0).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from tpu_dra.native.tpuinfo import Chip
+
+DEVICE_TYPE_CHIP = "chip"
+DEVICE_TYPE_SUBSLICE = "subslice"
+
+
+@dataclass(frozen=True)
+class SubslicePlacement:
+    """A specific core-range placement of a subslice profile on a chip."""
+    chip: Chip
+    core_count: int
+    core_start: int
+
+    @property
+    def profile(self) -> str:
+        return f"{self.core_count}c"
+
+    @property
+    def name(self) -> str:
+        return f"chip-{self.chip.index}-ss-{self.profile}-{self.core_start}"
+
+    @property
+    def hbm_bytes(self) -> int:
+        return self.chip.hbm_bytes * self.core_count // self.chip.tensorcore_count
+
+
+def subslice_placements(chip: Chip) -> List[SubslicePlacement]:
+    """All placements of all proper-subset profiles (1..cores-1 core sizes,
+    aligned). A 2-core v5p chip yields 1c@0 and 1c@1; single-core chips
+    yield none (nothing to subdivide)."""
+    out: List[SubslicePlacement] = []
+    size = 1
+    while size < chip.tensorcore_count:
+        for start in range(0, chip.tensorcore_count - size + 1, size):
+            out.append(SubslicePlacement(chip, size, start))
+        size *= 2
+    return out
+
+
+def chip_device_name(chip: Chip) -> str:
+    return f"chip-{chip.index}"
+
+
+@dataclass(frozen=True)
+class AllocatableDevice:
+    """Tagged union over chip / subslice (deviceinfo.go:40-88 analog)."""
+    type: str
+    chip: Chip
+    subslice: Optional[SubslicePlacement] = None
+
+    @property
+    def name(self) -> str:
+        if self.type == DEVICE_TYPE_SUBSLICE:
+            return self.subslice.name
+        return chip_device_name(self.chip)
+
+    def to_resource_api(self) -> Dict:
+        """Render the resourceapi.Device entry for the ResourceSlice
+        (deviceinfo.go GetDevice :90-253 analog). Attribute names sit under
+        the driver's implicit prefix; DeviceClass CEL selects on e.g.
+        device.attributes['tpu.dev'].type == 'chip'."""
+        chip = self.chip
+        attrs: Dict[str, Dict] = {
+            "type": {"string": self.type},
+            "uuid": {"string": chip.uuid},
+            "productName": {"string": f"tpu-{chip.generation}"},
+            "generation": {"string": chip.generation},
+            "driverVersion": {"version": _semverish(chip.driver_version)},
+            "pciAddress": {"string": chip.pci_address},
+            "sliceID": {"string": chip.slice_id},
+            "workerIndex": {"int": chip.worker_index},
+            "coordX": {"int": chip.coords[0]},
+            "coordY": {"int": chip.coords[1]},
+            "coordZ": {"int": chip.coords[2]},
+        }
+        if self.type == DEVICE_TYPE_CHIP:
+            capacity = {
+                "hbm": {"value": str(chip.hbm_bytes)},
+                "tensorcores": {"value": str(chip.tensorcore_count)},
+            }
+        else:
+            ss = self.subslice
+            attrs["parentUUID"] = {"string": chip.uuid}
+            attrs["profile"] = {"string": ss.profile}
+            attrs["coreStart"] = {"int": ss.core_start}
+            capacity = {
+                "hbm": {"value": str(ss.hbm_bytes)},
+                "tensorcores": {"value": str(ss.core_count)},
+            }
+        return {"name": self.name, "attributes": attrs, "capacity": capacity}
+
+
+def _semverish(version: str) -> str:
+    """resourceapi version attributes must be semver; coerce or fall back."""
+    parts = version.split("-")[0].split(".")
+    if len(parts) == 3 and all(p.isdigit() for p in parts):
+        return version.split("-")[0]
+    return "0.0.0"
+
+
+def enumerate_allocatable(chips: List[Chip],
+                          include_subslices: bool = True) -> Dict[str, AllocatableDevice]:
+    """All allocatable devices on this node, keyed by device name
+    (enumerateAllPossibleDevices analog, nvlib.go:111-183)."""
+    out: Dict[str, AllocatableDevice] = {}
+    for chip in chips:
+        dev = AllocatableDevice(type=DEVICE_TYPE_CHIP, chip=chip)
+        out[dev.name] = dev
+        if include_subslices:
+            for ss in subslice_placements(chip):
+                dev = AllocatableDevice(type=DEVICE_TYPE_SUBSLICE, chip=chip,
+                                        subslice=ss)
+                out[dev.name] = dev
+    return out

@@ -1,0 +1,153 @@
+"""TPU kubelet plugin driver: DRA callbacks + publishing + health wiring.
+
+Reference: cmd/gpu-kubelet-plugin/driver.go:49-301 — implements the
+kubeletplugin callbacks, holds a per-node flock so two driver pods (rolling
+upgrade) never interleave prepare/unprepare (:166-215), publishes
+ResourceSlices (:217-235) and republishes on health events (:237-301).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, List, Optional
+
+from tpu_dra.infra import featuregates
+from tpu_dra.infra.flock import Flock
+from tpu_dra.infra.metrics import DefaultRegistry
+from tpu_dra.infra.workqueue import WorkQueue, default_prep_unprep_rate_limiter
+from tpu_dra.k8s import ApiClient, RESOURCECLAIMS
+from tpu_dra.k8s.client import NotFoundError
+from tpu_dra.kubeletplugin.server import (
+    Claim, DRAPluginServer, DriverCallbacks, PrepareResult, publish_resources,
+)
+from tpu_dra.native.tpuinfo import HealthEvent, TpuInfoBackend
+from tpu_dra.tpuplugin.device_state import DeviceState
+from tpu_dra.tpuplugin.health import DeviceHealthMonitor
+
+log = logging.getLogger("tpu_dra.tpuplugin")
+
+claim_prepare_seconds = DefaultRegistry.histogram(
+    "tpu_dra_claim_prepare_seconds",
+    "NodePrepareResources per-claim latency (claim-to-ready component)")
+
+
+class TpuDriver(DriverCallbacks):
+    def __init__(self, *, state: DeviceState, client: ApiClient,
+                 driver_name: str, node_name: str,
+                 plugin_dir: str, registry_dir: Optional[str] = None,
+                 flock_path: Optional[str] = None,
+                 additional_codes_to_ignore=None):
+        self._state = state
+        self._client = client
+        self._driver_name = driver_name
+        self._node_name = node_name
+        self._pu_lock = Flock(flock_path or f"{plugin_dir}/pu.lock")
+        self._pool_generation = 1
+        self._gen_lock = threading.Lock()
+        self.server = DRAPluginServer(
+            driver_name=driver_name, node_name=node_name, callbacks=self,
+            plugin_dir=plugin_dir, registry_dir=registry_dir)
+        # Retry queue for ResourceSlice (re)publishing: a failed republish
+        # after a health event must not strand a dead chip in the inventory
+        # (closes the known gap the reference documents at driver.go:283-293).
+        self._publish_queue = WorkQueue(default_prep_unprep_rate_limiter())
+        self._health: Optional[DeviceHealthMonitor] = None
+        if featuregates.enabled(featuregates.TPUDeviceHealthCheck):
+            self._health = DeviceHealthMonitor(
+                state._backend, self._on_unhealthy_event,
+                additional_codes_to_ignore=additional_codes_to_ignore)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        self.server.start()
+        self._publish_queue.run_in_thread()
+        if self._health:
+            self._health.start()
+        self.publish_resources()
+
+    def shutdown(self) -> None:
+        if self._health:
+            self._health.stop()
+        self._publish_queue.shutdown()
+        self.server.stop()
+
+    # -- DRA callbacks ------------------------------------------------------
+
+    def prepare_claims(self, claims: List[Claim]) -> Dict[str, PrepareResult]:
+        results: Dict[str, PrepareResult] = {}
+        for claim in claims:
+            results[claim.uid] = self._node_prepare_resource(claim)
+        return results
+
+    def unprepare_claims(self, claims: List[Claim]) -> Dict[str, str]:
+        errors: Dict[str, str] = {}
+        for claim in claims:
+            errors[claim.uid] = self._node_unprepare_resource(claim)
+        return errors
+
+    def _node_prepare_resource(self, claim: Claim) -> PrepareResult:
+        """nodePrepareResource analog (driver.go:166-193): flock + fetch the
+        ResourceClaim from the API server + DeviceState.Prepare."""
+        import time
+        t0 = time.monotonic()
+        try:
+            self._pu_lock.acquire(timeout=10.0)
+        except TimeoutError as e:
+            return PrepareResult(error=str(e))
+        try:
+            try:
+                obj = self._client.get(RESOURCECLAIMS, claim.name,
+                                       claim.namespace)
+            except NotFoundError:
+                return PrepareResult(
+                    error=f"resourceclaim {claim.namespace}/{claim.name} not found")
+            if obj["metadata"].get("uid") != claim.uid:
+                return PrepareResult(
+                    error=f"claim UID mismatch for {claim.namespace}/{claim.name}")
+            result = self._state.prepare(obj)
+            claim_prepare_seconds.observe(time.monotonic() - t0)
+            return result
+        finally:
+            self._pu_lock.release()
+
+    def _node_unprepare_resource(self, claim: Claim) -> str:
+        try:
+            self._pu_lock.acquire(timeout=10.0)
+        except TimeoutError as e:
+            return str(e)
+        try:
+            err = self._state.unprepare(claim.uid)
+            return err or ""
+        finally:
+            self._pu_lock.release()
+
+    # -- publishing ---------------------------------------------------------
+
+    def publish_resources(self) -> None:
+        with self._gen_lock:
+            devices = self._state.healthy_devices()
+            publish_resources(self._client, self._driver_name, self._node_name,
+                              devices, pool_generation=self._pool_generation)
+            self._pool_generation += 1
+
+    # -- health -------------------------------------------------------------
+
+    def _on_unhealthy_event(self, event: HealthEvent) -> None:
+        """deviceHealthEvents analog (driver.go:237-301): yank affected
+        devices and republish through the retry queue — a failed republish
+        is retried with backoff rather than dropped (the reference documents
+        the no-retry behavior as a known gap, driver.go:283-293). Like the
+        reference, re-adding a recovered chip requires a restart
+        (driver.go:263-264)."""
+        if event.chip_index >= 0:
+            affected = self._state.mark_unhealthy(event.chip_index)
+        else:
+            affected = []
+            for chip in self._state._backend.chips():
+                affected += self._state.mark_unhealthy(chip.index)
+        log.warning("health event %s (code %d): yanking devices %s",
+                    event.kind, event.code, affected)
+        self._publish_queue.enqueue(
+            None, lambda _obj: self.publish_resources(), key="publish")

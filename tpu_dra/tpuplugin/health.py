@@ -1,0 +1,62 @@
+"""Event-driven device health monitor.
+
+Reference: cmd/gpu-kubelet-plugin/device_health.go:36-342 — registers for
+NVML Xid-critical/ECC events, waits in a 5s-timeout loop, filters a skip
+list of benign Xids (13,31,43,45,68,109 + flag-provided extras), maps the
+event to devices and pushes them onto an `unhealthy` channel consumed by
+the driver, which republishes the ResourceSlice without them (§3.5).
+
+TPU translation: libtpuinfo tails the accel driver's health event stream.
+Benign event codes are skipped by the same mechanism
+(ADDITIONAL_CODES_TO_IGNORE flag analog of ADDITIONAL_XIDS_TO_IGNORE).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Iterable, Optional, Set
+
+from tpu_dra.native.tpuinfo import HealthEvent, TpuInfoBackend
+
+# Benign/app-level event codes that must not yank a chip (the Xid skip-list
+# analog, device_health.go:320-342). Codes model: <100 = app/driver-level
+# recoverable (program aborts, preemptions, watchdog restarts), >=100 =
+# hardware faults — hardware-fault-range codes are never skipped by default.
+DEFAULT_SKIPPED_CODES = frozenset({13, 31, 43, 45, 68})
+
+# The reference waits 5s per NVML eventSet.Wait iteration; we use a shorter
+# quantum so stop() is responsive — the loop re-enters the wait immediately,
+# so event latency is unchanged.
+WAIT_TIMEOUT_S = 0.5
+
+
+class DeviceHealthMonitor:
+    def __init__(self, backend: TpuInfoBackend,
+                 on_unhealthy: Callable[[HealthEvent], None],
+                 additional_codes_to_ignore: Optional[Iterable[int]] = None):
+        self._backend = backend
+        self._on_unhealthy = on_unhealthy
+        self._skip: Set[int] = set(DEFAULT_SKIPPED_CODES)
+        self._skip.update(additional_codes_to_ignore or [])
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="tpu-health-monitor")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=WAIT_TIMEOUT_S + 1)
+
+    def _run(self) -> None:
+        """The eventSet.Wait loop (device_health.go:146-204)."""
+        while not self._stop.is_set():
+            event = self._backend.wait_health_event(WAIT_TIMEOUT_S)
+            if event is None:
+                continue
+            if event.code in self._skip:
+                continue
+            self._on_unhealthy(event)

@@ -1,0 +1,111 @@
+"""TPU kubelet plugin entrypoint.
+
+Reference: cmd/gpu-kubelet-plugin/main.go:44-293 — env-mirrored flags,
+debug signal handlers, driver construction, serve until signalled.
+
+Run: ``python -m tpu_dra.tpuplugin.main [flags]``
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+
+from tpu_dra.api.types import TPU_DRIVER_NAME
+from tpu_dra.cdi.handler import CDIHandler
+from tpu_dra.infra import debug, featuregates
+from tpu_dra.infra.flags import (
+    Flag, FlagSet, apply_feature_gates, feature_gate_flag, logging_flags,
+    setup_logging,
+)
+from tpu_dra.infra.metrics import MetricsServer
+from tpu_dra.k8s.client import HttpApiClient
+from tpu_dra.native.tpuinfo import get_backend
+from tpu_dra.tpuplugin.checkpoint import CheckpointManager
+from tpu_dra.tpuplugin.device_state import DeviceState
+from tpu_dra.tpuplugin.driver import TpuDriver
+from tpu_dra.tpuplugin.sharing import MultiprocessManager, TimeSlicingManager
+
+
+def flags() -> FlagSet:
+    return FlagSet("tpu-kubelet-plugin", [
+        Flag("node-name", "NODE_NAME", required=True,
+             help="name of the node this plugin runs on"),
+        Flag("namespace", "NAMESPACE", default="tpu-dra-driver",
+             help="driver namespace (multiprocess daemon deployments land here)"),
+        Flag("cdi-root", "CDI_ROOT", default="/var/run/cdi",
+             help="directory for CDI spec files"),
+        Flag("plugin-dir", "PLUGIN_DIR",
+             default=f"/var/lib/kubelet/plugins/{TPU_DRIVER_NAME}",
+             help="kubelet plugin dir (dra.sock, checkpoint, locks)"),
+        Flag("registry-dir", "REGISTRY_DIR",
+             default="/var/lib/kubelet/plugins_registry",
+             help="kubelet plugin watcher registry dir"),
+        Flag("driver-root", "TPU_DRIVER_ROOT", default="/",
+             help="host root to resolve libtpu under"),
+        Flag("kube-api-url", "KUBE_API_URL", default=None,
+             help="API server URL (default: in-cluster config)"),
+        Flag("healthcheck-port", "HEALTHCHECK_PORT", default=0, type=int,
+             help="metrics/health HTTP port (0 = disabled)"),
+        Flag("additional-codes-to-ignore", "ADDITIONAL_CODES_TO_IGNORE",
+             default="", help="comma-separated health event codes to skip"),
+        Flag("tpuctl-path", "TPUCTL_PATH", default="",
+             help="path to tpuctl (empty = direct libtpuinfo calls)"),
+        feature_gate_flag(),
+        *logging_flags(),
+    ])
+
+
+def main(argv=None) -> int:
+    fs = flags()
+    ns = fs.parse(argv)
+    logger = setup_logging(ns.v, ns.log_json)
+    apply_feature_gates(ns)
+    fs.dump_config(ns, logger)
+    debug.start_debug_signal_handlers()
+
+    backend = get_backend()
+    client = HttpApiClient(base_url=ns.kube_api_url)
+    cdi = CDIHandler(ns.cdi_root, driver_root=ns.driver_root)
+    checkpoints = CheckpointManager(ns.plugin_dir)
+
+    ts_manager = None
+    if featuregates.enabled(featuregates.TimeSlicingSettings):
+        ts_manager = TimeSlicingManager(backend, tpuctl_path=ns.tpuctl_path or None)
+    mp_manager = None
+    if featuregates.enabled(featuregates.MultiprocessSupport):
+        mp_manager = MultiprocessManager(
+            backend, client, node_name=ns.node_name, namespace=ns.namespace,
+            root_dir=f"{ns.plugin_dir}/multiprocess")
+
+    state = DeviceState(
+        backend=backend, cdi=cdi, checkpoints=checkpoints,
+        driver_name=TPU_DRIVER_NAME, node_name=ns.node_name,
+        ts_manager=ts_manager, mp_manager=mp_manager)
+
+    codes = [int(c) for c in ns.additional_codes_to_ignore.split(",") if c]
+    driver = TpuDriver(
+        state=state, client=client, driver_name=TPU_DRIVER_NAME,
+        node_name=ns.node_name, plugin_dir=ns.plugin_dir,
+        registry_dir=ns.registry_dir, additional_codes_to_ignore=codes)
+
+    metrics_srv = None
+    if ns.healthcheck_port:
+        metrics_srv = MetricsServer(addr="0.0.0.0", port=ns.healthcheck_port)  # noqa: S104
+        metrics_srv.start()
+
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+
+    driver.start()
+    logger.info("tpu kubelet plugin serving on %s", driver.server.dra_socket)
+    stop.wait()
+    driver.shutdown()
+    if metrics_srv:
+        metrics_srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,232 @@
+"""Sharing managers: time-slicing and multiprocess.
+
+Reference: cmd/gpu-kubelet-plugin/sharing.go:60-451 —
+
+- ``TimeSlicingManager`` execs ``nvidia-smi compute-policy --set-timeslice``
+  and resets compute mode (sharing.go:60-126, nvlib.go:564-601). TPU: the
+  accel driver's program scheduler quantum, programmed per chip through
+  libtpuinfo (or the ``tpuctl`` exec seam — both supported; exec keeps the
+  audit trail, direct lib call avoids the fork).
+- ``MpsManager`` runs a per-claim MPS control daemon as a Deployment with
+  tmpfs /dev/shm + pipe dir, waits for readiness, and contributes CDI edits
+  (sharing.go:163-451). TPU analog ``MultiprocessManager``: concurrent
+  libtpu processes on one chip need a per-claim coordination directory and
+  premapped-HBM/core limits exported as env; the Deployment-per-claim
+  lifecycle (create → assert ready → CDI edits → stop) is preserved so
+  operators get the same operational surface.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import time
+from typing import Dict, List, Optional
+
+from tpu_dra.api import types as apitypes
+from tpu_dra.k8s import ApiClient, DEPLOYMENTS, new_object_meta
+from tpu_dra.k8s.client import AlreadyExistsError, ConflictError, NotFoundError
+from tpu_dra.native.tpuinfo import Chip, TpuInfoBackend
+
+class TimeSlicingManager:
+    """Programs per-chip time-slice quanta (SetTimeSlice analog)."""
+
+    def __init__(self, backend: TpuInfoBackend, tpuctl_path: Optional[str] = None,
+                 sysfs_root: str = ""):
+        self._backend = backend
+        self._tpuctl = tpuctl_path
+        self._sysfs_root = sysfs_root
+
+    def set_timeslice(self, chips: List[Chip],
+                      config: apitypes.TimeSlicingConfig) -> None:
+        interval_us = config.interval_us()
+        for chip in chips:
+            if self._tpuctl:
+                env = dict(os.environ)
+                if self._sysfs_root:
+                    env["TPUINFO_SYSFS_ROOT"] = self._sysfs_root
+                res = subprocess.run(
+                    [self._tpuctl, "set-timeslice", str(chip.index),
+                     str(interval_us)],
+                    env=env, capture_output=True, text=True)
+                if res.returncode != 0:
+                    raise RuntimeError(
+                        f"tpuctl set-timeslice chip {chip.index}: {res.stderr.strip()}")
+            else:
+                self._backend.set_timeslice(chip.index, interval_us)
+            # Time-slicing implies shared access: drop exclusive mode
+            # (the compute-mode DEFAULT reset, nvlib.go:585-599).
+            self._backend.set_exclusive_mode(chip.index, False)
+
+    def reset(self, chips: List[Chip]) -> None:
+        self.set_timeslice(chips, apitypes.TimeSlicingConfig("Default"))
+
+
+class MultiprocessDaemon:
+    """Per-claim multiprocess coordination daemon (MpsControlDaemon analog,
+    sharing.go:191-412): owns the claim's coordination directory and the
+    Deployment that runs the coordinator pod on this node."""
+
+    def __init__(self, claim_uid: str, chips: List[Chip],
+                 config: apitypes.MultiprocessConfig, *,
+                 node_name: str, namespace: str, root_dir: str,
+                 client: ApiClient, image: str):
+        self._claim_uid = claim_uid
+        self._chips = chips
+        self._config = config
+        self._node_name = node_name
+        self._namespace = namespace
+        self._dir = os.path.join(root_dir, claim_uid)
+        self._client = client
+        self._image = image
+        self._name = f"tpu-multiprocess-{claim_uid[:13]}"
+
+    @property
+    def deployment_name(self) -> str:
+        return self._name
+
+    def start(self) -> None:
+        """Create coordination dir + Deployment (Start analog,
+        sharing.go:191-296)."""
+        os.makedirs(os.path.join(self._dir, "pipe"), exist_ok=True)
+        os.makedirs(os.path.join(self._dir, "log"), exist_ok=True)
+        deployment = {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": new_object_meta(
+                self._name, self._namespace,
+                labels={"app.kubernetes.io/name": "tpu-multiprocess-daemon",
+                        "tpu.dev/claim-uid": self._claim_uid}),
+            "spec": {
+                "replicas": 1,
+                "selector": {"matchLabels": {"tpu.dev/claim-uid": self._claim_uid}},
+                "template": {
+                    "metadata": {"labels": {
+                        "app.kubernetes.io/name": "tpu-multiprocess-daemon",
+                        "tpu.dev/claim-uid": self._claim_uid}},
+                    "spec": {
+                        "nodeName": self._node_name,
+                        "containers": [{
+                            "name": "coordinator",
+                            "image": self._image,
+                            "command": ["tpu-multiprocess-coordinator"],
+                            "env": [
+                                {"name": "TPU_VISIBLE_CHIPS", "value": ",".join(
+                                    str(c.index) for c in self._chips)},
+                                {"name": "TPU_MULTIPROCESS_DIR",
+                                 "value": "/multiprocess"},
+                            ],
+                            "volumeMounts": [
+                                {"name": "coord", "mountPath": "/multiprocess"},
+                                {"name": "shm", "mountPath": "/dev/shm"},
+                            ],
+                        }],
+                        "volumes": [
+                            {"name": "coord",
+                             "hostPath": {"path": self._dir,
+                                          "type": "DirectoryOrCreate"}},
+                            {"name": "shm",
+                             "emptyDir": {"medium": "Memory",
+                                          "sizeLimit": "64Mi"}},
+                        ],
+                    },
+                },
+            },
+        }
+        try:
+            self._client.create(DEPLOYMENTS, deployment)
+        except (AlreadyExistsError, ConflictError):
+            pass  # idempotent re-prepare after a crashed attempt
+
+    def assert_ready(self, timeout: float = 30.0, interval: float = 0.2) -> None:
+        """Block until the coordinator Deployment reports a ready replica
+        (AssertReady analog, sharing.go:298-353)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                dep = self._client.get(DEPLOYMENTS, self._name, self._namespace)
+            except NotFoundError:
+                dep = None
+            if dep and (dep.get("status") or {}).get("readyReplicas", 0) >= 1:
+                return
+            time.sleep(interval)
+        raise TimeoutError(
+            f"multiprocess daemon {self._name} not ready within {timeout}s")
+
+    def cdi_edits(self) -> Dict:
+        """Claim CDI contributions (GetCDIContainerEdits analog,
+        sharing.go:355-375): coordination dir mount + limit env."""
+        uuids = [c.uuid for c in self._chips]
+        indices = {c.uuid: c.index for c in self._chips}
+        env = {"TPU_MULTIPROCESS_DIR": "/multiprocess",
+               "TPU_MULTIPROCESS_ID": self._claim_uid}
+        if self._config.default_active_cores_percentage is not None:
+            env["TPU_TENSORCORE_PERCENTAGE"] = str(
+                self._config.default_active_cores_percentage)
+        limits: Dict[str, int] = {}
+        if self._config.per_device_hbm_limit is not None:
+            limits = self._config.per_device_hbm_limit.normalize(
+                uuids, indices, self._config.default_hbm_limit)
+        elif self._config.default_hbm_limit is not None:
+            from tpu_dra.infra.quantity import Quantity
+            limits = {u: Quantity(self._config.default_hbm_limit).value
+                      for u in uuids}
+        if limits:
+            # libtpu reads a single per-process premapped-HBM cap; export the
+            # per-chip map for multi-chip claims plus the scalar for 1-chip.
+            env["TPU_HBM_LIMIT_MAP"] = ",".join(
+                f"{u}={b}" for u, b in sorted(limits.items()))
+            if len(limits) == 1:
+                env["TPU_HBM_LIMIT_BYTES"] = str(next(iter(limits.values())))
+        mounts = [{"hostPath": self._dir, "containerPath": "/multiprocess",
+                   "options": ["rw", "nosuid", "nodev", "bind"]}]
+        return {"env": env, "mounts": mounts}
+
+    def stop(self) -> None:
+        """Delete Deployment + coordination dir (Stop analog,
+        sharing.go:377-412)."""
+        self._client.delete(DEPLOYMENTS, self._name, self._namespace)
+        shutil.rmtree(self._dir, ignore_errors=True)
+
+
+class MultiprocessManager:
+    """Factory/lifecycle tracking for per-claim daemons (MpsManager analog)."""
+
+    def __init__(self, backend: TpuInfoBackend, client: ApiClient, *,
+                 node_name: str, namespace: str, root_dir: str,
+                 image: str = "tpu-dra-driver:latest"):
+        self._backend = backend
+        self._client = client
+        self._node_name = node_name
+        self._namespace = namespace
+        self._root_dir = root_dir
+        self._image = image
+
+    def daemon(self, claim_uid: str, chips: List[Chip],
+               config: apitypes.MultiprocessConfig) -> MultiprocessDaemon:
+        return MultiprocessDaemon(
+            claim_uid, chips, config, node_name=self._node_name,
+            namespace=self._namespace, root_dir=self._root_dir,
+            client=self._client, image=self._image)
+
+    def start(self, claim_uid: str, chips: List[Chip],
+              config: apitypes.MultiprocessConfig,
+              ready_timeout: float = 30.0) -> MultiprocessDaemon:
+        # Multiprocess tenants must not race other workloads on the chip:
+        # set exclusive-to-claim mode (EXCLUSIVE_PROCESS analog).
+        for chip in chips:
+            self._backend.set_exclusive_mode(chip.index, True)
+        d = self.daemon(claim_uid, chips, config)
+        d.start()
+        d.assert_ready(timeout=ready_timeout)
+        return d
+
+    def stop(self, claim_uid: str, chips: List[Chip]) -> None:
+        d = self.daemon(claim_uid, chips, apitypes.MultiprocessConfig())
+        d.stop()
+        for chip in chips:
+            try:
+                self._backend.set_exclusive_mode(chip.index, False)
+            except Exception:  # noqa: BLE001 — chip may be gone
+                pass
