@@ -13,6 +13,15 @@ os.environ.setdefault("TPU_DRA_TPUINFO_BACKEND", "fake")
 
 import pytest  # noqa: E402
 
+# A sitecustomize in this image may pre-register a hardware TPU platform and
+# override jax_platforms before env vars are honored; pin the config back to
+# CPU so the test tier is hardware-free and sees the 8-device mesh.
+try:  # pragma: no cover — depends on image configuration
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+except Exception:  # noqa: BLE001
+    pass
+
 
 @pytest.fixture(autouse=True)
 def _reset_feature_gates():

@@ -1,0 +1,20 @@
+"""Workload-side JAX programs scheduled by the driver.
+
+The reference ships *workload* containers that its e2e/benchmark tier runs on
+driver-allocated devices: NCCL send/recv/broadcast jobs and nvbandwidth
+MPIJobs (reference: tests/bats/test_cd_mnnvl_workload.bats:18-45,
+demo/specs/imex/nvbandwidth-test-job-1.yaml). This package is the TPU analog:
+JAX/XLA programs that consume the env the driver's CDI edits inject
+(``TPU_VISIBLE_CHIPS``, slice rendezvous env) and exercise the allocated
+hardware — collective bandwidth probes and an SPMD training step.
+
+Nothing in here runs inside the driver processes; the driver is pure
+control plane. These run in pods whose ResourceClaims the driver prepared.
+"""
+
+from tpu_dra.workloads.allreduce import (  # noqa: F401
+    allreduce_bandwidth, device_put_sharded_uniform,
+)
+from tpu_dra.workloads.model import (  # noqa: F401
+    ModelConfig, TransformerLM, init_params, loss_fn, make_train_step,
+)

@@ -1,0 +1,81 @@
+"""All-reduce (psum) bandwidth probe over a device mesh.
+
+The workload-side analog of the reference's NCCL broadcast / nvbandwidth
+assertions (tests/bats/test_cd_mnnvl_workload.bats:18-45): a JAX ``psum``
+across every visible device, timed, reported as *algorithm bandwidth*
+(payload bytes / time) and *bus bandwidth* (scaled by ``2*(n-1)/n``, the
+standard ring all-reduce traffic factor, so numbers are comparable across
+device counts and to NCCL-style reporting).
+
+On a driver-provisioned slice the devices JAX sees are exactly the chips the
+DRA claim allocated (``TPU_VISIBLE_CHIPS`` from the claim's CDI spec), so
+this measures the ICI path the ComputeDomain stitched together.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def device_put_sharded_uniform(nbytes_per_device: int, devices: List
+                               ) -> jax.Array:
+    """One bf16 shard of `nbytes_per_device` on each device, stacked on a
+    1-D 'x' mesh (leading dim = device count). Shards are created directly
+    under the sharding — no full-array staging on device 0."""
+    n = len(devices)
+    elems = max(1, nbytes_per_device // 2)  # bfloat16 = 2 bytes
+    sharding = NamedSharding(Mesh(devices, ("x",)), P("x"))
+    make = jax.jit(lambda: jnp.ones((n, elems), dtype=jnp.bfloat16),
+                   out_shardings=sharding)
+    return make()
+
+
+def allreduce_bandwidth(nbytes_per_device: int = 64 << 20,
+                        iters: int = 10, warmup: int = 3,
+                        devices: Optional[List] = None) -> Dict[str, float]:
+    """Time `psum` over all (or the given) devices.
+
+    Returns {algo_gbps, bus_gbps, n_devices, payload_mib, mean_s}.
+    Single-device degenerates to an on-chip reduction (no ICI traffic);
+    bus_gbps is reported as 0 in that case to avoid a misleading number.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    x = device_put_sharded_uniform(nbytes_per_device, devices)
+    # Single source of truth for the mesh: the one the input is sharded on.
+    mesh = x.sharding.mesh
+
+    @jax.jit
+    def step(v):
+        # shard_map gives the per-device view; psum is the collective under
+        # test. Out spec keeps the result replicated so nothing is lazily
+        # discarded by DCE.
+        return jax.shard_map(
+            lambda s: jax.lax.psum(s, "x"),
+            mesh=mesh, in_specs=P("x"), out_specs=P(None))(v)
+
+    # Warmup covers compile (first TPU compile ~20-40s) + cache effects.
+    for _ in range(warmup):
+        step(x).block_until_ready()
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = step(x)
+    out.block_until_ready()
+    mean_s = (time.perf_counter() - t0) / iters
+
+    payload = x.dtype.itemsize * x.shape[1]  # bytes contributed per device
+    algo_gbps = payload / mean_s / 1e9
+    bus_gbps = algo_gbps * (2 * (n - 1) / n) if n > 1 else 0.0
+    return {
+        "algo_gbps": algo_gbps,
+        "bus_gbps": bus_gbps,
+        "n_devices": float(n),
+        "payload_mib": payload / (1 << 20),
+        "mean_s": mean_s,
+    }

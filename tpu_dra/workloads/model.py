@@ -1,0 +1,187 @@
+"""SPMD transformer LM — the flagship workload for driver-allocated slices.
+
+The multi-node e2e benchmark pod the driver schedules (the analog of the
+reference's NCCL/nvbandwidth workload images) runs this model's training
+step over a `jax.sharding.Mesh` spanning the chips a ComputeDomain claim
+allocated. Design is TPU-first:
+
+- params and activations are bfloat16 on the matmul path (MXU-friendly),
+  fp32 master copies only where it matters (logits/loss, optimizer state);
+- sharding is expressed as `PartitionSpec`s over a ('data', 'model') mesh —
+  batch/sequence on 'data' (DP + sequence sharding), hidden/heads on 'model'
+  (TP). XLA inserts the all-reduce/reduce-scatter collectives over ICI;
+- static shapes, `jax.checkpoint` on blocks to trade FLOPs for HBM;
+- no Python control flow inside jit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    vocab: int = 256
+    d_model: int = 128
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 512
+    max_seq: int = 128
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def d_head(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def _split(key, n):
+    return jax.random.split(key, n)
+
+
+def init_params(key, cfg: ModelConfig) -> Params:
+    """Initialize fp32 params (cast to cfg.dtype inside the forward)."""
+    def dense(key, shape):
+        fan_in = shape[0]
+        return jax.random.normal(key, shape, jnp.float32) / math.sqrt(fan_in)
+
+    keys = _split(key, 2 + cfg.n_layers)
+    params: Params = {
+        "embed": jax.random.normal(keys[0], (cfg.vocab, cfg.d_model),
+                                   jnp.float32) * 0.02,
+        "unembed": dense(keys[1], (cfg.d_model, cfg.vocab)),
+        "blocks": [],
+    }
+    for i in range(cfg.n_layers):
+        k = _split(keys[2 + i], 6)
+        params["blocks"].append({
+            "ln1_scale": jnp.ones((cfg.d_model,), jnp.float32),
+            "ln2_scale": jnp.ones((cfg.d_model,), jnp.float32),
+            "wqkv": dense(k[0], (cfg.d_model, 3 * cfg.d_model)),
+            "wo": dense(k[1], (cfg.d_model, cfg.d_model)),
+            "w_up": dense(k[2], (cfg.d_model, cfg.d_ff)),
+            "w_down": dense(k[3], (cfg.d_ff, cfg.d_model)),
+        })
+    return params
+
+
+def param_specs(cfg: ModelConfig) -> Params:
+    """PartitionSpecs mirroring init_params: TP shards the head/ff dims on
+    'model'; embeddings shard the vocab dim (row-parallel)."""
+    block = {
+        "ln1_scale": P(None),
+        "ln2_scale": P(None),
+        "wqkv": P(None, "model"),      # column-parallel QKV
+        "wo": P("model", None),        # row-parallel output proj
+        "w_up": P(None, "model"),      # column-parallel up-proj
+        "w_down": P("model", None),    # row-parallel down-proj
+    }
+    return {
+        "embed": P("model", None),
+        "unembed": P(None, "model"),
+        "blocks": [dict(block) for _ in range(cfg.n_layers)],
+    }
+
+
+def _rmsnorm(x, scale):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * scale.astype(x.dtype)
+
+
+def _rope(x, positions):
+    """Rotary position embedding; x: [B, S, H, D]."""
+    d = x.shape[-1]
+    freqs = jnp.exp(-jnp.arange(0, d, 2, dtype=jnp.float32)
+                    * (math.log(10000.0) / d))
+    angles = positions[:, :, None, None].astype(jnp.float32) * freqs
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    rotated = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return rotated.reshape(x.shape).astype(x.dtype)
+
+
+def _block(params, x, positions, cfg: ModelConfig):
+    B, S, D = x.shape
+    h = _rmsnorm(x, params["ln1_scale"])
+    qkv = h @ params["wqkv"].astype(cfg.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = _rope(q.reshape(B, S, cfg.n_heads, cfg.d_head), positions)
+    k = _rope(k.reshape(B, S, cfg.n_heads, cfg.d_head), positions)
+    v = v.reshape(B, S, cfg.n_heads, cfg.d_head)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(cfg.d_head)
+    causal = jnp.tril(jnp.ones((S, S), jnp.bool_))
+    scores = jnp.where(causal, scores.astype(jnp.float32), -1e30)
+    attn = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+    ctx = jnp.einsum("bhqk,bkhd->bqhd", attn, v).reshape(B, S, D)
+    x = x + ctx @ params["wo"].astype(cfg.dtype)
+
+    h = _rmsnorm(x, params["ln2_scale"])
+    up = jax.nn.gelu(h @ params["w_up"].astype(cfg.dtype))
+    return x + up @ params["w_down"].astype(cfg.dtype)
+
+
+class TransformerLM:
+    """Functional model wrapper: forward(params, tokens) -> logits."""
+
+    def __init__(self, cfg: ModelConfig):
+        self.cfg = cfg
+
+    def forward(self, params: Params, tokens: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        B, S = tokens.shape
+        x = params["embed"].astype(cfg.dtype)[tokens]
+        positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+        for bp in params["blocks"]:
+            # Rematerialize block activations: HBM for FLOPs.
+            x = jax.checkpoint(
+                lambda p, v: _block(p, v, positions, cfg))(bp, x)
+        x = _rmsnorm(x, jnp.ones((cfg.d_model,)))
+        return (x @ params["unembed"].astype(cfg.dtype)).astype(jnp.float32)
+
+
+def loss_fn(model: TransformerLM, params: Params, tokens: jax.Array) -> jax.Array:
+    logits = model.forward(params, tokens[:, :-1])
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    return jnp.mean(nll)
+
+
+def make_train_step(model: TransformerLM, mesh: Mesh, lr: float = 1e-3):
+    """Build a jitted SGD train step with explicit in/out shardings.
+
+    Batch (and thus sequence blocks after reshape) shard on 'data';
+    parameters shard per `param_specs` on 'model'. Gradients reduce over
+    'data' via the psum XLA inserts for the replicated-param out-sharding.
+    """
+    cfg = model.cfg
+    specs = param_specs(cfg)
+    p_shard = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                           is_leaf=lambda x: isinstance(x, P))
+    batch_shard = NamedSharding(mesh, P("data", None))
+
+    def step(params, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(model, p, tokens))(params)
+        new_params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+        return new_params, loss
+
+    return jax.jit(step,
+                   in_shardings=(p_shard, batch_shard),
+                   out_shardings=(p_shard, NamedSharding(mesh, P())))
+
+
+def shard_params(params: Params, mesh: Mesh, cfg: ModelConfig) -> Params:
+    # Map over specs first: is_leaf applies to the first tree, and P must be
+    # treated as a leaf (it is sequence-like and would otherwise traverse).
+    specs = param_specs(cfg)
+    return jax.tree.map(
+        lambda spec, arr: jax.device_put(arr, NamedSharding(mesh, spec)),
+        specs, params, is_leaf=lambda x: isinstance(x, P))
