@@ -52,12 +52,11 @@ def _make_claim(cluster, chips, name):
 
 
 def bench_claim_to_ready(n_cycles: int = 40):
-    import grpc
-
     from tpu_dra.api.types import TPU_DRIVER_NAME
     from tpu_dra.cdi.handler import CDIHandler
-    from tpu_dra.k8s import FakeCluster, RESOURCECLAIMS
+    from tpu_dra.k8s import FakeCluster
     from tpu_dra.kubeletplugin.gen import dra_v1_pb2 as dra
+    from tpu_dra.kubeletplugin.server import kubelet_stubs
     from tpu_dra.native.tpuinfo import get_backend
     from tpu_dra.tpuplugin.checkpoint import CheckpointManager
     from tpu_dra.tpuplugin.device_state import DeviceState
@@ -76,17 +75,8 @@ def bench_claim_to_ready(n_cycles: int = 40):
                        plugin_dir=os.path.join(tmp, "p"),
                        registry_dir=os.path.join(tmp, "r"))
     driver.start()
-    channel = grpc.insecure_channel(f"unix://{driver.server.dra_socket}")
+    channel, prepare, unprepare = kubelet_stubs(driver.server.dra_socket)
     try:
-        prepare = channel.unary_unary(
-            "/k8s.io.kubelet.pkg.apis.dra.v1.DRAPlugin/NodePrepareResources",
-            request_serializer=dra.NodePrepareResourcesRequest.SerializeToString,
-            response_deserializer=dra.NodePrepareResourcesResponse.FromString)
-        unprepare = channel.unary_unary(
-            "/k8s.io.kubelet.pkg.apis.dra.v1.DRAPlugin/NodeUnprepareResources",
-            request_serializer=dra.NodeUnprepareResourcesRequest.SerializeToString,
-            response_deserializer=dra.NodeUnprepareResourcesResponse.FromString)
-
         def grpc_prepare(obj):
             uid = obj["metadata"]["uid"]
             req = dra.NodePrepareResourcesRequest()
@@ -153,6 +143,10 @@ def bench_psum(visible_chips: str):
     r = allreduce_bandwidth(nbytes_per_device=payload, iters=10, warmup=3,
                             devices=devices)
     r["platform"] = devices[0].platform
+    # Flag degraded coverage: the claim allocated more chips than this
+    # process can see as JAX devices (e.g. single-chip tunnel vs 4 fake
+    # chips) — the psum then measures a subset, not the full slice.
+    r["coverage"] = f"{len(devices)}/{len(want) or len(all_devices)}"
     return r
 
 
@@ -165,6 +159,7 @@ def main():
         out["psum_algo_gbps"] = round(psum["algo_gbps"], 3)
         out["psum_bus_gbps"] = round(psum["bus_gbps"], 3)
         out["psum_devices"] = int(psum["n_devices"])
+        out["psum_coverage"] = psum["coverage"]
         out["platform"] = psum["platform"]
     except Exception as e:  # noqa: BLE001 — JAX phase is best-effort
         out["psum_error"] = str(e)

@@ -1,0 +1,235 @@
+"""ComputeDomain controller reconciliation against the fake API server.
+
+Covers the reference's controller state machine (cmd/compute-domain-
+controller): stamping (finalizer, DaemonSet, RCTs), readiness transitions,
+daemon-pod deletion handling, ordered teardown, and stale-object GC.
+"""
+
+import uuid
+
+import pytest
+
+from tpu_dra.api import types as apitypes
+from tpu_dra.cdcontroller import Controller
+from tpu_dra.cdcontroller.templates import daemon_object_name
+from tpu_dra.k8s import (
+    COMPUTEDOMAINS, DAEMONSETS, FakeCluster, NODES, PODS,
+    RESOURCECLAIMTEMPLATES,
+)
+from tpu_dra.k8s.client import NotFoundError
+
+NS = "tpu-dra-driver"
+LABEL = apitypes.COMPUTE_DOMAIN_LABEL_KEY
+
+
+def make_cd(cluster, name="cd-1", namespace="user-ns", num_nodes=2,
+            rct_name="my-workload-rct", allocation_mode="Single"):
+    return cluster.create(COMPUTEDOMAINS, {
+        "apiVersion": apitypes.API_VERSION,
+        "kind": "ComputeDomain",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {"numNodes": num_nodes,
+                 "channel": {"resourceClaimTemplate": {"name": rct_name},
+                             "allocationMode": allocation_mode}},
+    })
+
+
+@pytest.fixture
+def harness():
+    cluster = FakeCluster()
+    controller = Controller(cluster, namespace=NS, image="img:test",
+                            gc_interval=3600.0)
+    controller.start()
+    yield {"cluster": cluster, "controller": controller}
+    controller.stop()
+
+
+def get_cd(cluster, name="cd-1", namespace="user-ns"):
+    return cluster.get(COMPUTEDOMAINS, name, namespace)
+
+
+class TestStamping:
+    def test_finalizer_and_objects_created(self, harness):
+        cluster = harness["cluster"]
+        cd = make_cd(cluster)
+        dsname = daemon_object_name(cd)
+
+        assert cluster.wait_for(lambda: apitypes.COMPUTE_DOMAIN_FINALIZER in (
+            get_cd(cluster)["metadata"].get("finalizers") or []))
+        assert cluster.wait_for(
+            lambda: _exists(cluster, DAEMONSETS, dsname, NS))
+        assert cluster.wait_for(
+            lambda: _exists(cluster, RESOURCECLAIMTEMPLATES, dsname, NS))
+        assert cluster.wait_for(lambda: _exists(
+            cluster, RESOURCECLAIMTEMPLATES, "my-workload-rct", "user-ns"))
+
+        ds = cluster.get(DAEMONSETS, dsname, NS)
+        uid = cd["metadata"]["uid"]
+        assert ds["metadata"]["labels"][LABEL] == uid
+        assert ds["spec"]["template"]["spec"]["nodeSelector"][LABEL] == uid
+
+        daemon_rct = cluster.get(RESOURCECLAIMTEMPLATES, dsname, NS)
+        params = daemon_rct["spec"]["spec"]["devices"]["config"][0][
+            "opaque"]["parameters"]
+        assert params["kind"] == "ComputeDomainDaemonConfig"
+        assert params["domainID"] == uid
+
+        workload = cluster.get(RESOURCECLAIMTEMPLATES, "my-workload-rct",
+                               "user-ns")
+        params = workload["spec"]["spec"]["devices"]["config"][0][
+            "opaque"]["parameters"]
+        assert params["kind"] == "ComputeDomainChannelConfig"
+        assert params["domainID"] == uid
+        assert params["allocationMode"] == "Single"
+        req = workload["spec"]["spec"]["devices"]["requests"][0]
+        assert req["exactly"]["deviceClassName"] == apitypes.DEVICE_CLASS_CHANNEL
+
+    def test_allocation_mode_all_propagated(self, harness):
+        cluster = harness["cluster"]
+        make_cd(cluster, name="cd-all", rct_name="rct-all",
+                allocation_mode="All")
+        assert cluster.wait_for(
+            lambda: _exists(cluster, RESOURCECLAIMTEMPLATES, "rct-all",
+                            "user-ns"))
+        workload = cluster.get(RESOURCECLAIMTEMPLATES, "rct-all", "user-ns")
+        params = workload["spec"]["spec"]["devices"]["config"][0][
+            "opaque"]["parameters"]
+        assert params["allocationMode"] == "All"
+
+    def test_reconcile_idempotent(self, harness):
+        cluster = harness["cluster"]
+        cd = make_cd(cluster)
+        dsname = daemon_object_name(cd)
+        assert cluster.wait_for(lambda: _exists(cluster, DAEMONSETS, dsname, NS))
+        # Force another pass; nothing should error or duplicate.
+        harness["controller"].enqueue(cd["metadata"]["uid"])
+        assert cluster.wait_for(lambda: len(
+            cluster.list(DAEMONSETS, namespace=NS)) == 1)
+
+
+class TestReadiness:
+    def _set_ds_ready(self, cluster, cd, ready, desired=None):
+        dsname = daemon_object_name(cd)
+        ds = cluster.get(DAEMONSETS, dsname, NS)
+        ds["status"] = {"numberReady": ready,
+                        "desiredNumberScheduled": desired
+                        if desired is not None else ready}
+        cluster.update_status(DAEMONSETS, ds)
+
+    def test_ready_when_numnodes_met(self, harness):
+        cluster = harness["cluster"]
+        cd = make_cd(cluster, num_nodes=2)
+        assert cluster.wait_for(
+            lambda: _exists(cluster, DAEMONSETS, daemon_object_name(cd), NS))
+        self._set_ds_ready(cluster, cd, 2)
+        assert cluster.wait_for(lambda: (get_cd(cluster).get("status") or {})
+                                .get("status") == "Ready")
+        # Drop below numNodes -> NotReady
+        self._set_ds_ready(cluster, cd, 1, desired=2)
+        assert cluster.wait_for(lambda: get_cd(cluster)["status"]["status"]
+                                == "NotReady")
+
+    def test_numnodes_zero_follows_scheduled(self, harness):
+        cluster = harness["cluster"]
+        cd = make_cd(cluster, name="cd-z", num_nodes=0, rct_name="rct-z")
+        assert cluster.wait_for(
+            lambda: _exists(cluster, DAEMONSETS, daemon_object_name(cd), NS))
+        self._set_ds_ready(cluster, cd, 3, desired=3)
+        assert cluster.wait_for(
+            lambda: (get_cd(cluster, "cd-z").get("status") or {})
+            .get("status") == "Ready")
+
+
+class TestPodDeletion:
+    def test_pod_delete_removes_node_from_status(self, harness):
+        cluster = harness["cluster"]
+        cd = make_cd(cluster, num_nodes=2)
+        uid = cd["metadata"]["uid"]
+
+        # Daemon registered two nodes into the CD status (as cd-daemon does).
+        fresh = get_cd(cluster)
+        fresh["status"] = {"status": "Ready", "nodes": [
+            {"name": "node-a", "ipAddress": "10.0.0.1", "sliceID": "s0",
+             "index": 0, "status": "Ready"},
+            {"name": "node-b", "ipAddress": "10.0.0.2", "sliceID": "s0",
+             "index": 1, "status": "Ready"},
+        ]}
+        cluster.update_status(COMPUTEDOMAINS, fresh)
+
+        pod = cluster.create(PODS, {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "daemon-b", "namespace": NS,
+                         "labels": {LABEL: uid}},
+            "status": {"podIP": "10.0.0.2"},
+        })
+        assert cluster.wait_for(lambda: _exists(cluster, PODS, "daemon-b", NS))
+        cluster.delete(PODS, "daemon-b", NS)
+
+        def node_b_gone():
+            nodes = (get_cd(cluster).get("status") or {}).get("nodes") or []
+            return [n["name"] for n in nodes] == ["node-a"]
+        assert cluster.wait_for(node_b_gone)
+        assert get_cd(cluster)["status"]["status"] == "NotReady"
+
+
+class TestTeardown:
+    def test_ordered_teardown(self, harness):
+        cluster = harness["cluster"]
+        cd = make_cd(cluster)
+        uid = cd["metadata"]["uid"]
+        dsname = daemon_object_name(cd)
+        assert cluster.wait_for(lambda: _exists(cluster, DAEMONSETS, dsname, NS))
+        assert cluster.wait_for(lambda: _exists(
+            cluster, RESOURCECLAIMTEMPLATES, "my-workload-rct", "user-ns"))
+
+        # A node labeled into this CD (as the CD kubelet plugin does).
+        cluster.create(NODES, {
+            "apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": "node-a", "labels": {LABEL: uid}}})
+        assert cluster.wait_for(lambda: _exists(cluster, NODES, "node-a"))
+
+        cluster.delete(COMPUTEDOMAINS, "cd-1", "user-ns")
+
+        assert cluster.wait_for(
+            lambda: not _exists(cluster, COMPUTEDOMAINS, "cd-1", "user-ns"))
+        assert not _exists(cluster, DAEMONSETS, dsname, NS)
+        assert not _exists(cluster, RESOURCECLAIMTEMPLATES, dsname, NS)
+        assert not _exists(cluster, RESOURCECLAIMTEMPLATES,
+                           "my-workload-rct", "user-ns")
+        node = cluster.get(NODES, "node-a")
+        assert LABEL not in (node["metadata"].get("labels") or {})
+
+
+class TestCleanup:
+    def test_sweep_collects_orphans(self, harness):
+        cluster = harness["cluster"]
+        ghost_uid = str(uuid.uuid4())
+        cluster.create(RESOURCECLAIMTEMPLATES, {
+            "apiVersion": "resource.k8s.io/v1",
+            "kind": "ResourceClaimTemplate",
+            "metadata": {"name": "orphan-rct", "namespace": NS,
+                         "labels": {LABEL: ghost_uid}},
+            "spec": {"spec": {}}})
+        cluster.create(NODES, {
+            "apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": "node-x", "labels": {LABEL: ghost_uid}}})
+        harness["controller"]._cleanup.sweep()
+        assert not _exists(cluster, RESOURCECLAIMTEMPLATES, "orphan-rct", NS)
+        node = cluster.get(NODES, "node-x")
+        assert LABEL not in (node["metadata"].get("labels") or {})
+
+    def test_sweep_spares_live_cd_objects(self, harness):
+        cluster = harness["cluster"]
+        cd = make_cd(cluster)
+        dsname = daemon_object_name(cd)
+        assert cluster.wait_for(lambda: _exists(cluster, DAEMONSETS, dsname, NS))
+        harness["controller"]._cleanup.sweep()
+        assert _exists(cluster, DAEMONSETS, dsname, NS)
+
+
+def _exists(cluster, gvr, name, ns=None):
+    try:
+        cluster.get(gvr, name, ns)
+        return True
+    except NotFoundError:
+        return False

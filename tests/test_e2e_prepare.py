@@ -13,7 +13,6 @@ import json
 import os
 import uuid
 
-import grpc
 import pytest
 
 from tpu_dra.api.types import API_VERSION, TPU_DRIVER_NAME
@@ -21,6 +20,7 @@ from tpu_dra.cdi.handler import CDIHandler
 from tpu_dra.infra import featuregates
 from tpu_dra.k8s import FakeCluster, RESOURCECLAIMS, RESOURCESLICES, DEPLOYMENTS
 from tpu_dra.kubeletplugin.gen import dra_v1_pb2 as dra
+from tpu_dra.kubeletplugin.server import kubelet_stubs
 from tpu_dra.native.tpuinfo import FakeBackend, HealthEvent, default_fake_chips
 from tpu_dra.tpuplugin.checkpoint import CheckpointManager
 from tpu_dra.tpuplugin.device_state import DeviceState
@@ -67,15 +67,7 @@ def harness(tmp_path):
                        plugin_dir=str(tmp_path / "plugin"),
                        registry_dir=str(tmp_path / "registry"))
     driver.start()
-    channel = grpc.insecure_channel(f"unix://{driver.server.dra_socket}")
-    prepare = channel.unary_unary(
-        "/k8s.io.kubelet.pkg.apis.dra.v1.DRAPlugin/NodePrepareResources",
-        request_serializer=dra.NodePrepareResourcesRequest.SerializeToString,
-        response_deserializer=dra.NodePrepareResourcesResponse.FromString)
-    unprepare = channel.unary_unary(
-        "/k8s.io.kubelet.pkg.apis.dra.v1.DRAPlugin/NodeUnprepareResources",
-        request_serializer=dra.NodeUnprepareResourcesRequest.SerializeToString,
-        response_deserializer=dra.NodeUnprepareResourcesResponse.FromString)
+    channel, prepare, unprepare = kubelet_stubs(driver.server.dra_socket)
     yield {"cluster": cluster, "backend": backend, "cdi": cdi, "state": state,
            "driver": driver, "prepare": prepare, "unprepare": unprepare,
            "tmp": tmp_path, "ckpt": ckpt}

@@ -43,6 +43,14 @@ API_VERSION = f"{GROUP}/{VERSION}"
 TPU_DRIVER_NAME = "tpu.dev"
 COMPUTE_DOMAIN_DRIVER_NAME = "compute-domain.tpu.dev"
 
+# ComputeDomain orchestration constants shared by controller, daemon and
+# CD kubelet plugin (reference: resource.nvidia.com/computeDomain node label,
+# cd-controller computedomain.go finalizer, deviceclass templates).
+COMPUTE_DOMAIN_LABEL_KEY = "resource.tpu.dev/computeDomain"
+COMPUTE_DOMAIN_FINALIZER = "resource.tpu.dev/computeDomain"
+DEVICE_CLASS_DAEMON = "compute-domain-daemon.tpu.dev"
+DEVICE_CLASS_CHANNEL = "compute-domain-default-channel.tpu.dev"
+
 TPU_CONFIG_KIND = "TpuConfig"
 SUBSLICE_CONFIG_KIND = "SubsliceConfig"
 PASSTHROUGH_CONFIG_KIND = "PassthroughConfig"

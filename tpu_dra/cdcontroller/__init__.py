@@ -1,0 +1,10 @@
+"""ComputeDomain controller (reference: cmd/compute-domain-controller).
+
+Cluster-scoped, single-replica control loop: watches ComputeDomain CRs and
+materializes per-CD infrastructure — a per-CD DaemonSet of slice daemons
+(landing only on nodes the CD kubelet plugin labels), the daemon + workload
+ResourceClaimTemplates, Ready/NotReady status transitions, and garbage
+collection of everything when the CD goes away.
+"""
+
+from tpu_dra.cdcontroller.controller import Controller  # noqa: F401

@@ -1,0 +1,365 @@
+"""ComputeDomain reconciliation (reference: cmd/compute-domain-controller).
+
+One `Controller` wires five informers (ComputeDomains, DaemonSets, RCTs,
+daemon Pods, Nodes) into a rate-limited work queue. Reconcile semantics
+follow computedomain.go:57-289:
+
+- add/update: add finalizer, stamp daemon RCT + DaemonSet (driver
+  namespace) and the user-facing workload RCT (CD namespace); flip CD
+  status from DaemonSet readiness (daemonset.go:362-389).
+- delete: ordered teardown — delete stamped objects, strip node labels,
+  assert removal, then remove the finalizer (:237-271).
+- daemon pod deletion: drop that node from CD status by pod IP, flip
+  NotReady below numNodes (daemonsetpods.go:134-173).
+- stale sweeps: CleanupManager GC + node-label sweeps (cleanup.go, node.go).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, List, Optional
+
+from tpu_dra.api import types as apitypes
+from tpu_dra.cdcontroller import templates
+from tpu_dra.cdcontroller.cleanup import CleanupManager
+from tpu_dra.infra.metrics import DefaultRegistry
+from tpu_dra.infra.workqueue import WorkQueue, default_controller_rate_limiter
+from tpu_dra.k8s import (
+    ApiClient, COMPUTEDOMAINS, DAEMONSETS, NODES, PODS, RESOURCECLAIMTEMPLATES,
+)
+from tpu_dra.k8s.client import AlreadyExistsError, ConflictError, NotFoundError
+from tpu_dra.k8s.informer import Informer, label_index, uid_index
+
+log = logging.getLogger("tpu_dra.cdcontroller")
+
+reconciles_total = DefaultRegistry.counter(
+    "tpu_dra_cd_reconciles_total", "ComputeDomain reconcile passes")
+teardowns_total = DefaultRegistry.counter(
+    "tpu_dra_cd_teardowns_total", "ComputeDomain teardown completions")
+
+UID_INDEX = "uid"
+CD_LABEL_INDEX = "cd-uid"
+
+
+class RetryableError(Exception):
+    """Raised to push the reconcile back onto the rate-limited queue."""
+
+
+class Controller:
+    def __init__(self, client: ApiClient, *, namespace: str,
+                 image: str = "tpu-dra-driver:latest",
+                 log_verbosity: int = 0, feature_gates: str = "",
+                 max_nodes_per_slice_domain: int = 64,
+                 gc_interval: float = 600.0):
+        self._client = client
+        self._namespace = namespace  # driver namespace (DS + daemon RCT home)
+        self._image = image
+        self._log_verbosity = log_verbosity
+        self._feature_gates = feature_gates
+        self._max_nodes = max_nodes_per_slice_domain
+        self._queue = WorkQueue(default_controller_rate_limiter(),
+                                log=lambda m: log.debug("%s", m))
+        self._stop = threading.Event()
+
+        self.cd_informer = Informer(client, COMPUTEDOMAINS)
+        self.cd_informer.add_indexer(UID_INDEX, uid_index)
+        self.ds_informer = Informer(
+            client, DAEMONSETS, namespace=namespace,
+            label_selector=apitypes.COMPUTE_DOMAIN_LABEL_KEY)
+        self.ds_informer.add_indexer(
+            CD_LABEL_INDEX, label_index(apitypes.COMPUTE_DOMAIN_LABEL_KEY))
+        self.rct_informer = Informer(
+            client, RESOURCECLAIMTEMPLATES,
+            label_selector=apitypes.COMPUTE_DOMAIN_LABEL_KEY)
+        self.rct_informer.add_indexer(
+            CD_LABEL_INDEX, label_index(apitypes.COMPUTE_DOMAIN_LABEL_KEY))
+        self.pod_informer = Informer(
+            client, PODS, namespace=namespace,
+            label_selector=apitypes.COMPUTE_DOMAIN_LABEL_KEY)
+        self.node_informer = Informer(client, NODES)
+
+        self.cd_informer.on_add(lambda obj: self._enqueue_cd_obj(obj))
+        self.cd_informer.on_update(lambda _old, new: self._enqueue_cd_obj(new))
+        self.cd_informer.on_delete(self._on_cd_deleted)
+        self.ds_informer.on_update(self._on_ds_update)
+        self.pod_informer.on_delete(self._on_pod_deleted)
+
+        self._cleanup = CleanupManager(
+            client=client,
+            cd_exists=lambda uid: self._get_cd_by_uid(uid) is not None,
+            targets=[
+                (DAEMONSETS, namespace),
+                (RESOURCECLAIMTEMPLATES, None),
+            ],
+            interval=gc_interval,
+            extra_sweeps=[self._sweep_stale_node_labels])
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        for inf in (self.cd_informer, self.ds_informer, self.rct_informer,
+                    self.pod_informer, self.node_informer):
+            inf.start()
+        for inf in (self.cd_informer, self.ds_informer, self.rct_informer,
+                    self.pod_informer, self.node_informer):
+            inf.wait_for_sync()
+        self._queue.run_in_thread()
+        self._cleanup.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._cleanup.stop()
+        self._queue.shutdown()
+        for inf in (self.cd_informer, self.ds_informer, self.rct_informer,
+                    self.pod_informer, self.node_informer):
+            inf.stop()
+
+    # -- event handlers (fast, enqueue only) --------------------------------
+
+    def _enqueue_cd_obj(self, cd: Dict) -> None:
+        uid = cd["metadata"].get("uid", "")
+        if uid:
+            self.enqueue(uid)
+
+    def enqueue(self, uid: str) -> None:
+        self._queue.enqueue(uid, self._reconcile, key=f"cd/{uid}")
+
+    def _on_cd_deleted(self, cd: Dict) -> None:
+        # CD fully gone from the API server: sweep anything left behind.
+        uid = cd["metadata"].get("uid", "")
+        if uid:
+            self._queue.enqueue(uid, self._sweep_after_delete,
+                                key=f"gc/{uid}")
+
+    def _on_ds_update(self, _old: Dict, new: Dict) -> None:
+        uid = (new["metadata"].get("labels") or {}).get(
+            apitypes.COMPUTE_DOMAIN_LABEL_KEY)
+        if uid:
+            self.enqueue(uid)
+
+    def _on_pod_deleted(self, pod: Dict) -> None:
+        uid = (pod["metadata"].get("labels") or {}).get(
+            apitypes.COMPUTE_DOMAIN_LABEL_KEY)
+        if uid:
+            self._queue.enqueue((uid, pod), self._handle_pod_deleted,
+                                key=f"pod-del/{uid}/{pod['metadata']['name']}")
+
+    # -- helpers ------------------------------------------------------------
+
+    def _get_cd_by_uid(self, uid: str) -> Optional[Dict]:
+        hits = self.cd_informer.get_by_index(UID_INDEX, uid)
+        return hits[0] if hits else None
+
+    def _fresh_cd(self, uid: str) -> Optional[Dict]:
+        cached = self._get_cd_by_uid(uid)
+        if cached is None:
+            return None
+        meta = cached["metadata"]
+        try:
+            obj = self._client.get(COMPUTEDOMAINS, meta["name"],
+                                   meta.get("namespace"))
+        except NotFoundError:
+            return None
+        return obj if obj["metadata"].get("uid") == uid else None
+
+    # -- reconcile ----------------------------------------------------------
+
+    def _reconcile(self, uid: str) -> None:
+        reconciles_total.inc()
+        cd = self._fresh_cd(uid)
+        if cd is None:
+            self._sweep_after_delete(uid)
+            return
+        if cd["metadata"].get("deletionTimestamp"):
+            self._teardown(cd)
+            return
+        self._ensure_finalizer(cd)
+        self._ensure_stamped_objects(cd)
+        self._update_readiness(cd)
+
+    def _ensure_finalizer(self, cd: Dict) -> None:
+        fins = cd["metadata"].setdefault("finalizers", [])
+        if apitypes.COMPUTE_DOMAIN_FINALIZER in fins:
+            return
+        fins.append(apitypes.COMPUTE_DOMAIN_FINALIZER)
+        try:
+            updated = self._client.update(COMPUTEDOMAINS, cd)
+        except ConflictError as e:
+            raise RetryableError(f"finalizer add conflict: {e}") from e
+        cd["metadata"] = updated["metadata"]
+        self.cd_informer.update_cache(updated)
+
+    def _ensure_stamped_objects(self, cd: Dict) -> None:
+        ns = self._namespace
+        for build, gvr, obj_ns in (
+            (lambda: templates.daemon_claim_template(cd, namespace=ns),
+             RESOURCECLAIMTEMPLATES, ns),
+            (lambda: templates.daemon_daemonset(
+                cd, namespace=ns, image=self._image,
+                daemon_claim_template=templates.daemon_object_name(cd),
+                log_verbosity=self._log_verbosity,
+                feature_gates=self._feature_gates,
+                max_nodes_per_slice_domain=self._max_nodes),
+             DAEMONSETS, ns),
+            (lambda: templates.workload_claim_template(cd),
+             RESOURCECLAIMTEMPLATES,
+             cd["metadata"].get("namespace", "default")),
+        ):
+            obj = build()
+            if not obj["metadata"].get("name"):
+                # spec.channel.resourceClaimTemplate.name unset: without it
+                # the create would 422 on every reconcile. The webhook is the
+                # real gate; skip + log here so the CD can't wedge the queue.
+                log.warning("computedomain %s: no workload RCT name in spec; "
+                            "skipping workload template",
+                            cd["metadata"].get("name"))
+                continue
+            try:
+                created = self._client.create(gvr, obj, namespace=obj_ns)
+            except AlreadyExistsError:
+                continue
+            # Mutation cache: see our own write before the watch lands.
+            if gvr is DAEMONSETS:
+                self.ds_informer.update_cache(created)
+            else:
+                self.rct_informer.update_cache(created)
+
+    def _update_readiness(self, cd: Dict) -> None:
+        """daemonset.go:362-389: global CD status follows DaemonSet
+        readiness vs numNodes. With numNodes==0 (deprecated-field semantics,
+        SliceDaemonsWithDNSNames default) the CD is Ready once every
+        scheduled daemon is ready and at least one is."""
+        uid = cd["metadata"]["uid"]
+        hits = self.ds_informer.get_by_index(CD_LABEL_INDEX, uid)
+        if not hits:
+            return
+        status = hits[0].get("status") or {}
+        ready = status.get("numberReady", 0)
+        desired = status.get("desiredNumberScheduled", 0)
+        num_nodes = (cd.get("spec") or {}).get("numNodes", 0)
+        if num_nodes > 0:
+            want = (apitypes.COMPUTE_DOMAIN_STATUS_READY
+                    if ready >= num_nodes
+                    else apitypes.COMPUTE_DOMAIN_STATUS_NOT_READY)
+        else:
+            want = (apitypes.COMPUTE_DOMAIN_STATUS_READY
+                    if ready > 0 and ready >= desired
+                    else apitypes.COMPUTE_DOMAIN_STATUS_NOT_READY)
+        self._set_cd_status(uid, want)
+
+    def _set_cd_status(self, uid: str, want: str) -> None:
+        cd = self._fresh_cd(uid)
+        if cd is None:
+            return
+        status = cd.setdefault("status", {})
+        if status.get("status") == want:
+            return
+        status["status"] = want
+        status.setdefault("nodes", [])
+        try:
+            updated = self._client.update_status(COMPUTEDOMAINS, cd)
+        except (ConflictError, NotFoundError) as e:
+            raise RetryableError(f"status update: {e}") from e
+        self.cd_informer.update_cache(updated)
+        log.info("computedomain %s/%s status -> %s",
+                 cd["metadata"].get("namespace"), cd["metadata"]["name"], want)
+
+    # -- daemon pod deletions ----------------------------------------------
+
+    def _handle_pod_deleted(self, item) -> None:
+        uid, pod = item
+        cd = self._fresh_cd(uid)
+        if cd is None:
+            return
+        pod_ip = (pod.get("status") or {}).get("podIP", "")
+        if not pod_ip:
+            return
+        nodes = (cd.get("status") or {}).get("nodes") or []
+        kept = [n for n in nodes if n.get("ipAddress") != pod_ip]
+        if len(kept) == len(nodes):
+            return
+        cd.setdefault("status", {})["nodes"] = kept
+        num_nodes = (cd.get("spec") or {}).get("numNodes", 0)
+        if num_nodes and len(kept) < num_nodes:
+            cd["status"]["status"] = apitypes.COMPUTE_DOMAIN_STATUS_NOT_READY
+        try:
+            updated = self._client.update_status(COMPUTEDOMAINS, cd)
+        except (ConflictError, NotFoundError) as e:
+            raise RetryableError(f"pod-delete status update: {e}") from e
+        self.cd_informer.update_cache(updated)
+
+    # -- teardown -----------------------------------------------------------
+
+    def _teardown(self, cd: Dict) -> None:
+        """Ordered teardown (computedomain.go:237-271): stamped objects,
+        node labels, assert removal, then the finalizer."""
+        uid = cd["metadata"]["uid"]
+        ns = self._namespace
+        name = templates.daemon_object_name(cd)
+        workload_name = (((cd.get("spec") or {}).get("channel") or {})
+                         .get("resourceClaimTemplate") or {}).get("name", "")
+        self._client.delete(RESOURCECLAIMTEMPLATES, name, ns)
+        if workload_name:
+            self._client.delete(RESOURCECLAIMTEMPLATES, workload_name,
+                                cd["metadata"].get("namespace", "default"))
+        self._client.delete(DAEMONSETS, name, ns)
+        self._remove_node_labels(uid)
+
+        # Assert removal before dropping the finalizer.
+        leftovers: List[str] = []
+        for gvr, gvr_ns in ((DAEMONSETS, ns), (RESOURCECLAIMTEMPLATES, None)):
+            for obj in self._client.list(
+                    gvr, namespace=gvr_ns,
+                    label_selector=f"{apitypes.COMPUTE_DOMAIN_LABEL_KEY}={uid}"):
+                leftovers.append(f"{gvr.plural}/{obj['metadata']['name']}")
+        if leftovers:
+            raise RetryableError(f"teardown of {uid}: waiting on {leftovers}")
+
+        fins = cd["metadata"].get("finalizers") or []
+        if apitypes.COMPUTE_DOMAIN_FINALIZER in fins:
+            fins.remove(apitypes.COMPUTE_DOMAIN_FINALIZER)
+            cd["metadata"]["finalizers"] = fins
+            try:
+                self._client.update(COMPUTEDOMAINS, cd)
+            except ConflictError as e:
+                raise RetryableError(f"finalizer removal conflict: {e}") from e
+            except NotFoundError:
+                pass
+        teardowns_total.inc()
+        log.info("computedomain %s torn down", uid)
+
+    # -- node labels --------------------------------------------------------
+
+    def _remove_node_labels(self, uid: str) -> None:
+        """node.go:110-146: strip resource.tpu.dev/computeDomain=<uid>."""
+        for node in self.node_informer.lister.list():
+            labels = node["metadata"].get("labels") or {}
+            if labels.get(apitypes.COMPUTE_DOMAIN_LABEL_KEY) != uid:
+                continue
+            try:
+                self._client.patch(
+                    NODES, node["metadata"]["name"],
+                    {"metadata": {"labels": {
+                        apitypes.COMPUTE_DOMAIN_LABEL_KEY: None}}})
+            except NotFoundError:
+                pass
+
+    def _sweep_stale_node_labels(self) -> None:
+        """Periodic stale-label sweep (node.go:159): labels pointing at CDs
+        that no longer exist are removed."""
+        for node in self._client.list(NODES):
+            labels = node["metadata"].get("labels") or {}
+            uid = labels.get(apitypes.COMPUTE_DOMAIN_LABEL_KEY)
+            if uid and self._get_cd_by_uid(uid) is None:
+                try:
+                    self._client.patch(
+                        NODES, node["metadata"]["name"],
+                        {"metadata": {"labels": {
+                            apitypes.COMPUTE_DOMAIN_LABEL_KEY: None}}})
+                except NotFoundError:
+                    pass
+
+    def _sweep_after_delete(self, uid: str) -> None:
+        self._remove_node_labels(uid)
+        self._cleanup.collect_uid(uid)

@@ -1,0 +1,83 @@
+"""ComputeDomain controller entrypoint.
+
+Reference: cmd/compute-domain-controller/main.go:48-127, 243-290 — flags
+(incl. --max-nodes-per-slice-domain, the GB200 maxNodesPerIMEXDomain
+analog sized for TPU slice host counts), metrics endpoint, run loop.
+
+Run: ``python -m tpu_dra.cdcontroller.main [flags]``
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+
+from tpu_dra.cdcontroller.controller import Controller
+from tpu_dra.infra import debug
+from tpu_dra.infra.flags import (
+    Flag, FlagSet, apply_feature_gates, feature_gate_flag, logging_flags,
+    setup_logging,
+)
+from tpu_dra.infra.featuregates import Features
+from tpu_dra.infra.metrics import MetricsServer
+from tpu_dra.k8s.client import HttpApiClient
+
+
+def flags() -> FlagSet:
+    return FlagSet("tpu-cd-controller", [
+        Flag("namespace", "NAMESPACE", default="tpu-dra-driver",
+             help="driver namespace (DaemonSets + daemon RCTs land here)"),
+        Flag("image", "DAEMON_IMAGE", default="tpu-dra-driver:latest",
+             help="image for the per-CD slice-daemon DaemonSet"),
+        Flag("max-nodes-per-slice-domain", "MAX_NODES_PER_SLICE_DOMAIN",
+             default=64, type=int,
+             help="upper bound on hosts per ICI slice domain "
+                  "(e.g. 64 hosts = v5e-256)"),
+        Flag("kube-api-url", "KUBE_API_URL", default=None,
+             help="API server URL (default: in-cluster config)"),
+        Flag("http-endpoint-port", "HTTP_ENDPOINT_PORT", default=0, type=int,
+             help="metrics/pprof HTTP port (0 = disabled)"),
+        Flag("gc-interval-seconds", "GC_INTERVAL_SECONDS", default=600,
+             type=int, help="stale-object GC period"),
+        feature_gate_flag(),
+        *logging_flags(),
+    ])
+
+
+def main(argv=None) -> int:
+    fs = flags()
+    ns = fs.parse(argv)
+    logger = setup_logging(ns.v, ns.log_json)
+    apply_feature_gates(ns)
+    fs.dump_config(ns, logger)
+    debug.start_debug_signal_handlers()
+
+    client = HttpApiClient(base_url=ns.kube_api_url)
+    controller = Controller(
+        client, namespace=ns.namespace, image=ns.image,
+        log_verbosity=ns.v, feature_gates=Features.as_string(),
+        max_nodes_per_slice_domain=ns.max_nodes_per_slice_domain,
+        gc_interval=ns.gc_interval_seconds)
+
+    metrics_srv = None
+    if ns.http_endpoint_port:
+        metrics_srv = MetricsServer(addr="0.0.0.0",  # noqa: S104
+                                    port=ns.http_endpoint_port)
+        metrics_srv.start()
+
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+
+    controller.start()
+    logger.info("compute-domain controller running (namespace %s)",
+                ns.namespace)
+    stop.wait()
+    controller.stop()
+    if metrics_srv:
+        metrics_srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

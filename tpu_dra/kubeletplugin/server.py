@@ -135,6 +135,24 @@ def _registration_service(driver_name: str, endpoint: str,
                                                 handlers)
 
 
+def kubelet_stubs(dra_socket: str):
+    """Client-side stubs acting as kubelet: (channel, prepare, unprepare).
+
+    Single source of truth for the DRA v1 method paths/serializers used by
+    the bench harness and the e2e tests; close the returned channel when
+    done."""
+    channel = grpc.insecure_channel(f"unix://{dra_socket}")
+    prepare = channel.unary_unary(
+        "/k8s.io.kubelet.pkg.apis.dra.v1.DRAPlugin/NodePrepareResources",
+        request_serializer=dra.NodePrepareResourcesRequest.SerializeToString,
+        response_deserializer=dra.NodePrepareResourcesResponse.FromString)
+    unprepare = channel.unary_unary(
+        "/k8s.io.kubelet.pkg.apis.dra.v1.DRAPlugin/NodeUnprepareResources",
+        request_serializer=dra.NodeUnprepareResourcesRequest.SerializeToString,
+        response_deserializer=dra.NodeUnprepareResourcesResponse.FromString)
+    return channel, prepare, unprepare
+
+
 class DRAPluginServer:
     """Hosts the DRA + Registration services on unix sockets.
 
