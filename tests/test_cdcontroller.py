@@ -200,6 +200,56 @@ class TestTeardown:
         assert LABEL not in (node["metadata"].get("labels") or {})
 
 
+class TestTeardownRenamedRCT:
+    def test_renamed_workload_rct_does_not_wedge_teardown(self, harness):
+        """A workload RCT stamped under an older spec name still carries the
+        CD label; teardown must collect it by label, not by current name."""
+        cluster = harness["cluster"]
+        cd = make_cd(cluster, rct_name="rct-new")
+        uid = cd["metadata"]["uid"]
+        assert cluster.wait_for(lambda: _exists(
+            cluster, RESOURCECLAIMTEMPLATES, "rct-new", "user-ns"))
+        # Simulate an RCT left over from a previous spec name.
+        cluster.create(RESOURCECLAIMTEMPLATES, {
+            "apiVersion": "resource.k8s.io/v1",
+            "kind": "ResourceClaimTemplate",
+            "metadata": {"name": "rct-old", "namespace": "user-ns",
+                         "labels": {LABEL: uid}},
+            "spec": {"spec": {}}})
+        cluster.delete(COMPUTEDOMAINS, "cd-1", "user-ns")
+        assert cluster.wait_for(
+            lambda: not _exists(cluster, COMPUTEDOMAINS, "cd-1", "user-ns"))
+        assert not _exists(cluster, RESOURCECLAIMTEMPLATES, "rct-old",
+                           "user-ns")
+
+
+class TestStalePodDeletion:
+    def test_replacement_pod_with_same_ip_survives(self, harness):
+        """hostNetwork daemons: the replacement pod reuses the node IP; the
+        old pod's deletion event must not strip the registration."""
+        cluster = harness["cluster"]
+        cd = make_cd(cluster, num_nodes=1)
+        uid = cd["metadata"]["uid"]
+        fresh = get_cd(cluster)
+        fresh["status"] = {"status": "Ready", "nodes": [
+            {"name": "node-a", "ipAddress": "10.0.0.1", "sliceID": "s0",
+             "index": 0, "status": "Ready"}]}
+        cluster.update_status(COMPUTEDOMAINS, fresh)
+        for podname in ("daemon-old", "daemon-new"):
+            cluster.create(PODS, {
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": podname, "namespace": NS,
+                             "labels": {LABEL: uid}},
+                "status": {"podIP": "10.0.0.1"}})
+        assert cluster.wait_for(
+            lambda: _exists(cluster, PODS, "daemon-new", NS))
+        cluster.delete(PODS, "daemon-old", NS)
+        import time
+        time.sleep(0.5)  # give the (wrong) removal a chance to happen
+        nodes = (get_cd(cluster).get("status") or {}).get("nodes") or []
+        assert [n["name"] for n in nodes] == ["node-a"]
+
+
 class TestCleanup:
     def test_sweep_collects_orphans(self, harness):
         cluster = harness["cluster"]

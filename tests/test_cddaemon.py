@@ -1,0 +1,298 @@
+"""ComputeDomain daemon: registration, naming, supervision, native daemon.
+
+Covers the reference's cd-daemon behaviors (cmd/compute-domain-daemon):
+index-stable registration with gap filling, /etc/hosts + nodes.cfg
+maintenance, process watchdog restarts, and the READY probe against the
+real C++ tpu-slice-daemon binary.
+"""
+
+import os
+import signal
+import socket
+import time
+
+import pytest
+
+from tpu_dra.api import types as apitypes
+from tpu_dra.cddaemon.computedomain import (
+    ComputeDomainManager, IndexAllocationError, allocate_index,
+)
+from tpu_dra.cddaemon.dnsnames import (
+    stable_name, update_hosts_file, write_nodes_config,
+)
+from tpu_dra.cddaemon.main import DaemonRunner, discover_slice_id, flags, probe_ready
+from tpu_dra.cddaemon.process import ProcessManager
+from tpu_dra.k8s import COMPUTEDOMAINS, FakeCluster
+from tpu_dra.native.tpuinfo import FakeBackend, default_fake_chips
+
+DAEMON_BIN = os.path.join(os.path.dirname(__file__), "..", "native", "build",
+                          "tpu-slice-daemon")
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def make_cd(cluster, name="cd-1", namespace="user-ns"):
+    return cluster.create(COMPUTEDOMAINS, {
+        "apiVersion": apitypes.API_VERSION, "kind": "ComputeDomain",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {"numNodes": 2, "channel": {
+            "resourceClaimTemplate": {"name": "rct"},
+            "allocationMode": "Single"}},
+    })
+
+
+class TestIndexAllocation:
+    def test_gap_filling_within_slice(self):
+        nodes = [{"sliceID": "s0", "index": 0},
+                 {"sliceID": "s0", "index": 2},
+                 {"sliceID": "s1", "index": 1}]
+        assert allocate_index(nodes, "s0", 64) == 1
+        assert allocate_index(nodes, "s1", 64) == 0
+        assert allocate_index(nodes, "s2", 64) == 0
+
+    def test_bound(self):
+        nodes = [{"sliceID": "s0", "index": i} for i in range(4)]
+        with pytest.raises(IndexAllocationError):
+            allocate_index(nodes, "s0", 4)
+
+
+class TestRegistration:
+    def _mgr(self, cluster, cd, node, ip, slice_id="s0"):
+        return ComputeDomainManager(
+            cluster, cd_name=cd["metadata"]["name"],
+            cd_namespace=cd["metadata"]["namespace"],
+            cd_uid=cd["metadata"]["uid"], node_name=node, node_ip=ip,
+            slice_id=slice_id, max_nodes=8)
+
+    def test_three_nodes_stable_indices(self):
+        cluster = FakeCluster()
+        cd = make_cd(cluster)
+        mgrs = [self._mgr(cluster, cd, f"node-{c}", f"10.0.0.{i}")
+                for i, c in enumerate("abc")]
+        assert [m.ensure_node_info() for m in mgrs] == [0, 1, 2]
+        # Re-register is idempotent.
+        assert mgrs[1].ensure_node_info() == 1
+        # Middle node leaves; a new node fills its gap.
+        mgrs[1].remove_node_info()
+        new = self._mgr(cluster, cd, "node-d", "10.0.0.9")
+        assert new.ensure_node_info() == 1
+
+    def test_heterogeneous_slices_get_independent_indices(self):
+        cluster = FakeCluster()
+        cd = make_cd(cluster)
+        a = self._mgr(cluster, cd, "node-a", "10.0.0.1", "slice-A")
+        b = self._mgr(cluster, cd, "node-b", "10.0.0.2", "slice-B")
+        assert a.ensure_node_info() == 0
+        assert b.ensure_node_info() == 0
+        node_set = tuple(sorted(
+            (n["name"], n["ipAddress"], n["sliceID"], n["index"])
+            for n in cluster.get(COMPUTEDOMAINS, "cd-1", "user-ns")
+            ["status"]["nodes"]))
+        assert a.slice_peers(node_set) == [(0, "10.0.0.1")]
+        assert b.slice_peers(node_set) == [(0, "10.0.0.2")]
+
+    def test_set_node_status(self):
+        cluster = FakeCluster()
+        cd = make_cd(cluster)
+        mgr = self._mgr(cluster, cd, "node-a", "10.0.0.1")
+        mgr.ensure_node_info()
+        mgr.set_node_status(True)
+        nodes = cluster.get(COMPUTEDOMAINS, "cd-1", "user-ns")["status"]["nodes"]
+        assert nodes[0]["status"] == "Ready"
+
+    def test_ip_change_updates_registration(self):
+        cluster = FakeCluster()
+        cd = make_cd(cluster)
+        mgr = self._mgr(cluster, cd, "node-a", "10.0.0.1")
+        assert mgr.ensure_node_info() == 0
+        mgr2 = self._mgr(cluster, cd, "node-a", "10.0.0.99")
+        assert mgr2.ensure_node_info() == 0  # index stable across IP change
+        nodes = cluster.get(COMPUTEDOMAINS, "cd-1", "user-ns")["status"]["nodes"]
+        assert nodes[0]["ipAddress"] == "10.0.0.99"
+
+
+class TestDnsNames:
+    def test_hosts_block_managed(self, tmp_path):
+        hosts = tmp_path / "hosts"
+        hosts.write_text("127.0.0.1 localhost\n")
+        assert update_hosts_file(str(hosts), [(0, "10.0.0.1"), (1, "10.0.0.2")])
+        content = hosts.read_text()
+        assert "127.0.0.1 localhost" in content
+        assert f"10.0.0.1\t{stable_name(0)}" in content
+        # Unchanged content -> no rewrite reported.
+        assert not update_hosts_file(str(hosts),
+                                     [(0, "10.0.0.1"), (1, "10.0.0.2")])
+        # Member IP changes in place, block not duplicated.
+        assert update_hosts_file(str(hosts), [(0, "10.0.0.7")])
+        content = hosts.read_text()
+        assert content.count("BEGIN tpu-dra") == 1
+        assert "10.0.0.2" not in content
+
+    def test_nodes_config_change_detection(self, tmp_path):
+        path = str(tmp_path / "nodes.cfg")
+        assert write_nodes_config(path, ["a", "b"], 7551)
+        assert open(path).read() == "a:7551\nb:7551\n"
+        assert not write_nodes_config(path, ["a", "b"], 7551)
+        assert write_nodes_config(path, ["a"], 7551)
+
+
+class TestProcessManager:
+    def test_watchdog_restarts_on_unexpected_exit(self):
+        pm = ProcessManager(["sleep", "60"], watchdog_interval=0.05)
+        pm.ensure_started()
+        try:
+            assert pm.running()
+            pm._proc.kill()
+            deadline = time.monotonic() + 3
+            while time.monotonic() < deadline and pm.restarts == 0:
+                time.sleep(0.05)
+            assert pm.restarts >= 1
+            assert pm.running()
+        finally:
+            pm.stop()
+        assert not pm.running()
+
+    def test_reusable_after_stop(self):
+        """stop() then ensure_started() must re-arm the watchdog."""
+        pm = ProcessManager(["sleep", "60"], watchdog_interval=0.05)
+        pm.ensure_started()
+        pm.stop()
+        pm.ensure_started()
+        try:
+            pm._proc.kill()
+            deadline = time.monotonic() + 3
+            while time.monotonic() < deadline and pm.restarts == 0:
+                time.sleep(0.05)
+            assert pm.restarts >= 1
+        finally:
+            pm.stop()
+
+    def test_restart_and_signal(self):
+        pm = ProcessManager(["sleep", "60"], watchdog_interval=10)
+        pm.ensure_started()
+        try:
+            pid1 = pm._proc.pid
+            pm.restart()
+            assert pm._proc.pid != pid1
+            pm.signal(signal.SIGUSR1)  # sleep dies on SIGUSR1
+            time.sleep(0.1)
+            assert pm._proc.poll() is not None
+        finally:
+            pm.stop()
+
+
+@pytest.mark.skipif(not os.path.exists(DAEMON_BIN),
+                    reason="native daemon not built")
+class TestNativeDaemon:
+    def _write_cfg(self, tmp_path, port, nodes="", slice_id="s0", idx=0):
+        nodes_path = tmp_path / "nodes.cfg"
+        nodes_path.write_text(nodes)
+        cfg = tmp_path / "daemon.cfg"
+        cfg.write_text(f"node_ip=127.0.0.1\nport={port}\n"
+                       f"nodes_config={nodes_path}\nslice_id={slice_id}\n"
+                       f"worker_index={idx}\n")
+        return str(cfg)
+
+    def _wait_ready(self, port, timeout=5.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if probe_ready(port):
+                return True
+            time.sleep(0.05)
+        return False
+
+    def test_ready_and_peer_rendezvous(self, tmp_path):
+        port_a, port_b = free_port(), free_port()
+        (tmp_path / "a").mkdir()
+        (tmp_path / "b").mkdir()
+        pm_a = ProcessManager([DAEMON_BIN, "--config",
+                               self._write_cfg(tmp_path / "a", port_a)])
+        pm_b = ProcessManager([DAEMON_BIN, "--config",
+                               self._write_cfg(tmp_path / "b", port_b,
+                                               nodes=f"127.0.0.1:{port_a}\n",
+                                               idx=1)])
+        pm_a.ensure_started()
+        pm_b.ensure_started()
+        try:
+            assert self._wait_ready(port_a)
+            assert self._wait_ready(port_b)
+
+            # B dials A ("H" hello) and reports it reachable.
+            def b_sees_peer():
+                with socket.create_connection(("127.0.0.1", port_b), 1) as s:
+                    s.sendall(b"Q\n")
+                    return b"peers=1/1" in s.recv(128)
+            deadline = time.monotonic() + 5
+            ok = False
+            while time.monotonic() < deadline and not ok:
+                ok = b_sees_peer()
+                time.sleep(0.1)
+            assert ok
+        finally:
+            pm_a.stop()
+            pm_b.stop()
+
+
+@pytest.mark.skipif(not os.path.exists(DAEMON_BIN),
+                    reason="native daemon not built")
+class TestDaemonRunner:
+    def test_end_to_end_registration_and_readiness(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TPU_DRA_FAKE_SLICE_ID", "slice-A")
+        cluster = FakeCluster()
+        cd = make_cd(cluster)
+        port = free_port()
+        ns = flags().parse([
+            "--cd-uid", cd["metadata"]["uid"],
+            "--cd-name", "cd-1", "--cd-namespace", "user-ns",
+            "--node-name", "node-a", "--pod-ip", "127.0.0.1",
+            "--port", str(port),
+            "--work-dir", str(tmp_path / "work"),
+            "--hosts-file", str(tmp_path / "hosts"),
+            "--daemon-binary", DAEMON_BIN,
+        ])
+        runner = DaemonRunner(cluster, ns)
+        assert runner.slice_id == "slice-A"
+        runner.start()
+        try:
+            def node_ready():
+                nodes = (cluster.get(COMPUTEDOMAINS, "cd-1", "user-ns")
+                         .get("status") or {}).get("nodes") or []
+                return bool(nodes) and nodes[0]["status"] == "Ready"
+            assert cluster.wait_for(node_ready, timeout=10)
+            nodes = cluster.get(COMPUTEDOMAINS, "cd-1",
+                                "user-ns")["status"]["nodes"]
+            assert nodes[0]["name"] == "node-a"
+            assert nodes[0]["sliceID"] == "slice-A"
+            # Membership update loop rendered hosts + nodes.cfg.
+            assert cluster.wait_for(lambda: os.path.exists(
+                str(tmp_path / "hosts")), timeout=5)
+            hosts = open(str(tmp_path / "hosts")).read()
+            assert stable_name(0) in hosts
+        finally:
+            runner.stop()
+        # Self-removal on shutdown.
+        nodes = (cluster.get(COMPUTEDOMAINS, "cd-1", "user-ns")
+                 .get("status") or {}).get("nodes") or []
+        assert nodes == []
+
+
+class TestDiscoverSliceId:
+    def test_uniform(self):
+        b = FakeBackend(default_fake_chips(4, "v5e", slice_id="sl"))
+        assert discover_slice_id(b) == "sl"
+
+    def test_conflict_raises(self):
+        chips = (default_fake_chips(2, "v5e", slice_id="s1")
+                 + [c for c in default_fake_chips(4, "v5e", slice_id="s2")
+                    if c.index >= 2])
+        b = FakeBackend(chips)
+        with pytest.raises(RuntimeError):
+            discover_slice_id(b)
+
+    def test_empty_is_dcn_only(self):
+        b = FakeBackend(default_fake_chips(2, "v5e"))
+        assert discover_slice_id(b) == ""

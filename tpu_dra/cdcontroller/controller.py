@@ -275,6 +275,15 @@ class Controller:
         pod_ip = (pod.get("status") or {}).get("podIP", "")
         if not pod_ip:
             return
+        # Stale-event guard: with hostNetwork the replacement daemon pod
+        # reuses the node IP, and its registration must not be stripped by
+        # the queued deletion of its predecessor.
+        for live in self.pod_informer.lister.list():
+            if (live["metadata"]["name"] != pod["metadata"]["name"]
+                    and (live["metadata"].get("labels") or {}).get(
+                        apitypes.COMPUTE_DOMAIN_LABEL_KEY) == uid
+                    and (live.get("status") or {}).get("podIP") == pod_ip):
+                return
         nodes = (cd.get("status") or {}).get("nodes") or []
         kept = [n for n in nodes if n.get("ipAddress") != pod_ip]
         if len(kept) == len(nodes):
@@ -296,22 +305,23 @@ class Controller:
         node labels, assert removal, then the finalizer."""
         uid = cd["metadata"]["uid"]
         ns = self._namespace
-        name = templates.daemon_object_name(cd)
-        workload_name = (((cd.get("spec") or {}).get("channel") or {})
-                         .get("resourceClaimTemplate") or {}).get("name", "")
-        self._client.delete(RESOURCECLAIMTEMPLATES, name, ns)
-        if workload_name:
-            self._client.delete(RESOURCECLAIMTEMPLATES, workload_name,
-                                cd["metadata"].get("namespace", "default"))
-        self._client.delete(DAEMONSETS, name, ns)
+        # Delete by CD-UID label, not by current spec names: a renamed
+        # workload RCT would otherwise survive with the label and wedge the
+        # leftover assertion forever (the reference also deletes by label
+        # lookup, resourceclaimtemplate.go:195-213).
+        selector = f"{apitypes.COMPUTE_DOMAIN_LABEL_KEY}={uid}"
+        for gvr, gvr_ns in ((RESOURCECLAIMTEMPLATES, None), (DAEMONSETS, ns)):
+            for obj in self._client.list(gvr, namespace=gvr_ns,
+                                         label_selector=selector):
+                self._client.delete(gvr, obj["metadata"]["name"],
+                                    obj["metadata"].get("namespace"))
         self._remove_node_labels(uid)
 
         # Assert removal before dropping the finalizer.
         leftovers: List[str] = []
         for gvr, gvr_ns in ((DAEMONSETS, ns), (RESOURCECLAIMTEMPLATES, None)):
-            for obj in self._client.list(
-                    gvr, namespace=gvr_ns,
-                    label_selector=f"{apitypes.COMPUTE_DOMAIN_LABEL_KEY}={uid}"):
+            for obj in self._client.list(gvr, namespace=gvr_ns,
+                                         label_selector=selector):
                 leftovers.append(f"{gvr.plural}/{obj['metadata']['name']}")
         if leftovers:
             raise RetryableError(f"teardown of {uid}: waiting on {leftovers}")

@@ -1,0 +1,8 @@
+"""ComputeDomain node daemon (reference: cmd/compute-domain-daemon).
+
+Runs in each per-CD DaemonSet pod. Wraps the native ``tpu-slice-daemon``
+binary (the nvidia-imex analog), registers this node into the CD status
+with a stable per-slice index, maintains the peer rendezvous config
+(/etc/hosts + nodes.cfg, SIGUSR1 re-resolve), and exposes the ``check``
+readiness probe.
+"""

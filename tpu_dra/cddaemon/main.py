@@ -1,0 +1,252 @@
+"""ComputeDomain daemon entrypoint: ``run`` and ``check`` subcommands.
+
+Reference: cmd/compute-domain-daemon/main.go —
+``run`` (:190-294): write the native daemon's config with the pod IP,
+register this node into the CD status, spawn the update loop + process
+watchdog; membership changes rewrite /etc/hosts + nodes.cfg and SIGUSR1 the
+daemon (DNS-names mode, :296-377) or rewrite IPs and restart (legacy mode).
+``check`` (:381-405): local readiness probe — READY or exit 1.
+
+Divergence from the reference, by design: the slice daemon runs on every
+member (the reference skips IMEX on empty-clique nodes, main.go:205-213,
+because IMEX would export memory over a fabric that is not there; our
+daemon is a rendezvous/health server with no fabric side effects, so
+DCN-only members get the same probe path — their peer list is just empty).
+
+Run: ``python -m tpu_dra.cddaemon.main run|check [flags]``
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import signal
+import socket
+import sys
+import threading
+
+from tpu_dra.cddaemon.computedomain import ComputeDomainManager
+from tpu_dra.cddaemon.dnsnames import (
+    stable_name, update_hosts_file, write_nodes_config,
+)
+from tpu_dra.cddaemon.process import ProcessManager
+from tpu_dra.infra import debug, featuregates
+from tpu_dra.infra.flags import (
+    Flag, FlagSet, apply_feature_gates, feature_gate_flag, logging_flags,
+    setup_logging,
+)
+from tpu_dra.k8s.client import HttpApiClient
+from tpu_dra.native.tpuinfo import get_backend
+
+log = logging.getLogger("tpu_dra.cddaemon")
+
+DEFAULT_PORT = 7551
+
+
+def _default_daemon_binary() -> str:
+    here = os.path.dirname(os.path.abspath(__file__))
+    candidates = [
+        os.environ.get("TPU_DRA_SLICE_DAEMON", ""),
+        os.path.join(here, "..", "..", "native", "build", "tpu-slice-daemon"),
+        "/usr/local/bin/tpu-slice-daemon",
+    ]
+    for c in candidates:
+        if c and os.path.exists(c):
+            return os.path.abspath(c)
+    return "tpu-slice-daemon"
+
+
+def flags() -> FlagSet:
+    return FlagSet("tpu-cd-daemon", [
+        Flag("cd-uid", "CD_UID", required=True,
+             help="UID of the ComputeDomain this daemon belongs to"),
+        Flag("cd-name", "CD_NAME", required=True, help="ComputeDomain name"),
+        Flag("cd-namespace", "CD_NAMESPACE", required=True,
+             help="ComputeDomain namespace"),
+        Flag("node-name", "NODE_NAME", required=True, help="node name"),
+        Flag("pod-ip", "POD_IP", required=True, help="this pod's IP"),
+        Flag("port", "SLICE_DAEMON_PORT", default=DEFAULT_PORT, type=int,
+             help="slice daemon rendezvous/status port"),
+        Flag("work-dir", "WORK_DIR", default="/var/run/tpu-dra-cd",
+             help="config/state directory (the /imexd analog)"),
+        Flag("hosts-file", "HOSTS_FILE", default="/etc/hosts",
+             help="hosts file managed for stable peer names"),
+        Flag("daemon-binary", "SLICE_DAEMON_BINARY",
+             default=_default_daemon_binary(),
+             help="path to the native tpu-slice-daemon"),
+        Flag("max-nodes-per-slice-domain", "MAX_NODES_PER_SLICE_DOMAIN",
+             default=64, type=int, help="index allocation bound"),
+        Flag("kube-api-url", "KUBE_API_URL", default=None,
+             help="API server URL (default: in-cluster config)"),
+        feature_gate_flag(),
+        *logging_flags(),
+    ])
+
+
+def discover_slice_id(backend) -> str:
+    """cliqueID discovery analog (cd-plugin nvlib.go:187-258): every chip on
+    the node must agree on the slice identity; '' = not part of an ICI slice
+    (DCN-only member of a heterogeneous domain)."""
+    ids = {c.slice_id for c in backend.chips()}
+    if not ids:
+        return ""
+    if len(ids) > 1:
+        raise RuntimeError(
+            f"chips disagree on slice identity: {sorted(ids)}")
+    return ids.pop()
+
+
+class DaemonRunner:
+    """Wires CD registration, the native process, and the update loop;
+    factored as a class so tests can drive it without a real pod."""
+
+    def __init__(self, client, ns):
+        self.ns = ns
+        self.client = client
+        self.backend = get_backend()
+        self.slice_id = discover_slice_id(self.backend)
+        self.cd = ComputeDomainManager(
+            client, cd_name=ns.cd_name, cd_namespace=ns.cd_namespace,
+            cd_uid=ns.cd_uid, node_name=ns.node_name, node_ip=ns.pod_ip,
+            slice_id=self.slice_id, max_nodes=ns.max_nodes_per_slice_domain)
+        self.config_path = os.path.join(ns.work_dir, "slice-daemon.cfg")
+        self.nodes_path = os.path.join(ns.work_dir, "nodes.cfg")
+        self.process = ProcessManager(
+            [ns.daemon_binary, "--config", self.config_path])
+        self._stop = threading.Event()
+        self._threads = []
+        self._last_ready = None
+
+    # -- setup --------------------------------------------------------------
+
+    def write_config(self, index: int) -> None:
+        os.makedirs(self.ns.work_dir, exist_ok=True)
+        with open(self.config_path, "w") as f:
+            f.write(f"node_ip={self.ns.pod_ip}\n"
+                    f"port={self.ns.port}\n"
+                    f"nodes_config={self.nodes_path}\n"
+                    f"slice_id={self.slice_id}\n"
+                    f"worker_index={index}\n")
+
+    def start(self) -> None:
+        self.cd.start()
+        index = self.cd.ensure_node_info()
+        log.info("registered node %s (slice %r, index %d)",
+                 self.ns.node_name, self.slice_id, index)
+        self.write_config(index)
+        write_nodes_config(self.nodes_path, [], self.ns.port)
+        self.process.ensure_started()
+        self._threads = [
+            threading.Thread(target=self._update_loop, daemon=True,
+                             name="cd-update-loop"),
+            threading.Thread(target=self._readiness_loop, daemon=True,
+                             name="cd-readiness"),
+        ]
+        for t in self._threads:
+            t.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=3)
+        self.process.stop()
+        self.cd.remove_node_info()
+        self.cd.stop()
+
+    # -- loops --------------------------------------------------------------
+
+    def _update_loop(self) -> None:
+        """Membership changes -> peer config refresh (main.go:296-377)."""
+        dns_mode = featuregates.enabled(featuregates.SliceDaemonsWithDNSNames)
+        while not self._stop.is_set():
+            try:
+                node_set = self.cd.updates.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            try:
+                peers = self.cd.slice_peers(node_set)
+                if dns_mode:
+                    hosts_changed = update_hosts_file(
+                        self.ns.hosts_file, peers)
+                    names = [stable_name(i) for i, _ip in sorted(peers)]
+                    cfg_changed = write_nodes_config(
+                        self.nodes_path, names, self.ns.port)
+                    if hosts_changed or cfg_changed:
+                        self.process.signal(signal.SIGUSR1)
+                else:
+                    ips = [ip for _i, ip in sorted(peers)]
+                    if write_nodes_config(self.nodes_path, ips, self.ns.port):
+                        self.process.restart()
+            except Exception:  # noqa: BLE001 — keep consuming updates
+                log.exception("membership update failed")
+
+    def _readiness_loop(self) -> None:
+        """Probe the local daemon and mirror readiness into the per-node CD
+        status (the PodManager startup-probe mirror, podmanager.go:35-120)."""
+        while not self._stop.wait(1.0):
+            ready = probe_ready(self.ns.port)
+            if ready != self._last_ready:
+                try:
+                    self.cd.set_node_status(ready)
+                    self._last_ready = ready
+                except Exception:  # noqa: BLE001 — retried next tick
+                    log.exception("node status update failed")
+
+
+def probe_ready(port: int, host: str = "127.0.0.1",
+                timeout: float = 1.0) -> bool:
+    """The `tpu-slice-daemon --check` / `nvidia-imex-ctl -q` analog."""
+    try:
+        with socket.create_connection((host, port), timeout=timeout) as s:
+            s.settimeout(timeout)
+            s.sendall(b"Q\n")
+            return s.recv(128).startswith(b"READY")
+    except OSError:
+        return False
+
+
+def run(argv=None) -> int:
+    fs = flags()
+    ns = fs.parse(argv)
+    logger = setup_logging(ns.v, ns.log_json)
+    apply_feature_gates(ns)
+    fs.dump_config(ns, logger)
+    debug.start_debug_signal_handlers()
+
+    client = HttpApiClient(base_url=ns.kube_api_url)
+    runner = DaemonRunner(client, ns)
+
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+
+    runner.start()
+    logger.info("cd daemon running (cd %s/%s)", ns.cd_namespace, ns.cd_name)
+    stop.wait()
+    runner.stop()
+    return 0
+
+
+def check(argv=None) -> int:
+    port = int(os.environ.get("SLICE_DAEMON_PORT", str(DEFAULT_PORT)))
+    if argv:
+        for i, a in enumerate(argv):
+            if a == "--port" and i + 1 < len(argv):
+                port = int(argv[i + 1])
+    ok = probe_ready(port)
+    print("READY" if ok else "NOT_READY")
+    return 0 if ok else 1
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] not in ("run", "check"):
+        print("usage: tpu_dra.cddaemon.main run|check [flags]",
+              file=sys.stderr)
+        return 2
+    return run(argv[1:]) if argv[0] == "run" else check(argv[1:])
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

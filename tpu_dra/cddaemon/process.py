@@ -1,0 +1,102 @@
+"""Child-process supervision for the native slice daemon.
+
+Reference: cmd/compute-domain-daemon/process.go:38-247 — start/stop/restart
+with buffered wait-reaping, a 1s watchdog that restarts the child on
+unexpected exit (:170-203), and signal forwarding.
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import subprocess
+import threading
+import time
+from typing import List, Optional
+
+log = logging.getLogger("tpu_dra.cddaemon.process")
+
+
+class ProcessManager:
+    def __init__(self, argv: List[str], watchdog_interval: float = 1.0):
+        self._argv = argv
+        self._interval = watchdog_interval
+        self._proc: Optional[subprocess.Popen] = None
+        self._lock = threading.RLock()
+        self._want_running = False
+        self._stop = threading.Event()
+        self._watchdog: Optional[threading.Thread] = None
+        self.restarts = 0
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def ensure_started(self) -> None:
+        with self._lock:
+            self._want_running = True
+            if self._proc is None or self._proc.poll() is not None:
+                self._spawn_locked()
+        if self._watchdog is None:
+            # Re-arm after a previous stop(): a set _stop would make the new
+            # watchdog thread exit immediately, leaving the child unwatched.
+            self._stop.clear()
+            self._watchdog = threading.Thread(
+                target=self._watch, daemon=True, name="process-watchdog")
+            self._watchdog.start()
+
+    def _spawn_locked(self) -> None:
+        log.info("starting: %s", " ".join(self._argv))
+        self._proc = subprocess.Popen(self._argv)
+
+    def stop(self, grace: float = 5.0) -> None:
+        with self._lock:
+            self._want_running = False
+            proc = self._proc
+        self._stop.set()
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=grace)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+        if self._watchdog:
+            self._watchdog.join(timeout=2)
+            self._watchdog = None
+
+    def restart(self) -> None:
+        """Full stop/start (legacy IP-mode membership change)."""
+        with self._lock:
+            proc = self._proc
+            if proc is not None and proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait()
+            if self._want_running:
+                self._spawn_locked()
+                self.restarts += 1
+
+    def signal(self, sig: int = signal.SIGUSR1) -> None:
+        """Forward a signal (SIGUSR1 = re-resolve peers, main.go:368)."""
+        with self._lock:
+            if self._proc is not None and self._proc.poll() is None:
+                self._proc.send_signal(sig)
+
+    def running(self) -> bool:
+        with self._lock:
+            return self._proc is not None and self._proc.poll() is None
+
+    # -- watchdog -----------------------------------------------------------
+
+    def _watch(self) -> None:
+        while not self._stop.wait(self._interval):
+            with self._lock:
+                if not self._want_running:
+                    continue
+                if self._proc is not None and self._proc.poll() is not None:
+                    log.warning("child exited unexpectedly (rc=%s); restarting",
+                                self._proc.returncode)
+                    self._spawn_locked()
+                    self.restarts += 1
