@@ -1,0 +1,228 @@
+"""Full ComputeDomain convergence: controller + daemons + plugins.
+
+The reference exercises this only against a real multi-GPU cluster
+(tests/bats/test_cd_mnnvl_workload.bats); here the whole three-process
+dance (SURVEY §3.3) converges through the fake API server with the real
+C++ slice daemon doing rendezvous on localhost:
+
+  controller stamps per-CD objects -> workload claims prepare on two
+  "nodes" -> plugins label the nodes -> (test plays the DaemonSet) slice
+  daemons start, register, rendezvous, report Ready -> plugins release the
+  claims with the slice env injected -> teardown cleans everything.
+"""
+
+import json
+import os
+import threading
+import time
+import uuid
+
+import pytest
+
+from tpu_dra.api import types as apitypes
+from tpu_dra.cdcontroller import Controller
+from tpu_dra.cddaemon.main import DaemonRunner, flags as daemon_flags
+from tpu_dra.cdi.handler import CDIHandler
+from tpu_dra.cdplugin.computedomain import ComputeDomainManager
+from tpu_dra.cdplugin.device_state import DeviceState
+from tpu_dra.cdplugin.driver import CDDriver
+from tpu_dra.k8s import (
+    COMPUTEDOMAINS, DAEMONSETS, FakeCluster, NODES, RESOURCECLAIMS,
+    RESOURCECLAIMTEMPLATES,
+)
+from tpu_dra.k8s.client import NotFoundError
+from tpu_dra.kubeletplugin.server import Claim
+from tpu_dra.tpuplugin.checkpoint import CheckpointManager
+
+DRIVER_NS = "tpu-dra-driver"
+LABEL = apitypes.COMPUTE_DOMAIN_LABEL_KEY
+DAEMON_BIN = os.path.join(os.path.dirname(__file__), "..", "native", "build",
+                          "tpu-slice-daemon")
+
+
+def free_port():
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class FakeNode:
+    """One 'node': a CD kubelet plugin plus (once labeled) a cd daemon."""
+
+    def __init__(self, cluster, name, tmp_path):
+        self.cluster = cluster
+        self.name = name
+        self.tmp = tmp_path / name
+        cluster.create(NODES, {"apiVersion": "v1", "kind": "Node",
+                               "metadata": {"name": name}})
+        self.cd_manager = ComputeDomainManager(
+            cluster, node_name=name,
+            driver_plugin_dir=str(self.tmp / "plugin"))
+        self.cd_manager.start()
+        self.cdi = CDIHandler(str(self.tmp / "cdi"),
+                              vendor="k8s.compute-domain.tpu.dev")
+        self.state = DeviceState(
+            cd_manager=self.cd_manager, cdi=self.cdi,
+            checkpoints=CheckpointManager(str(self.tmp / "plugin")),
+            driver_name=apitypes.COMPUTE_DOMAIN_DRIVER_NAME,
+            node_name=name, slice_id="slice-A")
+        self.driver = CDDriver(
+            state=self.state, client=cluster,
+            driver_name=apitypes.COMPUTE_DOMAIN_DRIVER_NAME, node_name=name,
+            slice_id="slice-A", plugin_dir=str(self.tmp / "plugin"),
+            retry_timeout=20.0)
+        self.driver.start()
+        self.daemon = None
+
+    def start_daemon(self, cd):
+        """The DaemonSet-pod analog, started when the node is labeled."""
+        port = free_port()
+        ns = daemon_flags().parse([
+            "--cd-uid", cd["metadata"]["uid"],
+            "--cd-name", cd["metadata"]["name"],
+            "--cd-namespace", cd["metadata"]["namespace"],
+            "--node-name", self.name, "--pod-ip", "127.0.0.1",
+            "--port", str(port),
+            "--work-dir", str(self.tmp / "daemon"),
+            "--hosts-file", str(self.tmp / "hosts"),
+            "--daemon-binary", DAEMON_BIN,
+        ])
+        self.daemon = DaemonRunner(self.cluster, ns)
+        self.daemon.start()
+
+    def stop(self):
+        if self.daemon:
+            self.daemon.stop()
+        self.driver.shutdown()
+        self.cd_manager.stop()
+
+
+@pytest.mark.skipif(not os.path.exists(DAEMON_BIN),
+                    reason="native daemon not built")
+class TestFullConvergence:
+    def test_two_node_compute_domain_lifecycle(self, tmp_path):
+        cluster = FakeCluster()
+        controller = Controller(cluster, namespace=DRIVER_NS,
+                                image="img:test", gc_interval=3600.0)
+        controller.start()
+        nodes = [FakeNode(cluster, f"node-{c}", tmp_path) for c in "ab"]
+        try:
+            self._run(cluster, controller, nodes, tmp_path)
+        finally:
+            for n in nodes:
+                n.stop()
+            controller.stop()
+
+    def _run(self, cluster, controller, nodes, tmp_path):
+        # 1. User creates the ComputeDomain; controller stamps objects.
+        cd = cluster.create(COMPUTEDOMAINS, {
+            "apiVersion": apitypes.API_VERSION, "kind": "ComputeDomain",
+            "metadata": {"name": "train-cd", "namespace": "team"},
+            "spec": {"numNodes": 2, "channel": {
+                "resourceClaimTemplate": {"name": "train-rct"},
+                "allocationMode": "Single"}},
+        })
+        uid = cd["metadata"]["uid"]
+        assert cluster.wait_for(lambda: _exists(
+            cluster, RESOURCECLAIMTEMPLATES, "train-rct", "team"))
+
+        # 2. "Scheduler": instantiate the workload RCT into one claim per
+        #    node, allocated on each node's channel-0.
+        rct = cluster.get(RESOURCECLAIMTEMPLATES, "train-rct", "team")
+        claims = []
+        for node in nodes:
+            spec = json.loads(json.dumps(rct["spec"]["spec"]))
+            claim = cluster.create(RESOURCECLAIMS, {
+                "apiVersion": "resource.k8s.io/v1", "kind": "ResourceClaim",
+                "metadata": {"name": f"train-{node.name}",
+                             "namespace": "team"},
+                "spec": spec,
+                "status": {"allocation": {"devices": {
+                    "results": [{
+                        "request": spec["devices"]["requests"][0]["name"],
+                        "driver": apitypes.COMPUTE_DOMAIN_DRIVER_NAME,
+                        "pool": node.name, "device": "channel-0"}],
+                    "config": spec["devices"].get("config", []),
+                }}},
+            })
+            claims.append(claim)
+
+        # 3. kubelet calls prepare on both nodes concurrently.
+        results = {}
+
+        def kubelet(node, claim):
+            c = Claim(uid=claim["metadata"]["uid"],
+                      name=claim["metadata"]["name"], namespace="team")
+            results[node.name] = node.driver.prepare_claims([c])[c.uid]
+
+        threads = [threading.Thread(target=kubelet, args=(n, c))
+                   for n, c in zip(nodes, claims)]
+        for t in threads:
+            t.start()
+
+        # 4. Plugins label their nodes; the test plays the DaemonSet and
+        #    starts a daemon on each labeled node.
+        for node in nodes:
+            assert cluster.wait_for(
+                lambda n=node: (cluster.get(NODES, n.name)["metadata"]
+                                .get("labels") or {}).get(LABEL) == uid,
+                timeout=10), f"{node.name} never labeled"
+            node.start_daemon(cd)
+
+        for t in threads:
+            t.join(timeout=30)
+        assert all(r.error == "" for r in results.values()), results
+
+        # 5. Both workloads got coherent rendezvous env.
+        envs = {}
+        for node, claim in zip(nodes, claims):
+            path = os.path.join(
+                str(node.tmp / "cdi"),
+                "k8s.compute-domain.tpu.dev-claim_"
+                f"{claim['metadata']['uid']}.json")
+            spec = json.load(open(path))
+            envs[node.name] = dict(
+                e.split("=", 1)
+                for e in spec["devices"][0]["containerEdits"]["env"])
+        ids = sorted(int(envs[n]["TPU_WORKER_ID"]) for n in envs)
+        assert ids == [0, 1]
+        addrs = {envs[n]["TPU_COORDINATOR_ADDRESS"] for n in envs}
+        assert len(addrs) == 1  # everyone agrees on the coordinator
+        assert all(envs[n]["TPU_PROCESS_COUNT"] == "2" for n in envs)
+
+        # 6. CD status carries both nodes Ready (daemon-mirrored).
+        def both_ready():
+            st = (cluster.get(COMPUTEDOMAINS, "train-cd", "team")
+                  .get("status") or {})
+            n = st.get("nodes") or []
+            return len(n) == 2 and all(
+                x["status"] == "Ready" for x in n)
+        assert cluster.wait_for(both_ready, timeout=10)
+
+        # 7. Teardown: unprepare both claims, stop daemons, delete the CD.
+        for node, claim in zip(nodes, claims):
+            c = Claim(uid=claim["metadata"]["uid"],
+                      name=claim["metadata"]["name"], namespace="team")
+            assert node.driver.unprepare_claims([c])[c.uid] == ""
+        for node in nodes:
+            node.daemon.stop()
+            node.daemon = None
+        cluster.delete(COMPUTEDOMAINS, "train-cd", "team")
+        assert cluster.wait_for(
+            lambda: not _exists(cluster, COMPUTEDOMAINS, "train-cd", "team"),
+            timeout=10)
+        # Stamped objects and node labels are gone.
+        assert cluster.list(DAEMONSETS, namespace=DRIVER_NS) == []
+        for node in nodes:
+            labels = (cluster.get(NODES, node.name)["metadata"]
+                      .get("labels") or {})
+            assert LABEL not in labels
+
+
+def _exists(cluster, gvr, name, ns=None):
+    try:
+        cluster.get(gvr, name, ns)
+        return True
+    except NotFoundError:
+        return False

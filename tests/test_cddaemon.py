@@ -104,6 +104,24 @@ class TestRegistration:
         nodes = cluster.get(COMPUTEDOMAINS, "cd-1", "user-ns")["status"]["nodes"]
         assert nodes[0]["status"] == "Ready"
 
+    def test_slice_change_reallocates_index(self):
+        """A node re-provisioned into another slice must not keep an index
+        that collides inside the new group."""
+        cluster = FakeCluster()
+        cd = make_cd(cluster)
+        a = self._mgr(cluster, cd, "node-a", "10.0.0.1", "slice-A")
+        b = self._mgr(cluster, cd, "node-b", "10.0.0.2", "slice-B")
+        a2 = self._mgr(cluster, cd, "node-a2", "10.0.0.3", "slice-A")
+        assert [a.ensure_node_info(), b.ensure_node_info(),
+                a2.ensure_node_info()] == [0, 0, 1]
+        # node-a2 (slice-A index 1) moves to slice-B where index 0 is taken.
+        moved = self._mgr(cluster, cd, "node-a2", "10.0.0.3", "slice-B")
+        assert moved.ensure_node_info() == 1
+        nodes = cluster.get(COMPUTEDOMAINS, "cd-1", "user-ns")["status"]["nodes"]
+        slice_b = {(n["name"], n["index"]) for n in nodes
+                   if n["sliceID"] == "slice-B"}
+        assert slice_b == {("node-b", 0), ("node-a2", 1)}
+
     def test_ip_change_updates_registration(self):
         cluster = FakeCluster()
         cd = make_cd(cluster)

@@ -1,0 +1,342 @@
+"""ComputeDomain kubelet plugin: the readiness dance, exclusivity, GC.
+
+Covers cmd/compute-domain-kubelet-plugin behaviors: channel prepare
+(namespace assert -> node label -> blocked readiness wait -> rendezvous env
+injection), daemon prepare (domain dir + identity env), channel
+exclusivity ordering, the 45s retry envelope with permanent-error
+short-circuit, checkpoint GC, and the full controller+daemon+plugin
+convergence that the reference can only test e2e (SURVEY §3.3).
+"""
+
+import json
+import os
+import threading
+import uuid
+
+import pytest
+
+from tpu_dra.api import types as apitypes
+from tpu_dra.cddaemon.computedomain import ComputeDomainManager as DaemonCDManager
+from tpu_dra.cdi.handler import CDIHandler
+from tpu_dra.cdplugin.cleanup import CheckpointCleanup
+from tpu_dra.cdplugin.computedomain import (
+    ComputeDomainManager, PermanentError, RetryableNotReady,
+)
+from tpu_dra.cdplugin.device_state import DeviceState
+from tpu_dra.cdplugin.driver import CDDriver
+from tpu_dra.cdplugin.deviceinfo import published_devices
+from tpu_dra.k8s import (
+    COMPUTEDOMAINS, FakeCluster, NODES, RESOURCECLAIMS, RESOURCESLICES,
+)
+from tpu_dra.kubeletplugin.server import Claim
+
+NS = "user-ns"
+LABEL = apitypes.COMPUTE_DOMAIN_LABEL_KEY
+DRIVER = apitypes.COMPUTE_DOMAIN_DRIVER_NAME
+
+
+def make_cd(cluster, name="cd-1", namespace=NS):
+    return cluster.create(COMPUTEDOMAINS, {
+        "apiVersion": apitypes.API_VERSION, "kind": "ComputeDomain",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {"numNodes": 2, "channel": {
+            "resourceClaimTemplate": {"name": "rct"},
+            "allocationMode": "Single"}},
+    })
+
+
+def make_channel_claim(cluster, cd, devices=("channel-0",),
+                       allocation_mode="Single", namespace=NS, name=None):
+    cfg = {"apiVersion": apitypes.API_VERSION,
+           "kind": "ComputeDomainChannelConfig",
+           "domainID": cd["metadata"]["uid"],
+           "allocationMode": allocation_mode}
+    return _make_claim(cluster, devices, cfg, namespace, name)
+
+
+def make_daemon_claim(cluster, cd, namespace="tpu-dra-driver"):
+    cfg = {"apiVersion": apitypes.API_VERSION,
+           "kind": "ComputeDomainDaemonConfig",
+           "domainID": cd["metadata"]["uid"]}
+    return _make_claim(cluster, ["daemon"], cfg, namespace, None)
+
+
+def _make_claim(cluster, devices, cfg, namespace, name):
+    return cluster.create(RESOURCECLAIMS, {
+        "apiVersion": "resource.k8s.io/v1", "kind": "ResourceClaim",
+        "metadata": {"name": name or f"claim-{uuid.uuid4().hex[:8]}",
+                     "namespace": namespace},
+        "spec": {"devices": {"requests": [{"name": "r0"}]}},
+        "status": {"allocation": {"devices": {
+            "results": [{"request": "r0", "driver": DRIVER,
+                         "pool": "node-a", "device": d} for d in devices],
+            "config": [{"requests": ["r0"],
+                        "opaque": {"driver": DRIVER, "parameters": cfg}}],
+        }}},
+    })
+
+
+def register_node(cluster, cd, node="node-a", ip="10.0.0.1",
+                  slice_id="slice-A", index=0, ready=True):
+    """Play the cd-daemon: insert the node into CD status."""
+    mgr = DaemonCDManager(
+        cluster, cd_name=cd["metadata"]["name"],
+        cd_namespace=cd["metadata"]["namespace"],
+        cd_uid=cd["metadata"]["uid"], node_name=node, node_ip=ip,
+        slice_id=slice_id)
+    mgr.ensure_node_info()
+    if ready:
+        mgr.set_node_status(True)
+    return mgr
+
+
+@pytest.fixture
+def harness(tmp_path):
+    cluster = FakeCluster()
+    cluster.create(NODES, {"apiVersion": "v1", "kind": "Node",
+                           "metadata": {"name": "node-a"}})
+    cd_manager = ComputeDomainManager(
+        cluster, node_name="node-a",
+        driver_plugin_dir=str(tmp_path / "plugin"))
+    cd_manager.start()
+    cdi = CDIHandler(str(tmp_path / "cdi"),
+                     vendor="k8s.compute-domain.tpu.dev")
+    from tpu_dra.tpuplugin.checkpoint import CheckpointManager
+    state = DeviceState(cd_manager=cd_manager, cdi=cdi,
+                        checkpoints=CheckpointManager(str(tmp_path / "plugin")),
+                        driver_name=DRIVER, node_name="node-a",
+                        slice_id="slice-A")
+    driver = CDDriver(state=state, client=cluster, driver_name=DRIVER,
+                      node_name="node-a", slice_id="slice-A",
+                      plugin_dir=str(tmp_path / "plugin"),
+                      retry_timeout=3.0)
+    driver.start()
+    yield {"cluster": cluster, "cd_manager": cd_manager, "state": state,
+           "driver": driver, "cdi": cdi, "tmp": tmp_path}
+    driver.shutdown()
+    cd_manager.stop()
+
+
+def prepare(h, claim_obj):
+    claim = Claim(uid=claim_obj["metadata"]["uid"],
+                  name=claim_obj["metadata"]["name"],
+                  namespace=claim_obj["metadata"]["namespace"])
+    return h["driver"].prepare_claims([claim])[claim.uid]
+
+
+def unprepare(h, claim_obj):
+    claim = Claim(uid=claim_obj["metadata"]["uid"],
+                  name=claim_obj["metadata"]["name"],
+                  namespace=claim_obj["metadata"]["namespace"])
+    return h["driver"].unprepare_claims([claim])[claim.uid]
+
+
+def claim_env(h, claim_uid):
+    path = os.path.join(str(h["tmp"] / "cdi"),
+                        f"k8s.compute-domain.tpu.dev-claim_{claim_uid}.json")
+    with open(path) as f:
+        spec = json.load(f)
+    return dict(e.split("=", 1)
+                for e in spec["devices"][0]["containerEdits"]["env"])
+
+
+class TestPublishing:
+    def test_channel0_and_daemon_published(self, harness):
+        slices = harness["cluster"].list(RESOURCESLICES)
+        assert len(slices) == 1
+        names = [d["name"] for d in slices[0]["spec"]["devices"]]
+        assert names == ["channel-0", "daemon"]
+        assert slices[0]["spec"]["driver"] == DRIVER
+
+
+class TestChannelPrepare:
+    def test_happy_path_injects_rendezvous_env(self, harness):
+        cluster = harness["cluster"]
+        cd = make_cd(cluster)
+        register_node(cluster, cd, "node-a", "10.0.0.1", "slice-A", ready=True)
+        register_node(cluster, cd, "node-b", "10.0.0.2", "slice-A", ready=True)
+        claim = make_channel_claim(cluster, cd)
+        res = prepare(harness, claim)
+        assert res.error == ""
+        # Node got labeled into the CD.
+        node = cluster.get(NODES, "node-a")
+        assert node["metadata"]["labels"][LABEL] == cd["metadata"]["uid"]
+        env = claim_env(harness, claim["metadata"]["uid"])
+        assert env["COMPUTE_DOMAIN_UUID"] == cd["metadata"]["uid"]
+        assert env["TPU_WORKER_ID"] == "0"
+        assert env["TPU_PROCESS_COUNT"] == "2"
+        assert env["TPU_WORKER_HOSTNAMES"] == \
+            "tpu-cd-daemon-0000,tpu-cd-daemon-0001"
+        assert env["TPU_COORDINATOR_ADDRESS"] == "10.0.0.1:8476"
+        assert env["TPU_CD_CHANNELS"] == "0"
+        assert "MEGASCALE_NUM_SLICES" not in env  # homogeneous
+
+    def test_blocks_until_node_ready_then_completes(self, harness):
+        cluster = harness["cluster"]
+        cd = make_cd(cluster)
+        claim = make_channel_claim(cluster, cd)
+        done = {}
+
+        def run():
+            done["res"] = prepare(harness, claim)
+
+        t = threading.Thread(target=run)
+        t.start()
+        # The prepare retry loop labels the node; wait for the label (that
+        # is what summons the daemon pod), then play the daemon.
+        assert cluster.wait_for(
+            lambda: (cluster.get(NODES, "node-a")["metadata"].get("labels")
+                     or {}).get(LABEL) == cd["metadata"]["uid"], timeout=3)
+        register_node(cluster, cd, "node-a", "10.0.0.1", ready=True)
+        t.join(timeout=10)
+        assert done["res"].error == ""
+
+    def test_namespace_mismatch_is_permanent(self, harness):
+        cluster = harness["cluster"]
+        cd = make_cd(cluster)  # lives in user-ns
+        claim = make_channel_claim(cluster, cd, namespace="other-ns")
+        res = prepare(harness, claim)
+        assert res.error.startswith("permanent")
+        assert "does not match" in res.error
+
+    def test_retry_budget_exhausts_when_never_ready(self, harness):
+        cluster = harness["cluster"]
+        cd = make_cd(cluster)
+        register_node(cluster, cd, "node-a", "10.0.0.1", ready=False)
+        claim = make_channel_claim(cluster, cd)
+        res = prepare(harness, claim)
+        assert "retry budget exhausted" in res.error
+
+    def test_allocation_mode_all(self, harness):
+        cluster = harness["cluster"]
+        cd = make_cd(cluster)
+        register_node(cluster, cd, "node-a", "10.0.0.1", ready=True)
+        claim = make_channel_claim(cluster, cd, allocation_mode="All")
+        assert prepare(harness, claim).error == ""
+        env = claim_env(harness, claim["metadata"]["uid"])
+        assert env["TPU_CD_CHANNELS"] == "all"
+
+    def test_heterogeneous_multislice_env(self, harness):
+        cluster = harness["cluster"]
+        cd = make_cd(cluster)
+        register_node(cluster, cd, "node-a", "10.0.0.1", "slice-A")
+        register_node(cluster, cd, "node-b", "10.0.0.2", "slice-B")
+        claim = make_channel_claim(cluster, cd)
+        assert prepare(harness, claim).error == ""
+        env = claim_env(harness, claim["metadata"]["uid"])
+        assert env["MEGASCALE_NUM_SLICES"] == "2"
+        assert env["MEGASCALE_SLICE_ID"] == "0"  # slice-A sorts first
+        assert env["TPU_PROCESS_COUNT"] == "1"  # only slice-A members
+        # The megascale coordinator must be GLOBAL (same on every slice):
+        # compute slice-B's view directly and compare.
+        cd_fresh = cluster.get(COMPUTEDOMAINS, "cd-1", NS)
+        env_b = ComputeDomainManager(
+            cluster, node_name="node-b",
+            driver_plugin_dir=str(harness["tmp"] / "b")).workload_env(
+                cd_fresh, [0], "Single")
+        assert (env_b["MEGASCALE_COORDINATOR_ADDRESS"]
+                == env["MEGASCALE_COORDINATOR_ADDRESS"]
+                == "10.0.0.1:8476")
+        assert env_b["MEGASCALE_SLICE_ID"] == "1"
+
+    def test_idempotent(self, harness):
+        cluster = harness["cluster"]
+        cd = make_cd(cluster)
+        register_node(cluster, cd, "node-a", "10.0.0.1", ready=True)
+        claim = make_channel_claim(cluster, cd)
+        res1 = prepare(harness, claim)
+        res2 = prepare(harness, claim)
+        assert res1.error == res2.error == ""
+        assert (res1.devices[0].cdi_device_ids
+                == res2.devices[0].cdi_device_ids)
+
+
+class TestChannelExclusivity:
+    def test_channel_held_by_other_claim_retries_then_fails(self, harness):
+        cluster = harness["cluster"]
+        cd = make_cd(cluster)
+        register_node(cluster, cd, "node-a", "10.0.0.1", ready=True)
+        claim1 = make_channel_claim(cluster, cd)
+        assert prepare(harness, claim1).error == ""
+        claim2 = make_channel_claim(cluster, cd)
+        res = prepare(harness, claim2)
+        assert "still prepared" in res.error
+        # After unprepare of claim1, claim2 succeeds.
+        assert unprepare(harness, claim1) == ""
+        assert prepare(harness, claim2).error == ""
+
+    def test_unprepare_releases_node_label_on_last_claim(self, harness):
+        cluster = harness["cluster"]
+        cd = make_cd(cluster)
+        register_node(cluster, cd, "node-a", "10.0.0.1", ready=True)
+        claim = make_channel_claim(cluster, cd)
+        assert prepare(harness, claim).error == ""
+        assert unprepare(harness, claim) == ""
+        node = cluster.get(NODES, "node-a")
+        assert LABEL not in (node["metadata"].get("labels") or {})
+
+
+class TestDaemonPrepare:
+    def test_domain_dir_and_env(self, harness):
+        cluster = harness["cluster"]
+        cd = make_cd(cluster)
+        claim = make_daemon_claim(cluster, cd)
+        res = prepare(harness, claim)
+        assert res.error == ""
+        env = claim_env(harness, claim["metadata"]["uid"])
+        assert env["COMPUTE_DOMAIN_UUID"] == cd["metadata"]["uid"]
+        assert env["TPU_SLICE_ID"] == "slice-A"
+        dom_dir = harness["cd_manager"].domain_dir(cd["metadata"]["uid"])
+        assert os.path.isdir(dom_dir)
+        assert "COMPUTE_DOMAIN_NAME=cd-1" in open(
+            os.path.join(dom_dir, "domain.env")).read()
+
+    def test_domain_dir_gc(self, harness):
+        cluster = harness["cluster"]
+        cd = make_cd(cluster)
+        claim = make_daemon_claim(cluster, cd)
+        assert prepare(harness, claim).error == ""
+        uid = cd["metadata"]["uid"]
+        # CD vanishes (bypass finalizers in fake by direct store surgery).
+        cluster.delete(COMPUTEDOMAINS, "cd-1", NS)
+        assert cluster.wait_for(
+            lambda: harness["cd_manager"].get_by_uid(uid) is None)
+        removed = harness["cd_manager"].gc_domain_dirs()
+        assert uid in removed
+        assert not os.path.isdir(harness["cd_manager"].domain_dir(uid))
+
+
+class TestCheckpointGC:
+    def test_abandoned_prepare_started_collected(self, harness):
+        cluster = harness["cluster"]
+        cd = make_cd(cluster)
+        register_node(cluster, cd, "node-a", "10.0.0.1", ready=False)
+        claim = make_channel_claim(cluster, cd)
+        res = prepare(harness, claim)  # exhausts retry -> PrepareStarted
+        assert "exhausted" in res.error
+        uid = claim["metadata"]["uid"]
+        assert uid in harness["state"].prepared_claim_uids()
+
+        gc = CheckpointCleanup(client=cluster, state=harness["state"],
+                               cd_manager=harness["cd_manager"])
+        # Claim still exists: GC must keep it.
+        assert gc.sweep() == 0
+        assert uid in harness["state"].prepared_claim_uids()
+        # Claim deleted: GC collects.
+        cluster.delete(RESOURCECLAIMS, claim["metadata"]["name"], NS)
+        assert gc.sweep() == 1
+        assert uid not in harness["state"].prepared_claim_uids()
+
+    def test_recreated_same_name_claim_not_collected(self, harness):
+        cluster = harness["cluster"]
+        cd = make_cd(cluster)
+        register_node(cluster, cd, "node-a", "10.0.0.1", ready=False)
+        claim = make_channel_claim(cluster, cd, name="stable-name")
+        prepare(harness, claim)
+        uid = claim["metadata"]["uid"]
+        cluster.delete(RESOURCECLAIMS, "stable-name", NS)
+        make_channel_claim(cluster, cd, name="stable-name")  # new UID
+        gc = CheckpointCleanup(client=cluster, state=harness["state"],
+                               cd_manager=harness["cd_manager"])
+        assert gc.sweep() == 1  # old uid gone (uid comparison, not name)
+        assert uid not in harness["state"].prepared_claim_uids()

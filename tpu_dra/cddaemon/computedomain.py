@@ -102,7 +102,13 @@ class ComputeDomainManager:
                     self.index = mine.get("index", 0)
                     return self.index
                 mine["ipAddress"] = self._node_ip
-                mine["sliceID"] = self._slice_id
+                if mine.get("sliceID") != self._slice_id:
+                    # Re-provisioned into a different slice: the old index
+                    # may collide inside the new group — reallocate there.
+                    mine["sliceID"] = self._slice_id
+                    mine["index"] = allocate_index(
+                        [n for n in nodes if n is not mine],
+                        self._slice_id, self._max_nodes)
                 index = mine.get("index", 0)
             else:
                 index = allocate_index(nodes, self._slice_id, self._max_nodes)
@@ -139,6 +145,11 @@ class ComputeDomainManager:
                 return
             except ConflictError:
                 continue
+        # A silently stale registration holds the index and keeps the node
+        # counted Ready; surface the failure to the caller.
+        raise ConflictError(
+            f"could not deregister node {self._node_name} after "
+            f"{retries} tries")
 
     def set_node_status(self, ready: bool, retries: int = 10) -> None:
         """Mirror local daemon readiness into the per-node status field

@@ -33,8 +33,11 @@ def render_hosts_block(nodes: List[Tuple[int, str]]) -> str:
 
 
 def update_hosts_file(path: str, nodes: List[Tuple[int, str]]) -> bool:
-    """Replace (or append) the managed block; atomic rename so the daemon
-    never reads a torn file. Returns True if the content changed."""
+    """Replace (or append) the managed block, writing IN PLACE — /etc/hosts
+    is a kubelet bind mount in pods and rename-over-mount fails EBUSY, so a
+    torn read is theoretically possible but heals on the next resolve (the
+    reference accepts the same tradeoff, dnsnames.go:182). Returns True if
+    the content changed."""
     try:
         with open(path) as f:
             content = f.read()

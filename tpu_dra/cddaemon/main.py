@@ -151,7 +151,11 @@ class DaemonRunner:
         for t in self._threads:
             t.join(timeout=3)
         self.process.stop()
-        self.cd.remove_node_info()
+        try:
+            self.cd.remove_node_info()
+        except Exception:  # noqa: BLE001 — still stop the informer below
+            log.exception("deregistration failed; stale entry will be "
+                          "cleaned by the controller's pod-delete handler")
         self.cd.stop()
 
     # -- loops --------------------------------------------------------------

@@ -34,16 +34,14 @@ CDI_VENDOR = "k8s.tpu.dev"
 CDI_CLASS_CHIP = "chip"
 CDI_CLASS_CLAIM = "claim"
 
-CDI_KIND_CHIP = f"{CDI_VENDOR}/{CDI_CLASS_CHIP}"
-CDI_KIND_CLAIM = f"{CDI_VENDOR}/{CDI_CLASS_CLAIM}"
-
-
 class CDIHandler:
     """Writes CDI specs to `cdi_root` (host /var/run/cdi, flag-configurable
     like CDI_ROOT in main.go:96-102)."""
 
     def __init__(self, cdi_root: str, driver_root: str = "/",
-                 libtpu_path: Optional[str] = None, dev_root: str = "/"):
+                 libtpu_path: Optional[str] = None, dev_root: str = "/",
+                 vendor: str = CDI_VENDOR):
+        self._vendor = vendor
         self._cdi_root = cdi_root
         self._driver_root = driver_root.rstrip("/") or "/"
         self._dev_root = dev_root.rstrip("/") or "/"
@@ -64,20 +62,21 @@ class CDIHandler:
     # -- spec paths ---------------------------------------------------------
 
     def _standard_spec_path(self) -> str:
-        return os.path.join(self._cdi_root, f"{CDI_VENDOR}-{CDI_CLASS_CHIP}.json")
+        return os.path.join(self._cdi_root,
+                            f"{self._vendor}-{CDI_CLASS_CHIP}.json")
 
     def _claim_spec_path(self, claim_uid: str) -> str:
         return os.path.join(self._cdi_root,
-                            f"{CDI_VENDOR}-{CDI_CLASS_CLAIM}_{claim_uid}.json")
+                            f"{self._vendor}-{CDI_CLASS_CLAIM}_{claim_uid}.json")
 
     # -- device ids ---------------------------------------------------------
 
     def get_standard_device(self, chip_uuid: str) -> str:
         """Fully-qualified CDI id for a chip (GetStandardDevice analog)."""
-        return f"{CDI_KIND_CHIP}={chip_uuid}"
+        return f"{self._vendor}/{CDI_CLASS_CHIP}={chip_uuid}"
 
     def get_claim_device(self, claim_uid: str) -> str:
-        return f"{CDI_KIND_CLAIM}={claim_uid}"
+        return f"{self._vendor}/{CDI_CLASS_CLAIM}={claim_uid}"
 
     # -- spec generation ----------------------------------------------------
 
@@ -113,7 +112,7 @@ class CDIHandler:
 
         spec = {
             "cdiVersion": CDI_VERSION,
-            "kind": CDI_KIND_CHIP,
+            "kind": f"{self._vendor}/{CDI_CLASS_CHIP}",
             "devices": devices,
             "containerEdits": container_edits,
         }
@@ -135,7 +134,7 @@ class CDIHandler:
             edits["deviceNodes"] = device_nodes
         spec = {
             "cdiVersion": CDI_VERSION,
-            "kind": CDI_KIND_CLAIM,
+            "kind": f"{self._vendor}/{CDI_CLASS_CLAIM}",
             "devices": [{"name": claim_uid, "containerEdits": edits}],
         }
         path = self._claim_spec_path(claim_uid)

@@ -1,0 +1,9 @@
+"""ComputeDomain kubelet plugin (reference: cmd/compute-domain-kubelet-plugin).
+
+Node-side half of the ComputeDomain machinery: advertises synthetic
+``channel`` + ``daemon`` devices, and on claim prepare performs the
+readiness dance — label the node (pulling a slice-daemon pod here), wait
+for the CD to report this node Ready, then inject the slice rendezvous env
+(worker id, peer hostnames, coordinator address) into the workload
+container via CDI.
+"""

@@ -1,0 +1,73 @@
+"""CD plugin checkpoint + domain-dir garbage collection.
+
+Reference: cmd/compute-domain-kubelet-plugin/cleanup.go:41-271 — periodic
+GC of ``PrepareStarted`` (partially prepared) claims whose ResourceClaim no
+longer exists in the API server (compared by name+UID so a recreated
+same-name claim is not collected), plus the per-CD config-dir sweep.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+from tpu_dra.cdplugin.computedomain import ComputeDomainManager
+from tpu_dra.cdplugin.device_state import DeviceState
+from tpu_dra.k8s import ApiClient, RESOURCECLAIMS
+from tpu_dra.k8s.client import NotFoundError
+from tpu_dra.tpuplugin.checkpoint import PREPARE_STARTED
+
+log = logging.getLogger("tpu_dra.cdplugin.cleanup")
+
+
+class CheckpointCleanup:
+    def __init__(self, *, client: ApiClient, state: DeviceState,
+                 cd_manager: ComputeDomainManager,
+                 interval: float = 600.0):
+        self._client = client
+        self._state = state
+        self._cd = cd_manager
+        self._interval = interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="cd-ckpt-gc")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                self.sweep()
+            except Exception:  # noqa: BLE001 — GC must not die
+                log.exception("checkpoint GC failed")
+
+    def sweep(self) -> int:
+        """Collect abandoned PrepareStarted claims; returns count."""
+        collected = 0
+        snapshot = self._state.checkpoint_snapshot()
+        for uid, prepared in list(snapshot.claims.items()):
+            if prepared.state != PREPARE_STARTED:
+                continue
+            if not prepared.name:
+                continue  # legacy record without claim identity: keep
+            try:
+                obj = self._client.get(RESOURCECLAIMS, prepared.name,
+                                       prepared.namespace)
+                if obj["metadata"].get("uid") == uid:
+                    continue  # claim still exists: kubelet will retry
+            except NotFoundError:
+                pass
+            log.info("GC abandoned PrepareStarted claim %s (%s/%s)",
+                     uid, prepared.namespace, prepared.name)
+            self._state.drop_claim(uid)
+            collected += 1
+        self._cd.gc_domain_dirs()
+        return collected

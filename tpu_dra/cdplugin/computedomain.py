@@ -1,0 +1,205 @@
+"""Node-side ComputeDomain operations for the CD kubelet plugin.
+
+Reference: cmd/compute-domain-kubelet-plugin/computedomain.go —
+namespace assertion (:264-278, permanent error), node labeling (:280-332 —
+*this* is what pulls the per-CD DaemonSet pod onto the node), readiness
+assertion (:237-262, retried inside the prepare envelope), and the daemon
+config-dir lifecycle (:131-235, :352-407).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+from typing import Dict, List, Optional, Tuple
+
+from tpu_dra.api import types as apitypes
+from tpu_dra.cddaemon.dnsnames import stable_name
+from tpu_dra.k8s import ApiClient, COMPUTEDOMAINS, NODES
+from tpu_dra.k8s.client import NotFoundError
+from tpu_dra.k8s.informer import Informer, uid_index
+
+log = logging.getLogger("tpu_dra.cdplugin")
+
+UID_INDEX = "uid"
+
+# Default port for the JAX coordinator service on the index-0 worker
+# (jax.distributed.initialize convention).
+COORDINATOR_PORT = 8476
+
+
+class PermanentError(Exception):
+    """Not retryable inside the prepare envelope (driver.go permanentError)."""
+
+
+class ComputeDomainManager:
+    def __init__(self, client: ApiClient, *, node_name: str,
+                 driver_plugin_dir: str):
+        self._client = client
+        self._node_name = node_name
+        self._domains_root = os.path.join(driver_plugin_dir, "domains")
+        self.informer = Informer(client, COMPUTEDOMAINS)
+        self.informer.add_indexer(UID_INDEX, uid_index)
+
+    def start(self) -> None:
+        self.informer.start()
+        self.informer.wait_for_sync()
+
+    def stop(self) -> None:
+        self.informer.stop()
+
+    # -- lookups ------------------------------------------------------------
+
+    def get_by_uid(self, uid: str) -> Optional[Dict]:
+        hits = self.informer.get_by_index(UID_INDEX, uid)
+        if hits:
+            return hits[0]
+        # Fall back to a live list: the claim may arrive before the watch.
+        for cd in self._client.list(COMPUTEDOMAINS):
+            if cd["metadata"].get("uid") == uid:
+                self.informer.update_cache(cd)
+                return cd
+        return None
+
+    # -- assertions (computedomain.go:237-278) ------------------------------
+
+    def assert_namespace(self, cd_uid: str, claim_namespace: str) -> Dict:
+        """The workload claim must live in the CD's namespace; a mismatch is
+        permanent — retrying cannot fix a cross-namespace reference."""
+        cd = self.get_by_uid(cd_uid)
+        if cd is None:
+            raise RetryableNotReady(f"computedomain {cd_uid} not found (yet)")
+        if cd["metadata"].get("namespace") != claim_namespace:
+            raise PermanentError(
+                f"claim namespace {claim_namespace!r} does not match "
+                f"computedomain namespace {cd['metadata'].get('namespace')!r}")
+        return cd
+
+    def assert_node_ready(self, cd_uid: str) -> Dict:
+        """Block the prepare until the CD status reports *this* node Ready
+        (the local-daemon release semantics of the DNS-names mode)."""
+        cd = self.get_by_uid(cd_uid)
+        if cd is None:
+            raise RetryableNotReady(f"computedomain {cd_uid} not found")
+        nodes = (cd.get("status") or {}).get("nodes") or []
+        mine = next((n for n in nodes
+                     if n.get("name") == self._node_name), None)
+        if mine is None:
+            raise RetryableNotReady(
+                f"node {self._node_name} not yet registered in cd {cd_uid}")
+        if mine.get("status") != apitypes.COMPUTE_DOMAIN_STATUS_READY:
+            raise RetryableNotReady(
+                f"node {self._node_name} not Ready in cd {cd_uid}")
+        return cd
+
+    # -- node labeling (computedomain.go:280-332) ---------------------------
+
+    def add_node_label(self, cd_uid: str) -> None:
+        node = self._client.get(NODES, self._node_name)
+        labels = node["metadata"].get("labels") or {}
+        current = labels.get(apitypes.COMPUTE_DOMAIN_LABEL_KEY)
+        if current == cd_uid:
+            return
+        if current and self.get_by_uid(current) is not None:
+            # One CD at a time per node: TPU slices are exclusive hardware.
+            raise PermanentError(
+                f"node {self._node_name} already belongs to computedomain "
+                f"{current}")
+        self._client.patch(NODES, self._node_name, {"metadata": {"labels": {
+            apitypes.COMPUTE_DOMAIN_LABEL_KEY: cd_uid}}})
+
+    def remove_node_label(self, cd_uid: str) -> None:
+        try:
+            node = self._client.get(NODES, self._node_name)
+        except NotFoundError:
+            return
+        labels = node["metadata"].get("labels") or {}
+        if labels.get(apitypes.COMPUTE_DOMAIN_LABEL_KEY) != cd_uid:
+            return
+        self._client.patch(NODES, self._node_name, {"metadata": {"labels": {
+            apitypes.COMPUTE_DOMAIN_LABEL_KEY: None}}})
+
+    # -- rendezvous env (the IMEX-channel injection analog) -----------------
+
+    def workload_env(self, cd: Dict, channel_ids: List[int],
+                     allocation_mode: str) -> Dict[str, str]:
+        """Env a workload container needs to run collectives over the
+        provisioned slice: worker identity, peer list, coordinator, and
+        multi-slice (DCN) topology for heterogeneous domains."""
+        nodes = (cd.get("status") or {}).get("nodes") or []
+        mine = next(n for n in nodes if n.get("name") == self._node_name)
+        my_slice = mine.get("sliceID", "")
+        group = sorted(((n.get("index", 0), n) for n in nodes
+                        if n.get("sliceID", "") == my_slice),
+                       key=lambda pair: pair[0])
+        peers = [stable_name(i) for i, _n in group]
+        coordinator = next((n for i, n in group if i == 0), None)
+        slice_ids = sorted({n.get("sliceID", "") for n in nodes})
+        # Global coordinator for cross-slice (megascale) rendezvous: every
+        # slice must agree on ONE address — the index-0 member of the first
+        # slice in sorted order, not the per-slice coordinator.
+        global_coord = next(
+            (n for n in sorted(nodes, key=lambda n: (n.get("sliceID", ""),
+                                                     n.get("index", 0)))
+             if n.get("sliceID", "") == slice_ids[0]
+             and n.get("index", 0) == 0), None) if slice_ids else None
+
+        env = {
+            "COMPUTE_DOMAIN_UUID": cd["metadata"].get("uid", ""),
+            "COMPUTE_DOMAIN_NAME": cd["metadata"].get("name", ""),
+            "COMPUTE_DOMAIN_NAMESPACE": cd["metadata"].get("namespace", ""),
+            "TPU_SLICE_ID": my_slice,
+            "TPU_WORKER_ID": str(mine.get("index", 0)),
+            "TPU_WORKER_HOSTNAMES": ",".join(peers),
+            "TPU_PROCESS_COUNT": str(len(group)),
+        }
+        if coordinator is not None:
+            env["TPU_COORDINATOR_ADDRESS"] = (
+                f"{coordinator.get('ipAddress', '')}:{COORDINATOR_PORT}")
+        if len(slice_ids) > 1:
+            # Heterogeneous domain: slices talk over DCN (megascale-style).
+            env["MEGASCALE_NUM_SLICES"] = str(len(slice_ids))
+            env["MEGASCALE_SLICE_ID"] = str(slice_ids.index(my_slice))
+            if global_coord is not None:
+                env["MEGASCALE_COORDINATOR_ADDRESS"] = (
+                    f"{global_coord.get('ipAddress', '')}:{COORDINATOR_PORT}")
+        if allocation_mode == apitypes.ALLOCATION_MODE_ALL:
+            env["TPU_CD_CHANNELS"] = "all"
+        else:
+            env["TPU_CD_CHANNELS"] = ",".join(str(c) for c in channel_ids)
+        return env
+
+    # -- daemon config dirs (computedomain.go:131-235) ----------------------
+
+    def domain_dir(self, cd_uid: str) -> str:
+        return os.path.join(self._domains_root, cd_uid)
+
+    def prepare_daemon_dir(self, cd: Dict, slice_id: str) -> str:
+        """Per-CD config dir handed to the daemon pod (the /imexd mount)."""
+        path = self.domain_dir(cd["metadata"]["uid"])
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "domain.env"), "w") as f:
+            f.write(f"COMPUTE_DOMAIN_UUID={cd['metadata'].get('uid', '')}\n"
+                    f"COMPUTE_DOMAIN_NAME={cd['metadata'].get('name', '')}\n"
+                    f"COMPUTE_DOMAIN_NAMESPACE="
+                    f"{cd['metadata'].get('namespace', '')}\n"
+                    f"TPU_SLICE_ID={slice_id}\n")
+        return path
+
+    def gc_domain_dirs(self) -> List[str]:
+        """Remove config dirs of CDs that no longer exist (the plugin-side
+        dir GC, computedomain.go:352-407). Returns removed uids."""
+        removed = []
+        if not os.path.isdir(self._domains_root):
+            return removed
+        for uid in os.listdir(self._domains_root):
+            if self.get_by_uid(uid) is None:
+                shutil.rmtree(os.path.join(self._domains_root, uid),
+                              ignore_errors=True)
+                removed.append(uid)
+        return removed
+
+
+class RetryableNotReady(Exception):
+    """Retried by the prepare envelope until the 45s budget runs out."""

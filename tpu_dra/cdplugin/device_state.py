@@ -1,0 +1,255 @@
+"""CD plugin device state: checkpointed channel/daemon prepare.
+
+Reference: cmd/compute-domain-kubelet-plugin/device_state.go —
+channel prepare (:456-504): namespace assert (permanent), node label (pulls
+the daemon pod here), block until this node is Ready in the CD status, then
+inject rendezvous env via CDI (char-devs on NVIDIA, env on TPU — SURVEY
+§2.9). Daemon prepare (:506-563): per-CD config dir + identity env.
+Channel exclusivity (:625-664): checkpoint-based node-local assertion that
+a channel is not already held by a different completed claim.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, List, Optional
+
+from tpu_dra.api import scheme as apischeme
+from tpu_dra.api import types as apitypes
+from tpu_dra.cdi.handler import CDIHandler
+from tpu_dra.cdplugin import deviceinfo
+from tpu_dra.cdplugin.computedomain import (
+    ComputeDomainManager, PermanentError, RetryableNotReady,
+)
+from tpu_dra.kubeletplugin.server import PreparedDevice, PrepareResult
+from tpu_dra.tpuplugin.checkpoint import (
+    Checkpoint, CheckpointManager, PREPARE_COMPLETED, PREPARE_STARTED,
+    PreparedClaim,
+)
+
+log = logging.getLogger("tpu_dra.cdplugin")
+
+
+class DeviceState:
+    def __init__(self, *, cd_manager: ComputeDomainManager, cdi: CDIHandler,
+                 checkpoints: CheckpointManager, driver_name: str,
+                 node_name: str, slice_id: str):
+        self._cd = cd_manager
+        self._cdi = cdi
+        self._ckpt_mgr = checkpoints
+        self._driver_name = driver_name
+        self._node_name = node_name
+        self._slice_id = slice_id
+        self._lock = threading.Lock()
+        self._checkpoint = self._ckpt_mgr.load_or_init()
+
+    # ------------------------------------------------------------------
+    # Prepare
+    # ------------------------------------------------------------------
+
+    def prepare(self, claim: Dict) -> PrepareResult:
+        """May raise RetryableNotReady (the driver retries inside its 45s
+        envelope) or PermanentError (short-circuits)."""
+        uid = claim["metadata"]["uid"]
+        with self._lock:
+            existing = self._checkpoint.claims.get(uid)
+            if existing is not None and existing.state == PREPARE_COMPLETED:
+                return PrepareResult(devices=[
+                    self._rehydrate(r) for r in existing.devices])
+
+        allocation = ((claim.get("status") or {}).get("allocation") or {})
+        results = [r for r in (allocation.get("devices") or {})
+                   .get("results", [])
+                   if r.get("driver") == self._driver_name]
+        if not results:
+            raise PermanentError("claim has no allocation results for this driver")
+
+        config = self._decode_config(allocation, results)
+        if isinstance(config, apitypes.ComputeDomainChannelConfig):
+            return self._prepare_channel(claim, results, config)
+        if isinstance(config, apitypes.ComputeDomainDaemonConfig):
+            return self._prepare_daemon(claim, results, config)
+        raise PermanentError(
+            f"unsupported config kind {type(config).__name__}")
+
+    def _decode_config(self, allocation: Dict, results: List[Dict]):
+        entries = (allocation.get("devices") or {}).get("config", []) or []
+        for entry in entries:
+            opaque = entry.get("opaque") or {}
+            if opaque.get("driver") != self._driver_name:
+                continue
+            try:
+                cfg = apischeme.StrictDecoder.decode(
+                    opaque.get("parameters", {}))
+            except apischeme.DecodeError as e:
+                raise PermanentError(f"invalid opaque config: {e}") from e
+            cfg.normalize()
+            cfg.validate()
+            return cfg
+        raise PermanentError(
+            "claim carries no ComputeDomain opaque config for this driver")
+
+    # -- channel (workload) claims ------------------------------------------
+
+    def _prepare_channel(self, claim: Dict, results: List[Dict],
+                         config: apitypes.ComputeDomainChannelConfig
+                         ) -> PrepareResult:
+        uid = claim["metadata"]["uid"]
+        ns = claim["metadata"].get("namespace", "")
+        cd = self._cd.assert_namespace(config.domain_id, ns)
+
+        channel_ids = [deviceinfo.parse_channel_id(r["device"])
+                       for r in results]
+        with self._lock:
+            self._assert_channels_free(uid, channel_ids)
+            # Record intent before side effects (crash consistency).
+            self._checkpoint.claims[uid] = PreparedClaim(
+                uid=uid, state=PREPARE_STARTED,
+                name=claim["metadata"].get("name", ""), namespace=ns)
+            self._checkpoint.claims[uid].devices = [{
+                "type": deviceinfo.DEVICE_TYPE_CHANNEL,
+                "device": r["device"],
+                "request": r.get("request", ""),
+                "channel_id": deviceinfo.parse_channel_id(r["device"]),
+                "cd_uid": config.domain_id,
+                "pool": self._node_name,
+                "cdi_ids": [self._cdi.get_claim_device(uid)],
+            } for r in results]
+            self._ckpt_mgr.store(self._checkpoint)
+
+        # Label first (this is what summons the daemon pod), then wait.
+        self._cd.add_node_label(config.domain_id)
+        cd = self._cd.assert_node_ready(config.domain_id)  # raises retryable
+
+        env = self._cd.workload_env(cd, channel_ids, config.allocation_mode)
+        self._cdi.create_claim_spec_file(uid, env)
+        return self._complete(uid)
+
+    def _assert_channels_free(self, claim_uid: str,
+                              channel_ids: List[int]) -> None:
+        """Channel exclusivity (device_state.go:625-664): a channel held by
+        a *different* claim that completed prepare must first be
+        unprepared — orders prepare-after-unprepare correctly when kubelet
+        races a new pod against a terminating one."""
+        for other_uid, other in self._checkpoint.claims.items():
+            if other_uid == claim_uid or other.state != PREPARE_COMPLETED:
+                continue
+            held = {d.get("channel_id") for d in other.devices
+                    if d.get("type") == deviceinfo.DEVICE_TYPE_CHANNEL}
+            clash = held.intersection(channel_ids)
+            if clash:
+                raise RetryableNotReady(
+                    f"channel(s) {sorted(clash)} still prepared for claim "
+                    f"{other_uid}")
+
+    # -- daemon claims ------------------------------------------------------
+
+    def _prepare_daemon(self, claim: Dict, results: List[Dict],
+                        config: apitypes.ComputeDomainDaemonConfig
+                        ) -> PrepareResult:
+        uid = claim["metadata"]["uid"]
+        cd = self._cd.get_by_uid(config.domain_id)
+        if cd is None:
+            raise RetryableNotReady(
+                f"computedomain {config.domain_id} not found")
+        with self._lock:
+            self._checkpoint.claims[uid] = PreparedClaim(
+                uid=uid, state=PREPARE_STARTED,
+                name=claim["metadata"].get("name", ""),
+                namespace=claim["metadata"].get("namespace", ""))
+            self._checkpoint.claims[uid].devices = [{
+                "type": deviceinfo.DEVICE_TYPE_DAEMON,
+                "device": r["device"],
+                "request": r.get("request", ""),
+                "cd_uid": config.domain_id,
+                "pool": self._node_name,
+                "cdi_ids": [self._cdi.get_claim_device(uid)],
+            } for r in results]
+            self._ckpt_mgr.store(self._checkpoint)
+
+        domain_dir = self._cd.prepare_daemon_dir(cd, self._slice_id)
+        env = {
+            "COMPUTE_DOMAIN_UUID": cd["metadata"].get("uid", ""),
+            "COMPUTE_DOMAIN_NAME": cd["metadata"].get("name", ""),
+            "COMPUTE_DOMAIN_NAMESPACE": cd["metadata"].get("namespace", ""),
+            "TPU_SLICE_ID": self._slice_id,
+        }
+        mounts = [{
+            "hostPath": domain_dir,
+            "containerPath": "/var/run/tpu-dra-cd/domain",
+            "options": ["rw", "bind"],
+        }]
+        self._cdi.create_claim_spec_file(uid, env, mounts=mounts)
+        return self._complete(uid)
+
+    def _complete(self, uid: str) -> PrepareResult:
+        with self._lock:
+            prepared = self._checkpoint.claims.get(uid)
+            if prepared is None:
+                # GC collected the claim (deleted from the API server) while
+                # the readiness wait was in flight; don't resurrect it.
+                return PrepareResult(
+                    error="claim was garbage-collected during prepare")
+            prepared.state = PREPARE_COMPLETED
+            self._ckpt_mgr.store(self._checkpoint)
+            return PrepareResult(devices=[
+                self._rehydrate(r) for r in prepared.devices])
+
+    # ------------------------------------------------------------------
+    # Unprepare
+    # ------------------------------------------------------------------
+
+    def unprepare(self, claim_uid: str) -> Optional[str]:
+        with self._lock:
+            prepared = self._checkpoint.claims.get(claim_uid)
+            if prepared is None:
+                return None
+            cd_uids = {d.get("cd_uid") for d in prepared.devices
+                       if d.get("type") == deviceinfo.DEVICE_TYPE_CHANNEL}
+            self._cdi.delete_claim_spec_file(claim_uid)
+            del self._checkpoint.claims[claim_uid]
+            self._ckpt_mgr.store(self._checkpoint)
+            # Last channel claim for a CD releases the node from the domain
+            # (the daemon settings/dir GC is deferred, §3.4).
+            still_used = {
+                d.get("cd_uid")
+                for c in self._checkpoint.claims.values()
+                for d in c.devices
+                if d.get("type") == deviceinfo.DEVICE_TYPE_CHANNEL}
+        for cd_uid in cd_uids - still_used:
+            if cd_uid:
+                try:
+                    self._cd.remove_node_label(cd_uid)
+                except Exception as e:  # noqa: BLE001
+                    return f"remove node label for {cd_uid}: {e}"
+        return None
+
+    # ------------------------------------------------------------------
+
+    def _rehydrate(self, record: Dict) -> PreparedDevice:
+        return PreparedDevice(
+            pool_name=record.get("pool", ""),
+            device_name=record.get("device", ""),
+            cdi_device_ids=list(record.get("cdi_ids") or []),
+            request_names=([record["request"]]
+                           if record.get("request") else []))
+
+    def prepared_claim_uids(self) -> List[str]:
+        with self._lock:
+            return list(self._checkpoint.claims)
+
+    def checkpoint_snapshot(self) -> Checkpoint:
+        """Deep copy under the lock: GC iterates this while prepare threads
+        mutate the live checkpoint."""
+        import copy
+        with self._lock:
+            return copy.deepcopy(self._checkpoint)
+
+    def drop_claim(self, claim_uid: str) -> None:
+        """Checkpoint GC hook (cleanup.py)."""
+        with self._lock:
+            if claim_uid in self._checkpoint.claims:
+                self._cdi.delete_claim_spec_file(claim_uid)
+                del self._checkpoint.claims[claim_uid]
+                self._ckpt_mgr.store(self._checkpoint)

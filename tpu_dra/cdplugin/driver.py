@@ -1,0 +1,139 @@
+"""Retry-aware CD plugin driver.
+
+Reference: cmd/compute-domain-kubelet-plugin/driver.go:39-98, 164-231 —
+every claim is retried with backoff inside a 45s ``ErrorRetryMaxTimeout``
+envelope (kubelet re-calls prepare until the pod leaves
+ContainerCreating, so returning an error after 45s is safe and keeps the
+retry loop responsive); ``permanentError`` short-circuits. Claims are
+processed concurrently (``Serialize(false)``) because daemon-prepare and
+channel-prepare are co-dependent: the channel claim's readiness wait can
+only resolve once the daemon pod (whose own claim prepares through this
+same server) is up.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, List, Optional
+
+from tpu_dra.cdplugin.computedomain import PermanentError, RetryableNotReady
+from tpu_dra.cdplugin.device_state import DeviceState
+from tpu_dra.infra.metrics import DefaultRegistry
+from tpu_dra.k8s import ApiClient, RESOURCECLAIMS
+from tpu_dra.k8s.client import NotFoundError
+from tpu_dra.kubeletplugin.server import (
+    Claim, DRAPluginServer, DriverCallbacks, PrepareResult, publish_resources,
+)
+from tpu_dra.cdplugin.deviceinfo import published_devices
+
+log = logging.getLogger("tpu_dra.cdplugin")
+
+ERROR_RETRY_MAX_TIMEOUT = 45.0  # driver.go:39-50
+RETRY_BASE = 0.25
+RETRY_CAP = 3.0
+
+cd_prepare_seconds = DefaultRegistry.histogram(
+    "tpu_dra_cd_claim_prepare_seconds",
+    "CD plugin per-claim prepare latency (includes readiness wait)")
+
+
+class CDDriver(DriverCallbacks):
+    def __init__(self, *, state: DeviceState, client: ApiClient,
+                 driver_name: str, node_name: str, slice_id: str,
+                 plugin_dir: str, registry_dir: Optional[str] = None,
+                 retry_timeout: float = ERROR_RETRY_MAX_TIMEOUT):
+        self._state = state
+        self._client = client
+        self._driver_name = driver_name
+        self._node_name = node_name
+        self._slice_id = slice_id
+        self._retry_timeout = retry_timeout
+        self.server = DRAPluginServer(
+            driver_name=driver_name, node_name=node_name, callbacks=self,
+            plugin_dir=plugin_dir, registry_dir=registry_dir)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        self.server.start()
+        self.publish_resources()
+
+    def shutdown(self) -> None:
+        self.server.stop()
+
+    def publish_resources(self) -> None:
+        publish_resources(self._client, self._driver_name, self._node_name,
+                          published_devices(self._slice_id))
+
+    # -- DRA callbacks ------------------------------------------------------
+
+    def prepare_claims(self, claims: List[Claim]) -> Dict[str, PrepareResult]:
+        """Concurrent per-claim preparation (Serialize(false))."""
+        results: Dict[str, PrepareResult] = {}
+        threads = []
+        lock = threading.Lock()
+
+        def work(claim: Claim) -> None:
+            res = self._prepare_with_retry(claim)
+            with lock:
+                results[claim.uid] = res
+
+        for claim in claims:
+            t = threading.Thread(target=work, args=(claim,),
+                                 name=f"cd-prepare-{claim.uid[:8]}")
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+        return results
+
+    def unprepare_claims(self, claims: List[Claim]) -> Dict[str, str]:
+        errors: Dict[str, str] = {}
+        for claim in claims:
+            err = self._state.unprepare(claim.uid)
+            errors[claim.uid] = err or ""
+        return errors
+
+    # -- retry envelope -----------------------------------------------------
+
+    def _prepare_with_retry(self, claim: Claim) -> PrepareResult:
+        t0 = time.monotonic()
+        deadline = t0 + self._retry_timeout
+        delay = RETRY_BASE
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                obj = self._fetch_claim(claim)
+                result = self._state.prepare(obj)
+                cd_prepare_seconds.observe(time.monotonic() - t0)
+                return result
+            except PermanentError as e:
+                return PrepareResult(error=f"permanent: {e}")
+            except RetryableNotReady as e:
+                now = time.monotonic()
+                if now + delay >= deadline:
+                    return PrepareResult(
+                        error=f"retry budget exhausted after {attempt} "
+                              f"attempts: {e}")
+                log.debug("claim %s not ready (attempt %d): %s",
+                          claim.uid, attempt, e)
+                time.sleep(delay)
+                delay = min(delay * 2, RETRY_CAP)
+            except Exception as e:  # noqa: BLE001 — unexpected: report
+                return PrepareResult(error=f"prepare: {e}")
+
+    def _fetch_claim(self, claim: Claim) -> Dict:
+        try:
+            obj = self._client.get(RESOURCECLAIMS, claim.name,
+                                   claim.namespace)
+        except NotFoundError as e:
+            raise PermanentError(
+                f"resourceclaim {claim.namespace}/{claim.name} not found"
+            ) from e
+        if obj["metadata"].get("uid") != claim.uid:
+            raise PermanentError(
+                f"claim UID mismatch for {claim.namespace}/{claim.name}")
+        return obj
