@@ -1,0 +1,216 @@
+"""Webhook admission + deployment manifest tests.
+
+Reference: cmd/webhook/main_test.go (524 LoC of synthetic AdmissionReviews
+across resource.k8s.io v1/v1beta1/v1beta2) — same matrix here, plus the
+HTTP server path and manifest sanity.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from tpu_dra.api import types as apitypes
+from tpu_dra.deploy import demos, manifests
+from tpu_dra.infra import featuregates
+from tpu_dra.webhook import AdmissionHandler, WebhookServer
+
+API = apitypes.API_VERSION
+
+
+def review(obj, kind="ResourceClaim", group="resource.k8s.io",
+           version="v1", uid="req-1"):
+    return {
+        "apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+        "request": {
+            "uid": uid,
+            "resource": {"group": group, "version": version,
+                         "resource": kind.lower() + "s"},
+            "kind": {"kind": kind},
+            "object": obj,
+        },
+    }
+
+
+def claim_with_config(params, driver=apitypes.TPU_DRIVER_NAME):
+    return {
+        "apiVersion": "resource.k8s.io/v1", "kind": "ResourceClaim",
+        "metadata": {"name": "c", "namespace": "d"},
+        "spec": {"devices": {
+            "requests": [{"name": "tpu"}],
+            "config": [{"requests": ["tpu"],
+                        "opaque": {"driver": driver, "parameters": params}}],
+        }},
+    }
+
+
+class TestAdmission:
+    def setup_method(self):
+        self.handler = AdmissionHandler()
+
+    def test_valid_tpu_config_allowed(self):
+        featuregates.Features.set_from_string("TimeSlicingSettings=true")
+        obj = claim_with_config({
+            "apiVersion": API, "kind": "TpuConfig",
+            "sharing": {"strategy": "TimeSlicing"}})
+        out = self.handler.review(review(obj))
+        assert out["response"]["allowed"] is True
+        assert out["response"]["uid"] == "req-1"
+
+    def test_unknown_field_rejected(self):
+        obj = claim_with_config({"apiVersion": API, "kind": "TpuConfig",
+                                 "bogus": 1})
+        out = self.handler.review(review(obj))
+        assert out["response"]["allowed"] is False
+        assert "bogus" in out["response"]["status"]["message"]
+
+    def test_unknown_kind_rejected(self):
+        obj = claim_with_config({"apiVersion": API, "kind": "Mystery"})
+        out = self.handler.review(review(obj))
+        assert out["response"]["allowed"] is False
+
+    def test_invalid_channel_config_rejected(self):
+        obj = claim_with_config(
+            {"apiVersion": API, "kind": "ComputeDomainChannelConfig",
+             "domainID": "", "allocationMode": "Single"},
+            driver=apitypes.COMPUTE_DOMAIN_DRIVER_NAME)
+        out = self.handler.review(review(obj))
+        assert out["response"]["allowed"] is False
+        assert "domainID" in out["response"]["status"]["message"]
+
+    def test_foreign_driver_passes_through(self):
+        obj = claim_with_config({"whatever": True}, driver="gpu.example.com")
+        out = self.handler.review(review(obj))
+        assert out["response"]["allowed"] is True
+
+    def test_template_nested_spec_validated(self):
+        tmpl = {
+            "apiVersion": "resource.k8s.io/v1",
+            "kind": "ResourceClaimTemplate",
+            "metadata": {"name": "t", "namespace": "d"},
+            "spec": {"spec": {"devices": {"config": [{
+                "opaque": {"driver": apitypes.TPU_DRIVER_NAME,
+                           "parameters": {"apiVersion": API,
+                                          "kind": "TpuConfig",
+                                          "junk": 1}}}]}}},
+        }
+        out = self.handler.review(review(tmpl, kind="ResourceClaimTemplate"))
+        assert out["response"]["allowed"] is False
+
+    @pytest.mark.parametrize("version", ["v1", "v1beta1", "v1beta2"])
+    def test_all_supported_versions(self, version):
+        obj = claim_with_config({"apiVersion": API, "kind": "TpuConfig",
+                                 "junk": 1})
+        out = self.handler.review(review(obj, version=version))
+        assert out["response"]["allowed"] is False
+
+    def test_future_version_fails_open(self):
+        obj = claim_with_config({"apiVersion": API, "kind": "TpuConfig",
+                                 "junk": 1})
+        out = self.handler.review(review(obj, version="v2alpha1"))
+        assert out["response"]["allowed"] is True
+
+    def test_other_group_passes(self):
+        out = self.handler.review(review({"kind": "Pod"}, kind="Pod",
+                                         group="", version="v1"))
+        assert out["response"]["allowed"] is True
+
+    def test_missing_object_rejected(self):
+        out = self.handler.review({"request": {"uid": "x"}})
+        assert out["response"]["allowed"] is False
+
+
+class TestServer:
+    def test_http_roundtrip_and_readyz(self):
+        server = WebhookServer(port=0, addr="127.0.0.1")
+        server.start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            assert urllib.request.urlopen(f"{base}/readyz").read() == b"ok"
+            obj = claim_with_config({"apiVersion": API, "kind": "TpuConfig",
+                                     "junk": 1})
+            req = urllib.request.Request(
+                f"{base}/validate-resource-claim-parameters",
+                data=json.dumps(review(obj)).encode(),
+                headers={"Content-Type": "application/json"})
+            out = json.loads(urllib.request.urlopen(req).read())
+            assert out["response"]["allowed"] is False
+        finally:
+            server.stop()
+
+
+class TestServerTLS:
+    @pytest.fixture
+    def certs(self, tmp_path):
+        import subprocess
+        cert, key = str(tmp_path / "tls.crt"), str(tmp_path / "tls.key")
+        subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", key, "-out", cert, "-days", "1",
+             "-subj", "/CN=127.0.0.1",
+             "-addext", "subjectAltName=IP:127.0.0.1"],
+            check=True, capture_output=True)
+        return cert, key
+
+    def test_tls_roundtrip_and_stalled_client(self, certs):
+        import socket
+        import ssl as ssl_mod
+        cert, key = certs
+        server = WebhookServer(port=0, addr="127.0.0.1",
+                               cert_file=cert, key_file=key)
+        server.start()
+        try:
+            # A plain-TCP client that never handshakes must NOT block the
+            # accept loop (per-connection TLS wrap).
+            stalled = socket.create_connection(("127.0.0.1", server.port))
+            ctx = ssl_mod.create_default_context(cafile=cert)
+            out = urllib.request.urlopen(
+                f"https://127.0.0.1:{server.port}/readyz", context=ctx,
+                timeout=5).read()
+            assert out == b"ok"
+            stalled.close()
+        finally:
+            server.stop()
+
+
+class TestManifests:
+    def test_all_manifests_render(self):
+        docs = manifests.all_manifests()
+        kinds = [d["kind"] for d in docs]
+        for want in ("Namespace", "CustomResourceDefinition", "DeviceClass",
+                     "ClusterRole", "Deployment", "DaemonSet", "Service",
+                     "ValidatingWebhookConfiguration",
+                     "ValidatingAdmissionPolicy"):
+            assert want in kinds, f"missing {want}"
+        assert kinds.count("DeviceClass") == 4
+
+    def test_crd_immutability_rule(self):
+        from tpu_dra.api.crd import compute_domain_crd
+        crd = compute_domain_crd()
+        version = crd["spec"]["versions"][0]
+        spec_schema = version["schema"]["openAPIV3Schema"]["properties"]["spec"]
+        rules = spec_schema["x-kubernetes-validations"]
+        assert any(r["rule"] == "self == oldSelf" for r in rules)
+        assert version["subresources"] == {"status": {}}
+
+    def test_demo_specs_are_valid_configs(self):
+        """Every opaque config in the demo ladder must pass the webhook."""
+        featuregates.Features.set_from_string("TimeSlicingSettings=true")
+        handler = AdmissionHandler()
+        for name, docs in demos.all_demos().items():
+            for doc in docs:
+                if doc["kind"] not in ("ResourceClaim",
+                                       "ResourceClaimTemplate"):
+                    continue
+                out = handler.review(review(doc, kind=doc["kind"]))
+                assert out["response"]["allowed"], (
+                    f"{name}: {out['response'].get('status')}")
+
+    def test_yaml_render(self, tmp_path):
+        from tpu_dra.deploy.render import render_all
+        import yaml
+        written = render_all(str(tmp_path / "m"), "tpu-dra-driver",
+                             "img:test")
+        assert len(written) >= 7
+        docs = list(yaml.safe_load_all(open(written[0])))
+        assert docs[0]["kind"] == "Namespace"

@@ -1,0 +1,105 @@
+"""ComputeDomain CRD manifest (reference: the CRD in
+deployments/helm/nvidia-dra-driver-gpu/crds/, with the CEL spec-immutability
+rule of computedomain.go:59 and the status subresource).
+
+Generated as a dict so the deploy tool renders it to YAML and the fake
+apiserver tier can introspect the schema.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from tpu_dra.api.types import GROUP, VERSION
+
+
+def compute_domain_crd() -> Dict:
+    node_props = {
+        "name": {"type": "string"},
+        "ipAddress": {"type": "string"},
+        "sliceID": {"type": "string"},
+        "index": {"type": "integer"},
+        "status": {"type": "string", "enum": ["Ready", "NotReady"]},
+    }
+    spec_schema = {
+        "type": "object",
+        # Spec is immutable after creation (computedomain.go:59).
+        "x-kubernetes-validations": [{
+            "rule": "self == oldSelf",
+            "message": "ComputeDomain spec is immutable",
+        }],
+        "properties": {
+            "numNodes": {
+                "type": "integer",
+                "minimum": 0,
+                "description": "Deprecated: drives only the global Ready "
+                               "status; daemons start eagerly and workloads "
+                               "release on local readiness.",
+            },
+            "channel": {
+                "type": "object",
+                "required": ["resourceClaimTemplate"],
+                "properties": {
+                    "resourceClaimTemplate": {
+                        "type": "object",
+                        "required": ["name"],
+                        "properties": {"name": {"type": "string",
+                                                "minLength": 1}},
+                    },
+                    "allocationMode": {
+                        "type": "string",
+                        "enum": ["Single", "All"],
+                        "default": "Single",
+                    },
+                },
+            },
+        },
+        "required": ["channel"],
+    }
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": f"computedomains.{GROUP}"},
+        "spec": {
+            "group": GROUP,
+            "scope": "Namespaced",
+            "names": {
+                "plural": "computedomains",
+                "singular": "computedomain",
+                "kind": "ComputeDomain",
+                "shortNames": ["cd"],
+            },
+            "versions": [{
+                "name": VERSION,
+                "served": True,
+                "storage": True,
+                "subresources": {"status": {}},
+                "schema": {"openAPIV3Schema": {
+                    "type": "object",
+                    "properties": {
+                        "spec": spec_schema,
+                        "status": {
+                            "type": "object",
+                            "properties": {
+                                "status": {"type": "string",
+                                           "enum": ["Ready", "NotReady"]},
+                                "nodes": {
+                                    "type": "array",
+                                    "items": {"type": "object",
+                                              "properties": node_props},
+                                },
+                            },
+                        },
+                    },
+                }},
+                "additionalPrinterColumns": [
+                    {"name": "Status", "type": "string",
+                     "jsonPath": ".status.status"},
+                    {"name": "Nodes", "type": "integer",
+                     "jsonPath": ".spec.numNodes"},
+                    {"name": "Age", "type": "date",
+                     "jsonPath": ".metadata.creationTimestamp"},
+                ],
+            }],
+        },
+    }

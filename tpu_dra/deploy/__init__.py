@@ -1,0 +1,9 @@
+"""Deployment manifests (reference: deployments/helm/nvidia-dra-driver-gpu).
+
+Manifest builders for everything a cluster operator installs: the CRD,
+DeviceClasses with CEL selectors, the controller Deployment, the
+kubelet-plugin DaemonSet, the webhook, a ValidatingAdmissionPolicy, and
+RBAC. ``python -m tpu_dra.deploy.render`` writes them as YAML to
+deployments/manifests/ (the chart-render analog; Helm itself is not
+assumed).
+"""

@@ -1,0 +1,171 @@
+"""Demo / quickstart specs (reference: demo/specs/quickstart/v1).
+
+The reference's gpu-test1..5 ladder translated to TPU claims, plus the
+multi-node ComputeDomain benchmark job (the nvbandwidth/NCCL analog:
+a 2-pod JAX psum allreduce over a driver-provisioned slice).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from tpu_dra.api import types as apitypes
+
+WORKLOAD_IMAGE = "tpu-dra-driver:latest"
+
+
+def _ns(name: str) -> Dict:
+    return {"apiVersion": "v1", "kind": "Namespace",
+            "metadata": {"name": name}}
+
+
+def _rct(name: str, ns: str, device_class: str, count: int = 1,
+         config: Dict = None) -> Dict:
+    spec: Dict = {"devices": {"requests": [{
+        "name": "tpu",
+        "exactly": {"deviceClassName": device_class,
+                    **({"count": count} if count != 1 else {})},
+    }]}}
+    if config:
+        spec["devices"]["config"] = [{
+            "requests": ["tpu"],
+            "opaque": {"driver": apitypes.TPU_DRIVER_NAME,
+                       "parameters": config}}]
+    return {"apiVersion": "resource.k8s.io/v1",
+            "kind": "ResourceClaimTemplate",
+            "metadata": {"name": name, "namespace": ns},
+            "spec": {"spec": spec}}
+
+
+def _pod(name: str, ns: str, claim_source: Dict,
+         command: List[str] = None, containers: int = 1) -> Dict:
+    ctrs = []
+    for i in range(containers):
+        ctrs.append({
+            "name": f"ctr{i}" if containers > 1 else "ctr",
+            "image": WORKLOAD_IMAGE,
+            "command": command or [
+                "python", "-c",
+                "import os, jax; "
+                "print('TPU_VISIBLE_CHIPS=', "
+                "os.environ.get('TPU_VISIBLE_CHIPS')); "
+                "print('devices:', jax.devices())"],
+            "resources": {"claims": [{"name": "tpu"}]},
+        })
+    return {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {
+            "restartPolicy": "Never",
+            "containers": ctrs,
+            "resourceClaims": [{"name": "tpu", **claim_source}],
+        },
+    }
+
+
+# -- the quickstart ladder --------------------------------------------------
+
+def test1_exclusive_per_pod() -> List[Dict]:
+    """gpu-test1 analog: two pods, each with its own exclusive chip."""
+    ns = "tpu-test1"
+    return [_ns(ns), _rct("single-tpu", ns, "tpu.dev"),
+            _pod("pod0", ns, {"resourceClaimTemplateName": "single-tpu"}),
+            _pod("pod1", ns, {"resourceClaimTemplateName": "single-tpu"})]
+
+
+def test2_shared_claim_two_containers() -> List[Dict]:
+    """gpu-test2 analog: one claim shared by two containers of one pod."""
+    ns = "tpu-test2"
+    return [_ns(ns), _rct("shared-tpu", ns, "tpu.dev"),
+            _pod("pod0", ns, {"resourceClaimTemplateName": "shared-tpu"},
+                 containers=2)]
+
+
+def test3_time_sliced_across_pods() -> List[Dict]:
+    """gpu-test3 analog: one ResourceClaim (not template) time-shared by
+    two pods."""
+    ns = "tpu-test3"
+    claim = {
+        "apiVersion": "resource.k8s.io/v1", "kind": "ResourceClaim",
+        "metadata": {"name": "ts-tpu", "namespace": ns},
+        "spec": {"devices": {
+            "requests": [{"name": "tpu",
+                          "exactly": {"deviceClassName": "tpu.dev"}}],
+            "config": [{"requests": ["tpu"], "opaque": {
+                "driver": apitypes.TPU_DRIVER_NAME,
+                "parameters": {
+                    "apiVersion": apitypes.API_VERSION, "kind": "TpuConfig",
+                    "sharing": {"strategy": "TimeSlicing",
+                                "timeSlicingConfig": {"interval": "Long"}},
+                }}}],
+        }},
+    }
+    return [_ns(ns), claim,
+            _pod("pod0", ns, {"resourceClaimName": "ts-tpu"}),
+            _pod("pod1", ns, {"resourceClaimName": "ts-tpu"})]
+
+
+def test4_multi_chip() -> List[Dict]:
+    """gpu-test4 analog: one pod claiming 4 chips on one host."""
+    ns = "tpu-test4"
+    return [_ns(ns), _rct("quad-tpu", ns, "tpu.dev", count=4),
+            _pod("pod0", ns, {"resourceClaimTemplateName": "quad-tpu"})]
+
+
+def test5_subslice() -> List[Dict]:
+    """gpu-test5/MIG analog: two pods each claiming a TensorCore subslice
+    of (potentially) the same chip."""
+    ns = "tpu-test5"
+    return [_ns(ns), _rct("subslice", ns, "tpu-subslice.tpu.dev"),
+            _pod("pod0", ns, {"resourceClaimTemplateName": "subslice"}),
+            _pod("pod1", ns, {"resourceClaimTemplateName": "subslice"})]
+
+
+# -- multi-node ComputeDomain benchmark -------------------------------------
+
+def cd_allreduce_bench(num_nodes: int = 2) -> List[Dict]:
+    """The nvbandwidth/NCCL-test analog (demo/specs/imex/
+    nvbandwidth-test-job-1.yaml): a ComputeDomain + N pods that
+    jax.distributed-initialize over the injected rendezvous env and run the
+    psum bandwidth probe from tpu_dra.workloads."""
+    ns = "tpu-bench"
+    cd = {
+        "apiVersion": apitypes.API_VERSION, "kind": "ComputeDomain",
+        "metadata": {"name": "bench-cd", "namespace": ns},
+        "spec": {"numNodes": num_nodes, "channel": {
+            "resourceClaimTemplate": {"name": "bench-channel"},
+            "allocationMode": "Single"}},
+    }
+    command = [
+        "python", "-c",
+        "import os, jax; "
+        "jax.distributed.initialize("
+        "os.environ['TPU_COORDINATOR_ADDRESS'], "
+        "int(os.environ['TPU_PROCESS_COUNT']), "
+        "int(os.environ['TPU_WORKER_ID'])); "
+        "from tpu_dra.workloads.allreduce import allreduce_bandwidth; "
+        "print('RESULT', allreduce_bandwidth())",
+    ]
+    pods = []
+    for i in range(num_nodes):
+        pod = _pod(f"bench-{i}", ns,
+                   {"resourceClaimTemplateName": "bench-channel"}, command)
+        # One pod per node: the CD channel device exists once per node.
+        pod["spec"]["affinity"] = {"podAntiAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": [{
+                "labelSelector": {"matchLabels": {"app": "tpu-bench"}},
+                "topologyKey": "kubernetes.io/hostname"}]}}
+        pod["metadata"]["labels"] = {"app": "tpu-bench"}
+        pods.append(pod)
+    return [_ns(ns), cd] + pods
+
+
+def all_demos() -> Dict[str, List[Dict]]:
+    return {
+        "tpu-test1": test1_exclusive_per_pod(),
+        "tpu-test2": test2_shared_claim_two_containers(),
+        "tpu-test3": test3_time_sliced_across_pods(),
+        "tpu-test4": test4_multi_chip(),
+        "tpu-test5": test5_subslice(),
+        "cd-allreduce-bench": cd_allreduce_bench(),
+    }

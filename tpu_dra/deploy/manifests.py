@@ -1,0 +1,361 @@
+"""Cluster manifests for the TPU DRA driver.
+
+Reference mapping (deployments/helm/nvidia-dra-driver-gpu/templates/):
+- deviceclass-gpu.yaml / -mig.yaml        -> tpu / tpu-subslice DeviceClass
+- deviceclass-compute-domain-*.yaml       -> daemon / channel DeviceClass
+- controller.yaml                         -> controller Deployment
+- kubeletplugin.yaml                      -> plugin DaemonSet (2 plugins)
+- webhook.yaml + validatingwebhook        -> webhook Deployment + config
+- validatingadmissionpolicy.yaml          -> VAP with CEL opaque-cfg guard
+- clusterrole(binding).yaml               -> RBAC
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from tpu_dra.api import types as apitypes
+from tpu_dra.api.crd import compute_domain_crd
+
+APP = "tpu-dra-driver"
+DEFAULT_NAMESPACE = "tpu-dra-driver"
+DEFAULT_IMAGE = "tpu-dra-driver:latest"
+# Gates enabled in the rendered deployment so the shipped demo ladder
+# (tpu-test3 time-slicing) works out of the box; operators can override.
+DEFAULT_FEATURE_GATES = "TimeSlicingSettings=true"
+
+
+def namespace(ns: str = DEFAULT_NAMESPACE) -> Dict:
+    return {"apiVersion": "v1", "kind": "Namespace",
+            "metadata": {"name": ns}}
+
+
+# ---------------------------------------------------------------------------
+# DeviceClasses (CEL selectors over published device attributes)
+# ---------------------------------------------------------------------------
+
+def _device_class(name: str, driver: str, device_type: str) -> Dict:
+    cel = (f'device.driver == "{driver}" && '
+           f'device.attributes["{driver}"].type == "{device_type}"')
+    return {
+        "apiVersion": "resource.k8s.io/v1",
+        "kind": "DeviceClass",
+        "metadata": {"name": name},
+        "spec": {"selectors": [{"cel": {"expression": cel}}]},
+    }
+
+
+def device_classes() -> List[Dict]:
+    tpu = apitypes.TPU_DRIVER_NAME
+    cd = apitypes.COMPUTE_DOMAIN_DRIVER_NAME
+    return [
+        _device_class("tpu.dev", tpu, "chip"),
+        _device_class("tpu-subslice.tpu.dev", tpu, "subslice"),
+        _device_class(apitypes.DEVICE_CLASS_DAEMON, cd, "daemon"),
+        _device_class(apitypes.DEVICE_CLASS_CHANNEL, cd, "channel"),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# RBAC
+# ---------------------------------------------------------------------------
+
+def rbac(ns: str = DEFAULT_NAMESPACE) -> List[Dict]:
+    rules = [
+        {"apiGroups": [apitypes.GROUP],
+         "resources": ["computedomains", "computedomains/status"],
+         "verbs": ["get", "list", "watch", "create", "update", "patch",
+                   "delete"]},
+        {"apiGroups": ["resource.k8s.io"],
+         "resources": ["resourceclaims", "resourceclaimtemplates",
+                       "resourceslices", "deviceclasses"],
+         "verbs": ["get", "list", "watch", "create", "update", "patch",
+                   "delete"]},
+        {"apiGroups": ["apps"], "resources": ["daemonsets", "deployments"],
+         "verbs": ["get", "list", "watch", "create", "update", "patch",
+                   "delete"]},
+        {"apiGroups": [""], "resources": ["nodes", "pods"],
+         "verbs": ["get", "list", "watch", "patch", "update"]},
+        {"apiGroups": [""], "resources": ["events"],
+         "verbs": ["create", "patch"]},
+    ]
+    return [
+        {"apiVersion": "v1", "kind": "ServiceAccount",
+         "metadata": {"name": APP, "namespace": ns}},
+        {"apiVersion": "rbac.authorization.k8s.io/v1", "kind": "ClusterRole",
+         "metadata": {"name": APP}, "rules": rules},
+        {"apiVersion": "rbac.authorization.k8s.io/v1",
+         "kind": "ClusterRoleBinding",
+         "metadata": {"name": APP},
+         "roleRef": {"apiGroup": "rbac.authorization.k8s.io",
+                     "kind": "ClusterRole", "name": APP},
+         "subjects": [{"kind": "ServiceAccount", "name": APP,
+                       "namespace": ns}]},
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Controller Deployment
+# ---------------------------------------------------------------------------
+
+def controller_deployment(ns: str = DEFAULT_NAMESPACE,
+                          image: str = DEFAULT_IMAGE) -> Dict:
+    labels = {"app.kubernetes.io/name": f"{APP}-controller"}
+    return {
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": f"{APP}-controller", "namespace": ns,
+                     "labels": labels},
+        "spec": {
+            "replicas": 1,
+            "selector": {"matchLabels": labels},
+            "template": {
+                "metadata": {"labels": labels},
+                "spec": {
+                    "serviceAccountName": APP,
+                    "priorityClassName": "system-cluster-critical",
+                    "containers": [{
+                        "name": "controller",
+                        "image": image,
+                        "command": ["python", "-m",
+                                    "tpu_dra.cdcontroller.main"],
+                        "env": [
+                            {"name": "NAMESPACE", "valueFrom": {"fieldRef": {
+                                "fieldPath": "metadata.namespace"}}},
+                            {"name": "DAEMON_IMAGE", "value": image},
+                            {"name": "HTTP_ENDPOINT_PORT", "value": "8080"},
+                        ],
+                        "ports": [{"name": "metrics",
+                                   "containerPort": 8080}],
+                    }],
+                },
+            },
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# Kubelet plugin DaemonSet (both plugins on every TPU node)
+# ---------------------------------------------------------------------------
+
+def kubelet_plugin_daemonset(ns: str = DEFAULT_NAMESPACE,
+                             image: str = DEFAULT_IMAGE) -> Dict:
+    labels = {"app.kubernetes.io/name": f"{APP}-kubelet-plugin"}
+    host_mounts = [
+        {"name": "plugins", "hostPath": {
+            "path": "/var/lib/kubelet/plugins",
+            "type": "DirectoryOrCreate"}},
+        {"name": "plugins-registry", "hostPath": {
+            "path": "/var/lib/kubelet/plugins_registry",
+            "type": "DirectoryOrCreate"}},
+        {"name": "cdi", "hostPath": {"path": "/var/run/cdi",
+                                     "type": "DirectoryOrCreate"}},
+        {"name": "dev", "hostPath": {"path": "/dev"}},
+        {"name": "sys", "hostPath": {"path": "/sys"}},
+    ]
+    mounts = [
+        {"name": "plugins", "mountPath": "/var/lib/kubelet/plugins"},
+        {"name": "plugins-registry",
+         "mountPath": "/var/lib/kubelet/plugins_registry"},
+        {"name": "cdi", "mountPath": "/var/run/cdi"},
+        {"name": "dev", "mountPath": "/dev"},
+        {"name": "sys", "mountPath": "/sys", "readOnly": True},
+    ]
+    common_env = [
+        {"name": "NODE_NAME", "valueFrom": {"fieldRef": {
+            "fieldPath": "spec.nodeName"}}},
+        {"name": "NAMESPACE", "valueFrom": {"fieldRef": {
+            "fieldPath": "metadata.namespace"}}},
+        {"name": "FEATURE_GATES", "value": DEFAULT_FEATURE_GATES},
+    ]
+    return {
+        "apiVersion": "apps/v1", "kind": "DaemonSet",
+        "metadata": {"name": f"{APP}-kubelet-plugin", "namespace": ns,
+                     "labels": labels},
+        "spec": {
+            "selector": {"matchLabels": labels},
+            "template": {
+                "metadata": {"labels": labels},
+                "spec": {
+                    "serviceAccountName": APP,
+                    "priorityClassName": "system-node-critical",
+                    "nodeSelector": {"tpu.dev/present": "true"},
+                    # Prestart validation (the reference's initContainer
+                    # validating driver installation, main.go prestart).
+                    "initContainers": [{
+                        "name": "validate",
+                        "image": image,
+                        "command": ["python", "-c",
+                                    "from tpu_dra.native.tpuinfo import "
+                                    "get_backend; "
+                                    "print(len(get_backend().chips()), "
+                                    "'chips')"],
+                        "volumeMounts": mounts,
+                    }],
+                    "containers": [
+                        {
+                            "name": "tpu-plugin",
+                            "image": image,
+                            "command": ["python", "-m",
+                                        "tpu_dra.tpuplugin.main"],
+                            "securityContext": {"privileged": True},
+                            "env": common_env,
+                            "volumeMounts": mounts,
+                        },
+                        {
+                            "name": "cd-plugin",
+                            "image": image,
+                            "command": ["python", "-m",
+                                        "tpu_dra.cdplugin.main"],
+                            "securityContext": {"privileged": True},
+                            "env": common_env,
+                            "volumeMounts": mounts,
+                        },
+                    ],
+                    "volumes": host_mounts,
+                },
+            },
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# Webhook
+# ---------------------------------------------------------------------------
+
+def webhook_manifests(ns: str = DEFAULT_NAMESPACE,
+                      image: str = DEFAULT_IMAGE,
+                      ca_bundle: str = "") -> List[Dict]:
+    labels = {"app.kubernetes.io/name": f"{APP}-webhook"}
+    deployment = {
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": f"{APP}-webhook", "namespace": ns,
+                     "labels": labels},
+        "spec": {
+            "replicas": 1,
+            "selector": {"matchLabels": labels},
+            "template": {
+                "metadata": {"labels": labels},
+                "spec": {"containers": [{
+                    "name": "webhook",
+                    "image": image,
+                    "command": ["python", "-m", "tpu_dra.webhook.main"],
+                    "env": [
+                        {"name": "TLS_CERT_FILE",
+                         "value": "/etc/webhook/tls/tls.crt"},
+                        {"name": "TLS_KEY_FILE",
+                         "value": "/etc/webhook/tls/tls.key"},
+                        {"name": "FEATURE_GATES",
+                         "value": DEFAULT_FEATURE_GATES},
+                    ],
+                    "ports": [{"containerPort": 8443}],
+                    "readinessProbe": {"httpGet": {
+                        "path": "/readyz", "port": 8443, "scheme": "HTTPS"}},
+                    "volumeMounts": [{"name": "tls",
+                                      "mountPath": "/etc/webhook/tls",
+                                      "readOnly": True}],
+                }],
+                    "volumes": [{"name": "tls", "secret": {
+                        "secretName": f"{APP}-webhook-tls"}}]},
+            },
+        },
+    }
+    service = {
+        "apiVersion": "v1", "kind": "Service",
+        "metadata": {"name": f"{APP}-webhook", "namespace": ns},
+        "spec": {"selector": labels,
+                 "ports": [{"port": 443, "targetPort": 8443}]},
+    }
+    config = {
+        "apiVersion": "admissionregistration.k8s.io/v1",
+        "kind": "ValidatingWebhookConfiguration",
+        "metadata": {"name": f"{APP}-webhook"},
+        "webhooks": [{
+            "name": "resource-claim-parameters.tpu.dev",
+            "admissionReviewVersions": ["v1"],
+            "sideEffects": "None",
+            "failurePolicy": "Ignore",
+            "clientConfig": {
+                "service": {"name": f"{APP}-webhook", "namespace": ns,
+                            "path": "/validate-resource-claim-parameters"},
+                **({"caBundle": ca_bundle} if ca_bundle else {}),
+            },
+            "rules": [{
+                "apiGroups": ["resource.k8s.io"],
+                "apiVersions": ["v1", "v1beta1", "v1beta2"],
+                "operations": ["CREATE", "UPDATE"],
+                "resources": ["resourceclaims", "resourceclaimtemplates"],
+            }],
+        }],
+    }
+    return [deployment, service, config]
+
+
+def validating_admission_policy() -> List[Dict]:
+    """Deploy-time CEL guard (validatingadmissionpolicy.yaml analog):
+    rejects opaque configs owned by this driver whose apiVersion/kind are
+    not among the known ones — a cheap structural gate that works even
+    when the webhook is down (failurePolicy Ignore). Two policies, since
+    claims ('spec') and templates ('spec.spec') nest the device spec
+    differently."""
+    known_kinds = [apitypes.TPU_CONFIG_KIND, apitypes.SUBSLICE_CONFIG_KIND,
+                   apitypes.PASSTHROUGH_CONFIG_KIND,
+                   apitypes.COMPUTE_DOMAIN_CHANNEL_CONFIG_KIND,
+                   apitypes.COMPUTE_DOMAIN_DAEMON_CONFIG_KIND]
+    kinds_cel = "[" + ", ".join(f"'{k}'" for k in known_kinds) + "]"
+    drivers_cel = (f"['{apitypes.TPU_DRIVER_NAME}', "
+                   f"'{apitypes.COMPUTE_DOMAIN_DRIVER_NAME}']")
+
+    def _expr(spec_path: str) -> str:
+        return (
+            f"!has({spec_path}.devices) || "
+            f"!has({spec_path}.devices.config) || "
+            f"{spec_path}.devices.config.all(c, "
+            "!has(c.opaque) || !(c.opaque.driver in " + drivers_cel + ") || "
+            "(has(c.opaque.parameters.kind) && "
+            "c.opaque.parameters.kind in " + kinds_cel + " && "
+            "c.opaque.parameters.apiVersion == '"
+            + apitypes.API_VERSION + "'))")
+
+    out: List[Dict] = []
+    for suffix, resource, spec_path in (
+            ("claims", "resourceclaims", "object.spec"),
+            ("templates", "resourceclaimtemplates", "object.spec.spec")):
+        name = f"{APP}-opaque-config-{suffix}"
+        out.append({
+            "apiVersion": "admissionregistration.k8s.io/v1",
+            "kind": "ValidatingAdmissionPolicy",
+            "metadata": {"name": name},
+            "spec": {
+                "failurePolicy": "Fail",
+                "matchConstraints": {"resourceRules": [{
+                    "apiGroups": ["resource.k8s.io"],
+                    "apiVersions": ["v1"],
+                    "operations": ["CREATE", "UPDATE"],
+                    "resources": [resource],
+                }]},
+                "validations": [{
+                    "expression": _expr(spec_path),
+                    "message": "opaque device config owned by tpu.dev has "
+                               "an unknown kind or apiVersion",
+                }],
+            },
+        })
+        out.append({
+            "apiVersion": "admissionregistration.k8s.io/v1",
+            "kind": "ValidatingAdmissionPolicyBinding",
+            "metadata": {"name": name},
+            "spec": {"policyName": name, "validationActions": ["Deny"]},
+        })
+    return out
+
+
+def all_manifests(ns: str = DEFAULT_NAMESPACE,
+                  image: str = DEFAULT_IMAGE,
+                  ca_bundle: str = "") -> List[Dict]:
+    return ([namespace(ns), compute_domain_crd()]
+            + device_classes()
+            + rbac(ns)
+            + [controller_deployment(ns, image),
+               kubelet_plugin_daemonset(ns, image)]
+            + webhook_manifests(ns, image, ca_bundle)
+            + validating_admission_policy())

@@ -1,0 +1,53 @@
+"""Render deployment manifests + demo specs to YAML.
+
+Run: ``python -m tpu_dra.deploy.render -o deployments/manifests``
+(the `helm template` analog for this chart-less repo).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import yaml
+
+from tpu_dra.deploy import demos, manifests
+
+
+def render_all(out_dir: str, ns: str, image: str,
+               demo_dir: str = "demo/specs",
+               ca_bundle: str = "") -> list:
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, "tpu-dra-driver.yaml")
+    docs = manifests.all_manifests(ns, image, ca_bundle)
+    with open(path, "w") as f:
+        yaml.safe_dump_all(docs, f, sort_keys=False)
+    written = [path]
+    os.makedirs(demo_dir, exist_ok=True)
+    for name, spec_docs in demos.all_demos().items():
+        p = os.path.join(demo_dir, f"{name}.yaml")
+        with open(p, "w") as f:
+            yaml.safe_dump_all(spec_docs, f, sort_keys=False)
+        written.append(p)
+    return written
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tpu-dra-render")
+    ap.add_argument("-o", "--out-dir", default="deployments/manifests")
+    ap.add_argument("--demo-dir", default="demo/specs")
+    ap.add_argument("--namespace", default=manifests.DEFAULT_NAMESPACE)
+    ap.add_argument("--image", default=manifests.DEFAULT_IMAGE)
+    ap.add_argument("--ca-bundle", default="",
+                    help="base64 CA bundle for the webhook clientConfig "
+                         "(pair with the tpu-dra-driver-webhook-tls Secret "
+                         "an operator or cert-manager provides)")
+    ns = ap.parse_args(argv)
+    for path in render_all(ns.out_dir, ns.namespace, ns.image,
+                           demo_dir=ns.demo_dir, ca_bundle=ns.ca_bundle):
+        print(path)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

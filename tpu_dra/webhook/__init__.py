@@ -1,0 +1,8 @@
+"""Validating admission webhook (reference: cmd/webhook).
+
+Rejects ResourceClaims/ResourceClaimTemplates carrying malformed opaque
+device configs owned by this driver *at admission time*, instead of at
+node-side prepare where the pod is already scheduled.
+"""
+
+from tpu_dra.webhook.server import AdmissionHandler, WebhookServer  # noqa: F401

@@ -1,0 +1,204 @@
+"""Admission review handling + HTTPS server.
+
+Reference: cmd/webhook/main.go:113-124 (routes
+``/validate-resource-claim-parameters`` + ``/readyz``), resource.go:83-160
+(extracts ResourceClaim/Template at resource.k8s.io v1/v1beta1/v1beta2 and
+converts to v1), main.go:201-306 (strict-decode + Normalize + Validate
+every opaque config owned by this driver; unknown drivers pass through).
+
+The handler is transport-independent (AdmissionHandler.review(dict) ->
+dict) so it unit-tests without TLS; WebhookServer wraps it in an
+http.server with optional TLS for in-cluster deployment.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import ssl
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+from tpu_dra.api import scheme as apischeme
+from tpu_dra.api import types as apitypes
+
+log = logging.getLogger("tpu_dra.webhook")
+
+VALIDATE_PATH = "/validate-resource-claim-parameters"
+READYZ_PATH = "/readyz"
+
+# API versions of resource.k8s.io we accept (resource.go:83-160).
+SUPPORTED_VERSIONS = ("v1", "v1beta1", "v1beta2")
+OWNED_DRIVERS = (apitypes.TPU_DRIVER_NAME,
+                 apitypes.COMPUTE_DOMAIN_DRIVER_NAME)
+
+
+class AdmissionHandler:
+    """Pure request->response admission logic."""
+
+    def review(self, admission_review: Dict) -> Dict:
+        request = admission_review.get("request") or {}
+        uid = request.get("uid", "")
+        allowed, message = self._validate_request(request)
+        response: Dict = {"uid": uid, "allowed": allowed}
+        if not allowed:
+            response["status"] = {"message": message, "code": 422}
+        return {
+            "apiVersion": admission_review.get(
+                "apiVersion", "admission.k8s.io/v1"),
+            "kind": "AdmissionReview",
+            "response": response,
+        }
+
+    # -- internals ----------------------------------------------------------
+
+    def _validate_request(self, request: Dict) -> Tuple[bool, str]:
+        obj = request.get("object")
+        if obj is None:
+            return False, "no object in admission request"
+        group, version, kind = self._gvk(request, obj)
+        if group != "resource.k8s.io":
+            return True, ""
+        if version not in SUPPORTED_VERSIONS:
+            # Unknown future version: admit — the strict node-side decode
+            # still guards prepare (fail-open on version skew, resource.go).
+            return True, ""
+        try:
+            device_specs = self._device_specs(kind, obj)
+        except ValueError as e:
+            return False, str(e)
+        errors: List[str] = []
+        for spec in device_specs:
+            errors.extend(self._validate_device_spec(spec))
+        if errors:
+            return False, "; ".join(errors)
+        return True, ""
+
+    def _gvk(self, request: Dict, obj: Dict) -> Tuple[str, str, str]:
+        res = request.get("resource") or {}
+        group = res.get("group")
+        version = res.get("version")
+        kind = (request.get("kind") or {}).get("kind") or obj.get("kind", "")
+        if group is None or version is None:
+            api_version = obj.get("apiVersion", "")
+            group, _, version = api_version.partition("/")
+        return group, version, kind
+
+    def _device_specs(self, kind: str, obj: Dict) -> List[Dict]:
+        """Normalize claim vs template to the v1 DeviceClaim spec shape.
+        v1beta1/v1beta2 share the devices.config layout used here, so
+        conversion is structural (resource.go:83-160)."""
+        if kind == "ResourceClaim":
+            spec = obj.get("spec") or {}
+        elif kind == "ResourceClaimTemplate":
+            spec = ((obj.get("spec") or {}).get("spec") or {})
+        else:
+            return []
+        devices = spec.get("devices") or {}
+        if not isinstance(devices, dict):
+            raise ValueError("spec.devices must be an object")
+        return [devices]
+
+    def _validate_device_spec(self, devices: Dict) -> List[str]:
+        errors = []
+        for i, entry in enumerate(devices.get("config") or []):
+            opaque = (entry or {}).get("opaque") or {}
+            driver = opaque.get("driver", "")
+            if driver not in OWNED_DRIVERS:
+                continue  # not ours: admit
+            params = opaque.get("parameters")
+            if params is None:
+                errors.append(f"config[{i}]: missing opaque parameters")
+                continue
+            try:
+                cfg = apischeme.StrictDecoder.decode(params)
+                cfg.normalize()
+                cfg.validate()
+            except (apischeme.DecodeError, apitypes.ValidationError) as e:
+                errors.append(f"config[{i}]: {e}")
+        return errors
+
+
+class WebhookServer:
+    """HTTPS (or plain HTTP for tests) server hosting the handler."""
+
+    def __init__(self, handler: Optional[AdmissionHandler] = None,
+                 addr: str = "0.0.0.0", port: int = 8443,  # noqa: S104
+                 cert_file: Optional[str] = None,
+                 key_file: Optional[str] = None):
+        self._handler = handler or AdmissionHandler()
+        outer = self
+
+        class _Req(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet
+                log.debug(fmt, *args)
+
+            def do_GET(self):
+                if self.path == READYZ_PATH:
+                    self._respond(200, b"ok", "text/plain")
+                else:
+                    self._respond(404, b"not found", "text/plain")
+
+            def do_POST(self):
+                if self.path != VALIDATE_PATH:
+                    self._respond(404, b"not found", "text/plain")
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    review = json.loads(self.rfile.read(length))
+                    out = outer._handler.review(review)
+                except Exception as e:  # noqa: BLE001 — malformed request
+                    self._respond(400, str(e).encode(), "text/plain")
+                    return
+                self._respond(200, json.dumps(out).encode(),
+                              "application/json")
+
+            def _respond(self, code: int, body: bytes, ctype: str):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        if cert_file and key_file:
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(cert_file, key_file)
+
+            class _TLSReq(_Req):
+                """Handshake in the worker thread's setup(), NOT on the
+                listening socket or in get_request (both run on the accept
+                loop): one stalled client (port scanner, plain-TCP health
+                check) must not block all admission traffic."""
+
+                def setup(self):
+                    self.request.settimeout(10.0)
+                    self.request = ctx.wrap_socket(self.request,
+                                                   server_side=True)
+                    super().setup()
+
+                def handle(self):
+                    try:
+                        super().handle()
+                    except ssl.SSLError:
+                        pass  # failed handshake: drop the connection
+
+            self._server = ThreadingHTTPServer((addr, port), _TLSReq)
+        else:
+            self._server = ThreadingHTTPServer((addr, port), _Req)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True, name="webhook")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
