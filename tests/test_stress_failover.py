@@ -1,0 +1,187 @@
+"""Stress + failover tiers (reference: tests/bats/test_gpu_stress.bats,
+test_cd_failover.bats + lib/test_cd_nvb_failover.sh) and the healthcheck
+self-probe (gpu plugin health.go:49-144).
+
+The reference runs these against a live cluster with a 300s heal budget;
+here the same scenarios run in-process with tighter bounds.
+"""
+
+import os
+import threading
+import time
+import urllib.request
+import urllib.error
+
+import pytest
+
+from tpu_dra.api.types import TPU_DRIVER_NAME
+from tpu_dra.cdi.handler import CDIHandler
+from tpu_dra.infra.metrics import MetricsServer
+from tpu_dra.k8s import FakeCluster, RESOURCECLAIMS
+from tpu_dra.kubeletplugin.server import kubelet_stubs, self_probe
+from tpu_dra.native.tpuinfo import FakeBackend, default_fake_chips
+from tpu_dra.tpuplugin.checkpoint import CheckpointManager
+from tpu_dra.tpuplugin.device_state import DeviceState
+from tpu_dra.tpuplugin.driver import TpuDriver
+from tpu_dra.kubeletplugin.gen import dra_v1_pb2 as dra
+
+DAEMON_BIN = os.path.join(os.path.dirname(__file__), "..", "native", "build",
+                          "tpu-slice-daemon")
+
+
+@pytest.fixture
+def tpu_harness(tmp_path):
+    cluster = FakeCluster()
+    backend = FakeBackend(default_fake_chips(1, "v5e"))
+    state = DeviceState(
+        backend=backend,
+        cdi=CDIHandler(str(tmp_path / "cdi"),
+                       driver_root=str(tmp_path / "drv")),
+        checkpoints=CheckpointManager(str(tmp_path / "plugin")),
+        driver_name=TPU_DRIVER_NAME, node_name="node-a",
+        include_subslices=False)
+    driver = TpuDriver(state=state, client=cluster,
+                       driver_name=TPU_DRIVER_NAME, node_name="node-a",
+                       plugin_dir=str(tmp_path / "plugin"),
+                       registry_dir=str(tmp_path / "registry"))
+    driver.start()
+    channel, prepare, unprepare = kubelet_stubs(driver.server.dra_socket)
+    yield {"cluster": cluster, "driver": driver, "state": state,
+           "prepare": prepare, "unprepare": unprepare}
+    channel.close()
+    driver.shutdown()
+
+
+class TestSharedClaimStress:
+    """test_gpu_stress.bats analog: 15 pods x 5 loops on ONE shared claim.
+
+    Kubelet calls NodePrepareResources once per pod referencing the same
+    claim; prepare must be idempotent under concurrency and the churn must
+    never corrupt the checkpoint."""
+
+    PODS = 15
+    LOOPS = 5
+
+    def test_churn(self, tpu_harness):
+        cluster = tpu_harness["cluster"]
+        claim = cluster.create(RESOURCECLAIMS, {
+            "apiVersion": "resource.k8s.io/v1", "kind": "ResourceClaim",
+            "metadata": {"name": "shared", "namespace": "default"},
+            "spec": {"devices": {"requests": [{"name": "tpu"}]}},
+            "status": {"allocation": {"devices": {"results": [
+                {"request": "tpu", "driver": TPU_DRIVER_NAME,
+                 "pool": "node-a", "device": "chip-0"}], "config": []}}},
+        })
+        uid = claim["metadata"]["uid"]
+
+        def one_pod(errors):
+            req = dra.NodePrepareResourcesRequest()
+            c = req.claims.add()
+            c.uid, c.name, c.namespace = uid, "shared", "default"
+            resp = tpu_harness["prepare"](req)
+            if resp.claims[uid].error:
+                errors.append(resp.claims[uid].error)
+
+        for loop in range(self.LOOPS):
+            errors = []
+            threads = [threading.Thread(target=one_pod, args=(errors,))
+                       for _ in range(self.PODS)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert errors == [], f"loop {loop}: {errors}"
+            # Loop teardown: last pod gone -> kubelet unprepares once.
+            ureq = dra.NodeUnprepareResourcesRequest()
+            uc = ureq.claims.add()
+            uc.uid, uc.name, uc.namespace = uid, "shared", "default"
+            resp = tpu_harness["unprepare"](ureq)
+            assert resp.claims[uid].error == ""
+            assert tpu_harness["state"].prepared_claim_uids() == []
+
+
+class TestHealthSelfProbe:
+    def test_healthz_reflects_socket_liveness(self, tpu_harness):
+        driver = tpu_harness["driver"]
+        assert self_probe(driver.server) is True
+        srv = MetricsServer(addr="127.0.0.1", port=0,
+                            health_probe=lambda: self_probe(driver.server))
+        srv.start()
+        try:
+            out = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/healthz", timeout=5)
+            assert out.status == 200
+        finally:
+            srv.stop()
+
+    def test_healthz_503_when_socket_dead(self, tmp_path):
+        class DeadServer:
+            dra_socket = str(tmp_path / "nope.sock")
+            driver_name = "tpu.dev"
+        srv = MetricsServer(addr="127.0.0.1", port=0,
+                            health_probe=lambda: self_probe(
+                                DeadServer(), timeout=0.5))
+        srv.start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/healthz", timeout=10)
+            assert exc.value.code == 503
+        finally:
+            srv.stop()
+
+
+@pytest.mark.skipif(not os.path.exists(DAEMON_BIN),
+                    reason="native daemon not built")
+class TestDaemonFailover:
+    """test_cd_failover.bats analog: kill the slice daemon process; the
+    watchdog restarts it and readiness heals within the budget."""
+
+    HEAL_BUDGET_S = 10.0  # reference budget is 300s on a live cluster
+
+    def test_daemon_kill_heals(self, tmp_path):
+        import socket as socket_mod
+
+        from tpu_dra.api import types as apitypes
+        from tpu_dra.cddaemon.main import DaemonRunner, flags, probe_ready
+        from tpu_dra.k8s import COMPUTEDOMAINS
+
+        cluster = FakeCluster()
+        cd = cluster.create(COMPUTEDOMAINS, {
+            "apiVersion": apitypes.API_VERSION, "kind": "ComputeDomain",
+            "metadata": {"name": "cd-f", "namespace": "ns1"},
+            "spec": {"numNodes": 1, "channel": {
+                "resourceClaimTemplate": {"name": "rct"}}},
+        })
+        with socket_mod.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        ns = flags().parse([
+            "--cd-uid", cd["metadata"]["uid"], "--cd-name", "cd-f",
+            "--cd-namespace", "ns1", "--node-name", "node-a",
+            "--pod-ip", "127.0.0.1", "--port", str(port),
+            "--work-dir", str(tmp_path / "wd"),
+            "--hosts-file", str(tmp_path / "hosts"),
+            "--daemon-binary", DAEMON_BIN])
+        runner = DaemonRunner(cluster, ns)
+        runner.start()
+        try:
+            deadline = time.monotonic() + self.HEAL_BUDGET_S
+            while time.monotonic() < deadline and not probe_ready(port):
+                time.sleep(0.05)
+            assert probe_ready(port)
+
+            # Fault injection: SIGKILL the native daemon (force-delete
+            # analog). The watchdog must respawn it.
+            t_kill = time.monotonic()
+            runner.process._proc.kill()
+            while (time.monotonic() - t_kill < self.HEAL_BUDGET_S
+                   and not (runner.process.restarts >= 1
+                            and probe_ready(port))):
+                time.sleep(0.05)
+            heal = time.monotonic() - t_kill
+            assert runner.process.restarts >= 1, "watchdog never restarted"
+            assert probe_ready(port), "daemon not READY after restart"
+            assert heal < self.HEAL_BUDGET_S
+        finally:
+            runner.stop()

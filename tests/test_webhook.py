@@ -210,7 +210,7 @@ class TestManifests:
         from tpu_dra.deploy.render import render_all
         import yaml
         written = render_all(str(tmp_path / "m"), "tpu-dra-driver",
-                             "img:test")
+                             "img:test", demo_dir=str(tmp_path / "demo"))
         assert len(written) >= 7
         docs = list(yaml.safe_load_all(open(written[0])))
         assert docs[0]["kind"] == "Namespace"

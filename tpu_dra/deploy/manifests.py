@@ -191,6 +191,9 @@ def kubelet_plugin_daemonset(ns: str = DEFAULT_NAMESPACE,
                                     "'chips')"],
                         "volumeMounts": mounts,
                     }],
+                    # Distinct healthcheck ports: both containers share the
+                    # pod network namespace, so a shared HEALTHCHECK_PORT
+                    # would make the second bind fail and crashloop.
                     "containers": [
                         {
                             "name": "tpu-plugin",
@@ -198,7 +201,15 @@ def kubelet_plugin_daemonset(ns: str = DEFAULT_NAMESPACE,
                             "command": ["python", "-m",
                                         "tpu_dra.tpuplugin.main"],
                             "securityContext": {"privileged": True},
-                            "env": common_env,
+                            "env": common_env + [
+                                {"name": "HEALTHCHECK_PORT",
+                                 "value": "8081"}],
+                            "livenessProbe": {
+                                "httpGet": {"path": "/healthz",
+                                            "port": 8081},
+                                "periodSeconds": 10,
+                                "failureThreshold": 3,
+                            },
                             "volumeMounts": mounts,
                         },
                         {
@@ -207,7 +218,15 @@ def kubelet_plugin_daemonset(ns: str = DEFAULT_NAMESPACE,
                             "command": ["python", "-m",
                                         "tpu_dra.cdplugin.main"],
                             "securityContext": {"privileged": True},
-                            "env": common_env,
+                            "env": common_env + [
+                                {"name": "HEALTHCHECK_PORT",
+                                 "value": "8082"}],
+                            "livenessProbe": {
+                                "httpGet": {"path": "/healthz",
+                                            "port": 8082},
+                                "periodSeconds": 10,
+                                "failureThreshold": 3,
+                            },
                             "volumeMounts": mounts,
                         },
                     ],

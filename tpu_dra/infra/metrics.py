@@ -142,11 +142,16 @@ DefaultRegistry = Registry()
 
 
 class MetricsServer:
-    """Serves /metrics (text exposition) and /debug/stacks (pprof analog)."""
+    """Serves /metrics (text exposition), /debug/stacks (pprof analog) and
+    /healthz. With a `health_probe` callable, /healthz runs it per request
+    (the gRPC-healthcheck self-probe analog, gpu plugin health.go:49-144)
+    and returns 503 when it reports unhealthy."""
 
     def __init__(self, addr: str = "127.0.0.1", port: int = 0,
-                 registry: Registry = DefaultRegistry):
+                 registry: Registry = DefaultRegistry,
+                 health_probe=None):
         registry_ref = registry
+        probe_ref = health_probe
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802
@@ -160,8 +165,16 @@ class MetricsServer:
                     self.send_response(200)
                     self.send_header("Content-Type", "text/plain")
                 elif self.path == "/healthz":
-                    body = b"ok"
-                    self.send_response(200)
+                    healthy = True
+                    detail = "ok"
+                    if probe_ref is not None:
+                        try:
+                            healthy = bool(probe_ref())
+                            detail = "ok" if healthy else "probe failed"
+                        except Exception as e:  # noqa: BLE001
+                            healthy, detail = False, str(e)
+                    body = detail.encode()
+                    self.send_response(200 if healthy else 503)
                 else:
                     body = b"not found"
                     self.send_response(404)

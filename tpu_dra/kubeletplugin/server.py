@@ -135,6 +135,35 @@ def _registration_service(driver_name: str, endpoint: str,
                                                 handlers)
 
 
+def self_probe(server: "DRAPluginServer", timeout: float = 3.0) -> bool:
+    """Liveness self-probe (gpu plugin health.go:118-144): dial the
+    plugin's own sockets as kubelet would — GetInfo on the registration
+    socket, NodePrepareResources with an empty request on the DRA socket —
+    and report whether both answered."""
+    try:
+        channel, prepare, _ = kubelet_stubs(server.dra_socket)
+        try:
+            prepare(dra.NodePrepareResourcesRequest(), timeout=timeout)
+        finally:
+            channel.close()
+        reg_sock = getattr(server, "registration_socket", None)
+        if reg_sock:
+            reg_channel = grpc.insecure_channel(f"unix://{reg_sock}")
+            try:
+                get_info = reg_channel.unary_unary(
+                    "/pluginregistration.Registration/GetInfo",
+                    request_serializer=reg.InfoRequest.SerializeToString,
+                    response_deserializer=reg.PluginInfo.FromString)
+                info = get_info(reg.InfoRequest(), timeout=timeout)
+                if info.name != server.driver_name:
+                    return False
+            finally:
+                reg_channel.close()
+        return True
+    except grpc.RpcError:
+        return False
+
+
 def kubelet_stubs(dra_socket: str):
     """Client-side stubs acting as kubelet: (channel, prepare, unprepare).
 

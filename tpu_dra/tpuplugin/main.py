@@ -91,7 +91,10 @@ def main(argv=None) -> int:
 
     metrics_srv = None
     if ns.healthcheck_port:
-        metrics_srv = MetricsServer(addr="0.0.0.0", port=ns.healthcheck_port)  # noqa: S104
+        from tpu_dra.kubeletplugin.server import self_probe
+        metrics_srv = MetricsServer(
+            addr="0.0.0.0", port=ns.healthcheck_port,  # noqa: S104
+            health_probe=lambda: self_probe(driver.server))
         metrics_srv.start()
 
     stop = threading.Event()

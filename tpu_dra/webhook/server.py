@@ -173,15 +173,20 @@ class WebhookServer:
 
                 def setup(self):
                     self.request.settimeout(10.0)
-                    self.request = ctx.wrap_socket(self.request,
-                                                   server_side=True)
+                    try:
+                        self.request = ctx.wrap_socket(self.request,
+                                                       server_side=True)
+                    except (ssl.SSLError, OSError) as e:
+                        # Non-TLS probe or stalled client: drop quietly
+                        # instead of a per-connection stderr traceback.
+                        log.debug("TLS handshake failed: %s", e)
+                        self._handshake_failed = True
                     super().setup()
 
                 def handle(self):
-                    try:
-                        super().handle()
-                    except ssl.SSLError:
-                        pass  # failed handshake: drop the connection
+                    if getattr(self, "_handshake_failed", False):
+                        return
+                    super().handle()
 
             self._server = ThreadingHTTPServer((addr, port), _TLSReq)
         else:
