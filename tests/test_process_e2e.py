@@ -1,0 +1,242 @@
+"""Process-level e2e: real driver binaries against a real HTTP apiserver.
+
+The kind-cluster analog (SURVEY §4.2): `python -m tpu_dra.*.main` run as
+actual subprocesses wired to a FakeApiServer over HTTP; the test acts as
+kubelet over the plugins' unix-socket gRPC. This also exercises
+HttpApiClient (REST + chunked watch) for real — the in-process tiers only
+ever touch FakeCluster directly.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tpu_dra.api import types as apitypes
+from tpu_dra.k8s import COMPUTEDOMAINS, NODES, RESOURCECLAIMS, RESOURCESLICES
+from tpu_dra.k8s.client import HttpApiClient, NotFoundError
+from tpu_dra.k8s.fakeserver import FakeApiServer
+from tpu_dra.kubeletplugin.gen import dra_v1_pb2 as dra
+from tpu_dra.kubeletplugin.server import kubelet_stubs
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestHttpApiClient:
+    """HttpApiClient against the HTTP server (CRUD, status, patch, watch)."""
+
+    @pytest.fixture
+    def api(self):
+        server = FakeApiServer()
+        server.start()
+        yield HttpApiClient(base_url=server.url)
+        server.stop()
+
+    def test_crud_roundtrip(self, api):
+        obj = api.create(NODES, {"apiVersion": "v1", "kind": "Node",
+                                 "metadata": {"name": "n1"}})
+        assert obj["metadata"]["uid"]
+        got = api.get(NODES, "n1")
+        assert got["metadata"]["name"] == "n1"
+        api.patch(NODES, "n1", {"metadata": {"labels": {"x": "y"}}})
+        assert api.get(NODES, "n1")["metadata"]["labels"] == {"x": "y"}
+        assert len(api.list(NODES)) == 1
+        assert api.list(NODES, label_selector="x=y")
+        assert not api.list(NODES, label_selector="x=z")
+        api.delete(NODES, "n1")
+        with pytest.raises(NotFoundError):
+            api.get(NODES, "n1")
+
+    def test_status_subresource(self, api):
+        cd = api.create(COMPUTEDOMAINS, {
+            "apiVersion": apitypes.API_VERSION, "kind": "ComputeDomain",
+            "metadata": {"name": "cd", "namespace": "d"},
+            "spec": {"numNodes": 1,
+                     "channel": {"resourceClaimTemplate": {"name": "r"}}}})
+        cd["status"] = {"status": "Ready", "nodes": []}
+        api.update_status(COMPUTEDOMAINS, cd)
+        got = api.get(COMPUTEDOMAINS, "cd", "d")
+        assert got["status"]["status"] == "Ready"
+        assert got["spec"]["numNodes"] == 1
+
+    def test_watch_replay_closes_list_gap(self, api):
+        """An event emitted between LIST and WATCH must be replayed when
+        the watch resumes from the list's resourceVersion."""
+        api.create(NODES, {"apiVersion": "v1", "kind": "Node",
+                           "metadata": {"name": "pre"}})
+        items, rv = api.list_with_rv(NODES)
+        assert [i["metadata"]["name"] for i in items] == ["pre"]
+        assert rv
+        # The "gap": a create AND a delete land before the watch starts.
+        api.create(NODES, {"apiVersion": "v1", "kind": "Node",
+                           "metadata": {"name": "gap"}})
+        api.delete(NODES, "gap")
+        import threading
+        stop = threading.Event()
+        events = []
+        for ev, obj in api.watch(NODES, resource_version=rv, stop=stop):
+            events.append((ev, obj["metadata"]["name"]))
+            if len(events) >= 2:
+                stop.set()
+        assert events == [("ADDED", "gap"), ("DELETED", "gap")]
+
+    def test_watch_stream(self, api):
+        import threading
+        events = []
+        stop = threading.Event()
+
+        def watcher():
+            for ev, obj in api.watch(NODES, stop=stop):
+                events.append((ev, obj["metadata"]["name"]))
+                if len(events) >= 2:
+                    return
+
+        t = threading.Thread(target=watcher, daemon=True)
+        t.start()
+        time.sleep(0.3)  # let the watch register
+        api.create(NODES, {"apiVersion": "v1", "kind": "Node",
+                           "metadata": {"name": "w1"}})
+        api.delete(NODES, "w1")
+        t.join(timeout=5)
+        stop.set()
+        assert ("ADDED", "w1") in events
+        assert ("DELETED", "w1") in events
+
+
+@pytest.fixture
+def e2e(tmp_path):
+    server = FakeApiServer()
+    server.start()
+    api = HttpApiClient(base_url=server.url)
+    api.create(NODES, {"apiVersion": "v1", "kind": "Node",
+                       "metadata": {"name": "node-a"}})
+    procs = []
+
+    def spawn(module, extra_env=None, args=()):
+        env = dict(os.environ,
+                   PYTHONPATH=REPO,
+                   KUBE_API_URL=server.url,
+                   TPU_DRA_TPUINFO_BACKEND="fake",
+                   TPU_DRA_FAKE_SLICE_ID="slice-A",
+                   NODE_NAME="node-a",
+                   **(extra_env or {}))
+        # stderr to a file, not a pipe: an undrained pipe blocks a chatty
+        # child once the ~64KB buffer fills.
+        errfile = open(tmp_path / f"{module.rsplit('.', 1)[-1]}.stderr",
+                       "w+b")
+        p = subprocess.Popen([sys.executable, "-m", module, *args], env=env,
+                             stderr=errfile, cwd=str(tmp_path))
+        p._errfile = errfile  # type: ignore[attr-defined]
+        procs.append(p)
+        return p
+
+    yield {"server": server, "api": api, "spawn": spawn, "tmp": tmp_path,
+           "procs": procs}
+    for p in procs:
+        if p.poll() is None:
+            p.send_signal(signal.SIGTERM)
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+    server.stop()
+
+
+def wait_for(predicate, timeout=20.0, interval=0.1):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+class TestProcessE2E:
+    def test_tpu_plugin_process_publishes_and_prepares(self, e2e):
+        plugin_dir = str(e2e["tmp"] / "plugin")
+        proc = e2e["spawn"]("tpu_dra.tpuplugin.main", extra_env={
+            "PLUGIN_DIR": plugin_dir,
+            "REGISTRY_DIR": str(e2e["tmp"] / "registry"),
+            "CDI_ROOT": str(e2e["tmp"] / "cdi"),
+            "TPU_DRIVER_ROOT": str(e2e["tmp"] / "drv"),
+        })
+        api = e2e["api"]
+        assert wait_for(lambda: api.list(RESOURCESLICES)), _diag(proc)
+        devices = api.list(RESOURCESLICES)[0]["spec"]["devices"]
+        assert any(d["name"] == "chip-0" for d in devices)
+
+        claim = api.create(RESOURCECLAIMS, {
+            "apiVersion": "resource.k8s.io/v1", "kind": "ResourceClaim",
+            "metadata": {"name": "c1", "namespace": "default"},
+            "spec": {"devices": {"requests": [{"name": "tpu"}]}},
+            "status": {"allocation": {"devices": {"results": [
+                {"request": "tpu", "driver": apitypes.TPU_DRIVER_NAME,
+                 "pool": "node-a", "device": "chip-0"}], "config": []}}},
+        })
+        sock = os.path.join(plugin_dir, "dra.sock")
+        assert wait_for(lambda: os.path.exists(sock)), _diag(proc)
+        channel, prepare, unprepare = kubelet_stubs(sock)
+        try:
+            req = dra.NodePrepareResourcesRequest()
+            c = req.claims.add()
+            c.uid = claim["metadata"]["uid"]
+            c.name, c.namespace = "c1", "default"
+            resp = prepare(req, timeout=15)
+            assert resp.claims[c.uid].error == ""
+            spec_path = os.path.join(
+                str(e2e["tmp"] / "cdi"),
+                f"k8s.tpu.dev-claim_{c.uid}.json")
+            env = dict(e.split("=", 1) for e in json.load(open(spec_path))
+                       ["devices"][0]["containerEdits"]["env"])
+            assert env["TPU_VISIBLE_CHIPS"] == "0"
+        finally:
+            channel.close()
+
+    def test_controller_process_stamps_cd(self, e2e):
+        proc = e2e["spawn"]("tpu_dra.cdcontroller.main",
+                            extra_env={"NAMESPACE": "tpu-dra-driver"})
+        api = e2e["api"]
+        api.create(COMPUTEDOMAINS, {
+            "apiVersion": apitypes.API_VERSION, "kind": "ComputeDomain",
+            "metadata": {"name": "cd-p", "namespace": "team"},
+            "spec": {"numNodes": 1, "channel": {
+                "resourceClaimTemplate": {"name": "rct-p"}}},
+        })
+        from tpu_dra.k8s import DAEMONSETS, RESOURCECLAIMTEMPLATES
+
+        def stamped():
+            try:
+                api.get(RESOURCECLAIMTEMPLATES, "rct-p", "team")
+                return bool(api.list(DAEMONSETS, namespace="tpu-dra-driver"))
+            except NotFoundError:
+                return False
+        assert wait_for(stamped), _diag(proc)
+        # Teardown through the real HTTP path.
+        api.delete(COMPUTEDOMAINS, "cd-p", "team")
+        assert wait_for(lambda: not _exists(api, COMPUTEDOMAINS, "cd-p",
+                                            "team")), _diag(proc)
+
+
+def _exists(api, gvr, name, ns=None):
+    try:
+        api.get(gvr, name, ns)
+        return True
+    except NotFoundError:
+        return False
+
+
+def _diag(proc):
+    errfile = getattr(proc, "_errfile", None)
+    tail = ""
+    if errfile is not None:
+        errfile.flush()
+        errfile.seek(0)
+        tail = errfile.read().decode(errors="replace")[-2000:]
+    if proc.poll() is not None:
+        return f"process exited rc={proc.returncode}: {tail}"
+    return f"timeout (process still running); stderr tail: {tail}"

@@ -112,6 +112,13 @@ class ApiClient:
              label_selector: Optional[str] = None) -> List[Dict]:
         raise NotImplementedError
 
+    def list_with_rv(self, gvr: GVR, namespace: Optional[str] = None,
+                     label_selector: Optional[str] = None
+                     ) -> Tuple[List[Dict], str]:
+        """(items, collection resourceVersion). Default: no RV — watch then
+        starts from 'now' (pre-RV behavior)."""
+        return self.list(gvr, namespace, label_selector), ""
+
     def create(self, gvr: GVR, obj: Dict, namespace: Optional[str] = None) -> Dict:
         raise NotImplementedError
 
@@ -239,31 +246,117 @@ class HttpApiClient(ApiClient):
         except NotFoundError:
             pass
 
+    def list_with_rv(self, gvr, namespace=None, label_selector=None):
+        """(items, resourceVersion) — the List response's collection RV, for
+        gap-free list+watch resumption."""
+        query = {}
+        if label_selector:
+            query["labelSelector"] = label_selector
+        out = self._request("GET", gvr.path(namespace), query=query or None)
+        rv = (out.get("metadata") or {}).get("resourceVersion", "")
+        return out.get("items", []), rv
+
     def watch(self, gvr, namespace=None, label_selector=None,
               resource_version=None, stop=None):
+        """Streaming watch over a raw socket with our own HTTP/chunked
+        parser: connection establishment uses the full client timeout; the
+        stream is read with a 1s socket timeout so `stop` is noticed
+        promptly, and because ALL partial data lives in our own buffer a
+        timed-out read can never desync the chunked framing (which it can
+        inside http.client's buffered decoder)."""
         query = {"watch": "true"}
         if label_selector:
             query["labelSelector"] = label_selector
         if resource_version:
             query["resourceVersion"] = resource_version
-        url = self._base + gvr.path(namespace) + "?" + urllib.parse.urlencode(query)
-        req = urllib.request.Request(url)
-        if self._token:
-            req.add_header("Authorization", f"Bearer {self._token}")
-        with urllib.request.urlopen(req, timeout=self._timeout,
-                                    context=self._ssl) as resp:
+        parsed = urllib.parse.urlsplit(self._base)
+        path = gvr.path(namespace) + "?" + urllib.parse.urlencode(query)
+        port = parsed.port or (443 if parsed.scheme == "https" else 80)
+        sock = socket.create_connection((parsed.hostname, port),
+                                        timeout=self._timeout)
+        try:
+            if parsed.scheme == "https" and self._ssl is not None:
+                sock = self._ssl.wrap_socket(
+                    sock, server_hostname=parsed.hostname)
+            auth = (f"Authorization: Bearer {self._token}\r\n"
+                    if self._token else "")
+            sock.sendall(
+                f"GET {path} HTTP/1.1\r\n"
+                f"Host: {parsed.hostname}:{port}\r\n"
+                f"Accept: application/json\r\n{auth}"
+                f"Connection: close\r\n\r\n".encode())
+
             buf = b""
-            while stop is None or not stop.is_set():
-                try:
-                    chunk = resp.read1(65536)
-                except socket.timeout:
-                    continue
+            # Headers arrive within the establishment timeout.
+            while b"\r\n\r\n" not in buf:
+                chunk = sock.recv(65536)
                 if not chunk:
-                    return
+                    raise ApiError(0, "watch connection closed during headers")
                 buf += chunk
-                while b"\n" in buf:
-                    line, _, buf = buf.partition(b"\n")
+            head, _, buf = buf.partition(b"\r\n\r\n")
+            status_line = head.split(b"\r\n", 1)[0].decode()
+            status = int(status_line.split()[1])
+            if status != 200:
+                raise ApiError(status, f"watch failed: {status_line}")
+            chunked = b"transfer-encoding: chunked" in head.lower()
+
+            sock.settimeout(1.0)
+            line_buf = b""  # de-chunked JSON-lines payload
+
+            def feed(data: bytes):
+                nonlocal line_buf
+                line_buf += data
+
+            chunk_state = {"need": None}  # bytes left in current chunk
+
+            def dechunk():
+                """Consume complete chunked frames from buf into line_buf."""
+                nonlocal buf
+                while True:
+                    if chunk_state["need"] is None:
+                        if b"\r\n" not in buf:
+                            return
+                        size_line, _, rest = buf.partition(b"\r\n")
+                        try:
+                            size = int(size_line.split(b";")[0].strip()
+                                       or b"0", 16)
+                        except ValueError:
+                            raise ApiError(0, "bad chunk framing")
+                        buf = rest
+                        if size == 0:
+                            chunk_state["need"] = -1  # EOF marker
+                            return
+                        chunk_state["need"] = size
+                    elif chunk_state["need"] == -1:
+                        return
+                    else:
+                        need = chunk_state["need"]
+                        if len(buf) < need + 2:  # data + trailing CRLF
+                            return
+                        feed(buf[:need])
+                        buf = buf[need + 2:]
+                        chunk_state["need"] = None
+
+            while stop is None or not stop.is_set():
+                if chunked:
+                    dechunk()
+                else:
+                    feed(buf)
+                    buf = b""
+                while b"\n" in line_buf:
+                    line, _, line_buf = line_buf.partition(b"\n")
                     if not line.strip():
                         continue
                     evt = json.loads(line)
                     yield evt.get("type", ""), evt.get("object", {})
+                if chunk_state["need"] == -1:
+                    return  # server ended the stream
+                try:
+                    data = sock.recv(65536)
+                except socket.timeout:
+                    continue
+                if not data:
+                    return
+                buf += data
+        finally:
+            sock.close()

@@ -36,12 +36,20 @@ class _Watcher:
 class FakeCluster(ApiClient):
     """Thread-safe in-memory object store implementing the ApiClient surface."""
 
+    # Bounded event log for resourceVersion replay (closes the LIST->WATCH
+    # gap a real apiserver closes the same way).
+    EVENT_LOG_CAP = 4096
+
     def __init__(self):
         self._lock = threading.RLock()
         # (gvr.key, namespace or "") -> name -> object
         self._store: Dict[Tuple[str, str], Dict[str, Dict]] = {}
         self._rv = itertools.count(1)
+        self._last_rv = 0
         self._watchers: List[_Watcher] = []
+        # [(rv, gvr_key, ns, event_type, obj)] — replayed for watches that
+        # resume from an older resourceVersion.
+        self._events: List[Tuple[int, str, str, str, Dict]] = []
         # Hooks for tests: callables (verb, gvr, obj) -> obj|None run before
         # the verb; raising simulates apiserver errors (webhook analog).
         self.reactors = []
@@ -56,9 +64,14 @@ class FakeCluster(ApiClient):
         return (gvr.key, ns)
 
     def _bump(self, obj: Dict) -> None:
-        obj.setdefault("metadata", {})["resourceVersion"] = str(next(self._rv))
+        self._last_rv = next(self._rv)
+        obj.setdefault("metadata", {})["resourceVersion"] = str(self._last_rv)
 
     def _emit(self, gvr: GVR, ns: str, event_type: str, obj: Dict) -> None:
+        rv = int(obj.get("metadata", {}).get("resourceVersion", "0") or 0)
+        self._events.append((rv, gvr.key, ns, event_type, copy.deepcopy(obj)))
+        if len(self._events) > self.EVENT_LOG_CAP:
+            del self._events[:len(self._events) - self.EVENT_LOG_CAP]
         labels = obj.get("metadata", {}).get("labels", {}) or {}
         for w in list(self._watchers):
             if w.closed or w.gvr_key != gvr.key:
@@ -192,13 +205,38 @@ class FakeCluster(ApiClient):
                     self._emit(gvr, key[1], "MODIFIED", obj)
                 return
             del bucket[name]
+            # Deletion advances the RV so a replay from the pre-delete list
+            # RV includes this DELETED event.
+            self._bump(obj)
             self._emit(gvr, key[1], "DELETED", obj)
+
+    def list_with_rv(self, gvr, namespace=None, label_selector=None):
+        with self._lock:
+            return (self.list(gvr, namespace, label_selector),
+                    str(self._last_rv))
 
     def watch(self, gvr, namespace=None, label_selector=None,
               resource_version=None, stop=None
               ) -> Generator[Tuple[str, Dict], None, None]:
         w = _Watcher(gvr.key, namespace if gvr.namespaced else None, label_selector)
         with self._lock:
+            # Atomically: replay events after resource_version, then go
+            # live — no gap in which an event can be lost.
+            if resource_version:
+                try:
+                    since = int(resource_version)
+                except ValueError:
+                    since = 0
+                for rv, gvr_key, ns, event_type, obj in self._events:
+                    if rv <= since or gvr_key != gvr.key:
+                        continue
+                    if (w.namespace and gvr.namespaced
+                            and w.namespace != ns):
+                        continue
+                    labels = obj.get("metadata", {}).get("labels", {}) or {}
+                    if not label_selector_matches(label_selector, labels):
+                        continue
+                    w.events.put((event_type, copy.deepcopy(obj)))
             self._watchers.append(w)
         try:
             while stop is None or not stop.is_set():

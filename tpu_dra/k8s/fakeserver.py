@@ -1,0 +1,220 @@
+"""HTTP fake Kubernetes API server: FakeCluster behind real REST.
+
+The process-level e2e tier (the reference's kind-cluster story, SURVEY
+§4.2) needs the actual driver binaries (`python -m tpu_dra.*.main`) to run
+as separate processes against a real apiserver endpoint. This serves a
+FakeCluster over the k8s REST conventions HttpApiClient speaks:
+
+  GET    /api/v1/... | /apis/<group>/<version>/...      (get/list)
+  GET    ...?watch=true                                  (chunked stream)
+  POST   collection                                      (create)
+  PUT    item [/status]                                  (update)
+  PATCH  item (application/merge-patch+json)             (merge patch)
+  DELETE item
+
+It is deliberately schema-less (objects are opaque dicts), matching
+FakeCluster semantics: resourceVersion bumping, finalizer-aware deletion,
+label selectors, namespaced + cluster-scoped resources.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+
+from tpu_dra.k8s import resources
+from tpu_dra.k8s.client import ApiError, GVR, NotFoundError
+from tpu_dra.k8s.fake import FakeCluster
+
+# Registry of resources the server routes (plural -> GVR); mirrors
+# tpu_dra.k8s.resources. Unknown plurals 404 like a real apiserver.
+KNOWN_GVRS = {
+    (g.group, g.version, g.plural): g
+    for g in (resources.PODS, resources.NODES, resources.EVENTS,
+              resources.DAEMONSETS, resources.DEPLOYMENTS,
+              resources.RESOURCECLAIMS, resources.RESOURCECLAIMTEMPLATES,
+              resources.RESOURCESLICES, resources.DEVICECLASSES,
+              resources.COMPUTEDOMAINS)
+}
+
+
+def _parse_path(path: str) -> Optional[Tuple[GVR, Optional[str],
+                                             Optional[str], Optional[str]]]:
+    """Returns (gvr, namespace, name, subresource) or None."""
+    parts = [p for p in path.split("/") if p]
+    if not parts:
+        return None
+    if parts[0] == "api":
+        if len(parts) < 2:
+            return None
+        group, rest = "", parts[2:]
+        version = parts[1]
+    elif parts[0] == "apis":
+        if len(parts) < 3:
+            return None
+        group, version, rest = parts[1], parts[2], parts[3:]
+    else:
+        return None
+    namespace = None
+    if rest and rest[0] == "namespaces" and len(rest) >= 2:
+        namespace = rest[1]
+        rest = rest[2:]
+    if not rest:
+        return None
+    plural, rest = rest[0], rest[1:]
+    gvr = KNOWN_GVRS.get((group, version, plural))
+    if gvr is None:
+        return None
+    name = rest[0] if rest else None
+    subresource = rest[1] if len(rest) > 1 else None
+    return gvr, namespace, name, subresource
+
+
+class FakeApiServer:
+    """Serves `cluster` (a FakeCluster) over HTTP; `url` is the base URL
+    usable as --kube-api-url / KUBE_API_URL."""
+
+    def __init__(self, cluster: Optional[FakeCluster] = None,
+                 addr: str = "127.0.0.1", port: int = 0):
+        self.cluster = cluster or FakeCluster()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def _send_json(self, code: int, doc: Dict):
+                body = json.dumps(doc).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _error(self, code: int, message: str):
+                self._send_json(code, {
+                    "kind": "Status", "apiVersion": "v1", "code": code,
+                    "status": "Failure", "message": message})
+
+            def _body(self) -> Dict:
+                length = int(self.headers.get("Content-Length", 0))
+                return json.loads(self.rfile.read(length)) if length else {}
+
+            def do_GET(self):  # noqa: N802
+                url = urllib.parse.urlparse(self.path)
+                query = urllib.parse.parse_qs(url.query)
+                parsed = _parse_path(url.path)
+                if parsed is None:
+                    return self._error(404, f"unknown path {url.path}")
+                gvr, ns, name, _sub = parsed
+                try:
+                    if name:
+                        return self._send_json(
+                            200, outer.cluster.get(gvr, name, ns))
+                    selector = (query.get("labelSelector") or [None])[0]
+                    if (query.get("watch") or ["false"])[0] == "true":
+                        rv = (query.get("resourceVersion") or [None])[0]
+                        return self._watch(gvr, ns, selector, rv)
+                    items, rv = outer.cluster.list_with_rv(
+                        gvr, namespace=ns, label_selector=selector)
+                    return self._send_json(200, {
+                        "kind": "List", "apiVersion": "v1",
+                        "metadata": {"resourceVersion": rv},
+                        "items": items})
+                except NotFoundError as e:
+                    return self._error(404, str(e))
+
+            def _watch(self, gvr, ns, selector, resource_version=None):
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def write_chunk(data: bytes):
+                    self.wfile.write(f"{len(data):x}\r\n".encode())
+                    self.wfile.write(data + b"\r\n")
+                    self.wfile.flush()
+
+                try:
+                    for event_type, obj in outer.cluster.watch(
+                            gvr, namespace=ns, label_selector=selector,
+                            resource_version=resource_version,
+                            stop=outer._stop):
+                        line = json.dumps({"type": event_type,
+                                           "object": obj}) + "\n"
+                        write_chunk(line.encode())
+                except (BrokenPipeError, ConnectionResetError):
+                    return
+
+            def do_POST(self):  # noqa: N802
+                parsed = _parse_path(urllib.parse.urlparse(self.path).path)
+                if parsed is None:
+                    return self._error(404, "unknown path")
+                gvr, ns, _name, _sub = parsed
+                try:
+                    created = outer.cluster.create(gvr, self._body(),
+                                                   namespace=ns)
+                    return self._send_json(201, created)
+                except ApiError as e:
+                    return self._error(e.status, e.message)
+
+            def do_PUT(self):  # noqa: N802
+                parsed = _parse_path(urllib.parse.urlparse(self.path).path)
+                if parsed is None:
+                    return self._error(404, "unknown path")
+                gvr, ns, _name, sub = parsed
+                try:
+                    if sub == "status":
+                        out = outer.cluster.update_status(gvr, self._body(),
+                                                          namespace=ns)
+                    else:
+                        out = outer.cluster.update(gvr, self._body(),
+                                                   namespace=ns)
+                    return self._send_json(200, out)
+                except ApiError as e:
+                    return self._error(e.status, e.message)
+
+            def do_PATCH(self):  # noqa: N802
+                parsed = _parse_path(urllib.parse.urlparse(self.path).path)
+                if parsed is None or parsed[2] is None:
+                    return self._error(404, "unknown path")
+                gvr, ns, name, _sub = parsed
+                try:
+                    out = outer.cluster.patch(gvr, name, self._body(),
+                                              namespace=ns)
+                    return self._send_json(200, out)
+                except ApiError as e:
+                    return self._error(e.status, e.message)
+
+            def do_DELETE(self):  # noqa: N802
+                parsed = _parse_path(urllib.parse.urlparse(self.path).path)
+                if parsed is None or parsed[2] is None:
+                    return self._error(404, "unknown path")
+                gvr, ns, name, _sub = parsed
+                outer.cluster.delete(gvr, name, ns)
+                return self._send_json(200, {"kind": "Status",
+                                             "status": "Success"})
+
+        self._stop = threading.Event()
+        self._server = ThreadingHTTPServer((addr, port), Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True, name="fake-apiserver")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._server.shutdown()
+        self._server.server_close()

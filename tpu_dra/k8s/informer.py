@@ -102,7 +102,10 @@ class Informer:
     def stop(self) -> None:
         self._stop.set()
         if self._thread:
-            self._thread.join(timeout=5)
+            # Watch threads are daemons and notice _stop within ~1s (the
+            # client's short read timeout); a tight join keeps multi-informer
+            # shutdown inside a pod's termination grace period.
+            self._thread.join(timeout=2)
 
     def wait_for_sync(self, timeout: float = 10.0) -> bool:
         return self._synced.wait(timeout)
@@ -169,8 +172,12 @@ class Informer:
                 self._stop.wait(1.0)
 
     def _list_and_watch(self) -> None:
-        objs = self._client.list(self._gvr, namespace=self._namespace,
-                                 label_selector=self._selector)
+        # list_with_rv + resourceVersion-resumed watch closes the gap in
+        # which an event between LIST and WATCH would be lost (clients
+        # without RV support return "" and watch from 'now').
+        objs, list_rv = self._client.list_with_rv(
+            self._gvr, namespace=self._namespace,
+            label_selector=self._selector)
         with self._lock:
             seen = set()
             for obj in objs:
@@ -189,7 +196,8 @@ class Informer:
 
         for event_type, obj in self._client.watch(
                 self._gvr, namespace=self._namespace,
-                label_selector=self._selector, stop=self._stop):
+                label_selector=self._selector,
+                resource_version=list_rv or None, stop=self._stop):
             if self._stop.is_set():
                 return
             if not self._accepts(obj):
