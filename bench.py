@@ -124,6 +124,87 @@ def bench_claim_to_ready(n_cycles: int = 40):
     }
 
 
+def bench_cd_convergence():
+    """Full multi-node ComputeDomain claim-to-ready: controller + 2 CD
+    kubelet plugins + 2 real C++ slice daemons converging through the fake
+    API server (SURVEY §3.3). The reference's only bound on this machinery
+    is the 300s failover budget; this measures actual convergence wall
+    time from CD creation to both workload claims prepared."""
+    import threading
+
+    from tpu_dra.api import types as apitypes
+    from tpu_dra.cdcontroller import Controller
+    from tpu_dra.k8s import COMPUTEDOMAINS, FakeCluster, RESOURCECLAIMS
+    from tpu_dra.kubeletplugin.server import Claim
+    from tpu_dra.testing import DAEMON_BIN, FakeNode
+
+    if not os.path.exists(DAEMON_BIN):
+        return {"cd_convergence_error": "native daemon not built"}
+
+    tmp = tempfile.mkdtemp(prefix="tpu-dra-cdbench-")
+    cluster = FakeCluster()
+    controller = Controller(cluster, namespace="tpu-dra-driver",
+                            image="bench", gc_interval=3600.0)
+    controller.start()
+    nodes = [FakeNode(cluster, name, tmp, retry_timeout=30.0)
+             for name in ("node-a", "node-b")]
+
+    try:
+        t0 = time.perf_counter()
+        cd = cluster.create(COMPUTEDOMAINS, {
+            "apiVersion": apitypes.API_VERSION, "kind": "ComputeDomain",
+            "metadata": {"name": "bench-cd", "namespace": "bench"},
+            "spec": {"numNodes": 2, "channel": {
+                "resourceClaimTemplate": {"name": "bench-rct"}}},
+        })
+        results = {}
+
+        def kubelet(node):
+            claim = cluster.create(RESOURCECLAIMS, {
+                "apiVersion": "resource.k8s.io/v1", "kind": "ResourceClaim",
+                "metadata": {"name": f"w-{node.name}", "namespace": "bench"},
+                "spec": {"devices": {"requests": [{"name": "r0"}]}},
+                "status": {"allocation": {"devices": {
+                    "results": [{
+                        "request": "r0",
+                        "driver": apitypes.COMPUTE_DOMAIN_DRIVER_NAME,
+                        "pool": node.name, "device": "channel-0"}],
+                    "config": [{"requests": ["r0"], "opaque": {
+                        "driver": apitypes.COMPUTE_DOMAIN_DRIVER_NAME,
+                        "parameters": {
+                            "apiVersion": apitypes.API_VERSION,
+                            "kind": "ComputeDomainChannelConfig",
+                            "domainID": cd["metadata"]["uid"],
+                            "allocationMode": "Single"}}}]}}},
+            })
+            c = Claim(uid=claim["metadata"]["uid"],
+                      name=claim["metadata"]["name"], namespace="bench")
+            results[node.name] = node.driver.prepare_claims([c])[c.uid]
+
+        threads = [threading.Thread(target=kubelet, args=(n,))
+                   for n in nodes]
+        for t in threads:
+            t.start()
+        # Play the DaemonSet: start a daemon when its node gets labeled.
+        for node in nodes:
+            if not node.wait_labeled(cd["metadata"]["uid"]):
+                return {"cd_convergence_error":
+                        f"{node.name} never labeled"}
+            node.start_daemon(cd)
+        for t in threads:
+            t.join(timeout=40)
+        elapsed = time.perf_counter() - t0
+        errors = [f"{n}: {r.error}" for n, r in results.items() if r.error]
+        if errors or len(results) != 2:
+            return {"cd_convergence_error": "; ".join(errors) or "timeout"}
+        return {"cd_convergence_s": round(elapsed, 3)}
+    finally:
+        for node in nodes:
+            node.stop()
+        controller.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_psum(visible_chips: str):
     import jax
 
@@ -154,6 +235,10 @@ def main():
     out = {}
     c2r = bench_claim_to_ready()
     out.update(c2r)
+    try:
+        out.update(bench_cd_convergence())
+    except Exception as e:  # noqa: BLE001 — CD phase is best-effort
+        out["cd_convergence_error"] = str(e)
     try:
         psum = bench_psum(c2r["visible_chips"])
         out["psum_algo_gbps"] = round(psum["algo_gbps"], 3)

@@ -14,88 +14,21 @@ C++ slice daemon doing rendezvous on localhost:
 import json
 import os
 import threading
-import time
-import uuid
 
 import pytest
 
 from tpu_dra.api import types as apitypes
 from tpu_dra.cdcontroller import Controller
-from tpu_dra.cddaemon.main import DaemonRunner, flags as daemon_flags
-from tpu_dra.cdi.handler import CDIHandler
-from tpu_dra.cdplugin.computedomain import ComputeDomainManager
-from tpu_dra.cdplugin.device_state import DeviceState
-from tpu_dra.cdplugin.driver import CDDriver
 from tpu_dra.k8s import (
     COMPUTEDOMAINS, DAEMONSETS, FakeCluster, NODES, RESOURCECLAIMS,
     RESOURCECLAIMTEMPLATES,
 )
 from tpu_dra.k8s.client import NotFoundError
 from tpu_dra.kubeletplugin.server import Claim
-from tpu_dra.tpuplugin.checkpoint import CheckpointManager
+from tpu_dra.testing import DAEMON_BIN, FakeNode
 
 DRIVER_NS = "tpu-dra-driver"
 LABEL = apitypes.COMPUTE_DOMAIN_LABEL_KEY
-DAEMON_BIN = os.path.join(os.path.dirname(__file__), "..", "native", "build",
-                          "tpu-slice-daemon")
-
-
-def free_port():
-    import socket
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
-
-class FakeNode:
-    """One 'node': a CD kubelet plugin plus (once labeled) a cd daemon."""
-
-    def __init__(self, cluster, name, tmp_path):
-        self.cluster = cluster
-        self.name = name
-        self.tmp = tmp_path / name
-        cluster.create(NODES, {"apiVersion": "v1", "kind": "Node",
-                               "metadata": {"name": name}})
-        self.cd_manager = ComputeDomainManager(
-            cluster, node_name=name,
-            driver_plugin_dir=str(self.tmp / "plugin"))
-        self.cd_manager.start()
-        self.cdi = CDIHandler(str(self.tmp / "cdi"),
-                              vendor="k8s.compute-domain.tpu.dev")
-        self.state = DeviceState(
-            cd_manager=self.cd_manager, cdi=self.cdi,
-            checkpoints=CheckpointManager(str(self.tmp / "plugin")),
-            driver_name=apitypes.COMPUTE_DOMAIN_DRIVER_NAME,
-            node_name=name, slice_id="slice-A")
-        self.driver = CDDriver(
-            state=self.state, client=cluster,
-            driver_name=apitypes.COMPUTE_DOMAIN_DRIVER_NAME, node_name=name,
-            slice_id="slice-A", plugin_dir=str(self.tmp / "plugin"),
-            retry_timeout=20.0)
-        self.driver.start()
-        self.daemon = None
-
-    def start_daemon(self, cd):
-        """The DaemonSet-pod analog, started when the node is labeled."""
-        port = free_port()
-        ns = daemon_flags().parse([
-            "--cd-uid", cd["metadata"]["uid"],
-            "--cd-name", cd["metadata"]["name"],
-            "--cd-namespace", cd["metadata"]["namespace"],
-            "--node-name", self.name, "--pod-ip", "127.0.0.1",
-            "--port", str(port),
-            "--work-dir", str(self.tmp / "daemon"),
-            "--hosts-file", str(self.tmp / "hosts"),
-            "--daemon-binary", DAEMON_BIN,
-        ])
-        self.daemon = DaemonRunner(self.cluster, ns)
-        self.daemon.start()
-
-    def stop(self):
-        if self.daemon:
-            self.daemon.stop()
-        self.driver.shutdown()
-        self.cd_manager.stop()
 
 
 @pytest.mark.skipif(not os.path.exists(DAEMON_BIN),
@@ -164,10 +97,8 @@ class TestFullConvergence:
         # 4. Plugins label their nodes; the test plays the DaemonSet and
         #    starts a daemon on each labeled node.
         for node in nodes:
-            assert cluster.wait_for(
-                lambda n=node: (cluster.get(NODES, n.name)["metadata"]
-                                .get("labels") or {}).get(LABEL) == uid,
-                timeout=10), f"{node.name} never labeled"
+            assert node.wait_labeled(uid, timeout=10), \
+                f"{node.name} never labeled"
             node.start_daemon(cd)
 
         for t in threads:

@@ -298,6 +298,17 @@ class TestDaemonRunner:
         assert nodes == []
 
 
+class TestDriverVersionGate:
+    def test_version_parse_and_compare(self):
+        from tpu_dra.cddaemon.main import dns_names_supported, parse_driver_version
+        assert parse_driver_version("1.0.0-fake") == (1, 0, 0)
+        assert parse_driver_version("garbage") is None
+        assert dns_names_supported("1.0.0-fake")
+        assert dns_names_supported("570.158.1")
+        assert not dns_names_supported("0.8.9")
+        assert not dns_names_supported("unknown")
+
+
 class TestDiscoverSliceId:
     def test_uniform(self):
         b = FakeBackend(default_fake_chips(4, "v5e", slice_id="sl"))

@@ -1,0 +1,77 @@
+"""Ring attention vs reference attention on the 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_dra.workloads.ringattention import (
+    make_ring_attention, reference_attention,
+)
+
+B, S, H, D = 2, 64, 4, 16
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    return Mesh(np.array(devs[:8]), ("data",))
+
+
+def _qkv(dtype=jnp.float32, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    shape = (B, S, H, D)
+    return tuple(jax.random.normal(k, shape, dtype) for k in ks)
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_reference(self, mesh, causal):
+        q, k, v = _qkv()
+        want = reference_attention(q, k, v, causal=causal)
+        fn = make_ring_attention(mesh, causal=causal)
+        sharding = NamedSharding(mesh, P(None, "data", None, None))
+        qs, ks, vs = (jax.device_put(x, sharding) for x in (q, k, v))
+        got = fn(qs, ks, vs)
+        np.testing.assert_allclose(np.asarray(want), np.asarray(got),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_bf16_path(self, mesh):
+        q, k, v = _qkv(jnp.bfloat16, seed=1)
+        want = reference_attention(q, k, v)
+        fn = make_ring_attention(mesh)
+        sharding = NamedSharding(mesh, P(None, "data", None, None))
+        got = fn(*(jax.device_put(x, sharding) for x in (q, k, v)))
+        assert got.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(want, np.float32), np.asarray(got, np.float32),
+            rtol=5e-2, atol=5e-2)
+
+    def test_output_stays_sequence_sharded(self, mesh):
+        q, k, v = _qkv()
+        fn = make_ring_attention(mesh)
+        sharding = NamedSharding(mesh, P(None, "data", None, None))
+        got = fn(*(jax.device_put(x, sharding) for x in (q, k, v)))
+        assert got.sharding.spec == P(None, "data", None, None)
+
+    def test_gradients_flow(self, mesh):
+        """Ring attention must be differentiable for training use."""
+        q, k, v = _qkv()
+        sharding = NamedSharding(mesh, P(None, "data", None, None))
+        qs, ks, vs = (jax.device_put(x, sharding) for x in (q, k, v))
+        fn = make_ring_attention(mesh)
+
+        def loss(q, k, v):
+            return jnp.sum(jnp.square(fn(q, k, v)))
+
+        g = jax.jit(jax.grad(loss))(qs, ks, vs)
+        assert np.isfinite(np.asarray(g)).all()
+        # Compare against the reference gradient.
+        g_ref = jax.grad(
+            lambda q, k, v: jnp.sum(
+                jnp.square(reference_attention(q, k, v))))(q, k, v)
+        np.testing.assert_allclose(np.asarray(g_ref), np.asarray(g),
+                                   rtol=1e-4, atol=1e-4)

@@ -43,6 +43,25 @@ log = logging.getLogger("tpu_dra.cddaemon")
 
 DEFAULT_PORT = 7551
 
+# DNS-stable rendezvous needs an accel driver that re-resolves peer names
+# on SIGUSR1 (the driver >= 570.158.01 gate of the reference,
+# cd-plugin device_state.go:666-690).
+MIN_DNS_DRIVER_VERSION = (0, 9, 0)
+
+
+def parse_driver_version(raw: str):
+    """'1.2.3-suffix' -> (1, 2, 3); unparseable -> None."""
+    parts = raw.split("-")[0].split(".")
+    try:
+        return tuple(int(p) for p in parts[:3])
+    except ValueError:
+        return None
+
+
+def dns_names_supported(raw_version: str) -> bool:
+    parsed = parse_driver_version(raw_version)
+    return parsed is not None and parsed >= MIN_DNS_DRIVER_VERSION
+
 
 def _default_daemon_binary() -> str:
     here = os.path.dirname(os.path.abspath(__file__))
@@ -105,7 +124,13 @@ class DaemonRunner:
         self.ns = ns
         self.client = client
         self.backend = get_backend()
+        chips = self.backend.chips()
         self.slice_id = discover_slice_id(self.backend)
+        # Version-gate input, captured once (native backends rescan chips
+        # per call). Chipless DCN-only members have no accel driver to
+        # impose the constraint — treat DNS mode as supported there.
+        self.dns_supported = (not chips
+                              or dns_names_supported(chips[0].driver_version))
         self.cd = ComputeDomainManager(
             client, cd_name=ns.cd_name, cd_namespace=ns.cd_namespace,
             cd_uid=ns.cd_uid, node_name=ns.node_name, node_ip=ns.pod_ip,
@@ -163,6 +188,12 @@ class DaemonRunner:
     def _update_loop(self) -> None:
         """Membership changes -> peer config refresh (main.go:296-377)."""
         dns_mode = featuregates.enabled(featuregates.SliceDaemonsWithDNSNames)
+        if dns_mode and not self.dns_supported:
+            # Version gate (device_state.go:666-690 analog): fall back to
+            # legacy IP mode on drivers without SIGUSR1 re-resolve.
+            log.warning("accel driver predates DNS-stable rendezvous; "
+                        "falling back to IP mode")
+            dns_mode = False
         while not self._stop.is_set():
             try:
                 node_set = self.cd.updates.get(timeout=0.2)

@@ -1,0 +1,107 @@
+"""Shared multi-node ComputeDomain harness for tests and bench.
+
+One "node" = a CD kubelet plugin (ComputeDomainManager + DeviceState +
+CDDriver) plus, once the node is labeled, a DaemonRunner wrapping the real
+C++ slice daemon. Used by tests/test_cd_integration.py and bench.py so the
+wiring lives in exactly one place.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+from typing import Optional
+
+from tpu_dra.api import types as apitypes
+from tpu_dra.cddaemon.main import DaemonRunner, flags as daemon_flags
+from tpu_dra.cdi.handler import CDIHandler
+from tpu_dra.cdplugin.computedomain import ComputeDomainManager
+from tpu_dra.cdplugin.device_state import DeviceState
+from tpu_dra.cdplugin.driver import CDDriver
+from tpu_dra.k8s import NODES
+from tpu_dra.tpuplugin.checkpoint import CheckpointManager
+
+CD_CDI_VENDOR = "k8s.compute-domain.tpu.dev"
+
+DAEMON_BIN = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native", "build", "tpu-slice-daemon")
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class FakeNode:
+    """One 'node': a CD kubelet plugin plus (once labeled) a cd daemon."""
+
+    def __init__(self, cluster, name: str, tmp_path, *,
+                 slice_id: str = "slice-A", retry_timeout: float = 20.0,
+                 daemon_bin: str = DAEMON_BIN):
+        self.cluster = cluster
+        self.name = name
+        self.tmp = tmp_path / name if hasattr(tmp_path, "__truediv__") \
+            else _PathShim(os.path.join(str(tmp_path), name))
+        self._daemon_bin = daemon_bin
+        cluster.create(NODES, {"apiVersion": "v1", "kind": "Node",
+                               "metadata": {"name": name}})
+        self.cd_manager = ComputeDomainManager(
+            cluster, node_name=name,
+            driver_plugin_dir=str(self.tmp / "plugin"))
+        self.cd_manager.start()
+        self.cdi = CDIHandler(str(self.tmp / "cdi"), vendor=CD_CDI_VENDOR)
+        self.state = DeviceState(
+            cd_manager=self.cd_manager, cdi=self.cdi,
+            checkpoints=CheckpointManager(str(self.tmp / "plugin")),
+            driver_name=apitypes.COMPUTE_DOMAIN_DRIVER_NAME,
+            node_name=name, slice_id=slice_id)
+        self.driver = CDDriver(
+            state=self.state, client=cluster,
+            driver_name=apitypes.COMPUTE_DOMAIN_DRIVER_NAME, node_name=name,
+            slice_id=slice_id, plugin_dir=str(self.tmp / "plugin"),
+            retry_timeout=retry_timeout)
+        self.driver.start()
+        self.daemon: Optional[DaemonRunner] = None
+
+    def wait_labeled(self, cd_uid: str, timeout: float = 20.0) -> bool:
+        return self.cluster.wait_for(
+            lambda: (self.cluster.get(NODES, self.name)["metadata"]
+                     .get("labels") or {}).get(
+                apitypes.COMPUTE_DOMAIN_LABEL_KEY) == cd_uid,
+            timeout=timeout)
+
+    def start_daemon(self, cd) -> None:
+        """The DaemonSet-pod analog, started when the node is labeled."""
+        ns = daemon_flags().parse([
+            "--cd-uid", cd["metadata"]["uid"],
+            "--cd-name", cd["metadata"]["name"],
+            "--cd-namespace", cd["metadata"]["namespace"],
+            "--node-name", self.name, "--pod-ip", "127.0.0.1",
+            "--port", str(free_port()),
+            "--work-dir", str(self.tmp / "daemon"),
+            "--hosts-file", str(self.tmp / "hosts"),
+            "--daemon-binary", self._daemon_bin,
+        ])
+        self.daemon = DaemonRunner(self.cluster, ns)
+        self.daemon.start()
+
+    def stop(self) -> None:
+        if self.daemon:
+            self.daemon.stop()
+            self.daemon = None
+        self.driver.shutdown()
+        self.cd_manager.stop()
+
+
+class _PathShim:
+    """Minimal pathlib-like '/'-join for plain-string tmp dirs (bench)."""
+
+    def __init__(self, path: str):
+        self._path = path
+
+    def __truediv__(self, other: str) -> "_PathShim":
+        return _PathShim(os.path.join(self._path, other))
+
+    def __str__(self) -> str:
+        return self._path

@@ -1,0 +1,129 @@
+"""Ring attention: sequence-parallel causal attention over an ICI ring.
+
+Long-context workload for driver-provisioned slices: the sequence is
+sharded across the devices of a ComputeDomain slice; each device holds one
+Q/K/V block and K/V blocks rotate around the ring via `jax.lax.ppermute`
+(XLA lowers neighbor permutes to ICI sends), overlapping compute with the
+rotation. Softmax is computed online (running max + normalizer, the
+flash-attention recurrence) so no device ever materializes the full
+[S, S] score matrix — memory is O(S_local * S_local) per step and the
+context length scales linearly with ring size.
+
+This is the workload-side analog of the reference's NCCL bandwidth jobs
+(SURVEY §2.10): where those validate IMEX-brokered NVLink, this validates
+that a driver-stitched slice sustains ring collectives. TPU-first design
+notes: static shapes, `lax.fori_loop` over ring steps (no Python loop in
+jit), bf16 matmuls on the MXU with fp32 accumulators for the online
+softmax state.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, q_offset, kv_offset, causal):
+    """Scores of one (q-block, kv-block) pair with causal masking in GLOBAL
+    sequence coordinates. q: [B,Sq,H,D]; k,v: [B,Sk,H,D].
+    Returns (scores [B,H,Sq,Sk], values v) ready for the online update."""
+    d = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(d)
+    scores = scores.astype(jnp.float32)
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        q_pos = q_offset + jnp.arange(sq)[:, None]
+        k_pos = kv_offset + jnp.arange(sk)[None, :]
+        scores = jnp.where(q_pos >= k_pos, scores, NEG_INF)
+    return scores
+
+
+def _online_update(state, scores, v):
+    """Flash-attention online-softmax accumulation step.
+    state: (acc [B,H,Sq,D] f32, row_max [B,H,Sq] f32, denom [B,H,Sq] f32).
+    """
+    acc, row_max, denom = state
+    block_max = jnp.max(scores, axis=-1)
+    new_max = jnp.maximum(row_max, block_max)
+    correction = jnp.exp(row_max - new_max)
+    p = jnp.exp(scores - new_max[..., None])  # [B,H,Sq,Sk] f32
+    pv = jnp.einsum("bhqk,bkhd->bhqd", p.astype(v.dtype), v).astype(jnp.float32)
+    acc = acc * correction[..., None] + pv
+    denom = denom * correction + jnp.sum(p, axis=-1)
+    return acc, new_max, denom
+
+
+def ring_attention(q, k, v, *, axis_name: str, causal: bool = True):
+    """Per-device body (inside shard_map): q,k,v are the LOCAL sequence
+    blocks [B, S_local, H, D]. K/V rotate ring-wise; every device sees all
+    blocks after axis_size steps."""
+    axis_size = jax.lax.psum(1, axis_name)
+    my_index = jax.lax.axis_index(axis_name)
+    b, s_local, h, d = q.shape
+    q_offset = my_index * s_local
+
+    # pvary: the fresh carries are device-invariant but the loop produces
+    # device-varying values; shard_map's typed carries must agree.
+    acc = jax.lax.pvary(jnp.zeros((b, h, s_local, d), jnp.float32),
+                        axis_name)
+    row_max = jax.lax.pvary(jnp.full((b, h, s_local), NEG_INF, jnp.float32),
+                            axis_name)
+    denom = jax.lax.pvary(jnp.zeros((b, h, s_local), jnp.float32),
+                          axis_name)
+
+    def step(i, carry):
+        acc, row_max, denom, k_blk, v_blk = carry
+        # Block i arrived from neighbor (my_index + i) mod axis_size.
+        kv_index = (my_index + i) % axis_size
+        scores = _block_attend(q, k_blk, v_blk, q_offset,
+                               kv_index * s_local, causal)
+        acc, row_max, denom = _online_update((acc, row_max, denom),
+                                             scores, v_blk)
+        # Rotate K/V one hop around the ring (device p -> p-1, so the
+        # NEXT step sees the block of my_index+i+1). The final rotation
+        # is redundant but keeps the loop body uniform for the compiler.
+        perm = [(p, (p - 1) % axis_size) for p in range(axis_size)]
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return acc, row_max, denom, k_blk, v_blk
+
+    acc, row_max, denom, _, _ = jax.lax.fori_loop(
+        0, axis_size, step, (acc, row_max, denom, k, v))
+    out = acc / denom[..., None]
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)  # [B,Sq,H,D]
+
+
+def make_ring_attention(mesh: Mesh, axis_name: str = "data",
+                        causal: bool = True):
+    """Jitted sequence-parallel attention over `mesh`'s `axis_name` axis.
+    Inputs/outputs [B, S, H, D] sharded on S."""
+    seq_sharding = NamedSharding(mesh, P(None, axis_name, None, None))
+    spec = P(None, axis_name, None, None)
+
+    body = functools.partial(ring_attention, axis_name=axis_name,
+                             causal=causal)
+    fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec)
+    return jax.jit(fn, in_shardings=(seq_sharding,) * 3,
+                   out_shardings=seq_sharding)
+
+
+def reference_attention(q, k, v, causal: bool = True):
+    """Unsharded attention for correctness checks."""
+    d = q.shape[-1]
+    scores = (jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(d)
+              ).astype(jnp.float32)
+    if causal:
+        s = q.shape[1]
+        mask = jnp.tril(jnp.ones((s, s), jnp.bool_))
+        scores = jnp.where(mask, scores, NEG_INF)
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    return out.astype(q.dtype)
