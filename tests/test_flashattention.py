@@ -1,0 +1,52 @@
+"""Pallas flash attention vs reference (interpret mode on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_dra.workloads.flashattention import attend, flash_attention
+from tpu_dra.workloads.ringattention import reference_attention
+
+
+def _qkv(b=2, s=256, h=2, d=32, dtype=jnp.float32, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return tuple(jax.random.normal(k, (b, s, h, d), dtype) for k in ks)
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_reference(self, causal):
+        q, k, v = _qkv()
+        want = reference_attention(q, k, v, causal=causal)
+        got = flash_attention(q, k, v, causal=causal, interpret=True)
+        np.testing.assert_allclose(np.asarray(want), np.asarray(got),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_small_seq_single_block(self):
+        q, k, v = _qkv(s=128)
+        want = reference_attention(q, k, v)
+        got = flash_attention(q, k, v, interpret=True)
+        np.testing.assert_allclose(np.asarray(want), np.asarray(got),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_bf16(self):
+        q, k, v = _qkv(dtype=jnp.bfloat16, seed=3)
+        want = reference_attention(q, k, v)
+        got = flash_attention(q, k, v, interpret=True)
+        assert got.dtype == jnp.bfloat16
+        np.testing.assert_allclose(np.asarray(want, np.float32),
+                                   np.asarray(got, np.float32),
+                                   rtol=5e-2, atol=5e-2)
+
+    def test_rejects_indivisible_seq(self):
+        q, k, v = _qkv(s=192)
+        with pytest.raises(ValueError, match="not divisible"):
+            flash_attention(q, k, v, block_q=128, block_k=128)
+
+    def test_attend_dispatch_cpu_falls_back(self):
+        q, k, v = _qkv(s=64)
+        want = reference_attention(q, k, v)
+        got = attend(q, k, v)
+        np.testing.assert_allclose(np.asarray(want), np.asarray(got),
+                                   rtol=2e-5, atol=2e-5)

@@ -1,0 +1,120 @@
+"""Pallas flash-attention forward for TPU (the model's hot op).
+
+Tiled causal attention: the [S, S] score matrix never materializes in HBM.
+Grid is (batch*heads, q_blocks); each program streams K/V blocks for one
+Q tile through VMEM with the online-softmax recurrence, accumulating in
+fp32 while matmuls run bf16/f32 on the MXU.
+
+Design (pallas_guide.md): blocks sized to MXU/VREG tiling (128 lanes),
+`lax.fori_loop` over K/V blocks with a causal upper bound computed from the
+program id (no wasted blocks above the diagonal), fp32 scratch accumulators
+in VMEM, `interpret=True` path so numerics are testable on CPU.
+
+`attend()` picks this kernel on TPU and the plain jnp reference elsewhere,
+so the workload model runs everywhere and is fast where it matters.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, seq_len: int,
+                  causal: bool, sm_scale: float):
+    """One Q tile vs all (needed) K/V tiles.
+
+    Refs (VMEM): q [block_q, d]; k, v [seq_len, d]; o [block_q, d].
+    """
+    block_q, d = q_ref.shape
+    q_block_idx = pl.program_id(1)
+    q_start = q_block_idx * block_q
+
+    q = q_ref[...].astype(jnp.float32) * sm_scale
+
+    acc = jnp.zeros((block_q, d), jnp.float32)
+    row_max = jnp.full((block_q,), NEG_INF, jnp.float32)
+    denom = jnp.zeros((block_q,), jnp.float32)
+
+    # Causal: K blocks strictly above the diagonal contribute nothing.
+    num_k_blocks = seq_len // block_k
+    if causal:
+        last = jnp.minimum(num_k_blocks,
+                           (q_start + block_q + block_k - 1) // block_k)
+    else:
+        last = num_k_blocks
+
+    def body(kb, carry):
+        acc, row_max, denom = carry
+        k_start = kb * block_k
+        k_blk = k_ref[pl.dslice(k_start, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.dslice(k_start, block_k), :].astype(jnp.float32)
+        scores = q @ k_blk.T  # [block_q, block_k] on the MXU
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            scores = jnp.where(q_pos >= k_pos, scores, NEG_INF)
+        blk_max = jnp.max(scores, axis=1)
+        new_max = jnp.maximum(row_max, blk_max)
+        correction = jnp.exp(row_max - new_max)
+        p = jnp.exp(scores - new_max[:, None])
+        acc = acc * correction[:, None] + p @ v_blk
+        denom = denom * correction + jnp.sum(p, axis=1)
+        return acc, new_max, denom
+
+    acc, row_max, denom = jax.lax.fori_loop(0, last, body,
+                                            (acc, row_max, denom))
+    o_ref[...] = (acc / denom[:, None]).astype(o_ref.dtype)
+
+
+def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 128,
+                    block_k: int = 128, interpret: bool = False):
+    """q, k, v: [B, S, H, D] -> [B, S, H, D]. S must divide by the blocks
+    (pad upstream; the workload model uses power-of-two seq lens)."""
+    b, s, h, d = q.shape
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    if s % block_q or s % block_k:
+        raise ValueError(f"seq len {s} not divisible by blocks "
+                         f"({block_q}, {block_k})")
+    sm_scale = 1.0 / math.sqrt(d)
+
+    # [B,S,H,D] -> [B*H, S, D]: one grid row per (batch, head).
+    def to_bh(x):
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, s, d)
+
+    qb, kb, vb = to_bh(q), to_bh(k), to_bh(v)
+
+    kernel = functools.partial(_flash_kernel, block_k=block_k, seq_len=s,
+                               causal=causal, sm_scale=sm_scale)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, s // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((None, s, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((None, s, d), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d),
+                               lambda bh, qi: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        interpret=interpret,
+    )(qb, kb, vb)
+    return jnp.transpose(out.reshape(b, h, s, d), (0, 2, 1, 3))
+
+
+def attend(q, k, v, *, causal: bool = True):
+    """Dispatch: pallas kernel on TPU, jnp reference elsewhere."""
+    on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    if on_tpu and q.shape[1] >= 128 and q.shape[1] % 128 == 0:
+        return flash_attention(q, k, v, causal=causal)
+    from tpu_dra.workloads.ringattention import reference_attention
+    return reference_attention(q, k, v, causal=causal)
