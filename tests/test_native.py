@@ -153,3 +153,47 @@ class TestFakeBackend:
         be = FakeBackend()
         assert len(be.chips()) == 8
         assert be.chips()[0].generation == "v4"
+
+
+class TestGetBackend:
+    """Auto-selection hardening (round-1 weak #4): never silently serve
+    fake chips on a host whose JAX sees real TPUs."""
+
+    def test_auto_refuses_fake_when_jax_sees_tpu(self, monkeypatch, tmp_path):
+        from tpu_dra.native.tpuinfo import get_backend
+        monkeypatch.setenv("TPU_DRA_TPUINFO_BACKEND", "auto")
+        monkeypatch.setenv("TPUINFO_SYSFS_ROOT", str(tmp_path))  # no accel dir
+        with pytest.raises(RuntimeError, match="refusing to silently serve"):
+            get_backend(jax_tpu_devices=4)
+
+    def test_explicit_fake_overrides_tpu_presence(self, monkeypatch):
+        from tpu_dra.native.tpuinfo import get_backend
+        monkeypatch.setenv("TPU_DRA_TPUINFO_BACKEND", "fake")
+        be = get_backend(jax_tpu_devices=4)
+        assert be.kind == "fake"
+
+    def test_auto_serves_native_from_sysfs(self, monkeypatch, native_build,
+                                           sysfs):
+        from tpu_dra.native.tpuinfo import get_backend
+        root, chips, _ = sysfs
+        monkeypatch.setenv("TPU_DRA_TPUINFO_BACKEND", "auto")
+        monkeypatch.setenv("TPUINFO_SYSFS_ROOT", root)
+        be = get_backend(jax_tpu_devices=4)  # sysfs wins: no mismatch
+        assert be.kind == "native"
+        assert len(be.chips()) == len(chips)
+        be.close()
+
+    def test_auto_falls_back_to_fake_without_tpu(self, monkeypatch, tmp_path):
+        from tpu_dra.native.tpuinfo import get_backend
+        monkeypatch.setenv("TPU_DRA_TPUINFO_BACKEND", "auto")
+        monkeypatch.setenv("TPUINFO_SYSFS_ROOT", str(tmp_path))
+        be = get_backend(jax_tpu_devices=0)
+        assert be.kind == "fake"
+
+    def test_probe_reports_none_on_cpu_jax(self):
+        # The test session's JAX is pinned to CPU: the probe must not
+        # mistake it for TPU hardware.
+        from tpu_dra.native.tpuinfo import probe_jax_tpu_devices
+        import jax
+        jax.devices()  # ensure backends initialized
+        assert probe_jax_tpu_devices() is None

@@ -16,11 +16,15 @@ Both implement ``TpuInfoBackend``. ``get_backend()`` picks by env.
 from __future__ import annotations
 
 import ctypes
+import logging
 import os
 import queue
+import sys
 import threading
 from dataclasses import dataclass, field, replace
 from typing import Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
 
 # Generation table mirrored from native/src/tpuinfo.cc kGenTable.
 GEN_SPECS: Dict[str, Tuple[int, int]] = {
@@ -30,6 +34,30 @@ GEN_SPECS: Dict[str, Tuple[int, int]] = {
     "v5p": (2, 95 << 30),
     "v6e": (1, 32 << 30),
 }
+
+# Public per-chip peak dense bf16 TFLOP/s per generation (cloud.google.com
+# TPU system architecture pages); denominator for MFU reporting.
+PEAK_BF16_TFLOPS: Dict[str, float] = {
+    "v4": 275.0,
+    "v5e": 197.0,
+    "v5p": 459.0,
+    "v6e": 918.0,
+}
+
+
+def generation_from_device_kind(device_kind: str) -> Optional[str]:
+    """Map a JAX `device_kind` string (e.g. 'TPU v5 lite') to a generation
+    key in GEN_SPECS/PEAK_BF16_TFLOPS."""
+    k = device_kind.lower()
+    if "v6" in k or "trillium" in k:
+        return "v6e"
+    if "v5 lite" in k or "v5e" in k or "v5lite" in k:
+        return "v5e"
+    if "v5p" in k or "v5" in k:
+        return "v5p"
+    if "v4" in k:
+        return "v4"
+    return None
 
 
 @dataclass(frozen=True)
@@ -63,6 +91,8 @@ class HealthEvent:
 
 
 class TpuInfoBackend:
+    kind = "unknown"  # which implementation served the inventory
+
     def chips(self) -> List[Chip]:
         raise NotImplementedError
 
@@ -149,6 +179,7 @@ class NativeBackend(TpuInfoBackend):
     (root.go:26-110 locating libnvidia-ml.so.1 under a configurable host
     root) maps to the lib-path candidates + TPU_DRA_LIBTPUINFO override."""
 
+    kind = "native"
     _TIMEOUT_STATUS = -4  # TPUINFO_ERR_TIMEOUT
     _NOT_FOUND_STATUS = -1
 
@@ -269,6 +300,8 @@ class FakeBackend(TpuInfoBackend):
     'no unit tests for device_state/nvlib/cdi — the TPU build should do
     better here')."""
 
+    kind = "fake"
+
     def __init__(self, chips: Optional[List[Chip]] = None):
         if chips is None:
             count = int(os.environ.get("TPU_DRA_FAKE_CHIPS", "4"))
@@ -367,9 +400,40 @@ def append_health_event(root: str, event: HealthEvent) -> None:
         f.write(f"{event.chip_index} {event.code} {event.kind} {event.description}\n")
 
 
-def get_backend() -> TpuInfoBackend:
-    """Select backend by TPU_DRA_TPUINFO_BACKEND: 'fake' (default when no
-    accel devices present), 'native'."""
+def probe_jax_tpu_devices() -> Optional[Tuple[int, str]]:
+    """(device_count, device_kind) when this process's JAX has *already*
+    initialized a TPU backend; None otherwise. Deliberately never triggers
+    backend initialization itself — that is seconds of work (and possibly a
+    hard failure) the driver's hot path must not absorb."""
+    jax_mod = sys.modules.get("jax")
+    if jax_mod is None:
+        return None
+    try:
+        from jax._src import xla_bridge
+        initialized = getattr(xla_bridge, "backends_are_initialized",
+                              lambda: bool(getattr(xla_bridge, "_backends", None)))
+        if not initialized():
+            return None
+        if jax_mod.default_backend() != "tpu":
+            return None
+        devs = jax_mod.devices()
+        return len(devs), getattr(devs[0], "device_kind", "")
+    except Exception:  # noqa: BLE001 — probe is advisory only
+        return None
+
+
+def get_backend(jax_tpu_devices: Optional[int] = None) -> TpuInfoBackend:
+    """Select backend by TPU_DRA_TPUINFO_BACKEND: 'fake', 'native', or
+    'auto' (native when an accel sysfs class exists, else fake).
+
+    Auto-selection **refuses** to serve fake chips on a host where JAX has
+    a real TPU backend (passed via `jax_tpu_devices`, or probed from an
+    already-initialized in-process JAX): fake inventory on real hardware
+    means every claim the driver prepares lies about the machine
+    (round-1 failure mode — psum ran on 1 real device while the claim
+    said 4 fake chips). Set TPU_DRA_TPUINFO_BACKEND=fake to override
+    explicitly.
+    """
     choice = os.environ.get("TPU_DRA_TPUINFO_BACKEND", "auto")
     if choice == "fake":
         return FakeBackend()
@@ -379,4 +443,19 @@ def get_backend() -> TpuInfoBackend:
     root = os.environ.get("TPUINFO_SYSFS_ROOT", "")
     if os.path.isdir(os.path.join(root or "/", "sys", "class", "accel")):
         return NativeBackend(sysfs_root=root)
+    if jax_tpu_devices is None:
+        probed = probe_jax_tpu_devices()
+        jax_tpu_devices = probed[0] if probed else 0
+    if jax_tpu_devices:
+        raise RuntimeError(
+            f"get_backend(auto): this host exposes {jax_tpu_devices} real "
+            "TPU device(s) through JAX/libtpu but no accel sysfs class dir "
+            "for the native backend; refusing to silently serve fake chips "
+            "on real hardware. Set TPUINFO_SYSFS_ROOT to the accel tree, or "
+            "TPU_DRA_TPUINFO_BACKEND=fake to run with fake inventory "
+            "deliberately.")
+    logger.warning(
+        "get_backend(auto): no accel sysfs and no TPU visible to JAX — "
+        "serving the fake chip backend (TPU_DRA_FAKE_CHIPS=%s)",
+        os.environ.get("TPU_DRA_FAKE_CHIPS", "4"))
     return FakeBackend()

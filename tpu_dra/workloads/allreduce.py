@@ -59,15 +59,25 @@ def allreduce_bandwidth(nbytes_per_device: int = 64 << 20,
             lambda s: jax.lax.psum(s, "x"),
             mesh=mesh, in_specs=P("x"), out_specs=P(None))(v)
 
-    # Warmup covers compile (first TPU compile ~20-40s) + cache effects.
-    for _ in range(warmup):
-        step(x).block_until_ready()
+    def run(n: int) -> float:
+        """Time n psums + a scalar fetch. A scalar fetch is the only
+        synchronization barrier that holds on every PJRT backend
+        (block_until_ready is a no-op on remote-tunnel platforms); device
+        streams execute in order, so the last psum's scalar implies all n
+        completed. The fetch round-trip is constant and cancels in the
+        two-point measurement below."""
+        t0 = time.perf_counter()
+        out = x
+        for _ in range(n):
+            out = step(x)
+        float(out[(0,) * out.ndim])
+        return time.perf_counter() - t0
 
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = step(x)
-    out.block_until_ready()
-    mean_s = (time.perf_counter() - t0) / iters
+    # Warmup covers compile (first TPU compile ~20-40s) + cache effects.
+    for _ in range(max(1, warmup)):
+        run(1)
+    t_small, t_big = run(1), run(1 + iters)
+    mean_s = max((t_big - t_small) / iters, 1e-9)
 
     payload = x.dtype.itemsize * x.shape[1]  # bytes contributed per device
     algo_gbps = payload / mean_s / 1e9
