@@ -75,14 +75,12 @@ def pick_backend(jax_probe):
     the real JAX TPU device set when TPUs are visible without sysfs (this
     image's tunnel case), default fake otherwise.
     Returns (backend, descriptor)."""
-    from tpu_dra.native.tpuinfo import FakeBackend, default_fake_chips, get_backend
+    from tpu_dra.native.tpuinfo import (
+        FakeBackend, default_fake_chips, get_backend, has_accel_sysfs,
+    )
 
     choice = os.environ.get("TPU_DRA_TPUINFO_BACKEND", "auto")
-    if choice != "auto":
-        be = get_backend()
-        return be, be.kind
-    root = os.environ.get("TPUINFO_SYSFS_ROOT", "")
-    if os.path.isdir(os.path.join(root or "/", "sys", "class", "accel")):
+    if choice != "auto" or has_accel_sysfs():
         be = get_backend()
         return be, be.kind
     if jax_probe and jax_probe["platform"] == "tpu":

@@ -283,3 +283,51 @@ def _exists(cluster, gvr, name, ns=None):
         return True
     except NotFoundError:
         return False
+
+
+class TestDaemonSetUpgrade:
+    def test_existing_daemonset_converges_on_new_template(self):
+        """Controller upgrades must reach running CDs: on AlreadyExists the
+        stamped DaemonSet is compared against the fresh template and
+        updated when it differs (reference daemonset.go:340; ADVICE r1:
+        stamped objects were create-only)."""
+        cluster = FakeCluster()
+        c1 = Controller(cluster, namespace=NS, image="img:v1",
+                        gc_interval=3600.0)
+        c1.start()
+        try:
+            cd = make_cd(cluster)
+            dsname = daemon_object_name(cd)
+            assert cluster.wait_for(
+                lambda: _exists(cluster, DAEMONSETS, dsname, NS))
+        finally:
+            c1.stop()
+
+        c2 = Controller(cluster, namespace=NS, image="img:v2",
+                        gc_interval=3600.0)
+        c2.start()
+        try:
+            c2.enqueue(cd["metadata"]["uid"])
+
+            def image():
+                ds = cluster.get(DAEMONSETS, dsname, NS)
+                return ds["spec"]["template"]["spec"]["containers"][0]["image"]
+
+            assert cluster.wait_for(lambda: image() == "img:v2")
+        finally:
+            c2.stop()
+
+    def test_unchanged_daemonset_not_rewritten(self, harness):
+        """Subset comparison: a reconcile with an identical template must
+        not churn the object (server defaulting would otherwise cause a
+        perpetual update loop)."""
+        cluster = harness["cluster"]
+        cd = make_cd(cluster)
+        dsname = daemon_object_name(cd)
+        assert cluster.wait_for(lambda: _exists(cluster, DAEMONSETS, dsname, NS))
+        rv = cluster.get(DAEMONSETS, dsname, NS)["metadata"]["resourceVersion"]
+        harness["controller"].enqueue(cd["metadata"]["uid"])
+        import time
+        time.sleep(0.3)
+        assert (cluster.get(DAEMONSETS, dsname, NS)["metadata"]
+                ["resourceVersion"] == rv)

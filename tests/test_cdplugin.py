@@ -340,3 +340,42 @@ class TestCheckpointGC:
                                cd_manager=harness["cd_manager"])
         assert gc.sweep() == 1  # old uid gone (uid comparison, not name)
         assert uid not in harness["state"].prepared_claim_uids()
+
+
+class TestUnprepareRetry:
+    def test_label_survives_failed_unprepare_for_kubelet_retry(self, harness):
+        """Side-effect rollback must precede checkpoint removal: if label
+        removal fails transiently, kubelet's unprepare retry still finds
+        the claim and completes the cleanup (ADVICE r1: deleting the record
+        first made the retry a no-op and leaked the label forever)."""
+        cluster = harness["cluster"]
+        cd = make_cd(cluster)
+        register_node(cluster, cd, "node-a", "10.0.0.1", ready=True)
+        claim = make_channel_claim(cluster, cd)
+        assert prepare(harness, claim).error == ""
+
+        mgr = harness["cd_manager"]
+        real = mgr.remove_node_label
+        calls = {"n": 0}
+
+        def flaky(uid):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("transient api error")
+            return real(uid)
+
+        mgr.remove_node_label = flaky
+        try:
+            err = unprepare(harness, claim)
+            assert "remove node label" in err
+            # Claim record retained -> the retry has state to finish with.
+            assert (claim["metadata"]["uid"]
+                    in harness["state"].prepared_claim_uids())
+            # Retry (kubelet re-calls unprepare) completes the cleanup.
+            assert unprepare(harness, claim) == ""
+        finally:
+            mgr.remove_node_label = real
+        assert (claim["metadata"]["uid"]
+                not in harness["state"].prepared_claim_uids())
+        node = cluster.get(NODES, "node-a")
+        assert LABEL not in (node["metadata"].get("labels") or {})

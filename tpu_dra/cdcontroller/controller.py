@@ -41,6 +41,19 @@ teardowns_total = DefaultRegistry.counter(
 UID_INDEX = "uid"
 CD_LABEL_INDEX = "cd-uid"
 
+# Annotation recording the hash of the template a stamped DaemonSet was
+# last written from (kubectl last-applied analog): comparing hashes detects
+# every template change — including removed fields — without being fooled
+# by server-side defaulting of fields the template never set.
+TEMPLATE_HASH_ANNOTATION = "resource.tpu.dev/template-hash"
+
+
+def _template_hash(spec: Dict) -> str:
+    import hashlib
+    import json
+    return hashlib.sha256(
+        json.dumps(spec, sort_keys=True).encode()).hexdigest()[:16]
+
 
 class RetryableError(Exception):
     """Raised to push the reconcile back onto the rate-limited queue."""
@@ -207,6 +220,9 @@ class Controller:
              cd["metadata"].get("namespace", "default")),
         ):
             obj = build()
+            if gvr is DAEMONSETS:
+                obj["metadata"].setdefault("annotations", {})[
+                    TEMPLATE_HASH_ANNOTATION] = _template_hash(obj["spec"])
             if not obj["metadata"].get("name"):
                 # spec.channel.resourceClaimTemplate.name unset: without it
                 # the create would 422 on every reconcile. The webhook is the
@@ -218,12 +234,47 @@ class Controller:
             try:
                 created = self._client.create(gvr, obj, namespace=obj_ns)
             except AlreadyExistsError:
+                # DaemonSets get the reference's explicit update path
+                # (daemonset.go:340) so controller upgrades (new image,
+                # gates, max-nodes) reach running CDs; RCT specs are
+                # immutable upstream and stay create-only.
+                if gvr is DAEMONSETS:
+                    self._sync_stamped_daemonset(obj, obj_ns)
                 continue
             # Mutation cache: see our own write before the watch lands.
             if gvr is DAEMONSETS:
                 self.ds_informer.update_cache(created)
             else:
                 self.rct_informer.update_cache(created)
+
+    def _sync_stamped_daemonset(self, want: Dict, ns: str) -> None:
+        """Converge an existing per-CD DaemonSet onto the freshly built
+        template when the recorded template hash differs (a missing hash —
+        pre-upgrade object — converges once and gains the annotation)."""
+        name = want["metadata"]["name"]
+        try:
+            existing = self._client.get(DAEMONSETS, name, ns)
+        except NotFoundError:
+            raise RetryableError(
+                f"daemonset {name} vanished between create-conflict and get")
+        want_hash = want["metadata"]["annotations"][TEMPLATE_HASH_ANNOTATION]
+        have_hash = (existing["metadata"].get("annotations") or {}).get(
+            TEMPLATE_HASH_ANNOTATION)
+        if have_hash == want_hash:
+            return
+        fresh = dict(existing)
+        fresh["spec"] = want["spec"]
+        fresh["metadata"] = dict(existing["metadata"])
+        fresh["metadata"]["annotations"] = dict(
+            existing["metadata"].get("annotations") or {},
+            **{TEMPLATE_HASH_ANNOTATION: want_hash})
+        try:
+            updated = self._client.update(DAEMONSETS, fresh, namespace=ns)
+        except ConflictError as e:
+            raise RetryableError(f"daemonset {name} update conflict: {e}") \
+                from e
+        self.ds_informer.update_cache(updated)
+        log.info("daemonset %s/%s converged onto current template", ns, name)
 
     def _update_readiness(self, cd: Dict) -> None:
         """daemonset.go:362-389: global CD status follows DaemonSet

@@ -13,9 +13,11 @@ from __future__ import annotations
 import logging
 import queue
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 from tpu_dra.api import types as apitypes
+from tpu_dra.infra.workqueue import default_cd_daemon_rate_limiter
 from tpu_dra.k8s import ApiClient, COMPUTEDOMAINS
 from tpu_dra.k8s.client import ConflictError, NotFoundError
 from tpu_dra.k8s.informer import Informer
@@ -85,9 +87,13 @@ class ComputeDomainManager:
                 f"computedomain {self._cd_name} uid changed")
         return cd
 
-    def ensure_node_info(self, retries: int = 10) -> int:
+    def ensure_node_info(self, retries: int = 20) -> int:
         """Insert/refresh this node in the CD status; returns the stable
-        index. Conflict-retried: many daemons race on one status object."""
+        index. Conflict-retried with jittered exponential backoff: at
+        fleet startup up to max_nodes daemons race writes on one status
+        object, and a tight loop exhausts its budget and crashes the pod
+        (the reference drives this through DefaultCDDaemonRateLimiter)."""
+        backoff = default_cd_daemon_rate_limiter()
         for _ in range(retries):
             cd = self._get_cd()
             status = cd.setdefault("status", {})
@@ -124,12 +130,14 @@ class ComputeDomainManager:
                 self.index = index
                 return index
             except ConflictError:
+                time.sleep(backoff.when(0))
                 continue
         raise ConflictError(
             f"could not register node {self._node_name} after {retries} tries")
 
-    def remove_node_info(self, retries: int = 10) -> None:
+    def remove_node_info(self, retries: int = 20) -> None:
         """Self-removal on shutdown (computedomain.go:386-434)."""
+        backoff = default_cd_daemon_rate_limiter()
         for _ in range(retries):
             try:
                 cd = self._get_cd()
@@ -144,6 +152,7 @@ class ComputeDomainManager:
                 self._client.update_status(COMPUTEDOMAINS, cd)
                 return
             except ConflictError:
+                time.sleep(backoff.when(0))
                 continue
         # A silently stale registration holds the index and keeps the node
         # counted Ready; surface the failure to the caller.
@@ -151,11 +160,12 @@ class ComputeDomainManager:
             f"could not deregister node {self._node_name} after "
             f"{retries} tries")
 
-    def set_node_status(self, ready: bool, retries: int = 10) -> None:
+    def set_node_status(self, ready: bool, retries: int = 20) -> None:
         """Mirror local daemon readiness into the per-node status field
         (podmanager.go:35-120)."""
         want = (apitypes.COMPUTE_DOMAIN_STATUS_READY if ready
                 else apitypes.COMPUTE_DOMAIN_STATUS_NOT_READY)
+        backoff = default_cd_daemon_rate_limiter()
         for _ in range(retries):
             try:
                 cd = self._get_cd()
@@ -171,6 +181,7 @@ class ComputeDomainManager:
                 self._client.update_status(COMPUTEDOMAINS, cd)
                 return
             except ConflictError:
+                time.sleep(backoff.when(0))
                 continue
         # Surface exhaustion so the caller retries (a silent return would
         # let the readiness loop record the mirror as done).

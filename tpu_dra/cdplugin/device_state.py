@@ -207,22 +207,36 @@ class DeviceState:
                 return None
             cd_uids = {d.get("cd_uid") for d in prepared.devices
                        if d.get("type") == deviceinfo.DEVICE_TYPE_CHANNEL}
-            self._cdi.delete_claim_spec_file(claim_uid)
-            del self._checkpoint.claims[claim_uid]
-            self._ckpt_mgr.store(self._checkpoint)
             # Last channel claim for a CD releases the node from the domain
             # (the daemon settings/dir GC is deferred, §3.4).
             still_used = {
                 d.get("cd_uid")
-                for c in self._checkpoint.claims.values()
+                for uid, c in self._checkpoint.claims.items()
+                if uid != claim_uid
                 for d in c.devices
                 if d.get("type") == deviceinfo.DEVICE_TYPE_CHANNEL}
+        # Side effects are rolled back *before* the claim leaves the
+        # checkpoint: if label removal fails transiently, kubelet's
+        # unprepare retry still finds the claim and completes the cleanup
+        # (the reference orders unprepare work before checkpoint removal
+        # for the same reason, cd device_state.go:208-278). Deleting the
+        # record first would make the retry a no-op and leak the label,
+        # pinning the daemon pod and blocking other CDs on this node.
         for cd_uid in cd_uids - still_used:
             if cd_uid:
                 try:
                     self._cd.remove_node_label(cd_uid)
                 except Exception as e:  # noqa: BLE001
                     return f"remove node label for {cd_uid}: {e}"
+        with self._lock:
+            if claim_uid not in self._checkpoint.claims:
+                return None
+            # Spec-file delete precedes the pop: if it raises, the claim is
+            # still checkpointed and the kubelet retry can finish; popping
+            # first would diverge memory from disk and leak the spec file.
+            self._cdi.delete_claim_spec_file(claim_uid)
+            del self._checkpoint.claims[claim_uid]
+            self._ckpt_mgr.store(self._checkpoint)
         return None
 
     # ------------------------------------------------------------------

@@ -207,6 +207,17 @@ class HttpApiClient(ApiClient):
             if e.code == 404:
                 raise NotFoundError(msg) from e
             if e.code == 409:
+                # A real apiserver returns 409 for both optimistic-concurrency
+                # conflicts and create-on-existing; distinguish by the Status
+                # body's reason (client-go errors.IsAlreadyExists analog) so
+                # callers' `except AlreadyExistsError` works over HTTP too.
+                reason = ""
+                try:
+                    reason = json.loads(msg).get("reason", "")
+                except (ValueError, AttributeError):
+                    pass
+                if reason == "AlreadyExists" or "already exists" in msg:
+                    raise AlreadyExistsError(msg) from e
                 raise ConflictError(msg) from e
             raise ApiError(e.code, msg) from e
 

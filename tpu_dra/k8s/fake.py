@@ -50,6 +50,9 @@ class FakeCluster(ApiClient):
         # [(rv, gvr_key, ns, event_type, obj)] — replayed for watches that
         # resume from an older resourceVersion.
         self._events: List[Tuple[int, str, str, str, Dict]] = []
+        # Highest RV dropped from the bounded log: a resume from at or
+        # below it has a hole and must get 410 Gone, not a silent skip.
+        self._trimmed_rv = 0
         # Hooks for tests: callables (verb, gvr, obj) -> obj|None run before
         # the verb; raising simulates apiserver errors (webhook analog).
         self.reactors = []
@@ -71,7 +74,9 @@ class FakeCluster(ApiClient):
         rv = int(obj.get("metadata", {}).get("resourceVersion", "0") or 0)
         self._events.append((rv, gvr.key, ns, event_type, copy.deepcopy(obj)))
         if len(self._events) > self.EVENT_LOG_CAP:
-            del self._events[:len(self._events) - self.EVENT_LOG_CAP]
+            cut = len(self._events) - self.EVENT_LOG_CAP
+            self._trimmed_rv = max(self._trimmed_rv, self._events[cut - 1][0])
+            del self._events[:cut]
         labels = obj.get("metadata", {}).get("labels", {}) or {}
         for w in list(self._watchers):
             if w.closed or w.gvr_key != gvr.key:
@@ -219,6 +224,7 @@ class FakeCluster(ApiClient):
               resource_version=None, stop=None
               ) -> Generator[Tuple[str, Dict], None, None]:
         w = _Watcher(gvr.key, namespace if gvr.namespaced else None, label_selector)
+        gone = False
         with self._lock:
             # Atomically: replay events after resource_version, then go
             # live — no gap in which an event can be lost.
@@ -227,17 +233,31 @@ class FakeCluster(ApiClient):
                     since = int(resource_version)
                 except ValueError:
                     since = 0
-                for rv, gvr_key, ns, event_type, obj in self._events:
-                    if rv <= since or gvr_key != gvr.key:
-                        continue
-                    if (w.namespace and gvr.namespaced
-                            and w.namespace != ns):
-                        continue
-                    labels = obj.get("metadata", {}).get("labels", {}) or {}
-                    if not label_selector_matches(label_selector, labels):
-                        continue
-                    w.events.put((event_type, copy.deepcopy(obj)))
-            self._watchers.append(w)
+                if since < self._trimmed_rv:
+                    # History trimmed past the resume point: events between
+                    # `since` and the oldest retained RV are unrecoverable.
+                    # Real apiserver semantics: 410 Gone, client relists.
+                    gone = True
+                else:
+                    for rv, gvr_key, ns, event_type, obj in self._events:
+                        if rv <= since or gvr_key != gvr.key:
+                            continue
+                        if (w.namespace and gvr.namespaced
+                                and w.namespace != ns):
+                            continue
+                        labels = obj.get("metadata", {}).get("labels", {}) or {}
+                        if not label_selector_matches(label_selector, labels):
+                            continue
+                        w.events.put((event_type, copy.deepcopy(obj)))
+            if not gone:
+                self._watchers.append(w)
+        if gone:
+            yield ("ERROR", {
+                "kind": "Status", "apiVersion": "v1", "status": "Failure",
+                "code": 410, "reason": "Expired",
+                "message": f"too old resource version: {resource_version} "
+                           f"({self._trimmed_rv})"})
+            return
         try:
             while stop is None or not stop.is_set():
                 try:

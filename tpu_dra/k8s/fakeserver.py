@@ -26,7 +26,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
 
 from tpu_dra.k8s import resources
-from tpu_dra.k8s.client import ApiError, GVR, NotFoundError
+from tpu_dra.k8s.client import (
+    AlreadyExistsError, ApiError, ConflictError, GVR, NotFoundError,
+)
 from tpu_dra.k8s.fake import FakeCluster
 
 # Registry of resources the server routes (plural -> GVR); mirrors
@@ -96,10 +98,26 @@ class FakeApiServer:
                 self.end_headers()
                 self.wfile.write(body)
 
-            def _error(self, code: int, message: str):
-                self._send_json(code, {
+            def _error(self, code: int, message: str, reason: str = ""):
+                doc = {
                     "kind": "Status", "apiVersion": "v1", "code": code,
-                    "status": "Failure", "message": message})
+                    "status": "Failure", "message": message}
+                if reason:
+                    doc["reason"] = reason
+                self._send_json(code, doc)
+
+            def _api_error(self, e: ApiError):
+                # Mirror a real apiserver's Status reason so HTTP clients
+                # can distinguish AlreadyExists from update conflicts
+                # (client-go errors.IsAlreadyExists analog).
+                reason = ""
+                if isinstance(e, AlreadyExistsError):
+                    reason = "AlreadyExists"
+                elif isinstance(e, ConflictError):
+                    reason = "Conflict"
+                elif isinstance(e, NotFoundError):
+                    reason = "NotFound"
+                return self._error(e.status, e.message, reason)
 
             def _body(self) -> Dict:
                 length = int(self.headers.get("Content-Length", 0))
@@ -161,7 +179,7 @@ class FakeApiServer:
                                                    namespace=ns)
                     return self._send_json(201, created)
                 except ApiError as e:
-                    return self._error(e.status, e.message)
+                    return self._api_error(e)
 
             def do_PUT(self):  # noqa: N802
                 parsed = _parse_path(urllib.parse.urlparse(self.path).path)
@@ -177,7 +195,7 @@ class FakeApiServer:
                                                    namespace=ns)
                     return self._send_json(200, out)
                 except ApiError as e:
-                    return self._error(e.status, e.message)
+                    return self._api_error(e)
 
             def do_PATCH(self):  # noqa: N802
                 parsed = _parse_path(urllib.parse.urlparse(self.path).path)
@@ -189,7 +207,7 @@ class FakeApiServer:
                                               namespace=ns)
                     return self._send_json(200, out)
                 except ApiError as e:
-                    return self._error(e.status, e.message)
+                    return self._api_error(e)
 
             def do_DELETE(self):  # noqa: N802
                 parsed = _parse_path(urllib.parse.urlparse(self.path).path)

@@ -200,6 +200,12 @@ class Informer:
                 resource_version=list_rv or None, stop=self._stop):
             if self._stop.is_set():
                 return
+            if event_type == "ERROR":
+                # Checked before the field filter: the ERROR payload is a
+                # Status (no metadata), which any filter would reject. 410
+                # Gone or any server-side stream error: raise so _run
+                # relists instead of continuing on a stream with a hole.
+                raise RuntimeError(f"watch stream error: {obj}")
             if not self._accepts(obj):
                 continue
             if event_type == "ADDED":

@@ -422,6 +422,14 @@ def probe_jax_tpu_devices() -> Optional[Tuple[int, str]]:
         return None
 
 
+def has_accel_sysfs(root: Optional[str] = None) -> bool:
+    """Single source of truth for 'this host has the accel driver's sysfs
+    class' — used by get_backend(auto) and the bench's backend picker."""
+    if root is None:
+        root = os.environ.get("TPUINFO_SYSFS_ROOT", "")
+    return os.path.isdir(os.path.join(root or "/", "sys", "class", "accel"))
+
+
 def get_backend(jax_tpu_devices: Optional[int] = None) -> TpuInfoBackend:
     """Select backend by TPU_DRA_TPUINFO_BACKEND: 'fake', 'native', or
     'auto' (native when an accel sysfs class exists, else fake).
@@ -440,9 +448,9 @@ def get_backend(jax_tpu_devices: Optional[int] = None) -> TpuInfoBackend:
     if choice == "native":
         return NativeBackend(sysfs_root=os.environ.get("TPUINFO_SYSFS_ROOT", ""))
     # auto: native when a real accel class dir exists, else fake
-    root = os.environ.get("TPUINFO_SYSFS_ROOT", "")
-    if os.path.isdir(os.path.join(root or "/", "sys", "class", "accel")):
-        return NativeBackend(sysfs_root=root)
+    if has_accel_sysfs():
+        return NativeBackend(
+            sysfs_root=os.environ.get("TPUINFO_SYSFS_ROOT", ""))
     if jax_tpu_devices is None:
         probed = probe_jax_tpu_devices()
         jax_tpu_devices = probed[0] if probed else 0
