@@ -284,10 +284,15 @@ def bench_psum(jax_probe, visible_chips: str):
     by_id = {d.id: d for d in all_devices}
     missing = [i for i in want if i not in by_id]
     resolved = [by_id[i] for i in want if i in by_id]
-    # Coverage counts *claimed chips actually measured* — computed before
-    # any fallback so it can't read N/N when the claim didn't resolve.
-    coverage = f"{len(resolved)}/{len(want) or len(all_devices)}"
-    devices = resolved or list(all_devices)
+    if not resolved:
+        # No claimed chip maps to a JAX device: measuring the full device
+        # set here would report bandwidth for hardware the claim did not
+        # allocate. That is an error, not a fallback.
+        raise RuntimeError(
+            f"no claimed chip resolved to a JAX device (claimed={want}, "
+            f"jax_device_ids={sorted(by_id)})")
+    coverage = f"{len(resolved)}/{len(want)}"
+    devices = resolved
     on_tpu = devices[0].platform == "tpu"
     payload = (64 << 20) if on_tpu else (4 << 20)
     r = allreduce_bandwidth(nbytes_per_device=payload, iters=10, warmup=3,
@@ -361,11 +366,18 @@ def bench_mfu(jax_probe, steps: int = 10):
     # Trained tokens per step: the loss consumes seq-1 positions.
     tokens_per_step = batch * (cfg.max_seq - 1)
     # Standard matmul-FLOPs accounting: 6*N per trained token (fwd+bwd)
-    # plus causal attention score/value matmuls, 6*L*S*D per token.
-    flops_per_token = 6 * n_params + 6 * cfg.n_layers * cfg.max_seq * cfg.d_model
+    # over *matmul-participating* params plus causal attention score/value
+    # matmuls, 6*L*S*D per token. The input embedding table is excluded
+    # from the 6N term: its forward op is a gather, not a matmul (the
+    # unembed projection is a real matmul and stays). Counting the gather
+    # table inflated round-2 MFU by ~12%.
+    matmul_params = n_params - cfg.vocab * cfg.d_model
+    flops_per_token = (6 * matmul_params
+                       + 6 * cfg.n_layers * cfg.max_seq * cfg.d_model)
     step_tflops = flops_per_token * tokens_per_step / step_s / 1e12
     out = {
         "mfu_model_params": int(n_params),
+        "mfu_matmul_params": int(matmul_params),
         "train_step_s": round(step_s, 4),
         "tokens_per_s": round(tokens_per_step / step_s, 1),
         "step_tflops_per_s": round(step_tflops, 2),

@@ -1,0 +1,53 @@
+"""bench.py measurement-honesty regression tests (ADVICE r2, VERDICT r2).
+
+The bench is the artifact the judge reads; these tests pin the two
+accounting rules it must uphold:
+- psum coverage: measuring devices the claim did not allocate is an error,
+  never a silent fallback;
+- MFU: the input-embedding gather table is excluded from the 6N matmul-FLOPs
+  term (counting it inflated round-2 MFU by ~12%).
+"""
+
+import pytest
+
+import bench
+
+
+class FakeDevice:
+    def __init__(self, id_, platform="cpu"):
+        self.id = id_
+        self.platform = platform
+
+
+class TestPsumCoverage:
+    def test_unresolvable_claim_raises_instead_of_measuring_all(self):
+        probe = {"devices": [FakeDevice(0), FakeDevice(1)], "platform": "cpu"}
+        with pytest.raises(RuntimeError, match="no claimed chip resolved"):
+            bench.bench_psum(probe, visible_chips="7,9")
+
+    def test_empty_claim_raises(self):
+        probe = {"devices": [FakeDevice(0)], "platform": "cpu"}
+        with pytest.raises(RuntimeError, match="no claimed chip resolved"):
+            bench.bench_psum(probe, visible_chips="")
+
+    def test_partial_resolution_reports_partial_coverage(self):
+        import jax
+        real = jax.devices()[:1]
+        probe = {"devices": real, "platform": real[0].platform}
+        # Claim chip 0 (resolvable) and 99 (not): measured over chip 0 only,
+        # coverage says 1/2 and the error is surfaced.
+        r = bench.bench_psum(probe, visible_chips="0,99")
+        assert r["coverage"] == "1/2"
+        assert "99" in r["coverage_error"]
+        assert r["n_devices"] == 1.0
+
+
+class TestMfuAccounting:
+    def test_embedding_gather_excluded_from_6n(self):
+        # Force the CPU-tier config regardless of what hardware probe_jax
+        # found (this test may run on a TPU host): bench_mfu branches on
+        # platform, and the small config's embed table is 512*128.
+        probe = {**bench.probe_jax(), "platform": "cpu", "generation": None}
+        out = bench.bench_mfu(probe, steps=2)
+        assert out["mfu_matmul_params"] == out["mfu_model_params"] - 512 * 128
+        assert out["step_tflops_per_s"] > 0

@@ -276,6 +276,52 @@ class TestChannelExclusivity:
         assert LABEL not in (node["metadata"].get("labels") or {})
 
 
+class TestConcurrentUnprepare:
+    def test_concurrent_last_two_claims_release_label(self, harness):
+        """Two concurrent unprepares of the last two channel claims of one
+        CD must still release the node label (ADVICE r2 medium): without
+        whole-method serialization, each could see the other's claim still
+        checkpointed, both would skip remove_node_label, and the label
+        would leak with no kubelet retry left."""
+        cluster = harness["cluster"]
+        mgr = harness["cd_manager"]
+        real_remove = mgr.remove_node_label
+        calls = {"n": 0}
+
+        def counting_remove(uid):
+            calls["n"] += 1
+            return real_remove(uid)
+
+        mgr.remove_node_label = counting_remove
+        try:
+            for round_ in range(5):
+                cd = make_cd(cluster, name=f"cd-conc-{round_}")
+                register_node(cluster, cd, "node-a", "10.0.0.1", ready=True)
+                c1 = make_channel_claim(cluster, cd, devices=("channel-1",))
+                c2 = make_channel_claim(cluster, cd, devices=("channel-2",))
+                assert prepare(harness, c1).error == ""
+                assert prepare(harness, c2).error == ""
+                calls["n"] = 0
+                errs = {}
+                ts = [threading.Thread(
+                          target=lambda c=c, i=i: errs.__setitem__(
+                              i, unprepare(harness, c)))
+                      for i, c in enumerate((c1, c2))]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join(timeout=10)
+                assert errs == {0: "", 1: ""}
+                # Serialized unprepare: the one that ran second saw an empty
+                # still_used set and released the label.
+                assert calls["n"] >= 1
+                node = cluster.get(NODES, "node-a")
+                assert LABEL not in (node["metadata"].get("labels") or {})
+                cluster.delete(COMPUTEDOMAINS, cd["metadata"]["name"], NS)
+        finally:
+            mgr.remove_node_label = real_remove
+
+
 class TestDaemonPrepare:
     def test_domain_dir_and_env(self, harness):
         cluster = harness["cluster"]
@@ -326,6 +372,27 @@ class TestCheckpointGC:
         cluster.delete(RESOURCECLAIMS, claim["metadata"]["name"], NS)
         assert gc.sweep() == 1
         assert uid not in harness["state"].prepared_claim_uids()
+
+    def test_gc_drop_releases_leaked_node_label(self, harness):
+        """An abandoned PREPARE_STARTED claim added the node label before
+        its ResourceClaim was deleted; kubelet will never unprepare it, so
+        GC's drop must run the same last-claim label accounting as
+        unprepare — otherwise the label leaks forever (code-review r3)."""
+        cluster = harness["cluster"]
+        cd = make_cd(cluster)
+        register_node(cluster, cd, "node-a", "10.0.0.1", ready=False)
+        claim = make_channel_claim(cluster, cd)
+        res = prepare(harness, claim)  # label added, readiness never comes
+        assert "exhausted" in res.error
+        node = cluster.get(NODES, "node-a")
+        assert (node["metadata"].get("labels") or {}).get(LABEL) \
+            == cd["metadata"]["uid"]
+        cluster.delete(RESOURCECLAIMS, claim["metadata"]["name"], NS)
+        gc = CheckpointCleanup(client=cluster, state=harness["state"],
+                               cd_manager=harness["cd_manager"])
+        assert gc.sweep() == 1
+        node = cluster.get(NODES, "node-a")
+        assert LABEL not in (node["metadata"].get("labels") or {})
 
     def test_recreated_same_name_claim_not_collected(self, harness):
         cluster = harness["cluster"]

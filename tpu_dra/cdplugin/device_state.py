@@ -42,6 +42,22 @@ class DeviceState:
         self._node_name = node_name
         self._slice_id = slice_id
         self._lock = threading.Lock()
+        # Serializes every checkpoint-read→label-write sequence: unprepare()
+        # end-to-end, and prepare's checkpoint-record + add_node_label pair.
+        # still_used is computed from the checkpoint and then acted on
+        # outside self._lock (label removal is a network call); without this
+        # mutex, (a) two concurrent unprepares of the last two channel
+        # claims of one CD can each see the other still checkpointed, both
+        # skip remove_node_label, and the label leaks with no kubelet retry
+        # left; (b) an in-flight unprepare that computed still_used == {}
+        # can remove the label *after* a concurrent prepare checkpointed a
+        # new claim and added it. One node-global lock is deliberate — the
+        # reference holds a per-node flock across entire prepare/unprepare
+        # calls for the same reason (gpu driver.go:49-116); the held section
+        # here is one checkpoint read plus at most one label API call, and a
+        # hung API server stalls kubelet's envelope either way. Ordering:
+        # _label_lock is always taken outside self._lock.
+        self._label_lock = threading.Lock()
         self._checkpoint = self._ckpt_mgr.load_or_init()
 
     # ------------------------------------------------------------------
@@ -101,25 +117,31 @@ class DeviceState:
 
         channel_ids = [deviceinfo.parse_channel_id(r["device"])
                        for r in results]
-        with self._lock:
-            self._assert_channels_free(uid, channel_ids)
-            # Record intent before side effects (crash consistency).
-            self._checkpoint.claims[uid] = PreparedClaim(
-                uid=uid, state=PREPARE_STARTED,
-                name=claim["metadata"].get("name", ""), namespace=ns)
-            self._checkpoint.claims[uid].devices = [{
-                "type": deviceinfo.DEVICE_TYPE_CHANNEL,
-                "device": r["device"],
-                "request": r.get("request", ""),
-                "channel_id": deviceinfo.parse_channel_id(r["device"]),
-                "cd_uid": config.domain_id,
-                "pool": self._node_name,
-                "cdi_ids": [self._cdi.get_claim_device(uid)],
-            } for r in results]
-            self._ckpt_mgr.store(self._checkpoint)
+        # _label_lock spans checkpoint-record + add_node_label so a
+        # concurrent unprepare of this CD's last old claim cannot compute
+        # still_used == {} before this claim is recorded and then strip the
+        # label after we add it (see __init__). The long readiness wait
+        # below is NOT under the lock.
+        with self._label_lock:
+            with self._lock:
+                self._assert_channels_free(uid, channel_ids)
+                # Record intent before side effects (crash consistency).
+                self._checkpoint.claims[uid] = PreparedClaim(
+                    uid=uid, state=PREPARE_STARTED,
+                    name=claim["metadata"].get("name", ""), namespace=ns)
+                self._checkpoint.claims[uid].devices = [{
+                    "type": deviceinfo.DEVICE_TYPE_CHANNEL,
+                    "device": r["device"],
+                    "request": r.get("request", ""),
+                    "channel_id": deviceinfo.parse_channel_id(r["device"]),
+                    "cd_uid": config.domain_id,
+                    "pool": self._node_name,
+                    "cdi_ids": [self._cdi.get_claim_device(uid)],
+                } for r in results]
+                self._ckpt_mgr.store(self._checkpoint)
 
-        # Label first (this is what summons the daemon pod), then wait.
-        self._cd.add_node_label(config.domain_id)
+            # Label first (this is what summons the daemon pod), then wait.
+            self._cd.add_node_label(config.domain_id)
         cd = self._cd.assert_node_ready(config.domain_id)  # raises retryable
 
         env = self._cd.workload_env(cd, channel_ids, config.allocation_mode)
@@ -201,6 +223,11 @@ class DeviceState:
     # ------------------------------------------------------------------
 
     def unprepare(self, claim_uid: str) -> Optional[str]:
+        # Whole-method serialization: see _label_lock in __init__.
+        with self._label_lock:
+            return self._unprepare_locked(claim_uid)
+
+    def _unprepare_locked(self, claim_uid: str) -> Optional[str]:
         with self._lock:
             prepared = self._checkpoint.claims.get(claim_uid)
             if prepared is None:
@@ -261,9 +288,13 @@ class DeviceState:
             return copy.deepcopy(self._checkpoint)
 
     def drop_claim(self, claim_uid: str) -> None:
-        """Checkpoint GC hook (cleanup.py)."""
-        with self._lock:
-            if claim_uid in self._checkpoint.claims:
-                self._cdi.delete_claim_spec_file(claim_uid)
-                del self._checkpoint.claims[claim_uid]
-                self._ckpt_mgr.store(self._checkpoint)
+        """Checkpoint GC hook (cleanup.py). Runs the full unprepare path —
+        an abandoned PREPARE_STARTED claim may have added the node label
+        before its ResourceClaim was deleted, and kubelet will never call
+        unprepare for it; dropping the record without the last-claim label
+        accounting would leak the label with nothing left to remove it. If
+        label removal fails transiently the record is retained and the next
+        GC sweep retries."""
+        err = self.unprepare(claim_uid)
+        if err:
+            log.warning("GC drop of claim %s deferred: %s", claim_uid, err)

@@ -211,12 +211,16 @@ class HttpApiClient(ApiClient):
                 # conflicts and create-on-existing; distinguish by the Status
                 # body's reason (client-go errors.IsAlreadyExists analog) so
                 # callers' `except AlreadyExistsError` works over HTTP too.
+                # Only the parsed Status reason is trusted: a substring test
+                # on the raw body would misclassify a genuine stale-RV
+                # Conflict whose object data happens to echo the phrase
+                # "already exists".
                 reason = ""
                 try:
                     reason = json.loads(msg).get("reason", "")
                 except (ValueError, AttributeError):
                     pass
-                if reason == "AlreadyExists" or "already exists" in msg:
+                if reason == "AlreadyExists":
                     raise AlreadyExistsError(msg) from e
                 raise ConflictError(msg) from e
             raise ApiError(e.code, msg) from e

@@ -15,6 +15,7 @@ this measures the ICI path the ComputeDomain stitched together.
 from __future__ import annotations
 
 import time
+from functools import partial
 from typing import Dict, List, Optional
 
 import jax
@@ -50,27 +51,40 @@ def allreduce_bandwidth(nbytes_per_device: int = 64 << 20,
     # Single source of truth for the mesh: the one the input is sharded on.
     mesh = x.sharding.mesh
 
-    @jax.jit
+    inv_n = 1.0 / n
+    # Payload metadata is captured before the first step() call: the input
+    # buffer is donated below and stale handles must not be touched.
+    payload = x.dtype.itemsize * x.shape[1]  # bytes contributed per device
+
+    @partial(jax.jit, donate_argnums=(0,))
     def step(v):
         # shard_map gives the per-device view; psum is the collective under
-        # test. Out spec keeps the result replicated so nothing is lazily
-        # discarded by DCE.
+        # test. Each call consumes the previous call's *output* (donated,
+        # so the shard buffer is reused in place rather than copied):
+        # iteration i+1 data-depends on iteration i, which serializes
+        # dispatches on backends that run independent computations
+        # concurrently (PJRT CPU) — a last-output fetch alone would let the
+        # psums overlap and inflate bandwidth. The 1/n pre-scale keeps the
+        # values at ~1.0 across iterations so nothing over/underflows.
         return jax.shard_map(
-            lambda s: jax.lax.psum(s, "x"),
-            mesh=mesh, in_specs=P("x"), out_specs=P(None))(v)
+            lambda s: jax.lax.psum(s * jnp.asarray(inv_n, s.dtype), "x"),
+            mesh=mesh, in_specs=P("x"), out_specs=P("x"))(v)
 
-    def run(n: int) -> float:
-        """Time n psums + a scalar fetch. A scalar fetch is the only
+    state = {"v": x}
+
+    def run(k: int) -> float:
+        """Time k chained psums + a scalar fetch. A scalar fetch is the only
         synchronization barrier that holds on every PJRT backend
-        (block_until_ready is a no-op on remote-tunnel platforms); device
-        streams execute in order, so the last psum's scalar implies all n
-        completed. The fetch round-trip is constant and cancels in the
-        two-point measurement below."""
+        (block_until_ready is a no-op on remote-tunnel platforms); the final
+        output data-depends on every psum in the chain, so fetching one of
+        its elements implies all k completed. The fetch round-trip is
+        constant and cancels in the two-point measurement below."""
         t0 = time.perf_counter()
-        out = x
-        for _ in range(n):
-            out = step(x)
-        float(out[(0,) * out.ndim])
+        v = state["v"]
+        for _ in range(k):
+            v = step(v)
+        float(v[(0,) * v.ndim])
+        state["v"] = v
         return time.perf_counter() - t0
 
     # Warmup covers compile (first TPU compile ~20-40s) + cache effects.
@@ -79,7 +93,6 @@ def allreduce_bandwidth(nbytes_per_device: int = 64 << 20,
     t_small, t_big = run(1), run(1 + iters)
     mean_s = max((t_big - t_small) / iters, 1e-9)
 
-    payload = x.dtype.itemsize * x.shape[1]  # bytes contributed per device
     algo_gbps = payload / mean_s / 1e9
     bus_gbps = algo_gbps * (2 * (n - 1) / n) if n > 1 else 0.0
     return {
