@@ -1,0 +1,403 @@
+// tpu-multiprocess-coordinator — per-claim multi-tenant chip arbiter.
+//
+// TPU-native replacement for the nvidia-cuda-mps-control daemon that the
+// reference's MPS sharing runs per claim (templates/mps-control-daemon
+// .tmpl.yaml:27-42, lifecycle cmd/gpu-kubelet-plugin/sharing.go:191-412).
+// MPS arbitrates concurrent CUDA processes on one GPU through a pipe
+// directory plus per-client thread/memory limits; libtpu has no vendor
+// arbiter, so this daemon IS the arbiter for concurrent libtpu processes
+// sharing a chip:
+//
+//   1. own the claim's coordination directory (the hostPath the kubelet
+//      plugin created and the CDI spec bind-mounts into every tenant):
+//      create pipe/ and log/, write limits.env with the per-tenant
+//      premapped-HBM and TensorCore-percentage caps tenants must honor,
+//   2. arbitrate tenant leases over a Unix socket in pipe/ — tenants
+//      register with their pid, the coordinator enforces max concurrency
+//      and reaps leases whose process died,
+//   3. answer the readiness probe (`--check`) the Deployment's
+//      startup/readiness probes and the plugin's AssertReady use — the
+//      "startup complete" startup.log analog of the reference template.
+//
+// Protocol (newline-terminated ASCII over the Unix socket):
+//   "Q"          -> "READY clients=<n>/<max>\n" | "NOT_READY ...\n"
+//   "R <pid>"    -> "OK <lease_id>\n" | "DENIED max-clients\n"
+//   "U <lease>"  -> "OK\n" (idempotent)
+//   "L"          -> "LEASES <lease>:<pid> ...\n"
+//
+// A lease is CONNECTION-SCOPED: it lives while the tenant holds the
+// socket connection that registered it and is reaped on EOF/error — the
+// same liveness contract MPS clients get from their control pipe. This is
+// deliberate: tenants run in other pods, so their pids are meaningless in
+// the coordinator's PID namespace and kill(pid,0)-style liveness probes
+// cannot work; connection lifetime is the only namespace-proof signal.
+// The <pid> is recorded for the operator log only.
+//
+// Usage:
+//   tpu-multiprocess-coordinator --dir <coord-dir> [--chips 0,1]
+//       [--hbm-limit-map uuid=bytes,...] [--tensorcore-pct N]
+//       [--max-clients N]
+//   tpu-multiprocess-coordinator --check --dir <coord-dir>
+//
+// Every accepted connection carries a receive timeout so an idle or
+// hostile client can never wedge the serve loop (the probe robustness
+// posture of cmd/compute-domain-daemon/main.go:381-405).
+
+#include <errno.h>
+#include <signal.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdarg>
+#include <cstdio>
+#include <cstdlib>
+#include <ctime>
+#include <fstream>
+#include <map>
+#include <mutex>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+std::atomic<bool> g_stop{false};
+
+void OnSignal(int) { g_stop = true; }
+
+struct Options {
+  std::string dir;
+  std::string chips;          // "0,1" — exported to tenants verbatim
+  std::string hbm_limit_map;  // "uuid=bytes,..." — per-chip premapped caps
+  int tensorcore_pct = -1;    // -1 = unset
+  int max_clients = 16;
+};
+
+std::string SocketPath(const std::string& dir) {
+  return dir + "/pipe/coordinator.sock";
+}
+
+// AF_UNIX sun_path is 108 bytes; coordination dirs can be arbitrarily deep
+// (hostPath roots, test tmpdirs). Bind/connect via a relative path from a
+// temporary chdir so the daemon works regardless of path length. The chdir
+// window is confined to startup / one-shot probe setup, before any other
+// thread exists.
+class ScopedChdir {
+ public:
+  explicit ScopedChdir(const std::string& to) {
+    ok_ = getcwd(prev_, sizeof(prev_)) != nullptr && chdir(to.c_str()) == 0;
+  }
+  ~ScopedChdir() {
+    if (ok_) (void)!chdir(prev_);
+  }
+  bool ok() const { return ok_; }
+
+ private:
+  char prev_[4096];
+  bool ok_ = false;
+};
+
+class Log {
+ public:
+  explicit Log(const std::string& path) : f_(fopen(path.c_str(), "a")) {}
+  ~Log() {
+    if (f_) fclose(f_);
+  }
+  void Line(const char* fmt, ...) {
+    char msg[512];
+    va_list ap;
+    va_start(ap, fmt);
+    vsnprintf(msg, sizeof(msg), fmt, ap);
+    va_end(ap);
+    time_t now = time(nullptr);
+    char ts[32];
+    strftime(ts, sizeof(ts), "%Y-%m-%dT%H:%M:%S", gmtime(&now));
+    if (f_) {
+      fprintf(f_, "%s %s\n", ts, msg);
+      fflush(f_);
+    }
+    fprintf(stderr, "tpu-multiprocess-coordinator: %s\n", msg);
+  }
+
+ private:
+  FILE* f_;
+};
+
+class Coordinator {
+ public:
+  Coordinator(const Options& opts, Log* log) : opts_(opts), log_(log) {}
+
+  bool Start() {
+    if (mkdir((opts_.dir + "/pipe").c_str(), 0755) != 0 && errno != EEXIST)
+      return false;
+    if (mkdir((opts_.dir + "/log").c_str(), 0755) != 0 && errno != EEXIST)
+      return false;
+    if (!WriteLimitsEnv()) return false;
+
+    unlink(SocketPath(opts_.dir).c_str());  // stale crashed predecessor
+    listen_fd_ = socket(AF_UNIX, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) return false;
+    struct sockaddr_un addr{};
+    addr.sun_family = AF_UNIX;
+    strncpy(addr.sun_path, "coordinator.sock", sizeof(addr.sun_path) - 1);
+    {
+      ScopedChdir cd(opts_.dir + "/pipe");
+      if (!cd.ok()) return false;
+      if (bind(listen_fd_, (struct sockaddr*)&addr, sizeof(addr)) != 0)
+        return false;
+    }
+    if (listen(listen_fd_, 16) != 0) return false;
+
+    serve_thread_ = std::thread([this] { Serve(); });
+
+    // Startup marker last — only after the socket answers (the reference
+    // writes startup.log after the daemon accepted its settings).
+    std::ofstream marker(opts_.dir + "/log/startup.log");
+    marker << "startup complete\n";
+    ready_ = true;
+    return true;
+  }
+
+  void Stop() {
+    ready_ = false;
+    unlink((opts_.dir + "/log/startup.log").c_str());
+    if (listen_fd_ >= 0) {
+      shutdown(listen_fd_, SHUT_RDWR);
+      close(listen_fd_);
+      listen_fd_ = -1;
+    }
+    if (serve_thread_.joinable()) serve_thread_.join();
+    // Connection threads are detached; they observe g_stop within their
+    // 1s receive-timeout tick. Bound the wait so Stop() cannot hang on a
+    // wedged client.
+    for (int i = 0; i < 50 && active_conns_ > 0; ++i) usleep(100 * 1000);
+    unlink(SocketPath(opts_.dir).c_str());
+  }
+
+ private:
+  // limits.env is the published contract: every tenant container has this
+  // directory bind-mounted (CDI edit) and must honor these caps. The
+  // kubelet plugin passes the same values into the claim's CDI env, so
+  // file and env always agree — the file is the arbiter's copy tenants
+  // can re-read after coordinator restarts.
+  bool WriteLimitsEnv() {
+    std::ofstream f(opts_.dir + "/limits.env");
+    if (!f.good()) return false;
+    f << "# Written by tpu-multiprocess-coordinator; tenants must honor\n";
+    f << "# these caps when initializing libtpu.\n";
+    if (!opts_.chips.empty()) f << "TPU_VISIBLE_CHIPS=" << opts_.chips << "\n";
+    if (!opts_.hbm_limit_map.empty())
+      f << "TPU_HBM_LIMIT_MAP=" << opts_.hbm_limit_map << "\n";
+    if (opts_.tensorcore_pct >= 0)
+      f << "TPU_TENSORCORE_PERCENTAGE=" << opts_.tensorcore_pct << "\n";
+    f << "TPU_MULTIPROCESS_MAX_CLIENTS=" << opts_.max_clients << "\n";
+    return f.good();
+  }
+
+  void Serve() {
+    while (!g_stop) {
+      int fd = accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) {
+        if (g_stop) break;
+        continue;
+      }
+      // One thread per connection: probes are one-shot, but a tenant
+      // holds its connection for the lifetime of its lease, and an idle
+      // or hostile client must never delay other connections' probes.
+      // The receive timeout only paces the g_stop check.
+      struct timeval tv{1, 0};
+      setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+      setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+      // Detached + counted rather than joined: probe connections are
+      // frequent (kubelet execs --check every few seconds) and a grow-only
+      // thread list would leak; Stop() waits on the counter instead.
+      ++active_conns_;
+      std::thread([this, fd] { HandleConnection(fd); }).detach();
+    }
+  }
+
+  void HandleConnection(int fd) {
+    int lease_id = -1;  // lease registered by THIS connection, if any
+    char buf[256];
+    while (!g_stop) {
+      ssize_t n = read(fd, buf, sizeof(buf) - 1);
+      if (n == 0) break;  // EOF: tenant went away
+      if (n < 0) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) continue;  // pace tick
+        break;
+      }
+      buf[n] = '\0';
+      std::string reply = Handle(std::string(buf), &lease_id);
+      if (write(fd, reply.data(), reply.size()) < 0) break;
+    }
+    // Connection-scoped liveness: whatever this connection registered is
+    // reaped the moment the connection dies, however the tenant exited.
+    if (lease_id >= 0) {
+      std::lock_guard<std::mutex> l(mu_);
+      if (leases_.erase(lease_id))
+        log_->Line("reap lease %d: connection closed (%zu/%d)", lease_id,
+                   leases_.size(), opts_.max_clients);
+    }
+    close(fd);
+    --active_conns_;
+  }
+
+  std::string Handle(const std::string& req, int* conn_lease) {
+    std::istringstream in(req);
+    std::string cmd;
+    in >> cmd;
+    std::lock_guard<std::mutex> l(mu_);
+    if (cmd == "Q") {
+      char out[128];
+      snprintf(out, sizeof(out), "%s clients=%zu/%d\n",
+               ready_ ? "READY" : "NOT_READY", leases_.size(),
+               opts_.max_clients);
+      return out;
+    }
+    if (cmd == "R") {
+      long pid = 0;
+      in >> pid;
+      if (pid <= 0) return "ERR bad pid\n";
+      if (*conn_lease >= 0) return "ERR lease already held\n";
+      if ((int)leases_.size() >= opts_.max_clients) {
+        log_->Line("deny tenant pid=%ld: max-clients %d reached", pid,
+                   opts_.max_clients);
+        return "DENIED max-clients\n";
+      }
+      int id = next_lease_++;
+      leases_[id] = (pid_t)pid;
+      *conn_lease = id;
+      log_->Line("lease %d granted to pid %ld (%zu/%d)", id, pid,
+                 leases_.size(), opts_.max_clients);
+      char out[64];
+      snprintf(out, sizeof(out), "OK %d\n", id);
+      return out;
+    }
+    if (cmd == "U") {
+      int id = -1;
+      in >> id;
+      if (leases_.erase(id)) {
+        if (id == *conn_lease) *conn_lease = -1;
+        log_->Line("lease %d released (%zu/%d)", id, leases_.size(),
+                   opts_.max_clients);
+      }
+      return "OK\n";
+    }
+    if (cmd == "L") {
+      std::ostringstream out;
+      out << "LEASES";
+      for (const auto& kv : leases_) out << " " << kv.first << ":" << kv.second;
+      out << "\n";
+      return out.str();
+    }
+    return "ERR unknown command\n";
+  }
+
+  Options opts_;
+  Log* log_;
+  int listen_fd_ = -1;
+  std::thread serve_thread_;
+  std::atomic<int> active_conns_{0};
+  std::mutex mu_;
+  std::atomic<bool> ready_{false};
+  std::map<int, pid_t> leases_;
+  int next_lease_ = 1;
+};
+
+int DialSocket(const std::string& pipe_dir, int timeout_ms) {
+  int fd = socket(AF_UNIX, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  struct timeval tv{timeout_ms / 1000, (timeout_ms % 1000) * 1000};
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+  struct sockaddr_un addr{};
+  addr.sun_family = AF_UNIX;
+  strncpy(addr.sun_path, "coordinator.sock", sizeof(addr.sun_path) - 1);
+  ScopedChdir cd(pipe_dir);
+  if (!cd.ok() || connect(fd, (struct sockaddr*)&addr, sizeof(addr)) != 0) {
+    close(fd);
+    return -1;
+  }
+  return fd;
+}
+
+int RunCheck(const std::string& dir) {
+  int fd = DialSocket(dir + "/pipe", 1000);
+  if (fd < 0) {
+    fprintf(stderr, "check: cannot connect to %s\n", SocketPath(dir).c_str());
+    return 1;
+  }
+  (void)!write(fd, "Q\n", 2);
+  char buf[128];
+  ssize_t n = read(fd, buf, sizeof(buf) - 1);
+  close(fd);
+  if (n <= 0) return 1;
+  buf[n] = '\0';
+  printf("%s", buf);
+  return strncmp(buf, "READY", 5) == 0 ? 0 : 1;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  Options opts;
+  bool check = false;
+  for (int i = 1; i < argc; ++i) {
+    if (strcmp(argv[i], "--dir") == 0 && i + 1 < argc) {
+      opts.dir = argv[++i];
+    } else if (strcmp(argv[i], "--chips") == 0 && i + 1 < argc) {
+      opts.chips = argv[++i];
+    } else if (strcmp(argv[i], "--hbm-limit-map") == 0 && i + 1 < argc) {
+      opts.hbm_limit_map = argv[++i];
+    } else if (strcmp(argv[i], "--tensorcore-pct") == 0 && i + 1 < argc) {
+      opts.tensorcore_pct = atoi(argv[++i]);
+    } else if (strcmp(argv[i], "--max-clients") == 0 && i + 1 < argc) {
+      opts.max_clients = atoi(argv[++i]);
+    } else if (strcmp(argv[i], "--check") == 0) {
+      check = true;
+    } else {
+      fprintf(stderr,
+              "usage: tpu-multiprocess-coordinator --dir <d> [--chips c]\n"
+              "           [--hbm-limit-map m] [--tensorcore-pct n]\n"
+              "           [--max-clients n]\n"
+              "       tpu-multiprocess-coordinator --check --dir <d>\n");
+      return 2;
+    }
+  }
+  if (opts.dir.empty()) {
+    fprintf(stderr, "tpu-multiprocess-coordinator: --dir required\n");
+    return 2;
+  }
+  if (check) return RunCheck(opts.dir);
+
+  signal(SIGTERM, OnSignal);
+  signal(SIGINT, OnSignal);
+  signal(SIGPIPE, SIG_IGN);
+
+  // pipe/ and log/ may not exist yet when the pod starts before the
+  // kubelet plugin finished its mkdirs; create them before opening the
+  // log file (Start() re-checks them).
+  mkdir(opts.dir.c_str(), 0755);
+  mkdir((opts.dir + "/pipe").c_str(), 0755);
+  mkdir((opts.dir + "/log").c_str(), 0755);
+  Log log(opts.dir + "/log/coordinator.log");
+  Coordinator c(opts, &log);
+  if (!c.Start()) {
+    fprintf(stderr,
+            "tpu-multiprocess-coordinator: failed to start in %s: %s\n",
+            opts.dir.c_str(), strerror(errno));
+    return 1;
+  }
+  log.Line("serving on %s (chips=%s max_clients=%d)",
+           SocketPath(opts.dir).c_str(), opts.chips.c_str(),
+           opts.max_clients);
+  while (!g_stop) usleep(100 * 1000);
+  c.Stop();
+  log.Line("stopped");
+  return 0;
+}

@@ -166,6 +166,14 @@ class Daemon {
         if (g_stop) break;
         continue;
       }
+      // Bound the inbound read the same way outbound dials are bounded
+      // (DialPeer sets SO_RCVTIMEO): without this, one idle client — a
+      // port scanner, a stalled TCP connection — blocks the accept loop
+      // indefinitely, --check probes time out, and the node flaps
+      // NotReady even though the daemon is healthy.
+      struct timeval tv{1, 0};
+      setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+      setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
       char buf[256];
       ssize_t n = read(fd, buf, sizeof(buf) - 1);
       if (n > 0) {

@@ -195,7 +195,8 @@ class TestManifests:
 
     def test_demo_specs_are_valid_configs(self):
         """Every opaque config in the demo ladder must pass the webhook."""
-        featuregates.Features.set_from_string("TimeSlicingSettings=true")
+        featuregates.Features.set_from_string(
+            "TimeSlicingSettings=true,MultiprocessSupport=true")
         handler = AdmissionHandler()
         for name, docs in demos.all_demos().items():
             for doc in docs:

@@ -65,9 +65,9 @@ class CheckpointCleanup:
                     continue  # claim still exists: kubelet will retry
             except NotFoundError:
                 pass
-            log.info("GC abandoned PrepareStarted claim %s (%s/%s)",
-                     uid, prepared.namespace, prepared.name)
-            self._state.drop_claim(uid)
-            collected += 1
+            if self._state.drop_claim(uid):
+                log.info("GC abandoned PrepareStarted claim %s (%s/%s)",
+                         uid, prepared.namespace, prepared.name)
+                collected += 1
         self._cd.gc_domain_dirs()
         return collected

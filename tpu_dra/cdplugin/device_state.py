@@ -287,14 +287,17 @@ class DeviceState:
         with self._lock:
             return copy.deepcopy(self._checkpoint)
 
-    def drop_claim(self, claim_uid: str) -> None:
+    def drop_claim(self, claim_uid: str) -> bool:
         """Checkpoint GC hook (cleanup.py). Runs the full unprepare path —
         an abandoned PREPARE_STARTED claim may have added the node label
         before its ResourceClaim was deleted, and kubelet will never call
         unprepare for it; dropping the record without the last-claim label
-        accounting would leak the label with nothing left to remove it. If
-        label removal fails transiently the record is retained and the next
-        GC sweep retries."""
+        accounting would leak the label with nothing left to remove it.
+        Returns False when cleanup failed transiently: the record is
+        retained and the next GC sweep retries (the caller must not count
+        the claim as collected)."""
         err = self.unprepare(claim_uid)
         if err:
             log.warning("GC drop of claim %s deferred: %s", claim_uid, err)
+            return False
+        return True

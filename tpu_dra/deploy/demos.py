@@ -121,6 +121,35 @@ def test5_subslice() -> List[Dict]:
             _pod("pod1", ns, {"resourceClaimTemplateName": "subslice"})]
 
 
+def test_multiprocess_shared_chip() -> List[Dict]:
+    """gpu-test-mps analog (demo/specs/quickstart/v1/gpu-test-mps.yaml):
+    one pod, two containers sharing a chip through the
+    tpu-multiprocess-coordinator. Each tenant registers a lease on the
+    coordinator's socket (the CUDA_MPS_PIPE_DIRECTORY analog) and prints
+    the published limits it must honor."""
+    ns = "tpu-test-multiprocess"
+    config = {
+        "apiVersion": apitypes.API_VERSION, "kind": "TpuConfig",
+        "sharing": {"strategy": "Multiprocess",
+                    "multiprocessConfig": {
+                        "defaultActiveCoresPercentage": 50,
+                        "defaultHbmLimit": "10Gi"}},
+    }
+    tenant = [
+        "python", "-c",
+        "import os, socket; "
+        "s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM); "
+        "s.connect(os.environ['TPU_MULTIPROCESS_PIPE'] + '/coordinator.sock'); "
+        "s.sendall(('R %d\\n' % os.getpid()).encode()); "
+        "print('lease:', s.recv(64).decode().strip()); "
+        "print(open(os.environ['TPU_MULTIPROCESS_DIR'] + '/limits.env').read())",
+    ]
+    pod = _pod("pod0", ns, {"resourceClaimTemplateName": "shared-tpu"},
+               command=tenant, containers=2)
+    return [_ns(ns),
+            _rct("shared-tpu", ns, "tpu.dev", config=config), pod]
+
+
 # -- multi-node ComputeDomain benchmark -------------------------------------
 
 def cd_allreduce_bench(num_nodes: int = 2) -> List[Dict]:
@@ -167,5 +196,6 @@ def all_demos() -> Dict[str, List[Dict]]:
         "tpu-test3": test3_time_sliced_across_pods(),
         "tpu-test4": test4_multi_chip(),
         "tpu-test5": test5_subslice(),
+        "tpu-test-multiprocess": test_multiprocess_shared_chip(),
         "cd-allreduce-bench": cd_allreduce_bench(),
     }

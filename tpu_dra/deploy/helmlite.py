@@ -1,0 +1,547 @@
+"""helmlite: a Go-template-subset renderer for the Helm chart.
+
+The dev/CI environment has no ``helm`` binary, but the chart must still be
+renderable and validatable (`helm template | kubectl apply --dry-run=client`
+is the reference's gate, Makefile + tests/bats). This module implements the
+template subset the chart in deployments/helm/tpu-dra-driver uses:
+
+- actions: ``{{ expr }}`` with ``{{-``/``-}}`` whitespace trimming
+- blocks: if / else if / else, range (list and map, with ``$k, $v :=``),
+  with, define/include
+- pipelines: ``expr | fn arg | fn``
+- terms: ``.a.b.c`` field chains, ``$`` root, ``$var`` (range/with vars),
+  string literals, ints, bools, parenthesized expressions, function calls
+- functions: quote, squote, default, toYaml, nindent, indent, printf,
+  include, b64enc, eq, ne, not, and, or, empty, hasKey, trunc, trimSuffix,
+  lower, upper, replace, required, ternary, dict, list, fromYaml? (no),
+  len
+
+Truthiness follows Go templates: false, 0, "", nil, empty list/map are
+falsy. Rendering is strict: unknown functions and malformed actions raise
+``TemplateError`` (the ``helm template`` failure analog) rather than
+emitting garbage YAML.
+"""
+
+from __future__ import annotations
+
+import base64
+import re
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import yaml
+
+
+class TemplateError(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Lexing: split into literal text and {{ action }} nodes with trim markers
+# ---------------------------------------------------------------------------
+
+_ACTION_RE = re.compile(r"\{\{(-?)\s*(.*?)\s*(-?)\}\}", re.DOTALL)
+
+
+def _lex(src: str) -> List[Tuple[str, str]]:
+    """Returns [('text', s) | ('action', body)] with whitespace trimming
+    already applied per the -/- markers."""
+    nodes: List[Tuple[str, str]] = []
+    pos = 0
+    for m in _ACTION_RE.finditer(src):
+        text = src[pos:m.start()]
+        if m.group(1) == "-":
+            text = text.rstrip(" \t\n\r")
+        nodes.append(("text", text))
+        nodes.append(("action", m.group(2)))
+        pos = m.end()
+        if m.group(3) == "-":
+            rest = src[pos:]
+            trimmed = rest.lstrip(" \t\n\r")
+            pos += len(rest) - len(trimmed)
+    nodes.append(("text", src[pos:]))
+    return nodes
+
+
+# ---------------------------------------------------------------------------
+# Parsing: build a block tree
+# ---------------------------------------------------------------------------
+
+class _Node:
+    pass
+
+
+class _Text(_Node):
+    def __init__(self, s: str):
+        self.s = s
+
+
+class _Expr(_Node):
+    def __init__(self, src: str):
+        self.src = src
+
+
+class _If(_Node):
+    def __init__(self):
+        # list of (condition_src | None for else, body nodes)
+        self.branches: List[Tuple[Optional[str], List[_Node]]] = []
+
+
+class _Range(_Node):
+    def __init__(self, var_k, var_v, src):
+        self.var_k, self.var_v, self.src = var_k, var_v, src
+        self.body: List[_Node] = []
+
+
+class _With(_Node):
+    def __init__(self, src):
+        self.src = src
+        self.body: List[_Node] = []
+
+
+class _Define(_Node):
+    def __init__(self, name):
+        self.name = name
+        self.body: List[_Node] = []
+
+
+_RANGE_RE = re.compile(
+    r"^range(?:\s+(\$\w+)\s*(?:,\s*(\$\w+))?\s*:=)?\s+(.*)$", re.DOTALL)
+
+
+def _parse(nodes: List[Tuple[str, str]]) -> Tuple[List[_Node], Dict[str, List[_Node]]]:
+    defines: Dict[str, List[_Node]] = {}
+    root: List[_Node] = []
+    stack: List[Tuple[Any, List[_Node]]] = [(None, root)]
+
+    def body() -> List[_Node]:
+        return stack[-1][1]
+
+    for kind, val in nodes:
+        if kind == "text":
+            if val:
+                body().append(_Text(val))
+            continue
+        action = val.strip()
+        if action.startswith("/*") or action.startswith("//"):
+            continue  # comment
+        if action.startswith("if "):
+            node = _If()
+            node.branches.append((action[3:].strip(), []))
+            body().append(node)
+            stack.append((node, node.branches[-1][1]))
+        elif action.startswith("else"):
+            owner = stack[-1][0]
+            if not isinstance(owner, _If):
+                raise TemplateError(f"'else' outside if: {action!r}")
+            stack.pop()
+            cond = action[4:].strip()
+            if cond.startswith("if "):
+                cond = cond[3:].strip()
+            else:
+                cond = None
+            owner.branches.append((cond, []))
+            stack.append((owner, owner.branches[-1][1]))
+        elif action.startswith("range"):
+            m = _RANGE_RE.match(action)
+            if not m:
+                raise TemplateError(f"bad range: {action!r}")
+            node = _Range(m.group(1), m.group(2), m.group(3).strip())
+            body().append(node)
+            stack.append((node, node.body))
+        elif action.startswith("with "):
+            node = _With(action[5:].strip())
+            body().append(node)
+            stack.append((node, node.body))
+        elif action.startswith("define "):
+            m = re.match(r'define\s+"([^"]+)"', action)
+            if not m:
+                raise TemplateError(f"bad define: {action!r}")
+            node = _Define(m.group(1))
+            stack.append((node, node.body))
+        elif action == "end":
+            owner, _ = stack.pop()
+            if owner is None:
+                raise TemplateError("unbalanced 'end'")
+            if isinstance(owner, _Define):
+                defines[owner.name] = owner.body
+        else:
+            body().append(_Expr(action))
+    if len(stack) != 1:
+        raise TemplateError("unclosed block at EOF")
+    return root, defines
+
+
+# ---------------------------------------------------------------------------
+# Expression evaluation
+# ---------------------------------------------------------------------------
+
+_TOKEN_RE = re.compile(r"""
+    \s*(
+        "(?:[^"\\]|\\.)*"        # double-quoted string
+      | `[^`]*`                  # raw string
+      | \$\w*                    # $var or bare $
+      | \.[\w.]*                 # field chain .a.b / bare .
+      | -?\d+(?:\.\d+)?          # number
+      | \|                       # pipe
+      | \(|\)
+      | [A-Za-z_][\w]*           # ident (function, true/false)
+    )""", re.VERBOSE)
+
+
+def _tokenize(src: str) -> List[str]:
+    out, pos = [], 0
+    while pos < len(src):
+        m = _TOKEN_RE.match(src, pos)
+        if not m:
+            raise TemplateError(f"cannot tokenize: {src[pos:]!r}")
+        out.append(m.group(1))
+        pos = m.end()
+    return out
+
+
+def _truthy(v: Any) -> bool:
+    if v is None or v is False:
+        return False
+    if isinstance(v, (int, float)) and v == 0:
+        return False
+    if isinstance(v, (str, list, dict, tuple)) and len(v) == 0:
+        return False
+    return True
+
+
+class _Ctx:
+    def __init__(self, root: Any, dot: Any, vars_: Dict[str, Any],
+                 defines: Dict[str, List[_Node]], functions):
+        self.root, self.dot, self.vars = root, dot, vars_
+        self.defines, self.functions = defines, functions
+
+    def child(self, dot=None, extra_vars=None) -> "_Ctx":
+        v = dict(self.vars)
+        if extra_vars:
+            v.update(extra_vars)
+        return _Ctx(self.root, self.dot if dot is None else dot, v,
+                    self.defines, self.functions)
+
+
+def _resolve_field(base: Any, chain: str) -> Any:
+    cur = base
+    for part in [p for p in chain.split(".") if p]:
+        if isinstance(cur, dict):
+            cur = cur.get(part)
+        else:
+            cur = getattr(cur, part, None)
+        if cur is None:
+            return None
+    return cur
+
+
+class _ExprEval:
+    """Evaluates one pipeline: stages separated by '|'; each stage is a
+    term or a function call whose last argument is the previous stage's
+    output."""
+
+    def __init__(self, ctx: _Ctx):
+        self.ctx = ctx
+
+    def eval(self, src: str) -> Any:
+        tokens = _tokenize(src)
+        stages: List[List[str]] = [[]]
+        depth = 0
+        for t in tokens:
+            if t == "(":
+                depth += 1
+            elif t == ")":
+                depth -= 1
+            if t == "|" and depth == 0:
+                stages.append([])
+            else:
+                stages[-1].append(t)
+        value, first = None, True
+        for stage in stages:
+            if not stage:
+                raise TemplateError(f"empty pipeline stage in {src!r}")
+            value = self._eval_stage(stage, None if first else [value])
+            first = False
+        return value
+
+    def _eval_stage(self, tokens: List[str], piped: Optional[List[Any]]) -> Any:
+        pos = [0]
+
+        def peek():
+            return tokens[pos[0]] if pos[0] < len(tokens) else None
+
+        def term() -> Any:
+            t = peek()
+            if t is None:
+                raise TemplateError(f"unexpected end in {tokens!r}")
+            pos[0] += 1
+            if t == "(":
+                # sub-pipeline until matching ')'
+                depth, sub = 1, []
+                while depth > 0:
+                    nxt = peek()
+                    if nxt is None:
+                        raise TemplateError("unbalanced paren")
+                    pos[0] += 1
+                    if nxt == "(":
+                        depth += 1
+                    elif nxt == ")":
+                        depth -= 1
+                        if depth == 0:
+                            break
+                    sub.append(nxt)
+                return _ExprEval(self.ctx).eval(" ".join(sub))
+            if t.startswith('"'):
+                return t[1:-1].replace('\\"', '"').replace("\\\\", "\\") \
+                    .replace("\\n", "\n").replace("\\t", "\t")
+            if t.startswith("`"):
+                return t[1:-1]
+            if t == "$":
+                return self.ctx.root
+            if t.startswith("$"):
+                if t[1:] in self.ctx.vars:
+                    base = self.ctx.vars[t[1:]]
+                    nxt = peek()
+                    if nxt and nxt.startswith("."):
+                        pos[0] += 1
+                        return _resolve_field(base, nxt)
+                    return base
+                raise TemplateError(f"undefined variable {t}")
+            if t.startswith("."):
+                return _resolve_field(self.ctx.dot, t)
+            if re.fullmatch(r"-?\d+", t):
+                return int(t)
+            if re.fullmatch(r"-?\d+\.\d+", t):
+                return float(t)
+            if t == "true":
+                return True
+            if t == "false":
+                return False
+            if t == "nil":
+                return None
+            # function call: consume remaining tokens as args
+            fn = self.ctx.functions.get(t)
+            if fn is None:
+                raise TemplateError(f"unknown function {t!r}")
+            args = []
+            while peek() is not None:
+                args.append(term())
+            if piped is not None:
+                args.extend(piped)
+            return fn(self.ctx, *args)
+
+        first = term()
+        # A bare term stage with piped input and leftovers is a call-less
+        # stage (e.g. `.Values.x | quote` handled above); leftover tokens
+        # after a non-function term is an error.
+        if peek() is not None:
+            raise TemplateError(f"trailing tokens in {tokens!r}")
+        if piped is not None and not callable(first) and tokens and \
+                not re.fullmatch(r"[A-Za-z_]\w*", tokens[0]):
+            raise TemplateError(
+                f"stage {tokens!r} cannot accept piped input")
+        return first
+
+
+# ---------------------------------------------------------------------------
+# Rendering
+# ---------------------------------------------------------------------------
+
+def _render_nodes(nodes: List[_Node], ctx: _Ctx) -> str:
+    out: List[str] = []
+    for node in nodes:
+        if isinstance(node, _Text):
+            out.append(node.s)
+        elif isinstance(node, _Expr):
+            v = _ExprEval(ctx).eval(node.src)
+            if v is None:
+                continue
+            out.append(v if isinstance(v, str) else _gostr(v))
+        elif isinstance(node, _If):
+            for cond, body in node.branches:
+                if cond is None or _truthy(_ExprEval(ctx).eval(cond)):
+                    out.append(_render_nodes(body, ctx))
+                    break
+        elif isinstance(node, _Range):
+            coll = _ExprEval(ctx).eval(node.src)
+            if isinstance(coll, dict):
+                items = [(k, coll[k]) for k in sorted(coll)]
+            elif coll:
+                items = list(enumerate(coll))
+            else:
+                items = []
+            for k, v in items:
+                extra = {}
+                if node.var_k and node.var_v:
+                    extra = {node.var_k[1:]: k, node.var_v[1:]: v}
+                elif node.var_k:
+                    extra = {node.var_k[1:]: v}
+                out.append(_render_nodes(
+                    node.body, ctx.child(dot=v, extra_vars=extra)))
+        elif isinstance(node, _With):
+            v = _ExprEval(ctx).eval(node.src)
+            if _truthy(v):
+                out.append(_render_nodes(node.body, ctx.child(dot=v)))
+        else:
+            raise TemplateError(f"unhandled node {node!r}")
+    return "".join(out)
+
+
+def _gostr(v: Any) -> str:
+    if v is True:
+        return "true"
+    if v is False:
+        return "false"
+    return str(v)
+
+
+def _to_yaml(v: Any) -> str:
+    return yaml.safe_dump(v, default_flow_style=False, sort_keys=False).rstrip("\n")
+
+
+def _make_functions() -> Dict[str, Callable]:
+    def quote(ctx, v):
+        return '"' + _gostr("" if v is None else v).replace('"', '\\"') + '"'
+
+    def squote(ctx, v):
+        return "'" + _gostr("" if v is None else v) + "'"
+
+    def default(ctx, dflt, v=None):
+        return v if _truthy(v) else dflt
+
+    def to_yaml(ctx, v):
+        return _to_yaml(v)
+
+    def nindent(ctx, n, s):
+        pad = " " * int(n)
+        return "\n" + "\n".join(
+            pad + line if line else line for line in _gostr(s).split("\n"))
+
+    def indent(ctx, n, s):
+        pad = " " * int(n)
+        return "\n".join(
+            pad + line if line else line for line in _gostr(s).split("\n"))
+
+    def include(ctx, name, dot):
+        body = ctx.defines.get(name)
+        if body is None:
+            raise TemplateError(f"include of undefined template {name!r}")
+        return _render_nodes(body, ctx.child(dot=dot))
+
+    def printf(ctx, fmt, *args):
+        return fmt.replace("%s", "{}").replace("%d", "{}").format(
+            *[_gostr(a) for a in args])
+
+    def required(ctx, msg, v):
+        if not _truthy(v):
+            raise TemplateError(f"required value missing: {msg}")
+        return v
+
+    def ternary(ctx, if_true, if_false, cond):
+        return if_true if _truthy(cond) else if_false
+
+    return {
+        "quote": quote,
+        "squote": squote,
+        "default": default,
+        "toYaml": to_yaml,
+        "nindent": nindent,
+        "indent": indent,
+        "include": include,
+        "printf": printf,
+        "b64enc": lambda ctx, s: base64.b64encode(
+            _gostr(s).encode()).decode(),
+        "eq": lambda ctx, a, b: a == b,
+        "ne": lambda ctx, a, b: a != b,
+        "not": lambda ctx, v: not _truthy(v),
+        "and": lambda ctx, *vs: all(_truthy(v) for v in vs),
+        "or": lambda ctx, *vs: next((v for v in vs if _truthy(v)),
+                                    vs[-1] if vs else None),
+        "empty": lambda ctx, v: not _truthy(v),
+        "hasKey": lambda ctx, d, k: isinstance(d, dict) and k in d,
+        "len": lambda ctx, v: len(v) if v is not None else 0,
+        "trunc": lambda ctx, n, s: _gostr(s)[:int(n)],
+        "trimSuffix": lambda ctx, suf, s: _gostr(s)[:-len(suf)]
+        if _gostr(s).endswith(suf) else _gostr(s),
+        "lower": lambda ctx, s: _gostr(s).lower(),
+        "upper": lambda ctx, s: _gostr(s).upper(),
+        "replace": lambda ctx, old, new, s: _gostr(s).replace(old, new),
+        "required": required,
+        "ternary": ternary,
+        "dict": lambda ctx, *kv: {kv[i]: kv[i + 1]
+                                  for i in range(0, len(kv), 2)},
+        "list": lambda ctx, *vs: list(vs),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Chart driver
+# ---------------------------------------------------------------------------
+
+def _deep_merge(base: Dict, override: Dict) -> Dict:
+    out = dict(base)
+    for k, v in (override or {}).items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def render_chart(chart_dir: str, values_override: Optional[Dict] = None,
+                 release_name: str = "tpu-dra-driver",
+                 namespace: str = "tpu-dra-driver") -> List[Dict]:
+    """The `helm template` analog: renders every templates/*.yaml plus
+    crds/*.yaml and returns the parsed document list. Raises TemplateError
+    or yaml.YAMLError on malformed output — the validation gate."""
+    import os
+
+    with open(os.path.join(chart_dir, "Chart.yaml")) as f:
+        chart_meta = yaml.safe_load(f)
+    with open(os.path.join(chart_dir, "values.yaml")) as f:
+        values = yaml.safe_load(f) or {}
+    values = _deep_merge(values, values_override or {})
+
+    root = {
+        "Values": values,
+        "Release": {"Name": release_name, "Namespace": namespace,
+                    "Service": "Helm"},
+        "Chart": {"Name": chart_meta.get("name", ""),
+                  "Version": chart_meta.get("version", ""),
+                  "AppVersion": chart_meta.get("appVersion", "")},
+    }
+
+    tdir = os.path.join(chart_dir, "templates")
+    sources = {}
+    for fn in sorted(os.listdir(tdir)):
+        if fn.endswith((".yaml", ".tpl")):
+            with open(os.path.join(tdir, fn)) as f:
+                sources[fn] = f.read()
+
+    # First pass: collect defines from every file (helm shares them).
+    defines: Dict[str, List[_Node]] = {}
+    parsed = {}
+    for fn, src in sources.items():
+        tree, defs = _parse(_lex(src))
+        defines.update(defs)
+        parsed[fn] = tree
+
+    functions = _make_functions()
+    docs: List[Dict] = []
+    for fn, tree in parsed.items():
+        if fn.endswith(".tpl"):
+            continue
+        ctx = _Ctx(root, root, {}, defines, functions)
+        text = _render_nodes(tree, ctx)
+        for doc in yaml.safe_load_all(text):
+            if doc:
+                docs.append(doc)
+
+    cdir = os.path.join(chart_dir, "crds")
+    if os.path.isdir(cdir):
+        for fn in sorted(os.listdir(cdir)):
+            with open(os.path.join(cdir, fn)) as f:
+                for doc in yaml.safe_load_all(f.read()):
+                    if doc:
+                        docs.append(doc)
+    return docs

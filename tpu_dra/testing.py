@@ -94,6 +94,150 @@ class FakeNode:
         self.cd_manager.stop()
 
 
+COORDINATOR_BIN = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native", "build",
+    "tpu-multiprocess-coordinator")
+
+
+class CoordinatorNodeSim:
+    """Plays kubelet for multiprocess-coordinator Deployments.
+
+    Watches the cluster for Deployments labeled
+    ``app.kubernetes.io/name=tpu-multiprocess-daemon`` (the ones
+    MultiprocessDaemon.start creates), runs the REAL
+    tpu-multiprocess-coordinator binary with the pod's command — hostPath
+    volume substituted for /multiprocess — and flips readyReplicas to 1
+    only once the binary's own ``--check`` probe returns READY. Readiness
+    therefore comes from the actual process lifecycle, exactly as it would
+    from kubelet's exec probes in a real cluster; nothing is fabricated.
+    On Deployment deletion the process is terminated (kubelet reaping the
+    pod). Used by the multiprocess e2e tier and the cluster-tier e2e.
+    """
+
+    def __init__(self, cluster, namespace: str,
+                 binary: str = COORDINATOR_BIN, interval: float = 0.05):
+        self._cluster = cluster
+        self._namespace = namespace
+        self._binary = binary
+        self._interval = interval
+        self.processes = {}  # deployment name -> subprocess.Popen
+        self.errors = {}     # deployment name -> repr of last loop error
+        self._host_dirs = {}
+        self._stop = None
+        self._thread = None
+
+    def start(self) -> None:
+        import threading
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._stop:
+            self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+        for proc in self.processes.values():
+            if proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=3)
+                except Exception:  # noqa: BLE001
+                    proc.kill()
+        self.processes.clear()
+
+    def host_dir(self, deployment_name: str) -> Optional[str]:
+        return self._host_dirs.get(deployment_name)
+
+    # -- kubelet loop -------------------------------------------------------
+
+    def _run(self) -> None:
+        import subprocess
+        from tpu_dra.k8s import DEPLOYMENTS
+        sel = "app.kubernetes.io/name=tpu-multiprocess-daemon"
+        while not self._stop.wait(self._interval):
+            try:
+                deps = self._cluster.list(DEPLOYMENTS, self._namespace,
+                                          label_selector=sel)
+            except Exception:  # noqa: BLE001 — cluster shutting down
+                continue
+            seen = set()
+            for dep in deps:
+                name = dep["metadata"]["name"]
+                seen.add(name)
+                # Per-deployment errors (unbuildable binary, bad pod spec)
+                # must not kill the kubelet loop: record them so the test's
+                # eventual ready-timeout has a cause to point at.
+                try:
+                    proc = self.processes.get(name)
+                    if proc is None:
+                        self._launch(dep, subprocess)
+                    elif proc.poll() is None:
+                        self._set_ready(dep, self._probe(dep, subprocess))
+                    else:
+                        # Process died (e.g. test killed it): not ready.
+                        # The Deployment controller would restart it; tests
+                        # assert on the unready window, so we do not.
+                        self._set_ready(dep, False)
+                except Exception as e:  # noqa: BLE001
+                    self.errors[name] = repr(e)
+            # Deployment gone -> kubelet reaps the pod.
+            for name in list(self.processes):
+                if name not in seen:
+                    proc = self.processes.pop(name)
+                    self._host_dirs.pop(name, None)
+                    if proc.poll() is None:
+                        proc.terminate()
+                        try:
+                            proc.wait(timeout=3)
+                        except Exception:  # noqa: BLE001
+                            proc.kill()
+
+    def _pod_spec(self, dep):
+        return ((dep.get("spec") or {}).get("template") or {}).get("spec") or {}
+
+    def _launch(self, dep, subprocess_mod) -> None:
+        spec = self._pod_spec(dep)
+        host_dir = None
+        for vol in spec.get("volumes", []):
+            if vol.get("name") == "coord":
+                host_dir = (vol.get("hostPath") or {}).get("path")
+        container = (spec.get("containers") or [{}])[0]
+        command = list(container.get("command") or [])
+        if not host_dir or not command:
+            return
+        # kubelet's bind mount: the container sees /multiprocess, the host
+        # side is the claim's coordination dir.
+        argv = [self._binary] + [
+            host_dir if a == "/multiprocess" else a for a in command[1:]]
+        name = dep["metadata"]["name"]
+        self._host_dirs[name] = host_dir
+        self.processes[name] = subprocess_mod.Popen(
+            argv, stdout=subprocess_mod.DEVNULL,
+            stderr=subprocess_mod.DEVNULL)
+
+    def _probe(self, dep, subprocess_mod) -> bool:
+        host_dir = self._host_dirs.get(dep["metadata"]["name"])
+        if not host_dir:
+            return False
+        res = subprocess_mod.run(
+            [self._binary, "--check", "--dir", host_dir],
+            stdout=subprocess_mod.DEVNULL, stderr=subprocess_mod.DEVNULL)
+        return res.returncode == 0
+
+    def _set_ready(self, dep, ready: bool) -> None:
+        from tpu_dra.k8s import DEPLOYMENTS
+        want = 1 if ready else 0
+        if (dep.get("status") or {}).get("readyReplicas", 0) == want:
+            return
+        dep = dict(dep)
+        dep.setdefault("status", {})["readyReplicas"] = want
+        try:
+            self._cluster.update_status(DEPLOYMENTS, dep, self._namespace)
+        except Exception:  # noqa: BLE001 — conflict: next tick retries
+            pass
+
+
 class _PathShim:
     """Minimal pathlib-like '/'-join for plain-string tmp dirs (bench)."""
 

@@ -86,11 +86,46 @@ class MultiprocessDaemon:
     def deployment_name(self) -> str:
         return self._name
 
+    def _limits(self) -> Dict[str, int]:
+        """Per-chip premapped-HBM caps (bytes by uuid) — the single source
+        both the coordinator args and the CDI env are rendered from, so the
+        arbiter's limits.env and the tenants' environment always agree."""
+        uuids = [c.uuid for c in self._chips]
+        indices = {c.uuid: c.index for c in self._chips}
+        if self._config.per_device_hbm_limit is not None:
+            return self._config.per_device_hbm_limit.normalize(
+                uuids, indices, self._config.default_hbm_limit)
+        if self._config.default_hbm_limit is not None:
+            from tpu_dra.infra.quantity import Quantity
+            return {u: Quantity(self._config.default_hbm_limit).value
+                    for u in uuids}
+        return {}
+
+    def _coordinator_command(self) -> List[str]:
+        """The container command: the real tpu-multiprocess-coordinator
+        binary (native/src/multiprocess_coordinator.cc) with this claim's
+        chips and limits. Mirrors how the reference renders MPS settings
+        into the control daemon's startup script
+        (templates/mps-control-daemon.tmpl.yaml:27-42)."""
+        cmd = ["tpu-multiprocess-coordinator", "--dir", "/multiprocess",
+               "--chips", ",".join(str(c.index) for c in self._chips)]
+        limits = self._limits()
+        if limits:
+            cmd += ["--hbm-limit-map",
+                    ",".join(f"{u}={b}" for u, b in sorted(limits.items()))]
+        if self._config.default_active_cores_percentage is not None:
+            cmd += ["--tensorcore-pct",
+                    str(self._config.default_active_cores_percentage)]
+        return cmd
+
     def start(self) -> None:
         """Create coordination dir + Deployment (Start analog,
         sharing.go:191-296)."""
         os.makedirs(os.path.join(self._dir, "pipe"), exist_ok=True)
         os.makedirs(os.path.join(self._dir, "log"), exist_ok=True)
+        probe = {"exec": {"command": [
+            "tpu-multiprocess-coordinator", "--check",
+            "--dir", "/multiprocess"]}}
         deployment = {
             "apiVersion": "apps/v1",
             "kind": "Deployment",
@@ -110,13 +145,21 @@ class MultiprocessDaemon:
                         "containers": [{
                             "name": "coordinator",
                             "image": self._image,
-                            "command": ["tpu-multiprocess-coordinator"],
+                            "command": self._coordinator_command(),
                             "env": [
                                 {"name": "TPU_VISIBLE_CHIPS", "value": ",".join(
                                     str(c.index) for c in self._chips)},
                                 {"name": "TPU_MULTIPROCESS_DIR",
                                  "value": "/multiprocess"},
                             ],
+                            # Readiness comes from the binary's own probe
+                            # (socket answers READY), the startup.log-based
+                            # startupProbe shape of the reference template.
+                            "startupProbe": {**probe,
+                                             "initialDelaySeconds": 1,
+                                             "periodSeconds": 1,
+                                             "failureThreshold": 30},
+                            "readinessProbe": {**probe, "periodSeconds": 5},
                             "volumeMounts": [
                                 {"name": "coord", "mountPath": "/multiprocess"},
                                 {"name": "shm", "mountPath": "/dev/shm"},
@@ -156,22 +199,16 @@ class MultiprocessDaemon:
 
     def cdi_edits(self) -> Dict:
         """Claim CDI contributions (GetCDIContainerEdits analog,
-        sharing.go:355-375): coordination dir mount + limit env."""
-        uuids = [c.uuid for c in self._chips]
-        indices = {c.uuid: c.index for c in self._chips}
+        sharing.go:355-375): coordination dir mount + limit env. The pipe
+        path is the CUDA_MPS_PIPE_DIRECTORY analog — tenants find the
+        coordinator's Unix socket there to register their lease."""
         env = {"TPU_MULTIPROCESS_DIR": "/multiprocess",
+               "TPU_MULTIPROCESS_PIPE": "/multiprocess/pipe",
                "TPU_MULTIPROCESS_ID": self._claim_uid}
         if self._config.default_active_cores_percentage is not None:
             env["TPU_TENSORCORE_PERCENTAGE"] = str(
                 self._config.default_active_cores_percentage)
-        limits: Dict[str, int] = {}
-        if self._config.per_device_hbm_limit is not None:
-            limits = self._config.per_device_hbm_limit.normalize(
-                uuids, indices, self._config.default_hbm_limit)
-        elif self._config.default_hbm_limit is not None:
-            from tpu_dra.infra.quantity import Quantity
-            limits = {u: Quantity(self._config.default_hbm_limit).value
-                      for u in uuids}
+        limits = self._limits()
         if limits:
             # libtpu reads a single per-process premapped-HBM cap; export the
             # per-chip map for multi-chip claims plus the scalar for 1-chip.
@@ -195,13 +232,15 @@ class MultiprocessManager:
 
     def __init__(self, backend: TpuInfoBackend, client: ApiClient, *,
                  node_name: str, namespace: str, root_dir: str,
-                 image: str = "tpu-dra-driver:latest"):
+                 image: str = "tpu-dra-driver:latest",
+                 ready_timeout: float = 30.0):
         self._backend = backend
         self._client = client
         self._node_name = node_name
         self._namespace = namespace
         self._root_dir = root_dir
         self._image = image
+        self._ready_timeout = ready_timeout
 
     def daemon(self, claim_uid: str, chips: List[Chip],
                config: apitypes.MultiprocessConfig) -> MultiprocessDaemon:
@@ -212,14 +251,15 @@ class MultiprocessManager:
 
     def start(self, claim_uid: str, chips: List[Chip],
               config: apitypes.MultiprocessConfig,
-              ready_timeout: float = 30.0) -> MultiprocessDaemon:
+              ready_timeout: Optional[float] = None) -> MultiprocessDaemon:
         # Multiprocess tenants must not race other workloads on the chip:
         # set exclusive-to-claim mode (EXCLUSIVE_PROCESS analog).
         for chip in chips:
             self._backend.set_exclusive_mode(chip.index, True)
         d = self.daemon(claim_uid, chips, config)
         d.start()
-        d.assert_ready(timeout=ready_timeout)
+        d.assert_ready(timeout=ready_timeout if ready_timeout is not None
+                       else self._ready_timeout)
         return d
 
     def stop(self, claim_uid: str, chips: List[Chip]) -> None:
