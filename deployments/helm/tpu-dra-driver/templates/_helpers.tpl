@@ -1,0 +1,78 @@
+{{/*
+Naming/label helpers. Reference:
+deployments/helm/nvidia-dra-driver-gpu/templates/_helpers.tpl.
+*/}}
+
+{{- define "tpu-dra-driver.name" -}}
+{{- default .Chart.Name .Values.nameOverride | trunc 63 | trimSuffix "-" }}
+{{- end }}
+
+{{- define "tpu-dra-driver.fullname" -}}
+{{- if .Values.fullnameOverride -}}
+{{- .Values.fullnameOverride | trunc 63 | trimSuffix "-" -}}
+{{- else -}}
+{{- $name := default .Chart.Name .Values.nameOverride -}}
+{{- if contains $name .Release.Name -}}
+{{- .Release.Name | trunc 63 | trimSuffix "-" -}}
+{{- else -}}
+{{- printf "%s-%s" .Release.Name $name | trunc 63 | trimSuffix "-" -}}
+{{- end -}}
+{{- end -}}
+{{- end -}}
+
+{{- define "tpu-dra-driver.namespace" -}}
+{{- if .Values.namespaceOverride -}}
+{{- .Values.namespaceOverride -}}
+{{- else -}}
+{{- .Release.Namespace -}}
+{{- end -}}
+{{- end -}}
+
+{{- define "tpu-dra-driver.chart" -}}
+{{- $name := default .Chart.Name .Values.nameOverride -}}
+{{- printf "%s-%s" $name .Chart.Version | replace "+" "_" | trunc 63 | trimSuffix "-" }}
+{{- end }}
+
+{{/* Standard labels for top-level objects. */}}
+{{- define "tpu-dra-driver.labels" -}}
+helm.sh/chart: {{ include "tpu-dra-driver.chart" . }}
+app.kubernetes.io/version: {{ .Chart.AppVersion | quote }}
+app.kubernetes.io/managed-by: {{ .Release.Service }}
+app.kubernetes.io/name: {{ include "tpu-dra-driver.name" . }}
+app.kubernetes.io/instance: {{ .Release.Name }}
+{{- end }}
+
+{{/*
+Selector labels, parameterized by component. Call with
+(dict "context" . "componentName" "controller").
+*/}}
+{{- define "tpu-dra-driver.selectorLabels" -}}
+app.kubernetes.io/name: {{ include "tpu-dra-driver.name" .context }}
+app.kubernetes.io/instance: {{ .context.Release.Name }}
+{{- if .componentName }}
+app.kubernetes.io/component: {{ .componentName }}
+{{- end }}
+{{- end }}
+
+{{/* Image reference; empty tag defaults to the chart appVersion. */}}
+{{- define "tpu-dra-driver.image" -}}
+{{- printf "%s:%s" .Values.image.repository (default .Chart.AppVersion .Values.image.tag) }}
+{{- end }}
+
+{{/* FEATURE_GATES env value: "Gate1=true,Gate2=false". */}}
+{{- define "tpu-dra-driver.featureGates" -}}
+{{- $gates := list }}
+{{- range $k, $v := .Values.featureGates }}
+{{- $gates = append $gates (printf "%s=%t" $k $v) }}
+{{- end }}
+{{- join "," $gates }}
+{{- end }}
+
+{{/* Webhook service name + in-cluster DNS names. */}}
+{{- define "tpu-dra-driver.webhookService" -}}
+{{- printf "%s-webhook" (include "tpu-dra-driver.fullname" .) }}
+{{- end }}
+
+{{- define "tpu-dra-driver.webhookServiceFQDN" -}}
+{{- printf "%s.%s.svc" (include "tpu-dra-driver.webhookService" .) (include "tpu-dra-driver.namespace" .) }}
+{{- end }}

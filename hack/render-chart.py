@@ -1,0 +1,77 @@
+#!/usr/bin/env python3
+"""`helm template` analog for environments without the helm binary.
+
+    python hack/render-chart.py [--set key.path=value ...] \
+        [--namespace NS] [--release NAME] [--values FILE] [chart_dir]
+
+Renders the chart through tpu_dra.deploy.helmlite and prints a multi-doc
+YAML stream suitable for `kubectl apply -f -`. Exits non-zero (with the
+template error) on any validation failure — the reference's
+`helm template | kubectl apply --dry-run=client` gate.
+"""
+import argparse
+import os
+import sys
+
+import yaml
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from tpu_dra.deploy.helmlite import TemplateError, render_chart  # noqa: E402
+
+DEFAULT_CHART = os.path.join(os.path.dirname(__file__), "..",
+                             "deployments", "helm", "tpu-dra-driver")
+
+
+def _coerce(v: str):
+    if v in ("true", "false"):
+        return v == "true"
+    try:
+        return int(v)
+    except ValueError:
+        return v
+
+
+def _set_path(d: dict, dotted: str, value) -> None:
+    keys = dotted.split(".")
+    for k in keys[:-1]:
+        d = d.setdefault(k, {})
+    d[keys[-1]] = value
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("chart_dir", nargs="?", default=DEFAULT_CHART)
+    ap.add_argument("--set", action="append", default=[], dest="sets",
+                    metavar="key.path=value")
+    ap.add_argument("--values", "-f", default=None,
+                    help="extra values YAML file (merged over defaults)")
+    ap.add_argument("--namespace", "-n", default="tpu-dra-driver")
+    ap.add_argument("--release", default="tpu-dra-driver")
+    args = ap.parse_args()
+
+    overrides: dict = {}
+    if args.values:
+        with open(args.values) as f:
+            overrides = yaml.safe_load(f) or {}
+    for s in args.sets:
+        if "=" not in s:
+            print(f"bad --set {s!r} (need key=value)", file=sys.stderr)
+            return 2
+        k, v = s.split("=", 1)
+        _set_path(overrides, k, _coerce(v))
+
+    try:
+        docs = render_chart(args.chart_dir, overrides,
+                            release_name=args.release,
+                            namespace=args.namespace)
+    except TemplateError as e:
+        print(f"render error: {e}", file=sys.stderr)
+        return 1
+    print(yaml.safe_dump_all(docs, default_flow_style=False,
+                             sort_keys=False), end="")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

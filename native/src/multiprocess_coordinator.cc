@@ -204,6 +204,10 @@ class Coordinator {
       int fd = accept(listen_fd_, nullptr, nullptr);
       if (fd < 0) {
         if (g_stop) break;
+        // Persistent accept errors (EMFILE under fd exhaustion) must not
+        // busy-spin a full core and starve the connection threads whose
+        // completion would free fds.
+        usleep(10 * 1000);
         continue;
       }
       // One thread per connection: probes are one-shot, but a tenant
@@ -281,11 +285,18 @@ class Coordinator {
     if (cmd == "U") {
       int id = -1;
       in >> id;
-      if (leases_.erase(id)) {
-        if (id == *conn_lease) *conn_lease = -1;
-        log_->Line("lease %d released (%zu/%d)", id, leases_.size(),
-                   opts_.max_clients);
+      // A connection may only release ITS OWN lease: tenants are mutually
+      // untrusted processes, and honoring arbitrary ids would let one
+      // tenant free another's slot and over-admit past max_clients.
+      // Idempotent for the holder (repeat "U" after release is OK).
+      if (id != *conn_lease) {
+        if (leases_.count(id)) return "ERR not lease holder\n";
+        return "OK\n";  // already gone (or never existed): idempotent
       }
+      leases_.erase(id);
+      *conn_lease = -1;
+      log_->Line("lease %d released (%zu/%d)", id, leases_.size(),
+                 opts_.max_clients);
       return "OK\n";
     }
     if (cmd == "L") {

@@ -164,6 +164,9 @@ class Daemon {
       int fd = accept(listen_fd_, nullptr, nullptr);
       if (fd < 0) {
         if (g_stop) break;
+        // Back off on persistent accept errors (EMFILE) instead of
+        // busy-spinning a core.
+        usleep(10 * 1000);
         continue;
       }
       // Bound the inbound read the same way outbound dials are bounded

@@ -51,7 +51,8 @@ int CmdList(tpuinfo_ctx* ctx) {
 }  // namespace
 
 int main(int argc, char** argv) {
-  if (argc < 2) {
+  if (argc < 2 || strcmp(argv[1], "--help") == 0 ||
+      strcmp(argv[1], "-h") == 0) {
     fprintf(stderr, "usage: tpuctl <list|set-timeslice|get-timeslice|set-exclusive|version> ...\n");
     return 2;
   }

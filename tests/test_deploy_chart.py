@@ -1,0 +1,350 @@
+"""Helm chart rendering + validation tier.
+
+The environment has no helm/kubectl binaries, so the reference's packaging
+gate (`helm template | kubectl apply --dry-run=client`, Makefile + bats
+helpers.sh iupgrade_wait) is reproduced as: render the chart through
+helmlite across value permutations, then structurally validate every
+document (selector/label coherence, namespace placement, cert plumbing)
+— the checks dry-run server-side admission would do.
+
+Reference: deployments/helm/nvidia-dra-driver-gpu/templates/.
+"""
+
+import base64
+import os
+import subprocess
+import sys
+
+import pytest
+import yaml
+
+from tpu_dra.api import types as apitypes
+from tpu_dra.api.crd import compute_domain_crd
+from tpu_dra.cdcontroller import templates as cdtemplates
+from tpu_dra.deploy.helmlite import TemplateError, render_chart
+
+CHART = os.path.join(os.path.dirname(__file__), "..",
+                     "deployments", "helm", "tpu-dra-driver")
+
+
+def render(overrides=None, **kw):
+    return render_chart(CHART, overrides, **kw)
+
+
+def by_kind_name(docs):
+    return {(d["kind"], d["metadata"]["name"]): d for d in docs}
+
+
+# ---------------------------------------------------------------------------
+# Default render
+# ---------------------------------------------------------------------------
+
+class TestDefaultRender:
+    def test_all_expected_kinds(self):
+        docs = render()
+        kinds = sorted({d["kind"] for d in docs})
+        assert kinds == sorted({
+            "CustomResourceDefinition", "DaemonSet", "Deployment",
+            "DeviceClass", "ServiceAccount", "ClusterRole",
+            "ClusterRoleBinding", "NetworkPolicy", "Secret", "Service",
+            "ValidatingWebhookConfiguration", "ValidatingAdmissionPolicy",
+            "ValidatingAdmissionPolicyBinding"})
+
+    def test_every_doc_well_formed(self):
+        for d in render():
+            assert d.get("apiVersion"), d
+            assert d.get("kind"), d
+            assert d.get("metadata", {}).get("name"), d
+
+    def test_device_class_names_match_api_constants(self):
+        names = {d["metadata"]["name"] for d in render()
+                 if d["kind"] == "DeviceClass"}
+        assert names == {"tpu.dev", "tpu-subslice.tpu.dev",
+                         apitypes.DEVICE_CLASS_DAEMON,
+                         apitypes.DEVICE_CLASS_CHANNEL}
+
+    def test_device_class_cel_uses_driver_names(self):
+        for d in render():
+            if d["kind"] != "DeviceClass":
+                continue
+            expr = d["spec"]["selectors"][0]["cel"]["expression"]
+            assert (apitypes.TPU_DRIVER_NAME in expr
+                    or apitypes.COMPUTE_DOMAIN_DRIVER_NAME in expr)
+
+    def test_namespaced_objects_in_release_namespace(self):
+        cluster_scoped = {"CustomResourceDefinition", "DeviceClass",
+                          "ClusterRole", "ClusterRoleBinding",
+                          "ValidatingWebhookConfiguration",
+                          "ValidatingAdmissionPolicy",
+                          "ValidatingAdmissionPolicyBinding"}
+        for d in render(namespace="prod-ns"):
+            if d["kind"] in cluster_scoped:
+                assert "namespace" not in d["metadata"], d["kind"]
+            else:
+                assert d["metadata"]["namespace"] == "prod-ns", d["kind"]
+
+    def test_workload_selectors_match_pod_labels(self):
+        """The classic chart bug: selector.matchLabels drifting from
+        template labels makes the Deployment unadoptable."""
+        for d in render():
+            if d["kind"] not in ("Deployment", "DaemonSet"):
+                continue
+            sel = d["spec"]["selector"]["matchLabels"]
+            pod = d["spec"]["template"]["metadata"]["labels"]
+            for k, v in sel.items():
+                assert pod.get(k) == v, (d["metadata"]["name"], k)
+
+    def test_crd_matches_api_module(self):
+        crd = [d for d in render()
+               if d["kind"] == "CustomResourceDefinition"][0]
+        assert crd == compute_domain_crd()
+
+    def test_image_defaults_to_app_version(self):
+        with open(os.path.join(CHART, "Chart.yaml")) as f:
+            app_version = yaml.safe_load(f)["appVersion"]
+        docs = by_kind_name(render())
+        ctr = docs[("Deployment", "tpu-dra-driver-controller")]
+        image = ctr["spec"]["template"]["spec"]["containers"][0]["image"]
+        assert image == f"tpu-dra-driver:{app_version}"
+
+    def test_feature_gates_env_joined(self):
+        docs = by_kind_name(render(
+            {"featureGates": {"A": True, "B": False}}))
+        ds = docs[("DaemonSet", "tpu-dra-driver-kubelet-plugin")]
+        envs = {e["name"]: e.get("value") for c in
+                ds["spec"]["template"]["spec"]["containers"]
+                for e in c["env"]}
+        # values_override deep-merges over the default gate map.
+        assert envs["FEATURE_GATES"] == ("A=true,B=false,"
+                                         "MultiprocessSupport=true,"
+                                         "TimeSlicingSettings=true")
+
+    def test_plugin_health_ports_distinct(self):
+        docs = by_kind_name(render())
+        ds = docs[("DaemonSet", "tpu-dra-driver-kubelet-plugin")]
+        ports = [c["livenessProbe"]["httpGet"]["port"]
+                 for c in ds["spec"]["template"]["spec"]["containers"]]
+        assert len(ports) == len(set(ports)) == 2
+
+    def test_daemon_sa_wired_controller_to_rbac(self):
+        """The controller's DAEMON_SERVICE_ACCOUNT env must name the SA
+        the chart actually creates for daemon pods."""
+        docs = by_kind_name(render())
+        ctr = docs[("Deployment", "tpu-dra-driver-controller")]
+        envs = {e["name"]: e.get("value") for e in
+                ctr["spec"]["template"]["spec"]["containers"][0]["env"]}
+        sa = envs["DAEMON_SERVICE_ACCOUNT"]
+        assert ("ServiceAccount", sa) in docs
+
+    def test_rbac_bindings_reference_existing_roles(self):
+        docs = by_kind_name(render())
+        for (kind, name), d in docs.items():
+            if kind != "ClusterRoleBinding":
+                continue
+            assert ("ClusterRole", d["roleRef"]["name"]) in docs
+            for s in d["subjects"]:
+                assert ("ServiceAccount", s["name"]) in docs
+
+
+# ---------------------------------------------------------------------------
+# Webhook TLS modes
+# ---------------------------------------------------------------------------
+
+class TestWebhookTLS:
+    def test_selfsigned_secret_and_cabundle_share_cert(self):
+        docs = by_kind_name(render())
+        sec = docs[("Secret", "tpu-dra-driver-webhook-tls")]
+        vwc = docs[("ValidatingWebhookConfiguration", "tpu-dra-driver-webhook")]
+        assert (sec["data"]["tls.crt"]
+                == vwc["webhooks"][0]["clientConfig"]["caBundle"])
+        pem = base64.b64decode(sec["data"]["tls.crt"])
+        assert pem.startswith(b"-----BEGIN CERTIFICATE-----")
+        key = base64.b64decode(sec["data"]["tls.key"])
+        assert b"PRIVATE KEY" in key
+
+    def test_selfsigned_cert_has_service_san(self):
+        from cryptography import x509
+        docs = by_kind_name(render(namespace="ns1"))
+        sec = docs[("Secret", "tpu-dra-driver-webhook-tls")]
+        cert = x509.load_pem_x509_certificate(
+            base64.b64decode(sec["data"]["tls.crt"]))
+        san = cert.extensions.get_extension_for_class(
+            x509.SubjectAlternativeName).value
+        dns = san.get_values_for_type(x509.DNSName)
+        assert "tpu-dra-driver-webhook.ns1.svc" in dns
+        assert "tpu-dra-driver-webhook.ns1.svc.cluster.local" in dns
+
+    def test_cert_manager_mode(self):
+        docs = render({"webhook": {"tls": {"mode": "cert-manager"}}})
+        kinds = {d["kind"] for d in docs}
+        assert "Issuer" in kinds and "Certificate" in kinds
+        assert "Secret" not in kinds
+        vwc = [d for d in docs
+               if d["kind"] == "ValidatingWebhookConfiguration"][0]
+        assert "cert-manager.io/inject-ca-from" in vwc["metadata"]["annotations"]
+        assert "caBundle" not in vwc["webhooks"][0]["clientConfig"]
+
+    def test_cert_manager_external_issuer(self):
+        docs = render({"webhook": {"tls": {"mode": "cert-manager",
+                                           "certManager": {
+                                               "issuerType": "clusterissuer",
+                                               "issuerName": "corp-ca"}}}})
+        cert = [d for d in docs if d["kind"] == "Certificate"][0]
+        assert cert["spec"]["issuerRef"] == {"kind": "ClusterIssuer",
+                                             "name": "corp-ca"}
+        assert not any(d["kind"] == "Issuer" for d in docs)
+
+    def test_secret_mode_uses_operator_secret(self):
+        docs = by_kind_name(render(
+            {"webhook": {"tls": {"mode": "secret",
+                                 "secret": {"name": "my-tls",
+                                            "caBundle": "QUJD"}}}}))
+        dep = docs[("Deployment", "tpu-dra-driver-webhook")]
+        vol = dep["spec"]["template"]["spec"]["volumes"][0]
+        assert vol["secret"]["secretName"] == "my-tls"
+        vwc = docs[("ValidatingWebhookConfiguration", "tpu-dra-driver-webhook")]
+        assert vwc["webhooks"][0]["clientConfig"]["caBundle"] == "QUJD"
+
+    def test_webhook_disabled(self):
+        docs = render({"webhook": {"enabled": False}})
+        kinds = {d["kind"] for d in docs}
+        assert "ValidatingWebhookConfiguration" not in kinds
+        assert "Secret" not in kinds
+        # VAP backstop still present — it is the webhook-down guard.
+        assert "ValidatingAdmissionPolicy" in kinds
+
+
+# ---------------------------------------------------------------------------
+# Gating + validation failures
+# ---------------------------------------------------------------------------
+
+class TestGating:
+    def test_compute_domains_disabled(self):
+        docs = render({"resources": {"computeDomains": {"enabled": False}}})
+        names = {(d["kind"], d["metadata"]["name"]) for d in docs}
+        assert ("Deployment", "tpu-dra-driver-controller") not in names
+        assert ("ServiceAccount", "tpu-dra-driver-cd-daemon") not in names
+        ds = [d for d in docs if d["kind"] == "DaemonSet"][0]
+        assert [c["name"] for c in
+                ds["spec"]["template"]["spec"]["containers"]] == ["tpu-plugin"]
+
+    def test_tpus_disabled(self):
+        docs = render({"resources": {"tpus": {"enabled": False}}})
+        dc = {d["metadata"]["name"] for d in docs
+              if d["kind"] == "DeviceClass"}
+        assert dc == {apitypes.DEVICE_CLASS_DAEMON,
+                      apitypes.DEVICE_CLASS_CHANNEL}
+
+    @pytest.mark.parametrize("overrides,namespace,frag", [
+        (None, "default", "default' namespace"),
+        ({"webhook": {"tls": {"mode": "bogus"}}}, "x", "webhook.tls.mode"),
+        ({"webhook": {"tls": {"mode": "secret"}}}, "x", "secret.name"),
+        ({"resources": {"tpus": {"enabled": False},
+                        "computeDomains": {"enabled": False}}}, "x",
+         "At least one"),
+        ({"resourceApiVersion": ""}, "x", "resourceApiVersion"),
+        ({"resourceApiVersion": "apps/v1"}, "x", "resource.k8s.io"),
+        ({"webhook": {"tls": {"mode": "cert-manager",
+                              "certManager": {"issuerType": "issuer"}}}},
+         "x", "issuerName"),
+    ])
+    def test_validation_failures(self, overrides, namespace, frag):
+        with pytest.raises(TemplateError, match=frag.replace("'", ".")):
+            render(overrides, namespace=namespace)
+
+    def test_default_namespace_opt_in(self):
+        docs = render({"allowDefaultNamespace": True}, namespace="default")
+        assert docs  # explicit opt-in renders
+
+
+# ---------------------------------------------------------------------------
+# render CLI + consistency with the programmatic manifests
+# ---------------------------------------------------------------------------
+
+class TestRenderCli:
+    def test_cli_renders_and_sets_values(self):
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(__file__), "..", "hack",
+                          "render-chart.py"),
+             "--set", "image.repository=example.com/tpu-dra",
+             "--set", "image.tag=v9", "-n", "ns2"],
+            capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stderr
+        docs = list(yaml.safe_load_all(out.stdout))
+        ctr = [d for d in docs if d and d["kind"] == "Deployment"
+               and d["metadata"]["name"].endswith("controller")][0]
+        img = ctr["spec"]["template"]["spec"]["containers"][0]["image"]
+        assert img == "example.com/tpu-dra:v9"
+
+    def test_cli_fails_on_bad_values(self):
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(__file__), "..", "hack",
+                          "render-chart.py"),
+             "--set", "webhook.tls.mode=nope"],
+            capture_output=True, text=True, timeout=120)
+        assert out.returncode == 1
+        assert "webhook.tls.mode" in out.stderr
+
+
+class TestManifestConsistency:
+    """The chart and tpu_dra.deploy.manifests must not drift: same
+    commands, same driver wiring. (manifests.py is the programmatic
+    mirror the in-process e2e tier installs.)"""
+
+    def test_commands_match(self):
+        from tpu_dra.deploy import manifests
+        docs = by_kind_name(render())
+        chart_ctr = docs[("Deployment", "tpu-dra-driver-controller")]
+        prog_ctr = manifests.controller_deployment()
+        assert (chart_ctr["spec"]["template"]["spec"]["containers"][0]
+                ["command"]
+                == prog_ctr["spec"]["template"]["spec"]["containers"][0]
+                ["command"])
+        chart_ds = docs[("DaemonSet", "tpu-dra-driver-kubelet-plugin")]
+        prog_ds = manifests.kubelet_plugin_daemonset()
+        assert ([c["command"] for c in
+                 chart_ds["spec"]["template"]["spec"]["containers"]]
+                == [c["command"] for c in
+                    prog_ds["spec"]["template"]["spec"]["containers"]])
+
+    def test_daemonset_sa_template_plumbing(self):
+        cd = {"metadata": {"name": "cd1", "uid": "u1", "namespace": "ws"}}
+        ds = cdtemplates.daemon_daemonset(
+            cd, namespace="drv", image="img", daemon_claim_template="t",
+            service_account="the-sa")
+        assert (ds["spec"]["template"]["spec"]["serviceAccountName"]
+                == "the-sa")
+        ds2 = cdtemplates.daemon_daemonset(
+            cd, namespace="drv", image="img", daemon_claim_template="t")
+        assert "serviceAccountName" not in ds2["spec"]["template"]["spec"]
+
+
+# ---------------------------------------------------------------------------
+# Dockerfile sanity (no docker daemon here; structural checks)
+# ---------------------------------------------------------------------------
+
+class TestDockerfile:
+    DF = os.path.join(os.path.dirname(__file__), "..", "deployments",
+                      "container", "Dockerfile")
+
+    def test_stages_and_artifacts(self):
+        with open(self.DF) as f:
+            src = f.read()
+        assert src.count("FROM ") == 2  # build + runtime
+        for artifact in ("libtpuinfo.so", "tpuctl", "tpu-slice-daemon",
+                         "tpu-multiprocess-coordinator"):
+            assert f"/src/native/build/{artifact}" in src, artifact
+        assert "make -C native" in src
+        assert "TPU_DRA_LIBTPUINFO" in src  # tpuinfo.py:161-174 seam
+
+    def test_requirements_cover_driver_imports(self):
+        req = os.path.join(os.path.dirname(self.DF), "requirements.txt")
+        with open(req) as f:
+            lines = [ln.strip().lower() for ln in f
+                     if ln.strip() and not ln.startswith("#")]
+        for dep in ("grpcio", "protobuf", "pyyaml", "cryptography"):
+            assert any(ln.startswith(dep) for ln in lines), dep
+        # JAX belongs in workload images only.
+        assert not any(ln.startswith("jax") for ln in lines)

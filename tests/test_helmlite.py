@@ -1,0 +1,95 @@
+"""Unit tests for the helmlite Go-template-subset renderer — the engine
+under the chart tier (tests/test_deploy_chart.py). Focus: the semantics
+charts actually depend on (variable scoping, sprig list building, printf
+verbs, include isolation, fail)."""
+
+import pytest
+
+from tpu_dra.deploy import helmlite
+from tpu_dra.deploy.helmlite import TemplateError
+
+
+def render(src: str, data=None) -> str:
+    tree, defines = helmlite._parse(helmlite._lex(src))
+    data = data or {}
+    ctx = helmlite._Ctx(data, data, {}, defines, helmlite._make_functions())
+    return helmlite._render_nodes(tree, ctx)
+
+
+class TestVariables:
+    def test_declare_and_use(self):
+        assert render('{{- $x := "hi" }}{{ $x }}') == "hi"
+
+    def test_reassign_inside_range_mutates_outer(self):
+        src = ('{{- $all := list }}'
+               '{{- range $k, $v := .m }}'
+               '{{- $all = append $all (printf "%s=%t" $k $v) }}'
+               '{{- end }}'
+               '{{ join "," $all }}')
+        assert render(src, {"m": {"b": False, "a": True}}) == "a=true,b=false"
+
+    def test_declare_inside_range_scoped(self):
+        src = ('{{- range .xs }}{{- $inner := . }}{{- end }}{{ $inner }}')
+        with pytest.raises(TemplateError, match="undefined variable"):
+            render(src, {"xs": [1]})
+
+    def test_reassign_undeclared_errors(self):
+        with pytest.raises(TemplateError, match="undeclared"):
+            render('{{- $x = 1 }}')
+
+    def test_var_field_chain_attached(self):
+        assert render('{{- $c := .cfg }}{{ $c.a.b }}',
+                      {"cfg": {"a": {"b": "deep"}}}) == "deep"
+
+    def test_var_then_separate_field_arg(self):
+        # `$name .Release.Name` must be TWO args, not a field access.
+        src = '{{- $n := "abc" }}{{ if contains $n .Release.Name }}y{{ end }}'
+        assert render(src, {"Release": {"Name": "xx-abc-yy"}}) == "y"
+
+
+class TestFunctions:
+    def test_printf_verbs(self):
+        assert render('{{ printf "%s-%04d-%t" "a" 7 true }}') == "a-0007-true"
+
+    def test_printf_quote_verb(self):
+        assert render('{{ printf "%q" "v" }}') == '"v"'
+
+    def test_printf_arg_mismatch(self):
+        with pytest.raises(TemplateError, match="missing argument"):
+            render('{{ printf "%s %s" "one" }}')
+        with pytest.raises(TemplateError, match="too many"):
+            render('{{ printf "%s" "one" "two" }}')
+
+    def test_fail_raises(self):
+        with pytest.raises(TemplateError, match="boom"):
+            render('{{ fail "boom" }}')
+
+    def test_arithmetic_and_strings(self):
+        assert render('{{ add 1 2 3 }}/{{ sub 5 2 }}/{{ mul 2 3 }}') == "6/3/6"
+        assert render('{{ trimPrefix "v" "v1.2" }}') == "1.2"
+        assert render('{{ hasPrefix "re" "resource" }}') == "true"
+
+    def test_keys_sorted(self):
+        assert render('{{ join "," (keys .m) }}',
+                      {"m": {"z": 1, "a": 2}}) == "a,z"
+
+    def test_gen_self_signed_cert_fields(self):
+        out = render(
+            '{{- $c := genSelfSignedCert "cn.example" (list) '
+            '(list "alt.example") 30 }}{{ $c.Cert }}|{{ $c.Key }}')
+        cert_pem, key_pem = out.split("|")
+        assert cert_pem.startswith("-----BEGIN CERTIFICATE-----")
+        assert "PRIVATE KEY" in key_pem
+
+
+class TestIncludeScoping:
+    def test_include_does_not_see_caller_vars(self):
+        src = ('{{- define "t" -}}{{ $x }}{{- end -}}'
+               '{{- $x := "outer" }}{{ include "t" . }}')
+        with pytest.raises(TemplateError, match="undefined variable"):
+            render(src)
+
+    def test_include_gets_dot(self):
+        src = ('{{- define "t" -}}{{ .v }}{{- end -}}'
+               '{{ include "t" (dict "v" "val") }}')
+        assert render(src) == "val"

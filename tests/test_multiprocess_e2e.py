@@ -205,6 +205,44 @@ class TestCoordinatorBinary:
             proc.terminate()
             proc.wait(timeout=5)
 
+    def test_cannot_release_another_tenants_lease(self, tmp_path):
+        """Tenants are mutually untrusted: 'U <id>' must only release the
+        requesting connection's own lease, or one tenant could free
+        another's slot and over-admit past max-clients."""
+        d = str(tmp_path / "coord")
+        proc = subprocess.Popen(
+            [COORDINATOR_BIN, "--dir", d, "--chips", "0",
+             "--max-clients", "2"],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        try:
+            assert wait_for(lambda: os.path.exists(
+                os.path.join(d, "pipe", "coordinator.sock")), timeout=5)
+            me = os.getpid()
+            a = coordinator_connect(d)
+            b = coordinator_connect(d)
+            try:
+                assert request_on(a, f"R {me}\n").startswith("OK")
+                reply_b = request_on(b, f"R {me}\n")
+                assert reply_b.startswith("OK")
+                lease_b = reply_b.split()[1]
+                # Hostile: A tries to free B's lease.
+                assert request_on(a, f"U {lease_b}\n") \
+                    == "ERR not lease holder"
+                # B's lease still counts: a third tenant is denied.
+                assert coordinator_request(d, f"R {me}\n") \
+                    == "DENIED max-clients"
+                # B can release its own lease (and repeat idempotently).
+                assert request_on(b, f"U {lease_b}\n") == "OK"
+                assert request_on(b, f"U {lease_b}\n") == "OK"
+                # Slot actually freed now.
+                assert coordinator_request(d, f"R {me}\n").startswith("OK")
+            finally:
+                a.close()
+                b.close()
+        finally:
+            proc.terminate()
+            proc.wait(timeout=5)
+
     def test_check_fails_when_not_running(self, tmp_path):
         res = subprocess.run(
             [COORDINATOR_BIN, "--check", "--dir", str(tmp_path)],

@@ -64,13 +64,15 @@ class Controller:
                  image: str = "tpu-dra-driver:latest",
                  log_verbosity: int = 0, feature_gates: str = "",
                  max_nodes_per_slice_domain: int = 64,
-                 gc_interval: float = 600.0):
+                 gc_interval: float = 600.0,
+                 daemon_service_account: str = ""):
         self._client = client
         self._namespace = namespace  # driver namespace (DS + daemon RCT home)
         self._image = image
         self._log_verbosity = log_verbosity
         self._feature_gates = feature_gates
         self._max_nodes = max_nodes_per_slice_domain
+        self._daemon_sa = daemon_service_account
         self._queue = WorkQueue(default_controller_rate_limiter(),
                                 log=lambda m: log.debug("%s", m))
         self._stop = threading.Event()
@@ -213,7 +215,8 @@ class Controller:
                 daemon_claim_template=templates.daemon_object_name(cd),
                 log_verbosity=self._log_verbosity,
                 feature_gates=self._feature_gates,
-                max_nodes_per_slice_domain=self._max_nodes),
+                max_nodes_per_slice_domain=self._max_nodes,
+                service_account=self._daemon_sa),
              DAEMONSETS, ns),
             (lambda: templates.workload_claim_template(cd),
              RESOURCECLAIMTEMPLATES,

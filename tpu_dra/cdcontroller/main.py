@@ -29,6 +29,9 @@ def flags() -> FlagSet:
              help="driver namespace (DaemonSets + daemon RCTs land here)"),
         Flag("image", "DAEMON_IMAGE", default="tpu-dra-driver:latest",
              help="image for the per-CD slice-daemon DaemonSet"),
+        Flag("daemon-service-account", "DAEMON_SERVICE_ACCOUNT", default="",
+             help="serviceAccountName for stamped daemon pods "
+                  "(empty = namespace default SA)"),
         Flag("max-nodes-per-slice-domain", "MAX_NODES_PER_SLICE_DOMAIN",
              default=64, type=int,
              help="upper bound on hosts per ICI slice domain "
@@ -57,7 +60,8 @@ def main(argv=None) -> int:
         client, namespace=ns.namespace, image=ns.image,
         log_verbosity=ns.v, feature_gates=Features.as_string(),
         max_nodes_per_slice_domain=ns.max_nodes_per_slice_domain,
-        gc_interval=ns.gc_interval_seconds)
+        gc_interval=ns.gc_interval_seconds,
+        daemon_service_account=ns.daemon_service_account)
 
     metrics_srv = None
     if ns.http_endpoint_port:

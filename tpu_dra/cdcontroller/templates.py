@@ -30,7 +30,8 @@ def daemon_object_name(cd: Dict) -> str:
 def daemon_daemonset(cd: Dict, *, namespace: str, image: str,
                      daemon_claim_template: str, log_verbosity: int = 0,
                      feature_gates: str = "",
-                     max_nodes_per_slice_domain: int = 64) -> Dict:
+                     max_nodes_per_slice_domain: int = 64,
+                     service_account: str = "") -> Dict:
     """Per-CD DaemonSet. nodeSelector is the CD label, so daemon pods appear
     only as the CD kubelet plugin labels nodes (the workload-following
     behavior, daemonset.go:201-246)."""
@@ -38,6 +39,11 @@ def daemon_daemonset(cd: Dict, *, namespace: str, image: str,
     name = daemon_object_name(cd)
     labels = cd_labels(uid)
     pod_labels = dict(labels, **{"app.kubernetes.io/name": DAEMON_PREFIX})
+    # The daemon updates CD status from inside its pod; when deployed via
+    # the Helm chart it runs under the dedicated cd-daemon SA
+    # (rbac-compute-domain-daemon.yaml) rather than the namespace default.
+    sa_field = ({"serviceAccountName": service_account}
+                if service_account else {})
     return {
         "apiVersion": "apps/v1",
         "kind": "DaemonSet",
@@ -48,6 +54,7 @@ def daemon_daemonset(cd: Dict, *, namespace: str, image: str,
             "template": {
                 "metadata": {"labels": pod_labels},
                 "spec": {
+                    **sa_field,
                     "nodeSelector": cd_labels(uid),
                     "tolerations": [
                         {"key": "node-role.kubernetes.io/control-plane",

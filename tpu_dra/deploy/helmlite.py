@@ -11,10 +11,15 @@ template subset the chart in deployments/helm/tpu-dra-driver uses:
 - pipelines: ``expr | fn arg | fn``
 - terms: ``.a.b.c`` field chains, ``$`` root, ``$var`` (range/with vars),
   string literals, ints, bools, parenthesized expressions, function calls
-- functions: quote, squote, default, toYaml, nindent, indent, printf,
-  include, b64enc, eq, ne, not, and, or, empty, hasKey, trunc, trimSuffix,
-  lower, upper, replace, required, ternary, dict, list, fromYaml? (no),
-  len
+- statements: ``$x := expr`` (declare) and ``$x = expr`` (reassign the
+  nearest enclosing declaration, Go scoping — so list-building inside a
+  range mutates the outer variable, the sprig append/join idiom)
+- functions: quote, squote, default, toYaml, nindent, indent, printf
+  (Go verbs %s %d %v %t %q %f, width), include, b64enc, eq, ne, not, and,
+  or, empty, hasKey, trunc, trimSuffix, trimPrefix, lower, upper, replace,
+  required, ternary, dict, list, len, contains, hasPrefix, hasSuffix,
+  add, sub, mul, append, join, keys, toString, int, fail,
+  genSelfSignedCert (real PEM pair via the cryptography package)
 
 Truthiness follows Go templates: false, 0, "", nil, empty list/map are
 falsy. Rendering is strict: unknown functions and malformed actions raise
@@ -104,8 +109,18 @@ class _Define(_Node):
         self.body: List[_Node] = []
 
 
+class _Assign(_Node):
+    """``$x := expr`` (declare in current scope) or ``$x = expr``
+    (reassign nearest enclosing declaration — Go semantics, so a
+    ``$gates = append $gates ...`` inside range mutates the outer var)."""
+
+    def __init__(self, name: str, declare: bool, src: str):
+        self.name, self.declare, self.src = name, declare, src
+
+
 _RANGE_RE = re.compile(
     r"^range(?:\s+(\$\w+)\s*(?:,\s*(\$\w+))?\s*:=)?\s+(.*)$", re.DOTALL)
+_ASSIGN_RE = re.compile(r"^\$(\w+)\s*(:?=)\s*(.*)$", re.DOTALL)
 
 
 def _parse(nodes: List[Tuple[str, str]]) -> Tuple[List[_Node], Dict[str, List[_Node]]]:
@@ -165,7 +180,12 @@ def _parse(nodes: List[Tuple[str, str]]) -> Tuple[List[_Node], Dict[str, List[_N
             if isinstance(owner, _Define):
                 defines[owner.name] = owner.body
         else:
-            body().append(_Expr(action))
+            m = _ASSIGN_RE.match(action)
+            if m:
+                body().append(_Assign(m.group(1), m.group(2) == ":=",
+                                      m.group(3).strip()))
+            else:
+                body().append(_Expr(action))
     if len(stack) != 1:
         raise TemplateError("unclosed block at EOF")
     return root, defines
@@ -179,7 +199,8 @@ _TOKEN_RE = re.compile(r"""
     \s*(
         "(?:[^"\\]|\\.)*"        # double-quoted string
       | `[^`]*`                  # raw string
-      | \$\w*                    # $var or bare $
+      | \$\w+(?:\.[\w.]+)?       # $var with optional attached .field chain
+      | \$                       # bare $ (root)
       | \.[\w.]*                 # field chain .a.b / bare .
       | -?\d+(?:\.\d+)?          # number
       | \|                       # pipe
@@ -211,16 +232,38 @@ def _truthy(v: Any) -> bool:
 
 class _Ctx:
     def __init__(self, root: Any, dot: Any, vars_: Dict[str, Any],
-                 defines: Dict[str, List[_Node]], functions):
+                 defines: Dict[str, List[_Node]], functions,
+                 parent: Optional["_Ctx"] = None):
         self.root, self.dot, self.vars = root, dot, vars_
         self.defines, self.functions = defines, functions
+        self.parent = parent
 
     def child(self, dot=None, extra_vars=None) -> "_Ctx":
-        v = dict(self.vars)
-        if extra_vars:
-            v.update(extra_vars)
-        return _Ctx(self.root, self.dot if dot is None else dot, v,
-                    self.defines, self.functions)
+        # Own-vars dict + parent link (not a flat copy) so that a Go-style
+        # reassignment inside the child block mutates the declaring scope.
+        return _Ctx(self.root, self.dot if dot is None else dot,
+                    dict(extra_vars or {}), self.defines, self.functions,
+                    parent=self)
+
+    def lookup_var(self, name: str) -> Tuple[bool, Any]:
+        c: Optional[_Ctx] = self
+        while c is not None:
+            if name in c.vars:
+                return True, c.vars[name]
+            c = c.parent
+        return False, None
+
+    def declare_var(self, name: str, value: Any) -> None:
+        self.vars[name] = value
+
+    def assign_var(self, name: str, value: Any) -> None:
+        c: Optional[_Ctx] = self
+        while c is not None:
+            if name in c.vars:
+                c.vars[name] = value
+                return
+            c = c.parent
+        raise TemplateError(f"assignment to undeclared variable ${name}")
 
 
 def _resolve_field(base: Any, chain: str) -> Any:
@@ -299,14 +342,13 @@ class _ExprEval:
             if t == "$":
                 return self.ctx.root
             if t.startswith("$"):
-                if t[1:] in self.ctx.vars:
-                    base = self.ctx.vars[t[1:]]
-                    nxt = peek()
-                    if nxt and nxt.startswith("."):
-                        pos[0] += 1
-                        return _resolve_field(base, nxt)
-                    return base
-                raise TemplateError(f"undefined variable {t}")
+                name, chain = t[1:], ""
+                if "." in name:
+                    name, chain = name.split(".", 1)
+                found, base = self.ctx.lookup_var(name)
+                if not found:
+                    raise TemplateError(f"undefined variable ${name}")
+                return _resolve_field(base, chain) if chain else base
             if t.startswith("."):
                 return _resolve_field(self.ctx.dot, t)
             if re.fullmatch(r"-?\d+", t):
@@ -382,6 +424,12 @@ def _render_nodes(nodes: List[_Node], ctx: _Ctx) -> str:
             v = _ExprEval(ctx).eval(node.src)
             if _truthy(v):
                 out.append(_render_nodes(node.body, ctx.child(dot=v)))
+        elif isinstance(node, _Assign):
+            v = _ExprEval(ctx).eval(node.src)
+            if node.declare:
+                ctx.declare_var(node.name, v)
+            else:
+                ctx.assign_var(node.name, v)
         else:
             raise TemplateError(f"unhandled node {node!r}")
     return "".join(out)
@@ -397,6 +445,90 @@ def _gostr(v: Any) -> str:
 
 def _to_yaml(v: Any) -> str:
     return yaml.safe_dump(v, default_flow_style=False, sort_keys=False).rstrip("\n")
+
+
+_VERB_RE = re.compile(r"%(0?\d*)([sdvtqf%])")
+
+
+def _go_sprintf(fmt: str, args: Tuple[Any, ...]) -> str:
+    """Go fmt verb subset: %s %d %v %t %q %f, optional zero-padded width
+    (e.g. %04d), and %% escape. Errors on arg-count mismatch like Go's
+    EXTRA/MISSING markers would surface — strict beats garbage YAML."""
+    it = iter(args)
+
+    def sub(m: re.Match) -> str:
+        width, verb = m.group(1), m.group(2)
+        if verb == "%":
+            return "%"
+        try:
+            a = next(it)
+        except StopIteration:
+            raise TemplateError(f"printf {fmt!r}: missing argument")
+        if verb == "t":
+            s = "true" if _truthy(a) else "false"
+        elif verb == "d":
+            s = str(int(a))
+        elif verb == "f":
+            s = str(float(a))
+        elif verb == "q":
+            return '"' + _gostr(a).replace('"', '\\"') + '"'
+        else:
+            s = _gostr(a)
+        if width:
+            pad = "0" if width.startswith("0") else " "
+            s = s.rjust(int(width), pad)
+        return s
+
+    out = _VERB_RE.sub(sub, fmt)
+    if next(it, None) is not None:
+        raise TemplateError(f"printf {fmt!r}: too many arguments")
+    return out
+
+
+def _gen_self_signed_cert(cn: str, ips: List[str], dns_names: List[str],
+                          days: int) -> Dict[str, str]:
+    """helm/sprig genSelfSignedCert analog: returns {Cert, Key} PEM pair.
+    The cert is its own CA (BasicConstraints CA=true) so charts can use
+    Cert as both the server certificate and the webhook caBundle."""
+    import datetime
+    import ipaddress
+
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import ExtendedKeyUsageOID, NameOID
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, cn)])
+    sans: List[x509.GeneralName] = [x509.DNSName(cn)]
+    for d in dns_names or []:
+        if d and d != cn:
+            sans.append(x509.DNSName(str(d)))
+    for ip in ips or []:
+        if ip:
+            sans.append(x509.IPAddress(ipaddress.ip_address(str(ip))))
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name).issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=int(days)))
+        .add_extension(x509.BasicConstraints(ca=True, path_length=None),
+                       critical=True)
+        .add_extension(x509.SubjectAlternativeName(sans), critical=False)
+        .add_extension(x509.ExtendedKeyUsage(
+            [ExtendedKeyUsageOID.SERVER_AUTH]), critical=False)
+        .sign(key, hashes.SHA256())
+    )
+    return {
+        "Cert": cert.public_bytes(serialization.Encoding.PEM).decode(),
+        "Key": key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.TraditionalOpenSSL,
+            serialization.NoEncryption()).decode(),
+    }
 
 
 def _make_functions() -> Dict[str, Callable]:
@@ -426,11 +558,13 @@ def _make_functions() -> Dict[str, Callable]:
         body = ctx.defines.get(name)
         if body is None:
             raise TemplateError(f"include of undefined template {name!r}")
-        return _render_nodes(body, ctx.child(dot=dot))
+        # Fresh variable scope (Go template-invocation semantics): the
+        # callee sees only its argument, not the caller's $vars.
+        return _render_nodes(body, _Ctx(ctx.root, dot, {}, ctx.defines,
+                                        ctx.functions))
 
     def printf(ctx, fmt, *args):
-        return fmt.replace("%s", "{}").replace("%d", "{}").format(
-            *[_gostr(a) for a in args])
+        return _go_sprintf(fmt, args)
 
     def required(ctx, msg, v):
         if not _truthy(v):
@@ -471,7 +605,27 @@ def _make_functions() -> Dict[str, Callable]:
         "dict": lambda ctx, *kv: {kv[i]: kv[i + 1]
                                   for i in range(0, len(kv), 2)},
         "list": lambda ctx, *vs: list(vs),
+        "contains": lambda ctx, sub, s: sub in _gostr(s),
+        "hasPrefix": lambda ctx, pre, s: _gostr(s).startswith(pre),
+        "hasSuffix": lambda ctx, suf, s: _gostr(s).endswith(suf),
+        "trimPrefix": lambda ctx, pre, s: _gostr(s)[len(pre):]
+        if _gostr(s).startswith(pre) else _gostr(s),
+        "add": lambda ctx, *vs: sum(int(v) for v in vs),
+        "sub": lambda ctx, a, b: int(a) - int(b),
+        "mul": lambda ctx, *vs: __import__("math").prod(int(v) for v in vs),
+        "append": lambda ctx, lst, *items: list(lst or []) + list(items),
+        "join": lambda ctx, sep, lst: sep.join(_gostr(v) for v in lst or []),
+        "keys": lambda ctx, d: sorted((d or {}).keys()),
+        "toString": lambda ctx, v: _gostr(v),
+        "int": lambda ctx, v: int(v),
+        "fail": _fail,
+        "genSelfSignedCert": lambda ctx, cn, ips, dns, days:
+            _gen_self_signed_cert(cn, ips, dns, days),
     }
+
+
+def _fail(ctx, msg):
+    raise TemplateError(f"fail: {_gostr(msg)}")
 
 
 # ---------------------------------------------------------------------------

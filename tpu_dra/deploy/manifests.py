@@ -21,8 +21,9 @@ APP = "tpu-dra-driver"
 DEFAULT_NAMESPACE = "tpu-dra-driver"
 DEFAULT_IMAGE = "tpu-dra-driver:latest"
 # Gates enabled in the rendered deployment so the shipped demo ladder
-# (tpu-test3 time-slicing) works out of the box; operators can override.
-DEFAULT_FEATURE_GATES = "TimeSlicingSettings=true"
+# (tpu-test3 time-slicing, tpu-test-multiprocess) works out of the box;
+# operators can override.
+DEFAULT_FEATURE_GATES = "MultiprocessSupport=true,TimeSlicingSettings=true"
 
 
 def namespace(ns: str = DEFAULT_NAMESPACE) -> Dict:
@@ -202,6 +203,8 @@ def kubelet_plugin_daemonset(ns: str = DEFAULT_NAMESPACE,
                                         "tpu_dra.tpuplugin.main"],
                             "securityContext": {"privileged": True},
                             "env": common_env + [
+                                {"name": "COORDINATOR_IMAGE",
+                                 "value": image},
                                 {"name": "HEALTHCHECK_PORT",
                                  "value": "8081"}],
                             "livenessProbe": {

@@ -49,6 +49,10 @@ def flags() -> FlagSet:
              help="metrics/health HTTP port (0 = disabled)"),
         Flag("additional-codes-to-ignore", "ADDITIONAL_CODES_TO_IGNORE",
              default="", help="comma-separated health event codes to skip"),
+        Flag("coordinator-image", "COORDINATOR_IMAGE",
+             default="tpu-dra-driver:latest",
+             help="image for per-claim multiprocess-coordinator "
+                  "Deployments (set to the deployed driver image)"),
         Flag("tpuctl-path", "TPUCTL_PATH", default="",
              help="path to tpuctl (empty = direct libtpuinfo calls)"),
         feature_gate_flag(),
@@ -76,7 +80,8 @@ def main(argv=None) -> int:
     if featuregates.enabled(featuregates.MultiprocessSupport):
         mp_manager = MultiprocessManager(
             backend, client, node_name=ns.node_name, namespace=ns.namespace,
-            root_dir=f"{ns.plugin_dir}/multiprocess")
+            root_dir=f"{ns.plugin_dir}/multiprocess",
+            image=ns.coordinator_image)
 
     state = DeviceState(
         backend=backend, cdi=cdi, checkpoints=checkpoints,
