@@ -285,6 +285,7 @@ class Coordinator {
     if (cmd == "U") {
       int id = -1;
       in >> id;
+      if (id < 0) return "ERR bad id\n";
       // A connection may only release ITS OWN lease: tenants are mutually
       // untrusted processes, and honoring arbitrary ids would let one
       // tenant free another's slot and over-admit past max_clients.

@@ -1,0 +1,297 @@
+"""Passthrough (VFIO rebind) tier.
+
+The manager runs its REAL sysfs file protocol (driver_override write,
+unbind via the bound driver's unbind file, bind via the target driver's
+bind file) against a make_fake_sysfs tree; FakeKernelPci applies the
+kernel's bind/unbind semantics to the tree, so a rebind only 'takes' when
+the manager wrote exactly the files the ABI requires.
+
+Reference: cmd/gpu-kubelet-plugin/vfio-device.go:33-264,
+scripts/bind_to_driver.sh:6-37, scripts/unbind_from_driver.sh.
+"""
+
+import os
+import shutil
+import threading
+import time
+
+import pytest
+
+from tpu_dra.api import types as apitypes
+from tpu_dra.cdi.handler import CDIHandler
+from tpu_dra.infra import featuregates
+from tpu_dra.native.tpuinfo import FakeBackend, default_fake_chips, make_fake_sysfs
+from tpu_dra.testing import FakeKernelPci
+from tpu_dra.tpuplugin.checkpoint import CheckpointManager
+from tpu_dra.tpuplugin.device_state import DeviceState
+from tpu_dra.tpuplugin.passthrough import (
+    PassthroughError, PassthroughManager, PciSysfs, TPU_DRIVER, VFIO_DRIVER,
+)
+
+
+@pytest.fixture
+def sysroot(tmp_path):
+    chips = default_fake_chips(2, "v5e", "slice-A", 0)
+    root = make_fake_sysfs(str(tmp_path / "root"), chips)
+    kernel = FakeKernelPci(root).start()
+    try:
+        yield root, chips, kernel
+    finally:
+        kernel.stop()
+
+
+@pytest.fixture(autouse=True)
+def _gates():
+    featuregates.Features.reset()
+    yield
+    featuregates.Features.reset()
+
+
+class TestPciSysfs:
+    def test_prechecks_pass_on_fake_tree(self, sysroot):
+        root, _, _ = sysroot
+        PassthroughManager(PciSysfs(root)).prechecks()
+
+    def test_precheck_fails_without_vfio_module(self, sysroot):
+        root, _, _ = sysroot
+        shutil.rmtree(os.path.join(root, "sys", "module", "vfio_pci"))
+        with pytest.raises(PassthroughError, match="vfio_pci module"):
+            PassthroughManager(PciSysfs(root)).prechecks()
+
+    def test_precheck_fails_without_iommu(self, sysroot):
+        root, _, _ = sysroot
+        shutil.rmtree(os.path.join(root, "sys", "kernel", "iommu_groups"))
+        with pytest.raises(PassthroughError, match="IOMMU"):
+            PassthroughManager(PciSysfs(root)).prechecks()
+
+    def test_current_driver_and_group(self, sysroot):
+        root, chips, _ = sysroot
+        fs = PciSysfs(root)
+        assert fs.current_driver(chips[0].pci_address) == TPU_DRIVER
+        assert fs.iommu_group(chips[0].pci_address) == str(chips[0].index)
+        assert fs.group_devices(str(chips[0].index)) == [chips[0].pci_address]
+
+
+class TestRebind:
+    def test_configure_rebinds_to_vfio(self, sysroot):
+        root, chips, _ = sysroot
+        mgr = PassthroughManager(PciSysfs(root))
+        group = mgr.configure(chips[0])
+        assert group == str(chips[0].index)
+        fs = PciSysfs(root)
+        assert fs.current_driver(chips[0].pci_address) == VFIO_DRIVER
+        # Override cleared after a successful explicit bind.
+        with open(os.path.join(root, "sys", "bus", "pci", "devices",
+                               chips[0].pci_address, "driver_override")) as f:
+            assert f.read().strip() == ""
+        # Sibling chip untouched.
+        assert fs.current_driver(chips[1].pci_address) == TPU_DRIVER
+
+    def test_configure_idempotent(self, sysroot):
+        root, chips, _ = sysroot
+        mgr = PassthroughManager(PciSysfs(root))
+        assert mgr.configure(chips[0]) == mgr.configure(chips[0])
+
+    def test_unconfigure_restores_accel_driver(self, sysroot):
+        root, chips, _ = sysroot
+        mgr = PassthroughManager(PciSysfs(root))
+        mgr.configure(chips[0])
+        mgr.unconfigure(chips[0])
+        assert PciSysfs(root).current_driver(chips[0].pci_address) == TPU_DRIVER
+        mgr.unconfigure(chips[0])  # idempotent
+
+    def test_configure_refuses_foreign_driver(self, sysroot):
+        root, chips, _ = sysroot
+        addr = chips[0].pci_address
+        link = os.path.join(root, "sys", "bus", "pci", "devices", addr,
+                            "driver")
+        os.unlink(link)
+        foreign = os.path.join(root, "sys", "bus", "pci", "drivers", "other")
+        os.makedirs(foreign, exist_ok=True)
+        os.symlink(foreign, link)
+        with pytest.raises(PassthroughError, match="bound to 'other'"):
+            PassthroughManager(PciSysfs(root)).configure(chips[0])
+
+    def test_busy_device_waits_then_times_out(self, sysroot):
+        """fuser analog: an open fd on /dev/accelN blocks the rebind."""
+        root, chips, _ = sysroot
+        fd_dir = os.path.join(root, "proc", "4242", "fd")
+        os.makedirs(fd_dir)
+        os.symlink(os.path.join(root, "dev", f"accel{chips[0].index}"),
+                   os.path.join(fd_dir, "7"))
+        mgr = PassthroughManager(PciSysfs(root), free_timeout=0.3,
+                                 free_interval=0.05)
+        with pytest.raises(PassthroughError, match="held by pids \\[4242\\]"):
+            mgr.configure(chips[0])
+        # Device must still be bound to the accel driver (no half-rebind).
+        assert PciSysfs(root).current_driver(chips[0].pci_address) == TPU_DRIVER
+
+    def test_busy_device_proceeds_once_freed(self, sysroot):
+        root, chips, _ = sysroot
+        fd_dir = os.path.join(root, "proc", "4242", "fd")
+        os.makedirs(fd_dir)
+        fd_link = os.path.join(fd_dir, "7")
+        os.symlink(os.path.join(root, "dev", f"accel{chips[0].index}"),
+                   fd_link)
+        mgr = PassthroughManager(PciSysfs(root), free_timeout=5.0,
+                                 free_interval=0.05)
+        t = threading.Timer(0.2, os.unlink, args=(fd_link,))
+        t.start()
+        try:
+            assert mgr.configure(chips[0]) == str(chips[0].index)
+        finally:
+            t.cancel()
+
+    def test_bind_failure_rolls_back_override(self, sysroot):
+        """bind_to_driver.sh semantics: on bind failure the override is
+        cleared so the device can rebind normally later."""
+        root, chips, kernel = sysroot
+        addr = chips[0].pci_address
+        kernel.stop()  # no kernel -> bind never takes -> verify times out
+        mgr = PassthroughManager(PciSysfs(root), bind_timeout=0.2)
+        with pytest.raises(PassthroughError, match="did not bind"):
+            mgr.configure(chips[0])
+        with open(os.path.join(root, "sys", "bus", "pci", "devices", addr,
+                               "driver_override")) as f:
+            assert f.read().strip() == ""
+
+    def test_group_siblings_rebound_as_unit(self, tmp_path):
+        """Two functions sharing one IOMMU group must both leave the host
+        driver or the kernel refuses the vfio fd."""
+        chips = default_fake_chips(2, "v5e", "slice-A", 0)
+        root = make_fake_sysfs(str(tmp_path / "root"), chips)
+        # Merge chip 1 into chip 0's group.
+        dev1 = os.path.join(root, "sys", "bus", "pci", "devices",
+                            chips[1].pci_address)
+        g0 = os.path.join(root, "sys", "kernel", "iommu_groups", "0")
+        os.unlink(os.path.join(dev1, "iommu_group"))
+        os.symlink(g0, os.path.join(dev1, "iommu_group"))
+        os.symlink(dev1, os.path.join(g0, "devices", chips[1].pci_address))
+        kernel = FakeKernelPci(root).start()
+        try:
+            mgr = PassthroughManager(PciSysfs(root))
+            assert mgr.configure(chips[0]) == "0"
+            fs = PciSysfs(root)
+            assert fs.current_driver(chips[0].pci_address) == VFIO_DRIVER
+            assert fs.current_driver(chips[1].pci_address) == VFIO_DRIVER
+            mgr.unconfigure(chips[0])
+            assert fs.current_driver(chips[0].pci_address) == TPU_DRIVER
+            assert fs.current_driver(chips[1].pci_address) == TPU_DRIVER
+        finally:
+            kernel.stop()
+
+
+class TestDeviceStateIntegration:
+    """PassthroughConfig prepare performs — and unprepare reverses — an
+    observable rebind (the VERDICT round-2 'done' criterion)."""
+
+    def _state(self, root, chips, tmp_path):
+        backend = FakeBackend(chips)
+        cdi = CDIHandler(str(tmp_path / "cdi"), driver_root=root)
+        ckpts = CheckpointManager(str(tmp_path / "ckpt"))
+        mgr = PassthroughManager(PciSysfs(root))
+        return DeviceState(
+            backend=backend, cdi=cdi, checkpoints=ckpts,
+            driver_name=apitypes.TPU_DRIVER_NAME, node_name="node-a",
+            pt_manager=mgr), cdi
+
+    def _claim(self, uid, device):
+        cfg = {"apiVersion": apitypes.API_VERSION,
+               "kind": apitypes.PASSTHROUGH_CONFIG_KIND}
+        return {
+            "metadata": {"uid": uid, "name": uid, "namespace": "ws"},
+            "status": {"allocation": {"devices": {
+                "config": [{"opaque": {
+                    "driver": apitypes.TPU_DRIVER_NAME,
+                    "parameters": cfg}, "source": "FromClaim"}],
+                "results": [{"device": device, "driver":
+                             apitypes.TPU_DRIVER_NAME, "pool": "node-a",
+                             "request": "tpu"}],
+            }}},
+        }
+
+    def _plain_claim(self, uid, device):
+        return {
+            "metadata": {"uid": uid, "name": uid, "namespace": "ws"},
+            "status": {"allocation": {"devices": {
+                "results": [{"device": device, "driver":
+                             apitypes.TPU_DRIVER_NAME, "pool": "node-a",
+                             "request": "tpu"}],
+            }}},
+        }
+
+    def _merge_groups(self, root, chips):
+        """Put chip 1 into chip 0's IOMMU group."""
+        dev1 = os.path.join(root, "sys", "bus", "pci", "devices",
+                            chips[1].pci_address)
+        g0 = os.path.join(root, "sys", "kernel", "iommu_groups", "0")
+        os.unlink(os.path.join(dev1, "iommu_group"))
+        os.symlink(g0, os.path.join(dev1, "iommu_group"))
+        os.symlink(dev1, os.path.join(g0, "devices", chips[1].pci_address))
+
+    def test_passthrough_claim_gets_only_claim_cdi_device(self, sysroot,
+                                                          tmp_path):
+        """The standard per-chip CDI spec injects /dev/accelN — a node the
+        rebind destroys; passthrough claims must reference only the claim
+        device (code-review r3)."""
+        root, chips, _ = sysroot
+        featuregates.Features.set_from_string("PassthroughSupport=true")
+        state, cdi = self._state(root, chips, tmp_path)
+        result = state.prepare(self._claim("uid-pt", "chip-0"))
+        assert result.error == ""
+        (dev,) = result.devices
+        assert dev.cdi_device_ids == [cdi.get_claim_device("uid-pt")]
+
+    def test_passthrough_conflicts_with_sibling_claim(self, sysroot,
+                                                      tmp_path):
+        """A passthrough prepare must refuse when ANY other claim holds a
+        chip in the same IOMMU group — the rebind would yank it."""
+        root, chips, _ = sysroot
+        self._merge_groups(root, chips)
+        featuregates.Features.set_from_string("PassthroughSupport=true")
+        state, _ = self._state(root, chips, tmp_path)
+        assert state.prepare(self._plain_claim("uid-plain", "chip-1")
+                             ).error == ""
+        result = state.prepare(self._claim("uid-pt", "chip-0"))
+        assert "shares IOMMU group" in result.error
+        # Sibling's device must be untouched.
+        assert PciSysfs(root).current_driver(
+            chips[1].pci_address) == TPU_DRIVER
+
+    def test_normal_claim_conflicts_with_passthrough_group(self, sysroot,
+                                                           tmp_path):
+        """Reverse guard: a normal claim must not land on a chip whose
+        group a passthrough claim holds (its /dev/accelN is gone)."""
+        root, chips, _ = sysroot
+        self._merge_groups(root, chips)
+        featuregates.Features.set_from_string("PassthroughSupport=true")
+        state, _ = self._state(root, chips, tmp_path)
+        assert state.prepare(self._claim("uid-pt", "chip-0")).error == ""
+        result = state.prepare(self._plain_claim("uid-plain", "chip-1"))
+        assert "shares IOMMU group" in result.error
+
+    def test_prepare_rebinds_and_injects_vfio_nodes(self, sysroot, tmp_path):
+        root, chips, _ = sysroot
+        featuregates.Features.set_from_string("PassthroughSupport=true")
+        state, cdi = self._state(root, chips, tmp_path)
+        state.prepare(self._claim("uid-pt", "chip-0"))
+        assert PciSysfs(root).current_driver(chips[0].pci_address) == VFIO_DRIVER
+        spec = cdi.read_spec(cdi._claim_spec_path("uid-pt"))
+        edits = spec["devices"][0]["containerEdits"]
+        assert {"path": "/dev/vfio/vfio"} in edits["deviceNodes"]
+        assert {"path": "/dev/vfio/0"} in edits["deviceNodes"]
+        assert "TPU_PASSTHROUGH=true" in edits["env"]
+
+    def test_unprepare_reverses_rebind(self, sysroot, tmp_path):
+        root, chips, _ = sysroot
+        featuregates.Features.set_from_string("PassthroughSupport=true")
+        state, _ = self._state(root, chips, tmp_path)
+        state.prepare(self._claim("uid-pt", "chip-0"))
+        assert state.unprepare("uid-pt") is None
+        deadline = time.monotonic() + 3
+        while time.monotonic() < deadline:
+            if PciSysfs(root).current_driver(
+                    chips[0].pci_address) == TPU_DRIVER:
+                break
+            time.sleep(0.02)
+        assert PciSysfs(root).current_driver(chips[0].pci_address) == TPU_DRIVER

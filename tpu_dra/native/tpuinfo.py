@@ -390,7 +390,46 @@ def make_fake_sysfs(root: str, chips: List[Chip]) -> str:
                 f.write(content + "\n")
     # Health events file exists (empty) so tailing starts cleanly.
     open(os.path.join(class_dir, "health_events"), "a").close()
+    _materialize_pci(root, chips)
     return root
+
+
+def _materialize_pci(root: str, chips: List[Chip]) -> None:
+    """PCI/IOMMU sysfs topology for the passthrough path
+    (tpu_dra/tpuplugin/passthrough.py): per-device driver symlink +
+    driver_override, per-driver bind/unbind files, IOMMU groups (group id
+    = chip index), vfio module dir and /dev/vfio nodes."""
+    drivers = os.path.join(root, "sys", "bus", "pci", "drivers")
+    for drv in ("tpu-accel", "vfio-pci"):
+        os.makedirs(os.path.join(drivers, drv), exist_ok=True)
+        for f in ("bind", "unbind"):
+            open(os.path.join(drivers, drv, f), "w").close()
+    os.makedirs(os.path.join(root, "sys", "module", "vfio_pci"),
+                exist_ok=True)
+    os.makedirs(os.path.join(root, "dev", "vfio"), exist_ok=True)
+    open(os.path.join(root, "dev", "vfio", "vfio"), "w").close()
+    devices = os.path.join(root, "sys", "bus", "pci", "devices")
+    groups = os.path.join(root, "sys", "kernel", "iommu_groups")
+    for chip in chips:
+        if not chip.pci_address:
+            continue
+        ddir = os.path.join(devices, chip.pci_address)
+        os.makedirs(ddir, exist_ok=True)
+        open(os.path.join(ddir, "driver_override"), "w").close()
+        drv_link = os.path.join(ddir, "driver")
+        if not os.path.islink(drv_link):
+            os.symlink(os.path.join("..", "..", "drivers", "tpu-accel"),
+                       drv_link)
+        gdir = os.path.join(groups, str(chip.index), "devices")
+        os.makedirs(gdir, exist_ok=True)
+        dev_link = os.path.join(gdir, chip.pci_address)
+        if not os.path.islink(dev_link):
+            os.symlink(ddir, dev_link)
+        grp_link = os.path.join(ddir, "iommu_group")
+        if not os.path.islink(grp_link):
+            os.symlink(os.path.join(groups, str(chip.index)), grp_link)
+        open(os.path.join(root, "dev", "vfio", str(chip.index)),
+             "w").close()
 
 
 def append_health_event(root: str, event: HealthEvent) -> None:

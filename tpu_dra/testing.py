@@ -249,3 +249,97 @@ class _PathShim:
 
     def __str__(self) -> str:
         return self._path
+
+
+class FakeKernelPci:
+    """Simulates the kernel's PCI bind/unbind semantics over a fake sysfs
+    tree (make_fake_sysfs + _materialize_pci): a background thread consumes
+    writes to the per-driver bind/unbind files and moves the per-device
+    `driver` symlinks accordingly, honoring driver_override the way the
+    real bus match does. This lets PassthroughManager run its REAL file
+    protocol end-to-end in tests — the rebind only 'takes' if the manager
+    wrote the exact files the kernel ABI requires."""
+
+    DRIVERS = ("tpu-accel", "vfio-pci")
+
+    def __init__(self, root: str, tick: float = 0.005):
+        import threading as _threading
+        self._root = root.rstrip("/")
+        self._tick = tick
+        self._stop = _threading.Event()
+        self._thread: Optional[object] = None
+
+    def start(self) -> "FakeKernelPci":
+        import threading as _threading
+        self._thread = _threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+    def step(self) -> None:
+        """Synchronously process pending bind/unbind writes once."""
+        for drv in self.DRIVERS:
+            self._process_unbind(drv)
+        for drv in self.DRIVERS:
+            self._process_bind(drv)
+
+    # -- internals ----------------------------------------------------------
+
+    def _run(self) -> None:
+        import time as _time
+        while not self._stop.is_set():
+            self.step()
+            _time.sleep(self._tick)
+
+    def _driver_dir(self, drv: str) -> str:
+        return os.path.join(self._root, "sys", "bus", "pci", "drivers", drv)
+
+    def _device_dir(self, addr: str) -> str:
+        return os.path.join(self._root, "sys", "bus", "pci", "devices", addr)
+
+    def _consume(self, path: str) -> str:
+        try:
+            with open(path, "r+") as f:
+                content = f.read().strip()
+                f.seek(0)
+                f.truncate()
+            return content
+        except OSError:
+            return ""
+
+    def _process_unbind(self, drv: str) -> None:
+        addr = self._consume(os.path.join(self._driver_dir(drv), "unbind"))
+        if not addr:
+            return
+        link = os.path.join(self._device_dir(addr), "driver")
+        try:
+            if os.path.basename(os.readlink(link)) == drv:
+                os.unlink(link)
+        except OSError:
+            pass  # not bound: kernel would EINVAL; fake tolerates
+
+    def _process_bind(self, drv: str) -> None:
+        addr = self._consume(os.path.join(self._driver_dir(drv), "bind"))
+        if not addr:
+            return
+        ddir = self._device_dir(addr)
+        link = os.path.join(ddir, "driver")
+        if os.path.islink(link):
+            return  # already bound somewhere: kernel refuses double-bind
+        try:
+            with open(os.path.join(ddir, "driver_override")) as f:
+                override = f.read().strip()
+        except OSError:
+            override = ""
+        # Kernel match rules: an override must name this driver; without
+        # an override only the native accel driver matches the device id.
+        if override:
+            if override != drv:
+                return
+        elif drv != "tpu-accel":
+            return
+        os.symlink(self._driver_dir(drv), link)

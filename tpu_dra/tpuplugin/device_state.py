@@ -27,6 +27,7 @@ from tpu_dra.tpuplugin.checkpoint import (
     Checkpoint, CheckpointManager, PREPARE_COMPLETED, PREPARE_STARTED,
     PreparedClaim,
 )
+from tpu_dra.tpuplugin.passthrough import PassthroughManager
 from tpu_dra.tpuplugin.sharing import MultiprocessManager, TimeSlicingManager
 
 
@@ -83,6 +84,7 @@ class DeviceState:
                  node_name: str,
                  ts_manager: Optional[TimeSlicingManager] = None,
                  mp_manager: Optional[MultiprocessManager] = None,
+                 pt_manager: Optional[PassthroughManager] = None,
                  include_subslices: bool = True):
         self._backend = backend
         self._cdi = cdi
@@ -91,6 +93,7 @@ class DeviceState:
         self._node_name = node_name
         self._ts_manager = ts_manager
         self._mp_manager = mp_manager
+        self._pt_manager = pt_manager
         self._lock = threading.Lock()
         self.allocatable = deviceinfo.enumerate_allocatable(
             backend.chips(), include_subslices=include_subslices)
@@ -155,13 +158,23 @@ class DeviceState:
         subslice_hbm_total = 0
         claim_env: Dict[str, str] = {}
         claim_mounts: List[Dict] = []
+        claim_device_nodes: List[Dict] = []
 
         for cr in config_results:
             group_chips = self._chips_for_results(cr.results)
             # Record intent BEFORE applying side effects: if sharing setup
             # fails halfway, unprepare can still reset from these records.
+            is_passthrough = isinstance(cr.config, apitypes.PassthroughConfig)
             for result in cr.results:
                 dev = self.allocatable[result["device"]]
+                # Passthrough claims get ONLY the claim device: the VFIO
+                # rebind removes /dev/accelN from the host, so the standard
+                # per-chip spec's deviceNodes would point at a dead path
+                # and fail container creation.
+                cdi_ids = ([self._cdi.get_claim_device(uid)]
+                           if is_passthrough else
+                           [self._cdi.get_standard_device(dev.chip.uuid),
+                            self._cdi.get_claim_device(uid)])
                 records.append({
                     "type": dev.type,
                     "device": dev.name,
@@ -170,8 +183,7 @@ class DeviceState:
                     "chip_uuid": dev.chip.uuid,
                     "pool": self._node_name,
                     "config": cr.config.to_dict(),
-                    "cdi_ids": [self._cdi.get_standard_device(dev.chip.uuid),
-                                self._cdi.get_claim_device(uid)],
+                    "cdi_ids": cdi_ids,
                 })
 
             sharing_env = self._apply_sharing_config(uid, cr, group_chips)
@@ -187,8 +199,32 @@ class DeviceState:
                         range(ss.core_start, ss.core_start + ss.core_count))
                     subslice_hbm_total += ss.hbm_bytes
                 if isinstance(cr.config, apitypes.PassthroughConfig):
+                    if self._pt_manager is not None:
+                        self._assert_group_exclusive(
+                            dev.chip, uid, passthrough=True)
                     self._backend.set_exclusive_mode(dev.chip.index, True)
                     claim_env["TPU_PASSTHROUGH"] = "true"
+                    if self._pt_manager is not None:
+                        # Full VFIO rebind: the chip leaves the accel
+                        # driver; the claim gets /dev/vfio/<group> nodes
+                        # instead of a usable /dev/accelN. Rebinding
+                        # yanks every function in the IOMMU group, which
+                        # the exclusivity assert above made safe.
+                        group = self._pt_manager.configure(
+                            dev.chip,
+                            sibling_dev_paths=self._group_dev_paths(
+                                dev.chip))
+                        claim_device_nodes.extend(
+                            n for n in
+                            self._pt_manager.cdi_device_nodes(group)
+                            if n not in claim_device_nodes)
+                elif self._pt_manager is not None:
+                    # Reverse guard: a normal claim must not land on a
+                    # chip whose IOMMU group a passthrough claim holds —
+                    # its /dev/accelN is gone while the group sits on
+                    # vfio-pci.
+                    self._assert_group_exclusive(
+                        dev.chip, uid, passthrough=False)
 
         if subslice_cores:
             # Aggregate across all subslices of the claim. Single-chip claims
@@ -202,7 +238,50 @@ class DeviceState:
             claim_env["TPU_HBM_LIMIT_BYTES"] = str(subslice_hbm_total)
 
         claim_env.update(visible_chips_env(sorted(chip_indices)))
-        self._cdi.create_claim_spec_file(uid, claim_env, mounts=claim_mounts or None)
+        self._cdi.create_claim_spec_file(
+            uid, claim_env, mounts=claim_mounts or None,
+            device_nodes=claim_device_nodes or None)
+
+    def _group_chip_indices(self, chip: Chip) -> List[int]:
+        """Indices of every chip sharing `chip`'s IOMMU group (including
+        itself); just [chip.index] when topology is unknown."""
+        group = self._pt_manager.group_of(chip)
+        if group is None:
+            return [chip.index]
+        addrs = set(self._pt_manager.group_devices(group))
+        return [c.index for c in self._backend.chips()
+                if c.pci_address in addrs] or [chip.index]
+
+    def _group_dev_paths(self, chip: Chip) -> Dict[str, str]:
+        group = self._pt_manager.group_of(chip)
+        if group is None:
+            return {}
+        addrs = set(self._pt_manager.group_devices(group))
+        return {c.pci_address: c.dev_path for c in self._backend.chips()
+                if c.pci_address in addrs and c.index != chip.index}
+
+    def _assert_group_exclusive(self, chip: Chip, claim_uid: str,
+                                *, passthrough: bool) -> None:
+        """VFIO IOMMU-group exclusivity: a passthrough claim owns its whole
+        group, so (a) a passthrough prepare conflicts with ANY other claim
+        holding a group chip, and (b) a normal prepare conflicts with a
+        PASSTHROUGH claim holding a group chip (the rebind destroyed its
+        /dev/accelN). Callers hold self._lock, so checkpoint reads are
+        stable. (Sibling handling analog: device_state.go:526-552.)"""
+        group_indices = set(self._group_chip_indices(chip))
+        for uid, prepared in self._checkpoint.claims.items():
+            if uid == claim_uid:
+                continue
+            for record in prepared.devices:
+                if record.get("chip_index") not in group_indices:
+                    continue
+                other_is_pt = (record.get("config") or {}).get(
+                    "kind") == apitypes.PASSTHROUGH_CONFIG_KIND
+                if passthrough or other_is_pt:
+                    raise PrepareError(
+                        f"chip {chip.index} shares IOMMU group with chip "
+                        f"{record['chip_index']} held by claim {uid}; "
+                        "VFIO passthrough requires the whole group")
 
     def _chips_for_results(self, results: List[Dict]) -> List[Chip]:
         chips: Dict[int, Chip] = {}
@@ -353,6 +432,11 @@ class DeviceState:
         if apitypes.TimeSlicingStrategy in strategies and self._ts_manager:
             self._ts_manager.reset(chip_list)
         for chip in passthrough_chips:
+            if self._pt_manager is not None:
+                # Return the chip to the accel driver before clearing the
+                # exclusive marker; unconfigure is idempotent, so a crashed
+                # half-prepared claim unwinds cleanly too.
+                self._pt_manager.unconfigure(chip)
             self._backend.set_exclusive_mode(chip.index, False)
 
     # ------------------------------------------------------------------

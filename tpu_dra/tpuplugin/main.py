@@ -8,6 +8,7 @@ Run: ``python -m tpu_dra.tpuplugin.main [flags]``
 
 from __future__ import annotations
 
+import os
 import signal
 import threading
 
@@ -83,10 +84,20 @@ def main(argv=None) -> int:
             root_dir=f"{ns.plugin_dir}/multiprocess",
             image=ns.coordinator_image)
 
+    pt_manager = None
+    if featuregates.enabled(featuregates.PassthroughSupport):
+        from tpu_dra.tpuplugin.passthrough import PassthroughManager, PciSysfs
+        pt_manager = PassthroughManager(
+            PciSysfs(root=os.environ.get("TPUINFO_SYSFS_ROOT", "") or "/"))
+        # Fail fast like NewVfioPciManager: a node advertising passthrough
+        # without vfio/IOMMU support would break every claim at prepare.
+        pt_manager.prechecks()
+
     state = DeviceState(
         backend=backend, cdi=cdi, checkpoints=checkpoints,
         driver_name=TPU_DRIVER_NAME, node_name=ns.node_name,
-        ts_manager=ts_manager, mp_manager=mp_manager)
+        ts_manager=ts_manager, mp_manager=mp_manager,
+        pt_manager=pt_manager)
 
     codes = [int(c) for c in ns.additional_codes_to_ignore.split(",") if c]
     driver = TpuDriver(

@@ -1,0 +1,303 @@
+"""VFIO passthrough manager: rebind a TPU chip's PCI function from the
+accel driver to vfio-pci so a VM workload can claim the whole device.
+
+Reference mechanics (semantic port, not line port):
+- cmd/gpu-kubelet-plugin/vfio-device.go:33-264 — Prechecks (module +
+  IOMMU), Configure/Unconfigure with per-device locks, device-busy wait
+  (`fuser`), driver readlink dispatch.
+- scripts/bind_to_driver.sh:6-37 — driver_override write then bind-file
+  write, rolling the override back on bind failure.
+- scripts/unbind_from_driver.sh — unbind via the bound driver's own
+  unbind file, tolerating an already-unbound device.
+
+TPU differences:
+- the busy check scans /proc/*/fd for the chip's /dev/accelN (no `fuser`
+  binary dependency, works in a slim container),
+- sibling PCI functions in the same IOMMU group are rebound as a unit —
+  the kernel refuses the vfio fd otherwise (reference handles siblings in
+  device_state.go:526-552),
+- everything runs against an injectable filesystem root so the whole flow
+  is testable on the fake sysfs tree (tpu_dra/native/tpuinfo.py
+  make_fake_sysfs), the design improvement SURVEY §7.3 calls for.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from tpu_dra.native.tpuinfo import Chip
+
+log = logging.getLogger(__name__)
+
+VFIO_DRIVER = "vfio-pci"
+# Driver name the accel chips are normally bound to (the `nvidia` analog).
+TPU_DRIVER = "tpu-accel"
+
+
+class PassthroughError(Exception):
+    pass
+
+
+class PciSysfs:
+    """Raw sysfs/dev/proc operations against an injectable root.
+
+    All paths are the kernel ABI ones; `root` prefixes them so tests (and
+    kind-style CI nodes) can point at a materialized fake tree.
+    """
+
+    def __init__(self, root: str = "/"):
+        self.root = root.rstrip("/")
+
+    def _p(self, *parts: str) -> str:
+        return os.path.join(self.root + "/", *parts)
+
+    # -- module / IOMMU prechecks ------------------------------------------
+
+    def module_loaded(self, module: str) -> bool:
+        return os.path.isdir(self._p("sys", "module", module))
+
+    def iommu_enabled(self) -> bool:
+        path = self._p("sys", "kernel", "iommu_groups")
+        try:
+            return bool(os.listdir(path))
+        except FileNotFoundError:
+            return False
+
+    # -- device state -------------------------------------------------------
+
+    def current_driver(self, pci_address: str) -> Optional[str]:
+        link = self._p("sys", "bus", "pci", "devices", pci_address, "driver")
+        try:
+            return os.path.basename(os.readlink(link))
+        except OSError:
+            return None
+
+    def iommu_group(self, pci_address: str) -> Optional[str]:
+        link = self._p("sys", "bus", "pci", "devices", pci_address,
+                       "iommu_group")
+        try:
+            return os.path.basename(os.readlink(link))
+        except OSError:
+            return None
+
+    def group_devices(self, group: str) -> List[str]:
+        path = self._p("sys", "kernel", "iommu_groups", group, "devices")
+        try:
+            return sorted(os.listdir(path))
+        except FileNotFoundError:
+            return []
+
+    # -- rebind primitives (bind_to_driver.sh semantics) --------------------
+
+    def write_driver_override(self, pci_address: str, driver: str) -> None:
+        path = self._p("sys", "bus", "pci", "devices", pci_address,
+                       "driver_override")
+        if not os.path.exists(path):
+            raise PassthroughError(f"{path} does not exist")
+        with open(path, "w") as f:
+            f.write(driver + "\n" if driver else "\n")
+
+    def unbind(self, pci_address: str) -> None:
+        """Write the address to the bound driver's unbind file; no-op when
+        already unbound (unbind_from_driver.sh behavior)."""
+        drv = self.current_driver(pci_address)
+        if drv is None:
+            return
+        path = self._p("sys", "bus", "pci", "devices", pci_address,
+                       "driver", "unbind")
+        with open(path, "w") as f:
+            f.write(pci_address)
+
+    def bind(self, pci_address: str, driver: str) -> None:
+        path = self._p("sys", "bus", "pci", "drivers", driver, "bind")
+        if not os.path.exists(path):
+            raise PassthroughError(
+                f"driver {driver!r} has no bind file at {path}")
+        with open(path, "w") as f:
+            f.write(pci_address)
+
+    # -- busy check (fuser analog) ------------------------------------------
+
+    def open_fds_for(self, dev_path: str) -> List[int]:
+        """Pids holding an open fd on dev_path, via /proc scan."""
+        target = self._p(dev_path.lstrip("/"))
+        pids: List[int] = []
+        proc = self._p("proc")
+        try:
+            entries = os.listdir(proc)
+        except FileNotFoundError:
+            return []
+        for pid in entries:
+            if not pid.isdigit():
+                continue
+            fd_dir = os.path.join(proc, pid, "fd")
+            try:
+                fds = os.listdir(fd_dir)
+            except OSError:
+                continue
+            for fd in fds:
+                try:
+                    if os.readlink(os.path.join(fd_dir, fd)) in (
+                            dev_path, target):
+                        pids.append(int(pid))
+                        break
+                except OSError:
+                    continue
+        return pids
+
+
+class PassthroughManager:
+    """Configure/Unconfigure chips for VFIO passthrough
+    (VfioPciManager analog, vfio-device.go:33-264)."""
+
+    # The busy-wait runs inside DeviceState.prepare's lock, exactly like
+    # the reference (WaitForGPUFree under the DeviceState mutex,
+    # vfio-device.go:132-157 with gpuFreeCheckTimeout=60s) — but we cap it
+    # at 30s so a stuck passthrough prepare cannot starve unrelated
+    # prepare/unprepare calls past kubelet's retry window.
+    def __init__(self, sysfs: Optional[PciSysfs] = None, *,
+                 tpu_driver: str = TPU_DRIVER,
+                 free_timeout: float = 30.0, free_interval: float = 1.0,
+                 bind_timeout: float = 5.0):
+        self._fs = sysfs or PciSysfs()
+        self._tpu_driver = tpu_driver
+        self._free_timeout = free_timeout
+        self._free_interval = free_interval
+        self._bind_timeout = bind_timeout
+        # Per-chip mutexes (mutex.go:22-43 perGpuLock analog).
+        self._locks: Dict[str, threading.Lock] = {}
+        self._locks_mu = threading.Lock()
+
+    def _lock_for(self, key: str) -> threading.Lock:
+        with self._locks_mu:
+            return self._locks.setdefault(key, threading.Lock())
+
+    # -- prechecks (vfio-device.go:76-88) -----------------------------------
+
+    def prechecks(self) -> None:
+        if not self._fs.module_loaded("vfio_pci"):
+            raise PassthroughError("vfio_pci module is not loaded")
+        if not self._fs.iommu_enabled():
+            raise PassthroughError("IOMMU is not enabled in the kernel")
+
+    # -- group topology (for DeviceState's exclusivity guard) ---------------
+
+    def group_of(self, chip: Chip) -> Optional[str]:
+        return (self._fs.iommu_group(chip.pci_address)
+                if chip.pci_address else None)
+
+    def group_devices(self, group: str) -> List[str]:
+        return self._fs.group_devices(group)
+
+    # -- configure ----------------------------------------------------------
+
+    def configure(self, chip: Chip,
+                  sibling_dev_paths: Optional[Dict[str, str]] = None) -> str:
+        """Bind the chip (and its IOMMU-group siblings) to vfio-pci.
+        Returns the IOMMU group id whose /dev/vfio/<group> node the CDI
+        spec must inject. Idempotent.
+
+        The caller (DeviceState) is responsible for asserting that no
+        other claim holds any chip in the group — this method will yank
+        siblings, which is only safe under that exclusivity.
+        sibling_dev_paths maps sibling PCI addresses to their /dev/accelN
+        paths so the busy-wait covers every accel function rebound."""
+        if not chip.pci_address:
+            raise PassthroughError(
+                f"chip {chip.index} has no PCI address; cannot passthrough")
+        with self._lock_for(chip.pci_address):
+            self.prechecks()
+            group = self._fs.iommu_group(chip.pci_address)
+            if group is None:
+                raise PassthroughError(
+                    f"chip {chip.index} ({chip.pci_address}) has no IOMMU "
+                    "group")
+            # Every function in the group must leave the host driver or the
+            # kernel refuses the vfio fd.
+            sib = sibling_dev_paths or {}
+            for addr in self._fs.group_devices(group) or [chip.pci_address]:
+                busy = (chip.dev_path if addr == chip.pci_address
+                        else sib.get(addr))
+                self._rebind(addr, VFIO_DRIVER, busy_dev=busy)
+            return group
+
+    def unconfigure(self, chip: Chip) -> None:
+        """Return the chip's group to the accel driver. Idempotent."""
+        if not chip.pci_address:
+            return
+        with self._lock_for(chip.pci_address):
+            group = self._fs.iommu_group(chip.pci_address)
+            for addr in (self._fs.group_devices(group)
+                         if group else [chip.pci_address]):
+                self._rebind(addr, self._tpu_driver, busy_dev=None)
+
+    def cdi_device_nodes(self, group: str) -> List[Dict]:
+        """CDI deviceNodes edit for a configured group
+        (GetVfioCommonCDIContainerEdits analog)."""
+        return [{"path": "/dev/vfio/vfio"},
+                {"path": f"/dev/vfio/{group}"}]
+
+    # -- internals ----------------------------------------------------------
+
+    def _rebind(self, pci_address: str, target_driver: str,
+                busy_dev: Optional[str]) -> None:
+        current = self._fs.current_driver(pci_address)
+        if current == target_driver:
+            return
+        # Dispatch on the current driver like Configure does
+        # (vfio-device.go:173-186): only rebinds between the accel driver
+        # and vfio-pci are supported; anything else is operator error.
+        if current is not None and current not in (self._tpu_driver,
+                                                   VFIO_DRIVER):
+            raise PassthroughError(
+                f"{pci_address} is bound to {current!r}, expected "
+                f"{self._tpu_driver!r} or {VFIO_DRIVER!r}")
+        if busy_dev is not None:
+            self._wait_device_free(pci_address, busy_dev)
+        self._fs.write_driver_override(pci_address, target_driver)
+        try:
+            self._fs.unbind(pci_address)
+            self._fs.bind(pci_address, target_driver)
+            self._wait_bound(pci_address, target_driver)
+        except Exception:
+            # bind_to_driver.sh rolls the override back on failure so the
+            # device can rebind normally later.
+            try:
+                self._fs.write_driver_override(pci_address, "")
+            except Exception:  # noqa: BLE001
+                log.warning("override rollback failed for %s", pci_address)
+            raise
+        # Success: clear the override so future hotplug events bind
+        # normally; the explicit bind already happened.
+        self._fs.write_driver_override(pci_address, "")
+        log.info("rebound %s -> %s", pci_address, target_driver)
+
+    def _wait_device_free(self, pci_address: str, dev_path: str) -> None:
+        """WaitForGPUFree analog (vfio-device.go:132-157): poll until no
+        process holds the device node open."""
+        deadline = time.monotonic() + self._free_timeout
+        while True:
+            pids = self._fs.open_fds_for(dev_path)
+            if not pids:
+                return
+            if time.monotonic() >= deadline:
+                raise PassthroughError(
+                    f"timed out waiting for {dev_path} ({pci_address}) to "
+                    f"be free; held by pids {pids}")
+            log.info("%s busy (pids %s); waiting", dev_path, pids)
+            time.sleep(self._free_interval)
+
+    def _wait_bound(self, pci_address: str, driver: str) -> None:
+        deadline = time.monotonic() + self._bind_timeout
+        while time.monotonic() < deadline:
+            if self._fs.current_driver(pci_address) == driver:
+                return
+            time.sleep(0.02)
+        raise PassthroughError(
+            f"{pci_address} did not bind to {driver} within "
+            f"{self._bind_timeout}s (bound: "
+            f"{self._fs.current_driver(pci_address)!r})")
