@@ -446,3 +446,62 @@ class TestUnprepareRetry:
                 not in harness["state"].prepared_claim_uids())
         node = cluster.get(NODES, "node-a")
         assert LABEL not in (node["metadata"].get("labels") or {})
+
+
+class TestLegacyCheckpointBackfill:
+    """Legacy (V1-era) checkpoint records lack claim name/namespace; the
+    GC sweep must backfill identity from the API server by UID so they
+    become collectible — or collect them immediately when the claim is
+    gone everywhere (cd device_state.go:231-254, checkpoint_legacy.go)."""
+
+    def _make_legacy(self, harness, claim):
+        """Strip identity from the checkpoint record, simulating a V1
+        checkpoint loaded after upgrade."""
+        state = harness["state"]
+        with state._lock:
+            rec = state._checkpoint.claims[claim["metadata"]["uid"]]
+            rec.name = ""
+            rec.namespace = ""
+            state._ckpt_mgr.store(state._checkpoint)
+
+    def test_backfill_then_collect(self, harness):
+        cluster = harness["cluster"]
+        cd = make_cd(cluster)
+        register_node(cluster, cd, "node-a", "10.0.0.1", ready=False)
+        claim = make_channel_claim(cluster, cd)
+        res = prepare(harness, claim)  # readiness never comes
+        assert "exhausted" in res.error
+        uid = claim["metadata"]["uid"]
+        self._make_legacy(harness, claim)
+
+        gc = CheckpointCleanup(client=cluster, state=harness["state"],
+                               cd_manager=harness["cd_manager"])
+        # Claim still exists: sweep backfills identity, keeps the record.
+        assert gc.sweep() == 0
+        snap = harness["state"].checkpoint_snapshot()
+        assert snap.claims[uid].name == claim["metadata"]["name"]
+        assert snap.claims[uid].namespace == NS
+        # Claim deleted: the (now-identified) record is collected.
+        cluster.delete(RESOURCECLAIMS, claim["metadata"]["name"], NS)
+        assert gc.sweep() == 1
+        assert uid not in harness["state"].prepared_claim_uids()
+
+    def test_orphan_legacy_record_collected_immediately(self, harness):
+        cluster = harness["cluster"]
+        cd = make_cd(cluster)
+        register_node(cluster, cd, "node-a", "10.0.0.1", ready=False)
+        claim = make_channel_claim(cluster, cd)
+        res = prepare(harness, claim)
+        assert "exhausted" in res.error
+        uid = claim["metadata"]["uid"]
+        self._make_legacy(harness, claim)
+        cluster.delete(RESOURCECLAIMS, claim["metadata"]["name"], NS)
+
+        gc = CheckpointCleanup(client=cluster, state=harness["state"],
+                               cd_manager=harness["cd_manager"])
+        # No claim with this UID anywhere -> abandoned, collected now,
+        # including the node-label rollback drop_claim performs.
+        assert gc.sweep() == 1
+        assert uid not in harness["state"].prepared_claim_uids()
+        node = cluster.get(NODES, "node-a")
+        assert LABEL not in (node["metadata"].get("labels") or {})

@@ -104,6 +104,89 @@ class TestAdmission:
         out = self.handler.review(review(obj, version=version))
         assert out["response"]["allowed"] is False
 
+    def test_v1beta1_flat_request_converted_and_validated(self):
+        """v1beta1 requests are flat (no `exactly` wrapper); the webhook
+        must lift them to v1 before validation so request-name targeting
+        still resolves (resource.go:83-160 real conversion)."""
+        featuregates.Features.set_from_string("TimeSlicingSettings=true")
+        obj = {
+            "apiVersion": "resource.k8s.io/v1beta1", "kind": "ResourceClaim",
+            "metadata": {"name": "c", "namespace": "d"},
+            "spec": {"devices": {
+                "requests": [{"name": "tpu", "deviceClassName": "tpu.dev",
+                              "allocationMode": "ExactCount", "count": 1}],
+                "config": [{"requests": ["tpu"], "opaque": {
+                    "driver": apitypes.TPU_DRIVER_NAME,
+                    "parameters": {"apiVersion": API, "kind": "TpuConfig",
+                                   "sharing": {"strategy": "TimeSlicing"}},
+                }}],
+            }},
+        }
+        out = self.handler.review(review(obj, version="v1beta1"))
+        assert out["response"]["allowed"] is True, out
+
+    def test_v1beta1_with_v1_syntax_rejected(self):
+        """`exactly` is not a v1beta1 field; refusing beats guessing."""
+        obj = {
+            "apiVersion": "resource.k8s.io/v1beta1", "kind": "ResourceClaim",
+            "metadata": {"name": "c", "namespace": "d"},
+            "spec": {"devices": {
+                "requests": [{"name": "tpu",
+                              "exactly": {"deviceClassName": "tpu.dev"}}],
+            }},
+        }
+        out = self.handler.review(review(obj, version="v1beta1"))
+        assert out["response"]["allowed"] is False
+        assert "not a v1beta1 field" in out["response"]["status"]["message"]
+
+    def test_v1beta1_first_available_passes_through(self):
+        """DRAPrioritizedList (1.33) added firstAvailable to v1beta1 with
+        the same flat subrequest shape as v1: valid and convertible."""
+        featuregates.Features.set_from_string("TimeSlicingSettings=true")
+        obj = {
+            "apiVersion": "resource.k8s.io/v1beta1", "kind": "ResourceClaim",
+            "metadata": {"name": "c", "namespace": "d"},
+            "spec": {"devices": {
+                "requests": [{"name": "tpu", "firstAvailable": [
+                    {"name": "big", "deviceClassName": "tpu.dev",
+                     "count": 4},
+                    {"name": "small", "deviceClassName": "tpu.dev"}]}],
+                "config": [{"requests": ["tpu/big"], "opaque": {
+                    "driver": apitypes.TPU_DRIVER_NAME,
+                    "parameters": {"apiVersion": API, "kind": "TpuConfig",
+                                   "sharing": {"strategy": "TimeSlicing"}},
+                }}],
+            }},
+        }
+        out = self.handler.review(review(obj, version="v1beta1"))
+        assert out["response"]["allowed"] is True, out
+
+    def test_config_targeting_unknown_request_rejected(self):
+        featuregates.Features.set_from_string("TimeSlicingSettings=true")
+        obj = claim_with_config({
+            "apiVersion": API, "kind": "TpuConfig",
+            "sharing": {"strategy": "TimeSlicing"}})
+        obj["spec"]["devices"]["config"][0]["requests"] = ["nonexistent"]
+        out = self.handler.review(review(obj))
+        assert out["response"]["allowed"] is False
+        assert "unknown request" in out["response"]["status"]["message"]
+
+    def test_subrequest_targeting_allowed(self):
+        """v1/v1beta2 prioritized-list subrequests are addressable as
+        `req/sub` in config.requests."""
+        featuregates.Features.set_from_string("TimeSlicingSettings=true")
+        obj = claim_with_config({
+            "apiVersion": API, "kind": "TpuConfig",
+            "sharing": {"strategy": "TimeSlicing"}})
+        obj["spec"]["devices"]["requests"] = [{
+            "name": "tpu", "firstAvailable": [
+                {"name": "big", "deviceClassName": "tpu.dev", "count": 4},
+                {"name": "small", "deviceClassName": "tpu.dev", "count": 1},
+            ]}]
+        obj["spec"]["devices"]["config"][0]["requests"] = ["tpu/small"]
+        out = self.handler.review(review(obj))
+        assert out["response"]["allowed"] is True, out
+
     def test_future_version_fails_open(self):
         obj = claim_with_config({"apiVersion": API, "kind": "TpuConfig",
                                  "junk": 1})
@@ -118,6 +201,49 @@ class TestAdmission:
     def test_missing_object_rejected(self):
         out = self.handler.review({"request": {"uid": "x"}})
         assert out["response"]["allowed"] is False
+
+
+class TestConversion:
+    def test_v1beta1_lift_field_by_field(self):
+        from tpu_dra.webhook.server import convert_device_spec_to_v1
+        devices = {
+            "requests": [{"name": "r1", "deviceClassName": "tpu.dev",
+                          "selectors": [{"cel": {"expression": "true"}}],
+                          "allocationMode": "ExactCount", "count": 2,
+                          "adminAccess": True}],
+            "constraints": [{"requests": ["r1"],
+                             "matchAttribute": "tpu.dev/sliceID"}],
+            "config": [{"requests": ["r1"], "opaque": {"driver": "tpu.dev",
+                                                       "parameters": {}}}],
+        }
+        out = convert_device_spec_to_v1(devices, "v1beta1")
+        assert out["requests"] == [{"name": "r1", "exactly": {
+            "deviceClassName": "tpu.dev",
+            "selectors": [{"cel": {"expression": "true"}}],
+            "allocationMode": "ExactCount", "count": 2,
+            "adminAccess": True}}]
+        # Constraints/config shapes are version-stable: untouched.
+        assert out["constraints"] == devices["constraints"]
+        assert out["config"] == devices["config"]
+        # Input must not be mutated.
+        assert "exactly" not in devices["requests"][0]
+
+    def test_v1beta2_identity_preserves_divergent_fields(self):
+        from tpu_dra.webhook.server import convert_device_spec_to_v1
+        devices = {"requests": [{"name": "r1", "exactly": {
+            "deviceClassName": "tpu.dev",
+            "tolerations": [{"key": "tpu.dev/unhealthy",
+                             "operator": "Exists"}],
+            "capacity": {"requests": {"hbm": "16Gi"}}}}]}
+        assert convert_device_spec_to_v1(devices, "v1beta2") == devices
+        assert convert_device_spec_to_v1(devices, "v1") == devices
+
+    def test_unsupported_version_errors(self):
+        from tpu_dra.webhook.server import (
+            ConversionError, convert_device_spec_to_v1)
+        import pytest as _pytest
+        with _pytest.raises(ConversionError):
+            convert_device_spec_to_v1({}, "v1alpha3")
 
 
 class TestServer:

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import logging
 import threading
-from typing import Optional
+from typing import Dict, Optional
 
 from tpu_dra.cdplugin.computedomain import ComputeDomainManager
 from tpu_dra.cdplugin.device_state import DeviceState
@@ -53,11 +53,35 @@ class CheckpointCleanup:
         """Collect abandoned PrepareStarted claims; returns count."""
         collected = 0
         snapshot = self._state.checkpoint_snapshot()
+        # Lazily-built uid index over ONE cluster-wide LIST per sweep:
+        # only legacy records need it, and N of them must not cost N lists.
+        uid_index: Optional[Dict[str, Dict]] = None
         for uid, prepared in list(snapshot.claims.items()):
             if prepared.state != PREPARE_STARTED:
                 continue
             if not prepared.name:
-                continue  # legacy record without claim identity: keep
+                # Legacy (V1-era) record without claim identity: backfill
+                # it from the API server by UID (cd device_state.go:231-254
+                # analog). Found -> record becomes collectible on a later
+                # sweep once the claim disappears; not found anywhere ->
+                # the claim is gone and the record is abandoned now.
+                if uid_index is None:
+                    uid_index = {c["metadata"].get("uid", ""): c
+                                 for c in self._client.list(RESOURCECLAIMS)}
+                match = uid_index.get(uid)
+                if match is not None:
+                    self._state.backfill_claim_identity(
+                        uid, match["metadata"]["name"],
+                        match["metadata"].get("namespace", ""))
+                    log.info("backfilled legacy checkpoint identity for "
+                             "claim %s (%s/%s)", uid,
+                             match["metadata"].get("namespace", ""),
+                             match["metadata"]["name"])
+                    continue  # claim still exists: kubelet will retry
+                if self._state.drop_claim(uid):
+                    log.info("GC abandoned legacy claim %s", uid)
+                    collected += 1
+                continue
             try:
                 obj = self._client.get(RESOURCECLAIMS, prepared.name,
                                        prepared.namespace)

@@ -287,6 +287,24 @@ class DeviceState:
         with self._lock:
             return copy.deepcopy(self._checkpoint)
 
+    def backfill_claim_identity(self, claim_uid: str, name: str,
+                                namespace: str) -> bool:
+        """Write name/namespace into a legacy (V1-era) checkpoint record
+        that predates claim identity, and persist. The reference pulls the
+        missing fields from the API server on first touch
+        (cd device_state.go:231-254, checkpoint_legacy.go); here the GC
+        sweep does it so legacy records become collectible. Returns False
+        when the record vanished meanwhile."""
+        with self._lock:
+            prepared = self._checkpoint.claims.get(claim_uid)
+            if prepared is None:
+                return False
+            if not prepared.name:
+                prepared.name = name
+                prepared.namespace = namespace
+                self._ckpt_mgr.store(self._checkpoint)
+            return True
+
     def drop_claim(self, claim_uid: str) -> bool:
         """Checkpoint GC hook (cleanup.py). Runs the full unprepare path —
         an abandoned PREPARE_STARTED claim may have added the node label

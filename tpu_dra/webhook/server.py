@@ -33,6 +33,51 @@ SUPPORTED_VERSIONS = ("v1", "v1beta1", "v1beta2")
 OWNED_DRIVERS = (apitypes.TPU_DRIVER_NAME,
                  apitypes.COMPUTE_DOMAIN_DRIVER_NAME)
 
+# v1beta1 DeviceRequest fields that moved under the `exactly` wrapper when
+# v1beta2 introduced prioritized-list requests (the one structural break in
+# the resource.k8s.io version history; v1beta2 and v1 share the v1 shape).
+_V1BETA1_REQUEST_FIELDS = ("deviceClassName", "selectors", "allocationMode",
+                           "count", "adminAccess", "tolerations")
+
+
+class ConversionError(ValueError):
+    pass
+
+
+def convert_device_spec_to_v1(devices: Dict, version: str) -> Dict:
+    """Field-by-field conversion of a DeviceClaim ('spec.devices') to the
+    v1 shape (the scheme.Convert analog, resource.go:83-160). v1beta2 is
+    already the v1 shape; v1beta1 requests are flat and must be lifted
+    into the `exactly` wrapper."""
+    if version not in SUPPORTED_VERSIONS:
+        raise ConversionError(f"unsupported resource version {version!r}")
+    out = json.loads(json.dumps(devices))  # deep copy; input untouched
+    if version in ("v1", "v1beta2"):
+        return out
+    requests = out.get("requests") or []
+    converted = []
+    for i, req in enumerate(requests):
+        if not isinstance(req, dict):
+            raise ConversionError(f"requests[{i}] must be an object")
+        if "exactly" in req:
+            # v1beta2/v1 syntax inside a v1beta1 object: the API server
+            # would have rejected it; refuse rather than guess.
+            raise ConversionError(
+                f"requests[{i}]: 'exactly' is not a v1beta1 field")
+        if "firstAvailable" in req:
+            # DRAPrioritizedList added firstAvailable to v1beta1 too
+            # (k8s 1.33), and subrequests are flat in every version —
+            # already the v1 shape, pass through.
+            converted.append(req)
+            continue
+        exactly = {k: req[k] for k in _V1BETA1_REQUEST_FIELDS if k in req}
+        rest = {k: v for k, v in req.items()
+                if k not in _V1BETA1_REQUEST_FIELDS}
+        converted.append({**rest, "exactly": exactly})
+    if requests:
+        out["requests"] = converted
+    return out
+
 
 class AdmissionHandler:
     """Pure request->response admission logic."""
@@ -65,7 +110,8 @@ class AdmissionHandler:
             # still guards prepare (fail-open on version skew, resource.go).
             return True, ""
         try:
-            device_specs = self._device_specs(kind, obj)
+            device_specs = [convert_device_spec_to_v1(d, version)
+                            for d in self._device_specs(kind, obj)]
         except ValueError as e:
             return False, str(e)
         errors: List[str] = []
@@ -86,9 +132,9 @@ class AdmissionHandler:
         return group, version, kind
 
     def _device_specs(self, kind: str, obj: Dict) -> List[Dict]:
-        """Normalize claim vs template to the v1 DeviceClaim spec shape.
-        v1beta1/v1beta2 share the devices.config layout used here, so
-        conversion is structural (resource.go:83-160)."""
+        """Extract the DeviceClaim ('spec.devices') objects from a claim or
+        template; version conversion to v1 happens in
+        convert_device_spec_to_v1 (resource.go:83-160)."""
         if kind == "ResourceClaim":
             spec = obj.get("spec") or {}
         elif kind == "ResourceClaimTemplate":
@@ -102,11 +148,28 @@ class AdmissionHandler:
 
     def _validate_device_spec(self, devices: Dict) -> List[str]:
         errors = []
+        # Request names in v1 shape: plain names plus `req/sub` for
+        # prioritized-list subrequests. Only meaningful AFTER conversion —
+        # v1beta1's flat requests carry the same names, so the lift keeps
+        # this check version-uniform.
+        names = set()
+        for req in devices.get("requests") or []:
+            n = (req or {}).get("name")
+            if not n:
+                continue
+            names.add(n)
+            for sub in (req.get("firstAvailable") or []):
+                if (sub or {}).get("name"):
+                    names.add(f"{n}/{sub['name']}")
         for i, entry in enumerate(devices.get("config") or []):
             opaque = (entry or {}).get("opaque") or {}
             driver = opaque.get("driver", "")
             if driver not in OWNED_DRIVERS:
                 continue  # not ours: admit
+            for r in (entry or {}).get("requests") or []:
+                if names and r not in names:
+                    errors.append(
+                        f"config[{i}]: targets unknown request {r!r}")
             params = opaque.get("parameters")
             if params is None:
                 errors.append(f"config[{i}]: missing opaque parameters")
