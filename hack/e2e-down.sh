@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Tear down whatever hack/e2e-up.sh stood up.
+set -u
+ENV_FILE="${1:-/tmp/tpu-dra-e2e/env.sh}"
+[ -f "$ENV_FILE" ] || { echo "no env file $ENV_FILE"; exit 0; }
+# shellcheck disable=SC1090
+source "$ENV_FILE"
+if [ "${E2E_MODE:-sim}" = "kind" ]; then
+  kind delete cluster --name tpu-dra-e2e || true
+else
+  if [ -n "${E2E_SIM_PID:-}" ]; then
+    kill "$E2E_SIM_PID" 2>/dev/null || true
+    for _ in $(seq 1 50); do
+      kill -0 "$E2E_SIM_PID" 2>/dev/null || break
+      sleep 0.2
+    done
+    kill -9 "$E2E_SIM_PID" 2>/dev/null || true
+  fi
+fi
+rm -rf "$(dirname "$ENV_FILE")"
+echo ">> cluster down"

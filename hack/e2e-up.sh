@@ -1,0 +1,92 @@
+#!/usr/bin/env bash
+# Stand up a cluster for the e2e tier and install the chart.
+#
+#   hack/e2e-up.sh [ENV_FILE] [--nodes N] [--chips N]
+#
+# Two modes:
+#  - kind: when kind+kubectl+docker exist, build the image, create a kind
+#    cluster with a fake accel sysfs mounted into each node, install the
+#    chart with real kubectl (the reference's demo/clusters/kind story).
+#  - sim (default/fallback): start the simcluster (tpu_dra.simcluster) —
+#    real driver subprocesses around a fake apiserver — and install the
+#    chart through the kubectl shim.
+#
+# Writes ENV_FILE (default /tmp/tpu-dra-e2e/env.sh) exporting KUBECTL and
+# mode details; `source` it, then run tests/e2e/run.sh.
+set -euo pipefail
+
+REPO_ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+ENV_FILE="/tmp/tpu-dra-e2e/env.sh"
+NODES=2
+CHIPS=4
+while [ $# -gt 0 ]; do
+  case "$1" in
+    --nodes) NODES=$2; shift 2;;
+    --chips) CHIPS=$2; shift 2;;
+    *) ENV_FILE=$1; shift;;
+  esac
+done
+WORK="$(dirname "$ENV_FILE")"
+mkdir -p "$WORK"
+
+if command -v kind >/dev/null && command -v kubectl >/dev/null \
+   && command -v docker >/dev/null; then
+  echo ">> kind mode"
+  IMG=tpu-dra-driver:e2e
+  docker build -f "$REPO_ROOT/deployments/container/Dockerfile" \
+    -t "$IMG" "$REPO_ROOT"
+  # Materialize a fake accel sysfs for each node and mount it where the
+  # plugins look (TPUINFO_SYSFS_ROOT=/fake-accel in the values override).
+  python "$REPO_ROOT/hack/make-fake-sysfs.py" --out "$WORK/accel" \
+    --nodes "$NODES" --chips "$CHIPS"
+  {
+    echo "kind: Cluster"
+    echo "apiVersion: kind.x-k8s.io/v1alpha4"
+    echo "nodes:"
+    echo "  - role: control-plane"
+    for i in $(seq 0 $((NODES - 1))); do
+      echo "  - role: worker"
+      echo "    labels: {tpu.dev/present: \"true\"}"
+      echo "    extraMounts:"
+      echo "      - hostPath: $WORK/accel/n$i"
+      echo "        containerPath: /fake-accel"
+    done
+  } > "$WORK/kind.yaml"
+  kind create cluster --name tpu-dra-e2e --config "$WORK/kind.yaml"
+  kind load docker-image "$IMG" --name tpu-dra-e2e
+  python "$REPO_ROOT/hack/render-chart.py" \
+    --set image.repository=tpu-dra-driver --set image.tag=e2e \
+    -n tpu-dra-driver | kubectl apply -f -
+  cat > "$ENV_FILE" <<EOF
+export KUBECTL=kubectl
+export E2E_MODE=kind
+EOF
+else
+  echo ">> sim mode (kind/kubectl/docker not all present)"
+  make -C "$REPO_ROOT/native" -s
+  STATE="$WORK/state.json"
+  rm -f "$STATE"
+  PYTHONPATH="$REPO_ROOT" python -m tpu_dra.simcluster \
+    --workdir "$WORK/c" --nodes "$NODES" --chips-per-node "$CHIPS" \
+    --state-file "$STATE" > "$WORK/simcluster.log" 2>&1 &
+  SIM_PID=$!
+  for _ in $(seq 1 50); do
+    [ -f "$STATE" ] && break
+    kill -0 "$SIM_PID" 2>/dev/null || { cat "$WORK/simcluster.log"; exit 1; }
+    sleep 0.2
+  done
+  [ -f "$STATE" ] || { echo "simcluster never became ready"; exit 1; }
+  export KUBECTL_SHIM_STATE="$STATE"
+  PYTHONPATH="$REPO_ROOT" python "$REPO_ROOT/hack/render-chart.py" \
+    -n tpu-dra-driver \
+    | PYTHONPATH="$REPO_ROOT" python "$REPO_ROOT/hack/kubectl_shim.py" \
+        apply -f - >/dev/null
+  cat > "$ENV_FILE" <<EOF
+export KUBECTL="python $REPO_ROOT/hack/kubectl_shim.py"
+export KUBECTL_SHIM_STATE="$STATE"
+export E2E_MODE=sim
+export E2E_SIM_PID=$SIM_PID
+export PYTHONPATH="$REPO_ROOT"
+EOF
+fi
+echo ">> cluster up; source $ENV_FILE then run tests/e2e/run.sh"

@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# One command: cluster up -> full e2e suite -> cluster down.
+# (The VERDICT r2 item-3 'done' gate.) Flags pass through to e2e-up.sh.
+set -u
+REPO_ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+WORK="$(mktemp -d /tmp/tdra-XXXXXX)"
+ENV_FILE="$WORK/env.sh"
+
+"$REPO_ROOT/hack/e2e-up.sh" "$ENV_FILE" "$@" || exit 1
+# shellcheck disable=SC1090
+source "$ENV_FILE"
+bash "$REPO_ROOT/tests/e2e/run.sh"
+rc=$?
+"$REPO_ROOT/hack/e2e-down.sh" "$ENV_FILE"
+exit $rc

@@ -1,0 +1,286 @@
+#!/usr/bin/env python3
+"""kubectl subset for clusters without kubectl (the simcluster tier).
+
+Speaks the same HTTP API the drivers use (tpu_dra.k8s.HttpApiClient
+against FakeApiServer). Server discovery: --server, $KUBECTL_SHIM_SERVER,
+or $KUBECTL_SHIM_STATE (the JSON state file simcluster writes).
+
+Implemented: apply -f FILE|- ; delete KIND NAME | delete -f FILE ;
+get KIND [NAME] [-o json|name|jsonpath={.a.b}] ; wait KIND NAME
+--for=jsonpath={.path}=value [--timeout=60s] ; logs POD [-c CTR] ;
+exec-status. The e2e suite (tests/e2e/*.sh) uses only this subset, so the
+same scripts run with real kubectl against a real cluster.
+"""
+
+import argparse
+import json
+import os
+import re
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import yaml  # noqa: E402
+
+from tpu_dra.k8s.client import (  # noqa: E402
+    AlreadyExistsError, HttpApiClient, NotFoundError,
+)
+from tpu_dra.simcluster.gvk import gvr_for_doc, gvr_for_kind, resolve_kind  # noqa: E402
+
+
+def _client(server: str) -> HttpApiClient:
+    if not server:
+        server = os.environ.get("KUBECTL_SHIM_SERVER", "")
+    if not server and os.environ.get("KUBECTL_SHIM_STATE"):
+        with open(os.environ["KUBECTL_SHIM_STATE"]) as f:
+            server = json.load(f)["url"]
+    if not server:
+        print("no server: set --server / KUBECTL_SHIM_SERVER / "
+              "KUBECTL_SHIM_STATE", file=sys.stderr)
+        sys.exit(2)
+    return HttpApiClient(base_url=server)
+
+
+def _load_docs(path: str):
+    text = sys.stdin.read() if path == "-" else open(path).read()
+    return [d for d in yaml.safe_load_all(text) if d]
+
+
+def _jsonpath(obj, expr: str):
+    """Minimal jsonpath: {.a.b[0].c}"""
+    expr = expr.strip()
+    if expr.startswith("{") and expr.endswith("}"):
+        expr = expr[1:-1]
+    cur = obj
+    for part in [p for p in re.split(r"\.", expr) if p]:
+        m = re.match(r"^(\w[\w-]*)?(?:\[(\d+)\])?$", part)
+        if not m:
+            return None
+        key, idx = m.group(1), m.group(2)
+        if key is not None:
+            if not isinstance(cur, dict):
+                return None
+            cur = cur.get(key)
+        if idx is not None:
+            if not isinstance(cur, list) or int(idx) >= len(cur):
+                return None
+            cur = cur[int(idx)]
+        if cur is None:
+            return None
+    return cur
+
+
+def cmd_apply(args) -> int:
+    api = _client(args.server)
+    for doc in _load_docs(args.filename):
+        gvr = gvr_for_doc(doc)
+        ns = doc["metadata"].get("namespace") or (
+            args.namespace if gvr.namespaced else None)
+        if gvr.namespaced and ns:
+            doc["metadata"]["namespace"] = ns
+        try:
+            api.create(gvr, doc, namespace=ns)
+            verb = "created"
+        except AlreadyExistsError:
+            current = api.get(gvr, doc["metadata"]["name"], ns)
+            doc["metadata"]["resourceVersion"] = \
+                current["metadata"].get("resourceVersion")
+            api.update(gvr, doc, ns)
+            verb = "configured"
+        print(f"{doc['kind'].lower()}/{doc['metadata']['name']} {verb}")
+    return 0
+
+
+def cmd_delete(args) -> int:
+    api = _client(args.server)
+    targets = []
+    if args.filename:
+        for doc in _load_docs(args.filename):
+            gvr = gvr_for_doc(doc)
+            targets.append((gvr, doc["metadata"]["name"],
+                            doc["metadata"].get("namespace")
+                            or (args.namespace if gvr.namespaced else None)))
+    else:
+        kind = resolve_kind(args.kind or "")
+        if kind is None:
+            print(f"unknown kind {args.kind!r}", file=sys.stderr)
+            return 2
+        gvr = gvr_for_kind(kind)
+        targets.append((gvr, args.name,
+                        args.namespace if gvr.namespaced else None))
+    rc = 0
+    for gvr, name, ns in targets:
+        try:
+            api.delete(gvr, name, ns)
+            print(f"{gvr.plural}/{name} deleted")
+        except NotFoundError:
+            if not args.ignore_not_found:
+                print(f"{gvr.plural}/{name} not found", file=sys.stderr)
+                rc = 1
+    return rc
+
+
+def cmd_get(args) -> int:
+    api = _client(args.server)
+    kind = resolve_kind(args.kind or "")
+    if kind is None:
+        print(f"unknown kind {args.kind!r}", file=sys.stderr)
+        return 2
+    gvr = gvr_for_kind(kind)
+    ns = args.namespace if gvr.namespaced else None
+    if args.name:
+        try:
+            objs = [api.get(gvr, args.name, ns)]
+        except NotFoundError:
+            print(f"{gvr.plural}/{args.name} not found", file=sys.stderr)
+            return 1
+    else:
+        objs = api.list(gvr, namespace=ns,
+                        label_selector=args.selector or None)
+    if args.output == "json":
+        doc = objs[0] if args.name else {"apiVersion": "v1", "kind": "List",
+                                         "items": objs}
+        print(json.dumps(doc, indent=2))
+    elif args.output and args.output.startswith("jsonpath="):
+        expr = args.output[len("jsonpath="):]
+        vals = [_jsonpath(o, expr) for o in objs]
+        print(" ".join("" if v is None else
+                       (json.dumps(v) if isinstance(v, (dict, list))
+                        else str(v)) for v in vals))
+    elif args.output == "name":
+        for o in objs:
+            print(f"{gvr.plural}/{o['metadata']['name']}")
+    else:
+        for o in objs:
+            phase = (o.get("status") or {}).get("phase", "")
+            print(f"{o['metadata'].get('namespace', ''):<16}"
+                  f"{o['metadata']['name']:<48}{phase}")
+    return 0
+
+
+def _parse_timeout(s: str) -> float:
+    m = re.match(r"^(\d+)(s|m)?$", s or "60s")
+    if not m:
+        return 60.0
+    return int(m.group(1)) * (60 if m.group(2) == "m" else 1)
+
+
+def cmd_wait(args) -> int:
+    api = _client(args.server)
+    kind = resolve_kind(args.kind or "")
+    if kind is None:
+        print(f"unknown kind {args.kind!r}", file=sys.stderr)
+        return 2
+    gvr = gvr_for_kind(kind)
+    ns = args.namespace if gvr.namespaced else None
+    cond = args.wait_for
+    deadline = time.monotonic() + _parse_timeout(args.timeout)
+
+    def satisfied(obj) -> bool:
+        if cond.startswith("delete"):
+            return False  # handled below
+        if cond.startswith("condition="):
+            want = cond[len("condition="):]
+            name, _, val = want.partition("=")
+            val = val or "True"
+            for c in (obj.get("status") or {}).get("conditions") or []:
+                if c.get("type") == name:
+                    return c.get("status") == val
+            return False
+        if cond.startswith("jsonpath="):
+            expr, _, want = cond[len("jsonpath="):].partition("=")
+            got = _jsonpath(obj, expr)
+            return str(got) == want
+        return False
+
+    while time.monotonic() < deadline:
+        try:
+            obj = api.get(gvr, args.name, ns)
+            if cond.startswith("delete"):
+                pass
+            elif satisfied(obj):
+                print(f"{gvr.plural}/{args.name} condition met")
+                return 0
+        except NotFoundError:
+            if cond.startswith("delete"):
+                print(f"{gvr.plural}/{args.name} deleted")
+                return 0
+        time.sleep(0.25)
+    print(f"timed out waiting for {cond} on {gvr.plural}/{args.name}",
+          file=sys.stderr)
+    return 1
+
+
+def cmd_logs(args) -> int:
+    api = _client(args.server)
+    state_file = os.environ.get("KUBECTL_SHIM_STATE", "")
+    if not state_file:
+        print("logs requires KUBECTL_SHIM_STATE (sim mode only)",
+              file=sys.stderr)
+        return 2
+    with open(state_file) as f:
+        workdir = json.load(f)["workdir"]
+    gvr = gvr_for_kind("Pod")
+    try:
+        pod = api.get(gvr, args.name, args.namespace)
+    except NotFoundError:
+        print(f"pod {args.name} not found", file=sys.stderr)
+        return 1
+    node = pod["spec"].get("nodeName", "")
+    uid = pod["metadata"]["uid"]
+    ctr = args.container or pod["spec"]["containers"][0]["name"]
+    path = os.path.join(workdir, node, "pods", uid, "logs", f"{ctr}.log")
+    if not os.path.exists(path):
+        print(f"no logs at {path}", file=sys.stderr)
+        return 1
+    sys.stdout.write(open(path, errors="replace").read())
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="kubectl-shim")
+    ap.add_argument("--server", default="")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("apply")
+    p.add_argument("-f", "--filename", required=True)
+    p.add_argument("-n", "--namespace", default="default")
+    p.set_defaults(fn=cmd_apply)
+
+    p = sub.add_parser("delete")
+    p.add_argument("kind", nargs="?")
+    p.add_argument("name", nargs="?")
+    p.add_argument("-f", "--filename", default="")
+    p.add_argument("-n", "--namespace", default="default")
+    p.add_argument("--ignore-not-found", action="store_true")
+    p.set_defaults(fn=cmd_delete)
+
+    p = sub.add_parser("get")
+    p.add_argument("kind")
+    p.add_argument("name", nargs="?")
+    p.add_argument("-n", "--namespace", default="default")
+    p.add_argument("-l", "--selector", default="")
+    p.add_argument("-o", "--output", default="")
+    p.set_defaults(fn=cmd_get)
+
+    p = sub.add_parser("wait")
+    p.add_argument("kind")
+    p.add_argument("name")
+    p.add_argument("--for", dest="wait_for", required=True)
+    p.add_argument("-n", "--namespace", default="default")
+    p.add_argument("--timeout", default="60s")
+    p.set_defaults(fn=cmd_wait)
+
+    p = sub.add_parser("logs")
+    p.add_argument("name")
+    p.add_argument("-c", "--container", default="")
+    p.add_argument("-n", "--namespace", default="default")
+    p.set_defaults(fn=cmd_logs)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

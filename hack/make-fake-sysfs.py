@@ -1,0 +1,29 @@
+#!/usr/bin/env python3
+"""Materialize fake accel sysfs trees for kind-node mounts.
+
+    python hack/make-fake-sysfs.py --out DIR --nodes N --chips M
+
+One tree per node under DIR/n<i>, each the ABI tpu_dra.native reads
+(chips, topology, PCI/IOMMU for passthrough). Used by hack/e2e-up.sh's
+kind mode; the simcluster materializes its own trees in-process.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from tpu_dra.native.tpuinfo import default_fake_chips, make_fake_sysfs  # noqa: E402
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--nodes", type=int, default=2)
+    ap.add_argument("--chips", type=int, default=4)
+    ap.add_argument("--slice-id", default="slice-A")
+    args = ap.parse_args()
+    for i in range(args.nodes):
+        root = os.path.join(args.out, f"n{i}")
+        make_fake_sysfs(root, default_fake_chips(
+            args.chips, "v5e", args.slice_id, i))
+        print(f"wrote {root}")

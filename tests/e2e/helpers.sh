@@ -1,0 +1,55 @@
+# Shared helpers for the cluster-tier e2e suite.
+# Reference analog: tests/bats/helpers.sh. The suite speaks only the
+# kubectl subset hack/kubectl_shim.py implements, so the SAME scripts run
+# against a real cluster (KUBECTL=kubectl) or the simcluster
+# (KUBECTL="python hack/kubectl_shim.py", set by hack/e2e-up.sh).
+
+set -u
+
+: "${KUBECTL:?KUBECTL must be set (source the env file from hack/e2e-up.sh)}"
+REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/../.." && pwd)"
+
+k() { ${KUBECTL} "$@"; }
+
+log() { echo "[$(date +%H:%M:%S)] $*"; }
+
+die() { echo "FAIL: $*" >&2; exit 1; }
+
+# wait_until <timeout_s> <desc> <cmd...> — retry cmd until success.
+wait_until() {
+  local timeout=$1 desc=$2; shift 2
+  local deadline=$((SECONDS + timeout))
+  while ((SECONDS < deadline)); do
+    if "$@" >/dev/null 2>&1; then return 0; fi
+    sleep 1
+  done
+  die "timed out (${timeout}s) waiting for: ${desc}"
+}
+
+# jsonpath get helper: jp <kind> <name> <ns> <path>
+jp() { k get "$1" "$2" -n "$3" -o "jsonpath={$4}"; }
+
+pod_phase() { jp pod "$1" "$2" .status.phase; }
+
+pod_phase_is() { [ "$(pod_phase "$1" "$2")" = "$3" ]; }
+
+all_pods_phase() {  # all_pods_phase <ns> <phase>
+  # Count-checked: pods without a phase yet yield empty jsonpath fields,
+  # which an unquoted loop would silently skip.
+  local ns=$1 want=$2 n c=0 phases
+  n=$(k get pods -n "$ns" -o name 2>/dev/null | wc -l)
+  [ "$n" -gt 0 ] || return 1
+  phases=$(k get pods -n "$ns" -o "jsonpath={.status.phase}") || return 1
+  for p in $phases; do
+    [ "$p" = "$want" ] || return 1
+    c=$((c + 1))
+  done
+  [ "$c" -eq "$n" ]
+}
+
+cleanup_namespace() {  # best-effort demo teardown
+  local ns=$1
+  k get pods -n "$ns" -o name 2>/dev/null | while read -r p; do
+    k delete pod "${p##*/}" -n "$ns" --ignore-not-found >/dev/null 2>&1
+  done
+}

@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Run the whole e2e suite against whatever cluster the sourced env file
+# points at. Usage:
+#   hack/e2e-up.sh /tmp/e2e-env.sh && source /tmp/e2e-env.sh && tests/e2e/run.sh
+# or just `hack/e2e.sh` for up+run+down in one command.
+set -u
+HERE="$(cd "$(dirname "$0")" && pwd)"
+
+SUITES=${E2E_SUITES:-"test_basics test_tpu_claims test_stress test_multiprocess test_cd_lifecycle test_cd_failover"}
+
+failed=0
+for s in $SUITES; do
+  echo "=== $s ==="
+  if bash "$HERE/$s.sh"; then
+    echo "=== $s PASSED ==="
+  else
+    echo "=== $s FAILED ==="
+    failed=1
+    [ "${E2E_FAIL_FAST:-1}" = "1" ] && break
+  fi
+done
+exit $failed

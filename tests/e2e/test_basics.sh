@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# Basics: chart installed, driver components up, inventory published.
+# Reference analog: tests/bats/test_basics.bats.
+source "$(dirname "$0")/helpers.sh"
+
+log "CRD present"
+k get crd computedomains.resource.tpu.dev -o name >/dev/null \
+  || die "ComputeDomain CRD missing"
+
+log "DeviceClasses present"
+for dc in tpu.dev tpu-subslice.tpu.dev compute-domain-daemon.tpu.dev \
+          compute-domain-default-channel.tpu.dev; do
+  k get deviceclass "$dc" -o name >/dev/null || die "DeviceClass $dc missing"
+done
+
+log "driver pods Running and Ready"
+check_driver_pods() {
+  all_pods_phase tpu-dra-driver Running || return 1
+  local n c=0 conds
+  n=$(k get pods -n tpu-dra-driver -o name | wc -l)
+  conds=$(k get pods -n tpu-dra-driver \
+            -o "jsonpath={.status.conditions[0].status}")
+  for s in $conds; do
+    [ "$s" = "True" ] || return 1
+    c=$((c + 1))
+  done
+  [ "$c" -eq "$n" ]
+}
+wait_until 120 "driver pods Ready" check_driver_pods
+
+log "ResourceSlices published by both drivers"
+check_slices() {
+  local names
+  names=$(k get resourceslices -o name)
+  echo "$names" | grep -q "tpu.dev" || return 1
+  echo "$names" | grep -q "compute-domain.tpu.dev" || return 1
+}
+wait_until 60 "resource slices" check_slices
+
+log "OK test_basics"

@@ -1,0 +1,76 @@
+#!/usr/bin/env bash
+# CD failover: kill the slice-daemon processes under a Ready domain and
+# measure time-to-heal. Reference analog: tests/bats/test_cd_failover.bats
+# + lib/test_cd_nvb_failover.sh (300s bound).
+source "$(dirname "$0")/helpers.sh"
+
+NS=cd-failover
+CD=cd-failover-domain
+HEAL_BOUND=${HEAL_BOUND:-240}
+
+cat <<EOF | k apply -f -
+apiVersion: v1
+kind: Namespace
+metadata:
+  name: $NS
+---
+apiVersion: resource.tpu.dev/v1beta1
+kind: ComputeDomain
+metadata:
+  name: $CD
+  namespace: $NS
+spec:
+  numNodes: 2
+  channel:
+    resourceClaimTemplate:
+      name: ${CD}-channel
+EOF
+wait_until 60 "workload RCT" k get rct "${CD}-channel" -n $NS -o name
+
+for i in 0 1; do
+  cat <<EOF | k apply -f -
+apiVersion: v1
+kind: Pod
+metadata:
+  name: wl-$i
+  namespace: $NS
+spec:
+  restartPolicy: Never
+  nodeName: n$i
+  containers:
+  - name: ctr
+    image: x
+    command: ["python", "-c", "import time; time.sleep(900)"]
+    resources:
+      claims: [{name: ch}]
+  resourceClaims:
+  - name: ch
+    resourceClaimTemplateName: ${CD}-channel
+EOF
+done
+
+cd_ready() { [ "$(jp cd $CD $NS .status.status)" = "Ready" ]; }
+cd_not_ready() { [ "$(jp cd $CD $NS .status.status)" = "NotReady" ]; }
+wait_until 240 "CD Ready" cd_ready
+
+log "fault injection: kill every slice-daemon wrapper (the"
+log "'force-delete all IMEX daemons' case)"
+if [ "${E2E_MODE:-sim}" = "sim" ]; then
+  pkill -f "tpu_dra.cddaemon.main" || die "no daemon processes to kill"
+else
+  for pod in $(k get pods -n tpu-dra-driver -o name | grep tpu-cd-daemon); do
+    k delete "${pod#pods/}" -n tpu-dra-driver
+  done
+fi
+
+log "domain must notice (NotReady) ..."
+wait_until 120 "CD NotReady after fault" cd_not_ready
+
+log "... and heal within ${HEAL_BOUND}s"
+t0=$SECONDS
+wait_until "$HEAL_BOUND" "CD Ready again" cd_ready
+log "healed in $((SECONDS - t0))s"
+
+for i in 0 1; do k delete pod wl-$i -n $NS --ignore-not-found; done
+k delete cd $CD -n $NS
+log "OK test_cd_failover"

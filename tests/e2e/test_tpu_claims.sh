@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# Demo ladder: exclusive claims (tpu-test1), shared claim + multi-container
+# (tpu-test2), time-slicing (tpu-test3). Reference analog:
+# tests/bats/test_gpu_basic.bats driving demo/specs/quickstart.
+source "$(dirname "$0")/helpers.sh"
+
+log "tpu-test1: two pods, one exclusive chip each"
+k apply -f "$REPO_ROOT/demo/specs/tpu-test1.yaml"
+wait_until 120 "tpu-test1 pods Succeeded" all_pods_phase tpu-test1 Succeeded
+log0=$(k logs pod0 -n tpu-test1)
+log1=$(k logs pod1 -n tpu-test1)
+echo "$log0" | grep -q "TPU_VISIBLE_CHIPS=" || die "pod0 missing chip env"
+echo "$log1" | grep -q "TPU_VISIBLE_CHIPS=" || die "pod1 missing chip env"
+chip0=$(echo "$log0" | sed -n 's/.*TPU_VISIBLE_CHIPS= *//p' | head -1)
+chip1=$(echo "$log1" | sed -n 's/.*TPU_VISIBLE_CHIPS= *//p' | head -1)
+[ "$chip0" != "$chip1" ] || die "exclusive claims got the same chip ($chip0)"
+k delete -f "$REPO_ROOT/demo/specs/tpu-test1.yaml" --ignore-not-found
+
+log "tpu-test2: pods sharing one claim see the same chip"
+k apply -f "$REPO_ROOT/demo/specs/tpu-test2.yaml"
+wait_until 120 "tpu-test2 pods Succeeded" all_pods_phase tpu-test2 Succeeded
+k delete -f "$REPO_ROOT/demo/specs/tpu-test2.yaml" --ignore-not-found
+
+log "tpu-test3: time-sliced shared claim"
+k apply -f "$REPO_ROOT/demo/specs/tpu-test3.yaml"
+wait_until 120 "tpu-test3 pods Succeeded" all_pods_phase tpu-test3 Succeeded
+k logs pod0 -n tpu-test3 | grep -q "TPU_VISIBLE_CHIPS=" \
+  || die "tpu-test3 pod missing chip env"
+k delete -f "$REPO_ROOT/demo/specs/tpu-test3.yaml" --ignore-not-found
+
+log "OK test_tpu_claims"

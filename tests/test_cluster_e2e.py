@@ -1,0 +1,35 @@
+"""Cluster-tier e2e: the one-command suite (hack/e2e.sh) as a pytest.
+
+Stands up the simcluster (real driver subprocesses around the fake HTTP
+apiserver, chart installed via the kubectl shim) and runs the shell suite
+mirroring tests/bats. Set TPU_DRA_E2E_SUITES to narrow, or
+TPU_DRA_SKIP_CLUSTER_E2E=1 to skip the (multi-minute) tier locally.
+"""
+
+import os
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# The CD suites dominate wall-clock (~4 min: the channel prepare
+# deliberately retries until the domain converges, plus failover heal).
+DEFAULT_SUITES = os.environ.get(
+    "TPU_DRA_E2E_SUITES",
+    "test_basics test_tpu_claims test_stress test_multiprocess "
+    "test_cd_lifecycle")
+
+
+@pytest.mark.skipif(os.environ.get("TPU_DRA_SKIP_CLUSTER_E2E") == "1",
+                    reason="cluster e2e disabled by env")
+def test_cluster_e2e_suite():
+    env = dict(os.environ, E2E_SUITES=DEFAULT_SUITES)
+    # The suite manages its own JAX processes; don't leak the test
+    # runner's platform pinning into the cluster-up path.
+    res = subprocess.run(
+        ["bash", os.path.join(REPO, "hack", "e2e.sh")],
+        env=env, capture_output=True, text=True, timeout=1500)
+    tail = "\n".join(res.stdout.splitlines()[-60:])
+    assert res.returncode == 0, f"e2e suite failed:\n{tail}\n{res.stderr[-2000:]}"
+    assert "FAILED" not in res.stdout

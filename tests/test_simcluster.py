@@ -1,0 +1,222 @@
+"""Unit tier for the simcluster pieces (fast, in-process, no subprocesses
+— the full driver-in-the-loop path is tests/test_cluster_e2e.py)."""
+
+import pytest
+
+from tpu_dra.k8s.fake import FakeCluster
+from tpu_dra.k8s.resources import (
+    DAEMONSETS, DEVICECLASSES, NODES, PODS, RESOURCECLAIMS,
+    RESOURCECLAIMTEMPLATES, RESOURCESLICES,
+)
+from tpu_dra.simcluster.gvk import gvr_for_kind, resolve_kind
+from tpu_dra.simcluster.scheduler import Scheduler
+from tpu_dra.simcluster.workloads import WorkloadController
+
+
+def make_cluster_with_inventory(chips=2):
+    c = FakeCluster()
+    c.create(NODES, {"apiVersion": "v1", "kind": "Node",
+                     "metadata": {"name": "n0",
+                                  "labels": {"tpu.dev/present": "true"}}})
+    c.create(DEVICECLASSES, {
+        "apiVersion": "resource.k8s.io/v1", "kind": "DeviceClass",
+        "metadata": {"name": "tpu.dev"},
+        "spec": {"selectors": [{"cel": {"expression":
+            'device.driver == "tpu.dev" && '
+            'device.attributes["tpu.dev"].type == "chip"'}}]}})
+    c.create(RESOURCESLICES, {
+        "apiVersion": "resource.k8s.io/v1", "kind": "ResourceSlice",
+        "metadata": {"name": "n0-tpu.dev"},
+        "spec": {"driver": "tpu.dev", "nodeName": "n0",
+                 "pool": {"name": "n0", "generation": 1},
+                 "devices": [
+                     {"name": f"chip-{i}",
+                      "attributes": {"type": {"string": "chip"}}}
+                     for i in range(chips)]}})
+    return c
+
+
+def pod_with_claim(name, claim_entry, ns="default"):
+    return {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {"containers": [{"name": "c", "image": "x",
+                                 "command": ["true"],
+                                 "resources": {"claims": [{"name": "t"}]}}],
+                 "resourceClaims": [dict(claim_entry, name="t")]},
+    }
+
+
+class TestScheduler:
+    def test_claim_from_template_and_allocation(self):
+        c = make_cluster_with_inventory()
+        c.create(RESOURCECLAIMTEMPLATES, {
+            "apiVersion": "resource.k8s.io/v1",
+            "kind": "ResourceClaimTemplate",
+            "metadata": {"name": "tmpl", "namespace": "default"},
+            "spec": {"spec": {"devices": {"requests": [
+                {"name": "tpu",
+                 "exactly": {"deviceClassName": "tpu.dev"}}]}}},
+        }, namespace="default")
+        c.create(PODS, pod_with_claim(
+            "p1", {"resourceClaimTemplateName": "tmpl"}), namespace="default")
+        s = Scheduler(c)
+        for _ in range(3):
+            s.reconcile_once()
+        pod = c.get(PODS, "p1", "default")
+        assert pod["spec"].get("nodeName") == "n0"
+        claims = c.list(RESOURCECLAIMS, namespace="default")
+        assert len(claims) == 1
+        alloc = claims[0]["status"]["allocation"]["devices"]
+        assert alloc["results"][0]["driver"] == "tpu.dev"
+        assert alloc["results"][0]["pool"] == "n0"
+        assert alloc["results"][0]["device"].startswith("chip-")
+
+    def test_exclusive_devices_not_double_allocated(self):
+        c = make_cluster_with_inventory(chips=1)
+        for name in ("c1", "c2"):
+            c.create(RESOURCECLAIMS, {
+                "apiVersion": "resource.k8s.io/v1", "kind": "ResourceClaim",
+                "metadata": {"name": name, "namespace": "default"},
+                "spec": {"devices": {"requests": [
+                    {"name": "tpu",
+                     "exactly": {"deviceClassName": "tpu.dev"}}]}},
+            }, namespace="default")
+        c.create(PODS, pod_with_claim("p1", {"resourceClaimName": "c1"}),
+                 namespace="default")
+        c.create(PODS, pod_with_claim("p2", {"resourceClaimName": "c2"}),
+                 namespace="default")
+        s = Scheduler(c)
+        for _ in range(3):
+            s.reconcile_once()
+        allocated = [cl for cl in c.list(RESOURCECLAIMS, namespace="default")
+                     if (cl.get("status") or {}).get("allocation")]
+        # One chip: exactly one claim can allocate; the other pod stays
+        # unscheduled rather than sharing the device.
+        assert len(allocated) == 1
+
+    def test_shared_claim_pins_second_pod_to_same_node(self):
+        c = make_cluster_with_inventory()
+        c.create(RESOURCECLAIMS, {
+            "apiVersion": "resource.k8s.io/v1", "kind": "ResourceClaim",
+            "metadata": {"name": "shared", "namespace": "default"},
+            "spec": {"devices": {"requests": [
+                {"name": "tpu",
+                 "exactly": {"deviceClassName": "tpu.dev"}}]}},
+        }, namespace="default")
+        c.create(PODS, pod_with_claim("p1", {"resourceClaimName": "shared"}),
+                 namespace="default")
+        c.create(PODS, pod_with_claim("p2", {"resourceClaimName": "shared"}),
+                 namespace="default")
+        s = Scheduler(c)
+        for _ in range(3):
+            s.reconcile_once()
+        assert c.get(PODS, "p1", "default")["spec"]["nodeName"] == "n0"
+        assert c.get(PODS, "p2", "default")["spec"]["nodeName"] == "n0"
+
+    def test_count_request(self):
+        c = make_cluster_with_inventory(chips=4)
+        c.create(RESOURCECLAIMS, {
+            "apiVersion": "resource.k8s.io/v1", "kind": "ResourceClaim",
+            "metadata": {"name": "quad", "namespace": "default"},
+            "spec": {"devices": {"requests": [
+                {"name": "tpu", "exactly": {"deviceClassName": "tpu.dev",
+                                            "count": 4}}]}},
+        }, namespace="default")
+        c.create(PODS, pod_with_claim("p1", {"resourceClaimName": "quad"}),
+                 namespace="default")
+        Scheduler(c).reconcile_once()
+        claim = c.get(RESOURCECLAIMS, "quad", "default")
+        results = claim["status"]["allocation"]["devices"]["results"]
+        assert len(results) == 4
+        assert len({r["device"] for r in results}) == 4
+
+
+class TestWorkloadController:
+    def _ds(self, selector):
+        return {
+            "apiVersion": "apps/v1", "kind": "DaemonSet",
+            "metadata": {"name": "d", "namespace": "default"},
+            "spec": {"selector": {"matchLabels": {"a": "b"}},
+                     "template": {
+                         "metadata": {"labels": {"a": "b"}},
+                         "spec": {"nodeSelector": selector,
+                                  "containers": [{"name": "c", "image": "x",
+                                                  "command": ["true"]}]}}},
+        }
+
+    def test_daemonset_follows_node_labels(self):
+        c = FakeCluster()
+        c.create(NODES, {"apiVersion": "v1", "kind": "Node",
+                         "metadata": {"name": "n0", "labels": {}}})
+        c.create(DAEMONSETS, self._ds({"want": "yes"}), namespace="default")
+        wc = WorkloadController(c)
+        wc.reconcile_once()
+        assert not c.list(PODS, namespace="default")
+        # Label the node: pod appears (workload-following).
+        node = c.get(NODES, "n0")
+        node["metadata"]["labels"] = {"want": "yes"}
+        c.update(NODES, node)
+        wc.reconcile_once()
+        pods = c.list(PODS, namespace="default")
+        assert [p["metadata"]["name"] for p in pods] == ["d-n0"]
+        assert pods[0]["spec"]["nodeName"] == "n0"
+        # Unlabel: pod goes away.
+        node = c.get(NODES, "n0")
+        node["metadata"]["labels"] = {}
+        c.update(NODES, node)
+        wc.reconcile_once()
+        assert not c.list(PODS, namespace="default")
+
+    def test_daemonset_number_ready_tracks_pod_readiness(self):
+        c = FakeCluster()
+        c.create(NODES, {"apiVersion": "v1", "kind": "Node",
+                         "metadata": {"name": "n0",
+                                      "labels": {"want": "yes"}}})
+        c.create(DAEMONSETS, self._ds({"want": "yes"}), namespace="default")
+        wc = WorkloadController(c)
+        wc.reconcile_once()
+        ds = c.get(DAEMONSETS, "d", "default")
+        assert ds["status"]["numberReady"] == 0
+        pod = c.get(PODS, "d-n0", "default")
+        pod.setdefault("status", {})["conditions"] = [
+            {"type": "Ready", "status": "True"}]
+        c.update_status(PODS, pod, "default")
+        wc.reconcile_once()
+        ds = c.get(DAEMONSETS, "d", "default")
+        assert ds["status"]["numberReady"] == 1
+
+
+class TestGvk:
+    @pytest.mark.parametrize("alias,kind", [
+        ("po", "Pod"), ("pods", "Pod"), ("cd", "ComputeDomain"),
+        ("rct", "ResourceClaimTemplate"), ("deviceclass", "DeviceClass"),
+        ("crd", "CustomResourceDefinition"), ("ds", "DaemonSet"),
+    ])
+    def test_aliases(self, alias, kind):
+        assert resolve_kind(alias) == kind
+
+    def test_gvr_matches_fakeserver_registry(self):
+        from tpu_dra.k8s.fakeserver import KNOWN_GVRS
+        for kind in ("Pod", "Secret", "ComputeDomain", "ResourceSlice",
+                     "CustomResourceDefinition", "ClusterRole",
+                     "ValidatingWebhookConfiguration"):
+            g = gvr_for_kind(kind)
+            assert (g.group, g.version, g.plural) in KNOWN_GVRS, kind
+
+
+class TestShimJsonpath:
+    def test_paths(self):
+        import importlib.util
+        import os
+        spec = importlib.util.spec_from_file_location(
+            "kshim", os.path.join(os.path.dirname(__file__), "..",
+                                  "hack", "kubectl_shim.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        obj = {"status": {"phase": "Running",
+                          "conditions": [{"type": "Ready",
+                                          "status": "True"}]}}
+        assert mod._jsonpath(obj, "{.status.phase}") == "Running"
+        assert mod._jsonpath(obj, "{.status.conditions[0].status}") == "True"
+        assert mod._jsonpath(obj, "{.status.missing}") is None

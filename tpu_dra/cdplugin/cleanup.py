@@ -70,13 +70,15 @@ class CheckpointCleanup:
                                  for c in self._client.list(RESOURCECLAIMS)}
                 match = uid_index.get(uid)
                 if match is not None:
-                    self._state.backfill_claim_identity(
-                        uid, match["metadata"]["name"],
-                        match["metadata"].get("namespace", ""))
-                    log.info("backfilled legacy checkpoint identity for "
-                             "claim %s (%s/%s)", uid,
-                             match["metadata"].get("namespace", ""),
-                             match["metadata"]["name"])
+                    if self._state.backfill_claim_identity(
+                            uid, match["metadata"]["name"],
+                            match["metadata"].get("namespace", "")):
+                        log.info("backfilled legacy checkpoint identity "
+                                 "for claim %s (%s/%s)", uid,
+                                 match["metadata"].get("namespace", ""),
+                                 match["metadata"]["name"])
+                    # else: record unprepared between snapshot and now —
+                    # nothing was written, nothing to collect.
                     continue  # claim still exists: kubelet will retry
                 if self._state.drop_claim(uid):
                     log.info("GC abandoned legacy claim %s", uid)

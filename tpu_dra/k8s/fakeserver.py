@@ -39,7 +39,14 @@ KNOWN_GVRS = {
               resources.DAEMONSETS, resources.DEPLOYMENTS,
               resources.RESOURCECLAIMS, resources.RESOURCECLAIMTEMPLATES,
               resources.RESOURCESLICES, resources.DEVICECLASSES,
-              resources.COMPUTEDOMAINS)
+              resources.COMPUTEDOMAINS,
+              resources.NAMESPACES, resources.SECRETS, resources.SERVICES,
+              resources.SERVICEACCOUNTS, resources.CRDS,
+              resources.CLUSTERROLES, resources.CLUSTERROLEBINDINGS,
+              resources.NETWORKPOLICIES,
+              resources.VALIDATINGWEBHOOKCONFIGURATIONS,
+              resources.VALIDATINGADMISSIONPOLICIES,
+              resources.VALIDATINGADMISSIONPOLICYBINDINGS)
 }
 
 

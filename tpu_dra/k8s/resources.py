@@ -26,6 +26,30 @@ DEVICECLASSES = GVR("resource.k8s.io", "v1", "deviceclasses", namespaced=False)
 
 COMPUTEDOMAINS = GVR("resource.tpu.dev", "v1beta1", "computedomains")
 
+# Kinds the driver itself never reads but the deployment manifests carry;
+# registered so the fake apiserver can store a full chart install
+# (simcluster tier).
+NAMESPACES = GVR("", "v1", "namespaces", namespaced=False)
+SECRETS = GVR("", "v1", "secrets")
+SERVICES = GVR("", "v1", "services")
+SERVICEACCOUNTS = GVR("", "v1", "serviceaccounts")
+CRDS = GVR("apiextensions.k8s.io", "v1", "customresourcedefinitions",
+           namespaced=False)
+CLUSTERROLES = GVR("rbac.authorization.k8s.io", "v1", "clusterroles",
+                   namespaced=False)
+CLUSTERROLEBINDINGS = GVR("rbac.authorization.k8s.io", "v1",
+                          "clusterrolebindings", namespaced=False)
+NETWORKPOLICIES = GVR("networking.k8s.io", "v1", "networkpolicies")
+VALIDATINGWEBHOOKCONFIGURATIONS = GVR(
+    "admissionregistration.k8s.io", "v1",
+    "validatingwebhookconfigurations", namespaced=False)
+VALIDATINGADMISSIONPOLICIES = GVR(
+    "admissionregistration.k8s.io", "v1",
+    "validatingadmissionpolicies", namespaced=False)
+VALIDATINGADMISSIONPOLICYBINDINGS = GVR(
+    "admissionregistration.k8s.io", "v1",
+    "validatingadmissionpolicybindings", namespaced=False)
+
 
 def new_object_meta(name: str, namespace: Optional[str] = None,
                     labels: Optional[Dict[str, str]] = None,

@@ -1,0 +1,27 @@
+"""simcluster: a cluster-in-processes for the e2e tier.
+
+The dev/CI environment has no kind/kubectl/docker (SURVEY §4.2's
+"simulated accel device directory" CI tier). This package stands in for
+the cluster pieces the driver does NOT own, so the pieces it DOES own run
+for real, as subprocesses, wired over real HTTP/gRPC:
+
+- FakeApiServer        -> the API server (HTTP + watch)
+- Scheduler            -> claims-from-templates + DRA allocation + binding
+                          (upstream kube-scheduler's DRA plugin analog)
+- WorkloadController   -> DaemonSet/Deployment -> Pod stamping + status
+                          (kube-controller-manager analog)
+- NodeSim              -> per-node kubelet: runs pod commands as real
+                          subprocesses, drives the REAL driver plugins over
+                          their dra.sock gRPC, applies REAL CDI spec edits
+                          to container env, runs probes, reports status
+
+The driver components themselves (kubelet plugins, CD controller, CD
+daemon wrapping the C++ slice daemon, webhook, multiprocess coordinator)
+are launched from the SAME manifests the Helm chart renders — nothing is
+faked inside the driver path.
+
+`python -m tpu_dra.simcluster` serves a cluster for hack/e2e-up.sh; the
+kubectl shim (hack/kubectl_shim.py) talks to its URL.
+"""
+
+from tpu_dra.simcluster.cluster import SimCluster  # noqa: F401

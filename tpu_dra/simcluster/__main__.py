@@ -1,0 +1,4 @@
+from tpu_dra.simcluster.cluster import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

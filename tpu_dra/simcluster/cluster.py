@@ -1,0 +1,143 @@
+"""SimCluster assembly + `python -m tpu_dra.simcluster` server mode."""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal
+import sys
+import threading
+from typing import Dict, List, Optional
+
+from tpu_dra.k8s.client import AlreadyExistsError, HttpApiClient
+from tpu_dra.k8s.fakeserver import FakeApiServer
+from tpu_dra.native.tpuinfo import default_fake_chips, make_fake_sysfs
+from tpu_dra.simcluster.gvk import gvr_for_doc
+from tpu_dra.simcluster.nodesim import NodeSim
+from tpu_dra.simcluster.scheduler import Scheduler
+from tpu_dra.simcluster.workloads import WorkloadController
+
+log = logging.getLogger("simcluster")
+
+
+class SimCluster:
+    """N simulated TPU nodes around a FakeApiServer; see package docstring.
+
+    Each node gets a hostfs with a make_fake_sysfs tree (the kind-node
+    fake-accel-mount analog), so the kubelet plugins launched onto it
+    enumerate chips through the REAL C++ libtpuinfo against that tree.
+    """
+
+    def __init__(self, workdir: str, *, num_nodes: int = 2,
+                 chips_per_node: int = 4, slice_id: str = "slice-A"):
+        self.workdir = workdir
+        self.server = FakeApiServer()
+        self.nodes: Dict[str, NodeSim] = {}
+        self._num_nodes = num_nodes
+        self._chips = chips_per_node
+        self._slice_id = slice_id
+        self.scheduler: Optional[Scheduler] = None
+        self.workloads: Optional[WorkloadController] = None
+        self.api: Optional[HttpApiClient] = None
+
+    @property
+    def url(self) -> str:
+        return self.server.url
+
+    def start(self) -> "SimCluster":
+        self.server.start()
+        self.api = HttpApiClient(base_url=self.server.url)
+        from tpu_dra.k8s.resources import NODES
+        for i in range(self._num_nodes):
+            # Short names throughout: the kubelet registry socket path
+            # must stay under the AF_UNIX 107-char limit
+            # (<workdir>/<node>/fs/var/lib/kubelet/plugins_registry/
+            # compute-domain.tpu.dev-reg.sock).
+            name = f"n{i}"
+            node_dir = os.path.join(self.workdir, name)
+            hostfs = os.path.join(node_dir, "fs")
+            chips = default_fake_chips(self._chips, "v5e", self._slice_id, i)
+            make_fake_sysfs(hostfs, chips)
+            self.api.create(NODES, {
+                "apiVersion": "v1", "kind": "Node",
+                "metadata": {"name": name,
+                             "labels": {"tpu.dev/present": "true"}},
+            })
+            sim = NodeSim(self.api, name, node_dir, api_url=self.server.url)
+            sim.start()
+            self.nodes[name] = sim
+        self.scheduler = Scheduler(self.api)
+        self.scheduler.start()
+        self.workloads = WorkloadController(self.api)
+        self.workloads.start()
+        return self
+
+    def stop(self) -> None:
+        if self.workloads:
+            self.workloads.stop()
+        if self.scheduler:
+            self.scheduler.stop()
+        for sim in self.nodes.values():
+            sim.stop()
+        self.server.stop()
+
+    # ------------------------------------------------------------------
+
+    def install(self, docs: List[Dict]) -> int:
+        """Apply manifests (the `kubectl apply -f` of the install step).
+        Returns the number of objects created."""
+        assert self.api is not None
+        n = 0
+        for doc in docs:
+            if not doc:
+                continue
+            gvr = gvr_for_doc(doc)
+            ns = doc["metadata"].get("namespace")
+            try:
+                self.api.create(gvr, doc, namespace=ns)
+                n += 1
+            except AlreadyExistsError:
+                self.api.update(gvr, doc, ns)
+        return n
+
+
+def main(argv=None) -> int:
+    """Serve a sim cluster until SIGTERM; used by hack/e2e-up.sh.
+
+    Writes {url, workdir, pid} as JSON to --state-file once ready so the
+    caller (and the kubectl shim via KUBECTL_SHIM_STATE) can find it.
+    """
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--workdir", required=True)
+    ap.add_argument("--nodes", type=int, default=2)
+    ap.add_argument("--chips-per-node", type=int, default=4)
+    ap.add_argument("--state-file", default="")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
+    cluster = SimCluster(args.workdir, num_nodes=args.nodes,
+                         chips_per_node=args.chips_per_node).start()
+    state = {"url": cluster.url, "workdir": args.workdir,
+             "pid": os.getpid()}
+    if args.state_file:
+        with open(args.state_file, "w") as f:
+            json.dump(state, f)
+    print(json.dumps(state), flush=True)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    cluster.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

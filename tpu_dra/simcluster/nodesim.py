@@ -1,0 +1,552 @@
+"""NodeSim: the kubelet + containerd analog for one simulated node.
+
+For every pod bound to this node it does what kubelet does, with the real
+driver in the loop:
+
+1. waits until every pod claim is allocated,
+2. calls NodePrepareResources on the REAL plugin's dra.sock (gRPC) for
+   each driver named in the allocation results,
+3. resolves the returned CDI device ids against the REAL CDI spec files
+   the plugin wrote under this node's CDI root and applies their env
+   edits (containerd's CDI injection analog),
+4. launches each container's command as a subprocess (image ignored —
+   the sim's containers share the host interpreter, the documented
+   containerization shim),
+5. runs startup/readiness/liveness probes (exec + httpGet) and mirrors
+   them into pod conditions,
+6. on pod deletion: SIGTERM, NodeUnprepareResources, status cleanup.
+
+Driver DaemonSet pods (the plugins themselves) are launched the same way
+from the same manifests the chart renders — they are just pods whose
+commands happen to be `python -m tpu_dra...`.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from typing import Dict, List, Optional, Tuple
+
+from tpu_dra.k8s.client import ApiClient, ApiError, NotFoundError
+from tpu_dra.k8s.resources import PODS, RESOURCECLAIMS
+
+log = logging.getLogger("simcluster.nodesim")
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class _RunningPod:
+    def __init__(self, uid: str):
+        self.uid = uid
+        self.procs: List[subprocess.Popen] = []
+        self.claim_refs: List[Tuple[str, str, str]] = []  # (uid, name, ns)
+        self.prepared_drivers: List[str] = []
+        self.ready = False
+        self.next_probe = 0.0
+        self.logs_dir = ""
+        self.restart_at: Optional[float] = None
+        self.links: List[str] = []  # short symlinks for CDI mounts
+
+
+class NodeSim:
+    def __init__(self, client: ApiClient, node_name: str, node_dir: str,
+                 *, api_url: str, interval: float = 0.2):
+        self._client = client
+        self._node = node_name
+        self._dir = node_dir          # <node_dir>/hostfs is the node's "/"
+        self._api_url = api_url
+        self._interval = interval
+        self._running: Dict[str, _RunningPod] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def hostfs(self) -> str:
+        return os.path.join(self._dir, "fs")
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"nodesim-{self._node}")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=10)
+        for rp in self._running.values():
+            self._terminate(rp)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                self.reconcile_once()
+            except Exception:  # noqa: BLE001
+                log.exception("nodesim %s reconcile failed", self._node)
+
+    # ------------------------------------------------------------------
+
+    def reconcile_once(self) -> None:
+        pods = {p["metadata"]["uid"]: p for p in self._client.list(PODS)
+                if p["spec"].get("nodeName") == self._node}
+        # Reap pods whose object vanished or is terminating.
+        for uid in list(self._running):
+            pod = pods.get(uid)
+            if pod is None or pod["metadata"].get("deletionTimestamp"):
+                self._teardown(self._running.pop(uid), pod)
+        for uid, pod in pods.items():
+            if pod["metadata"].get("deletionTimestamp"):
+                self._finalize_delete(pod)
+                continue
+            rp = self._running.get(uid)
+            if rp is None:
+                phase = (pod.get("status") or {}).get("phase", "Pending")
+                if phase in ("", "Pending"):
+                    self._maybe_start(pod)
+            else:
+                self._update_running(pod, rp)
+
+    # -- startup --------------------------------------------------------
+
+    def _maybe_start(self, pod: Dict) -> None:
+        ns = pod["metadata"].get("namespace", "default")
+        uid = pod["metadata"]["uid"]
+        claims = self._resolve_claims(pod, ns)
+        if claims is None:
+            return  # not all allocated yet
+        rp = _RunningPod(uid)
+        rp.logs_dir = os.path.join(self._dir, "pods", uid, "logs")
+        os.makedirs(rp.logs_dir, exist_ok=True)
+        cdi_env: Dict[str, str] = {}
+        cdi_mounts: List[Tuple[str, str]] = []
+        try:
+            for claim in claims:
+                rp.claim_refs.append((claim["metadata"]["uid"],
+                                      claim["metadata"]["name"], ns))
+                ids = self._prepare_claim(claim, rp)
+                env_part, mounts_part = self._cdi_edits(ids)
+                cdi_env.update(env_part)
+                # Short symlinks for mount targets: a rewritten AF_UNIX
+                # socket path (coordinator pipe) must stay <= 107 chars.
+                for i, (cpath, hpath) in enumerate(mounts_part):
+                    link = f"/tmp/simm-{uid[:8]}-{len(cdi_mounts) + i}"
+                    if os.path.islink(link):
+                        os.unlink(link)
+                    os.symlink(hpath, link)
+                    rp.links.append(link)
+                    cdi_mounts.append((cpath, link))
+        except Exception as e:  # noqa: BLE001
+            # kubelet semantics: a failed prepare is retried on the next
+            # sync, NOT unprepared — prepare is idempotent, and the CD
+            # channel path deliberately fails-and-retries until the domain
+            # reports Ready (cd device_state.go:456-504).
+            log.warning("pod %s/%s prepare failed (will retry): %s", ns,
+                        pod["metadata"]["name"], e)
+            self._set_status(pod, phase="Pending", ready=False,
+                             message=f"prepare failed: {e}")
+            return
+        try:
+            for ctr in pod["spec"].get("containers") or []:
+                rp.procs.append(self._launch(pod, ctr, cdi_env, rp,
+                                             cdi_mounts=cdi_mounts))
+        except Exception as e:  # noqa: BLE001
+            log.warning("pod %s/%s launch failed: %s", ns,
+                        pod["metadata"]["name"], e)
+            self._terminate(rp)
+            self._set_status(pod, phase="Failed", ready=False,
+                             message=str(e))
+            return
+        self._running[uid] = rp
+        self._set_status(pod, phase="Running", ready=False)
+
+    def _resolve_claims(self, pod: Dict, ns: str) -> Optional[List[Dict]]:
+        statuses = {s["name"]: s["resourceClaimName"] for s in
+                    ((pod.get("status") or {})
+                     .get("resourceClaimStatuses") or [])}
+        claims = []
+        for entry in (pod["spec"].get("resourceClaims") or []):
+            name = entry.get("resourceClaimName") or statuses.get(
+                entry["name"])
+            if not name:
+                return None
+            try:
+                claim = self._client.get(RESOURCECLAIMS, name, ns)
+            except NotFoundError:
+                return None
+            if not (claim.get("status") or {}).get("allocation"):
+                return None
+            claims.append(claim)
+        return claims
+
+    def _prepare_claim(self, claim: Dict, rp: _RunningPod) -> List[str]:
+        """kubelet's NodePrepareResources over the plugin's unix socket."""
+        from tpu_dra.kubeletplugin.gen import dra_v1_pb2 as dra
+        from tpu_dra.kubeletplugin.server import kubelet_stubs
+
+        alloc = claim["status"]["allocation"]
+        drivers = sorted({r.get("driver", "") for r in
+                          (alloc.get("devices") or {}).get("results") or []})
+        cdi_ids: List[str] = []
+        for driver in drivers:
+            sock = os.path.join(self.hostfs, "var", "lib", "kubelet",
+                                "plugins", driver, "dra.sock")
+            if not os.path.exists(sock):
+                raise RuntimeError(f"plugin socket missing: {sock}")
+            channel, prepare, _ = kubelet_stubs(sock)
+            try:
+                req = dra.NodePrepareResourcesRequest()
+                c = req.claims.add()
+                c.uid = claim["metadata"]["uid"]
+                c.name = claim["metadata"]["name"]
+                c.namespace = claim["metadata"].get("namespace", "default")
+                resp = prepare(req, timeout=60)
+                result = resp.claims[c.uid]
+                if result.error:
+                    raise RuntimeError(
+                        f"{driver} prepare: {result.error}")
+                for dev in result.devices:
+                    cdi_ids.extend(dev.cdi_device_ids)
+                rp.prepared_drivers.append(driver)
+            finally:
+                channel.close()
+        return cdi_ids
+
+    def _cdi_edits(self, cdi_ids: List[str]
+                   ) -> Tuple[Dict[str, str], List[Tuple[str, str]]]:
+        """containerd's CDI resolution analog: map fully-qualified device
+        ids to (env, mounts) edits from the spec files under this node's
+        CDI root. Mounts come back as (containerPath, hostPath) pairs for
+        the env-rewrite map — the sim cannot bind-mount, so paths that
+        reference a mount are rewritten to the host location instead."""
+        cdi_root = os.path.join(self.hostfs, "var", "run", "cdi")
+        specs = []
+        if os.path.isdir(cdi_root):
+            for fn in sorted(os.listdir(cdi_root)):
+                if fn.endswith(".json"):
+                    with open(os.path.join(cdi_root, fn)) as f:
+                        specs.append(json.load(f))
+        env: Dict[str, str] = {}
+        mounts: List[Tuple[str, str]] = []
+
+        def apply(edits: Dict) -> None:
+            for kv in (edits or {}).get("env") or []:
+                k, _, v = kv.partition("=")
+                env[k] = v
+            for m in (edits or {}).get("mounts") or []:
+                if m.get("containerPath") and m.get("hostPath"):
+                    mounts.append((m["containerPath"], m["hostPath"]))
+
+        for cdi_id in cdi_ids:
+            kind, _, name = cdi_id.partition("=")
+            for spec in specs:
+                if spec.get("kind") != kind:
+                    continue
+                for dev in spec.get("devices") or []:
+                    if dev.get("name") == name:
+                        apply(spec.get("containerEdits") or {})
+                        apply(dev.get("containerEdits") or {})
+        return env, mounts
+
+    # -- container launch ----------------------------------------------
+
+    def _launch(self, pod: Dict, ctr: Dict, cdi_env: Dict[str, str],
+                rp: _RunningPod,
+                cdi_mounts: Optional[List[Tuple[str, str]]] = None
+                ) -> subprocess.Popen:
+        ns = pod["metadata"].get("namespace", "default")
+        mounts = self._mount_map(pod, ctr, rp)
+        mounts.extend(cdi_mounts or [])
+        mounts.sort(key=lambda kv: -len(kv[0]))
+        env = dict(os.environ)
+        env.update({
+            "PYTHONPATH": REPO,
+            "KUBE_API_URL": self._api_url,   # in-cluster config analog
+            "TPUINFO_SYSFS_ROOT": self.hostfs,
+            "TPU_DRA_TPUINFO_BACKEND": "native",
+            "PATH": os.pathsep.join([
+                os.path.join(REPO, "native", "build"),
+                env.get("PATH", "")]),
+        })
+        # The containerization shim: paths that are pod-local in a real
+        # cluster must be disambiguated per pod/node here.
+        env.setdefault("WORK_DIR",
+                       os.path.join(self._dir, "pods", rp.uid, "work"))
+        env.setdefault("HOSTS_FILE", os.path.join(self._dir, "hosts"))
+        env.setdefault("SLICE_DAEMON_PORT", str(free_port()))
+        env.setdefault("SLICE_DAEMON_BINARY",
+                       os.path.join(REPO, "native", "build",
+                                    "tpu-slice-daemon"))
+        manifest_keys = set()
+        for e in ctr.get("env") or []:
+            value = e.get("value")
+            if value is None and "valueFrom" in e:
+                value = self._field_ref(pod, e["valueFrom"])
+            if value is None:
+                continue
+            env[e["name"]] = self._rewrite_path(str(value), mounts)
+            manifest_keys.add(e["name"])
+        for k, v in cdi_env.items():
+            env[k] = self._rewrite_path(v, mounts)
+        # Sim containers share one network namespace (the host), so fixed
+        # listen ports from the manifest must be remapped per pod; probes
+        # consult the same map. JAX workloads run on the CPU backend unless
+        # the manifest says otherwise — N concurrent sim pods cannot share
+        # one real TPU's libtpu lock, and the launching shell's
+        # JAX_PLATFORMS must not leak into "containers".
+        if "JAX_PLATFORMS" not in manifest_keys:
+            env["JAX_PLATFORMS"] = "cpu"
+        port_map: Dict[str, str] = {}
+        for key in ("HEALTHCHECK_PORT", "WEBHOOK_PORT",
+                    "HTTP_ENDPOINT_PORT"):
+            if env.get(key, "0") not in ("", "0"):
+                port_map[env[key]] = str(free_port())
+                env[key] = port_map[env[key]]
+        cmd = [self._rewrite_path(c, mounts) for c in
+               list(ctr.get("command") or []) + list(ctr.get("args") or [])]
+        if not cmd:
+            raise RuntimeError(
+                f"container {ctr['name']} has no command (images are not "
+                "runnable in the sim)")
+        if cmd[0] == "python":
+            cmd[0] = sys.executable
+        out = open(os.path.join(rp.logs_dir, f"{ctr['name']}.log"), "ab")
+        proc = subprocess.Popen(
+            cmd, env=env, stdout=out, stderr=subprocess.STDOUT,
+            cwd=os.path.join(self._dir, "pods", rp.uid))
+        proc._ctr = ctr          # type: ignore[attr-defined]
+        proc._logfile = out      # type: ignore[attr-defined]
+        proc._env = env          # type: ignore[attr-defined]
+        proc._port_map = port_map  # type: ignore[attr-defined]
+        proc._mounts = mounts      # type: ignore[attr-defined]
+        log.info("node %s: started %s/%s:%s (pid %d)", self._node, ns,
+                 pod["metadata"]["name"], ctr["name"], proc.pid)
+        return proc
+
+    def _mount_map(self, pod: Dict, ctr: Dict,
+                   rp: _RunningPod) -> List[Tuple[str, str]]:
+        """containerPath -> hostPath mappings for env rewriting. hostPath
+        volumes land under the node's hostfs; secret volumes are
+        materialized from the Secret object."""
+        vols = {v["name"]: v for v in pod["spec"].get("volumes") or []}
+        out: List[Tuple[str, str]] = []
+        for vm in ctr.get("volumeMounts") or []:
+            vol = vols.get(vm["name"])
+            if vol is None:
+                continue
+            if "hostPath" in vol:
+                path = vol["hostPath"]["path"]
+                # Objects created by components that already run inside the
+                # sim (e.g. the plugin's coordinator Deployment) carry
+                # hostPaths that are ALREADY sim-host-absolute; only
+                # genuine in-cluster paths get the hostfs prefix.
+                host = (path if os.path.exists(path) else
+                        os.path.join(self.hostfs, path.lstrip("/")))
+                os.makedirs(host, exist_ok=True)
+                out.append((vm["mountPath"], host))
+            elif "secret" in vol:
+                host = os.path.join(self._dir, "pods", rp.uid, "secrets",
+                                    vm["name"])
+                os.makedirs(host, exist_ok=True)
+                try:
+                    sec = self._client.get(
+                        self._secret_gvr(), vol["secret"]["secretName"],
+                        pod["metadata"].get("namespace", "default"))
+                    for k, v in (sec.get("data") or {}).items():
+                        with open(os.path.join(host, k), "wb") as f:
+                            f.write(base64.b64decode(v))
+                except (NotFoundError, ApiError):
+                    pass
+                out.append((vm["mountPath"], host))
+        # Longest prefix first so nested mounts resolve correctly.
+        out.sort(key=lambda kv: -len(kv[0]))
+        return out
+
+    @staticmethod
+    def _secret_gvr():
+        from tpu_dra.simcluster.gvk import gvr_for_kind
+        return gvr_for_kind("Secret")
+
+    @staticmethod
+    def _rewrite_path(value: str, mounts: List[Tuple[str, str]]) -> str:
+        for cpath, hpath in mounts:
+            if value == cpath or value.startswith(cpath.rstrip("/") + "/"):
+                return hpath + value[len(cpath.rstrip("/")):]
+        return value
+
+    def _field_ref(self, pod: Dict, value_from: Dict) -> Optional[str]:
+        path = (value_from.get("fieldRef") or {}).get("fieldPath", "")
+        return {
+            "metadata.name": pod["metadata"]["name"],
+            "metadata.namespace": pod["metadata"].get("namespace",
+                                                      "default"),
+            "metadata.uid": pod["metadata"].get("uid", ""),
+            "spec.nodeName": self._node,
+            "spec.serviceAccountName":
+                pod["spec"].get("serviceAccountName", "default"),
+            "status.podIP": "127.0.0.1",
+        }.get(path)
+
+    # -- running-pod upkeep ---------------------------------------------
+
+    def _update_running(self, pod: Dict, rp: _RunningPod) -> None:
+        rcs = [p.poll() for p in rp.procs]
+        if all(rc is not None for rc in rcs):
+            restart = pod["spec"].get("restartPolicy", "Always")
+            failed = any(rc != 0 for rc in rcs)
+            if restart == "Always" or (restart == "OnFailure" and failed):
+                if rp.restart_at is None:
+                    rp.restart_at = time.monotonic() + 1.0
+                if time.monotonic() >= rp.restart_at:
+                    rp.restart_at = None
+                    for i, p in enumerate(rp.procs):
+                        ctr = p._ctr  # type: ignore[attr-defined]
+                        rp.procs[i] = subprocess.Popen(
+                            p.args, env=p._env,  # type: ignore
+                            stdout=p._logfile,   # type: ignore
+                            stderr=subprocess.STDOUT)
+                        rp.procs[i]._ctr = ctr        # type: ignore
+                        rp.procs[i]._logfile = p._logfile  # type: ignore
+                        rp.procs[i]._env = p._env     # type: ignore
+                return
+            del self._running[rp.uid]
+            self._unprepare_all(rp)
+            self._set_status(pod, phase="Failed" if failed else "Succeeded",
+                             ready=False)
+            return
+        if time.monotonic() >= rp.next_probe:
+            rp.next_probe = time.monotonic() + 2.0
+            ready = all(self._probe_ok(p) for p in rp.procs)
+            if ready != rp.ready:
+                rp.ready = ready
+                self._set_status(pod, phase="Running", ready=ready)
+
+    def _probe_ok(self, proc: subprocess.Popen) -> bool:
+        ctr = proc._ctr  # type: ignore[attr-defined]
+        probe = (ctr.get("startupProbe") or ctr.get("readinessProbe")
+                 or ctr.get("livenessProbe"))
+        if probe is None:
+            return True
+        if "exec" in probe:
+            mounts = getattr(proc, "_mounts", [])
+            cmd = [self._rewrite_path(c, mounts)
+                   for c in probe["exec"].get("command") or []]
+            if cmd and cmd[0] == "python":
+                cmd[0] = sys.executable
+            try:
+                return subprocess.run(
+                    cmd, env=proc._env,  # type: ignore[attr-defined]
+                    capture_output=True, timeout=10).returncode == 0
+            except Exception:  # noqa: BLE001
+                return False
+        if "httpGet" in probe:
+            hg = probe["httpGet"]
+            port_map = getattr(proc, "_port_map", {})
+            port = port_map.get(str(hg.get("port")), str(hg.get("port")))
+            url = (f"{'https' if hg.get('scheme') == 'HTTPS' else 'http'}"
+                   f"://127.0.0.1:{port}{hg.get('path', '/')}")
+            try:
+                import ssl
+                ctx = ssl._create_unverified_context() \
+                    if hg.get("scheme") == "HTTPS" else None
+                urllib.request.urlopen(url, timeout=5, context=ctx)
+                return True
+            except Exception:  # noqa: BLE001
+                return False
+        return True
+
+    # -- teardown -------------------------------------------------------
+
+    def _terminate(self, rp: _RunningPod) -> None:
+        for p in rp.procs:
+            if p.poll() is None:
+                try:
+                    p.send_signal(signal.SIGTERM)
+                except OSError:
+                    pass
+        deadline = time.monotonic() + 10
+        for p in rp.procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+    def _teardown(self, rp: _RunningPod, pod: Optional[Dict]) -> None:
+        self._terminate(rp)
+        self._unprepare_all(rp)
+        if pod is not None:
+            self._finalize_delete(pod)
+
+    def _finalize_delete(self, pod: Dict) -> None:
+        # FakeCluster deletes synchronously (no kubelet grace period);
+        # nothing to strip. Kept as a seam for finalizer support.
+        return
+
+    def _unprepare_all(self, rp: _RunningPod) -> None:
+        for driver in rp.prepared_drivers:
+            self._unprepare(rp, driver)
+        rp.prepared_drivers = []
+        for link in rp.links:
+            try:
+                os.unlink(link)
+            except OSError:
+                pass
+        rp.links = []
+
+    def _unprepare(self, rp: _RunningPod, driver: str) -> None:
+        from tpu_dra.kubeletplugin.gen import dra_v1_pb2 as dra
+        from tpu_dra.kubeletplugin.server import kubelet_stubs
+
+        sock = os.path.join(self.hostfs, "var", "lib", "kubelet",
+                            "plugins", driver, "dra.sock")
+        if not os.path.exists(sock):
+            return
+        channel, _, unprepare = kubelet_stubs(sock)
+        try:
+            req = dra.NodeUnprepareResourcesRequest()
+            for uid, name, ns in rp.claim_refs:
+                c = req.claims.add()
+                c.uid, c.name, c.namespace = uid, name, ns
+            unprepare(req, timeout=30)
+        except Exception as e:  # noqa: BLE001
+            log.warning("unprepare via %s failed: %s", driver, e)
+        finally:
+            channel.close()
+
+    def _set_status(self, pod: Dict, *, phase: str, ready: bool,
+                    message: str = "") -> None:
+        ns = pod["metadata"].get("namespace", "default")
+        try:
+            fresh = self._client.get(PODS, pod["metadata"]["name"], ns)
+        except NotFoundError:
+            return
+        status = fresh.setdefault("status", {})
+        status["phase"] = phase
+        status["podIP"] = "127.0.0.1"
+        status["conditions"] = [{
+            "type": "Ready",
+            "status": "True" if ready else "False",
+            **({"message": message} if message else {}),
+        }]
+        status["containerStatuses"] = [
+            {"name": c["name"], "ready": ready,
+             "state": {"running": {}} if phase == "Running" else {}}
+            for c in fresh["spec"].get("containers") or []]
+        try:
+            self._client.update_status(PODS, fresh, ns)
+        except ApiError:
+            pass  # conflict: next tick rewrites

@@ -1,0 +1,277 @@
+"""DRA scheduler sim: claims-from-templates, device allocation, binding.
+
+Stands in for the upstream kube-scheduler's DRA plugin + the
+kube-controller-manager's resourceclaim controller (neither is driver
+code — SURVEY §1: "there is no scheduler code to rebuild"). Allocation
+follows the real algorithm's observable behavior: DeviceClass CEL
+selectors are matched against device attributes published in
+ResourceSlices, devices already referenced by any allocated claim are
+excluded, and the pod binds to a node that can satisfy every claim.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+from tpu_dra.k8s.client import ApiClient, ConflictError, NotFoundError
+from tpu_dra.k8s.resources import (
+    DEVICECLASSES, NODES, PODS, RESOURCECLAIMS, RESOURCECLAIMTEMPLATES,
+    RESOURCESLICES,
+)
+
+log = logging.getLogger("simcluster.scheduler")
+
+# The CEL shape our DeviceClasses use (deviceclass-*.yaml):
+#   device.driver == "D" && device.attributes["D"].type == "T"
+_CEL_RE = re.compile(
+    r"device\.driver\s*==\s*\"([^\"]+)\"\s*&&\s*"
+    r"device\.attributes\[\"[^\"]+\"\]\.type\s*==\s*\"([^\"]+)\"")
+
+
+class Scheduler:
+    def __init__(self, client: ApiClient, interval: float = 0.15):
+        self._client = client
+        self._interval = interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="sim-scheduler")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                self.reconcile_once()
+            except Exception:  # noqa: BLE001 — the loop must survive
+                log.exception("scheduler reconcile failed")
+
+    # ------------------------------------------------------------------
+
+    def reconcile_once(self) -> None:
+        pods = self._client.list(PODS)
+        self._gc_orphan_claims(pods)
+        for pod in pods:
+            if pod["metadata"].get("deletionTimestamp"):
+                continue
+            phase = (pod.get("status") or {}).get("phase", "Pending")
+            if phase not in ("", "Pending"):
+                continue
+            try:
+                self._ensure_claims_from_templates(pod)
+                self._schedule(pod)
+            except ConflictError:
+                continue  # racing another write: next tick retries
+
+    def _gc_orphan_claims(self, pods: List[Dict]) -> None:
+        """The resourceclaim controller's ownerRef GC analog: a claim
+        generated from a template dies with its pod — otherwise exclusive
+        devices (channel-0, the daemon device) stay allocated forever and
+        the next workload can never schedule."""
+        alive = {(p["metadata"].get("namespace", "default"),
+                  p["metadata"]["name"]) for p in pods}
+        for claim in self._client.list(RESOURCECLAIMS):
+            owner = (claim["metadata"].get("annotations") or {}).get(
+                "sim/owner-pod")
+            if not owner:
+                continue
+            ns = claim["metadata"].get("namespace", "default")
+            if (ns, owner) not in alive:
+                try:
+                    self._client.delete(RESOURCECLAIMS,
+                                        claim["metadata"]["name"], ns)
+                    log.info("GC claim %s/%s (pod %s gone)", ns,
+                             claim["metadata"]["name"], owner)
+                except NotFoundError:
+                    pass
+
+    # -- resourceclaim controller analog --------------------------------
+
+    def _ensure_claims_from_templates(self, pod: Dict) -> None:
+        ns = pod["metadata"].get("namespace", "default")
+        statuses = ((pod.get("status") or {})
+                    .get("resourceClaimStatuses") or [])
+        known = {s["name"]: s["resourceClaimName"] for s in statuses}
+        changed = False
+        for entry in (pod["spec"].get("resourceClaims") or []):
+            if entry.get("resourceClaimName") or entry["name"] in known:
+                continue
+            tmpl_name = entry.get("resourceClaimTemplateName")
+            if not tmpl_name:
+                continue
+            try:
+                rct = self._client.get(RESOURCECLAIMTEMPLATES, tmpl_name, ns)
+            except NotFoundError:
+                continue  # template not stamped yet; retry next tick
+            claim_name = f"{pod['metadata']['name']}-{entry['name']}"
+            claim = {
+                "apiVersion": "resource.k8s.io/v1", "kind": "ResourceClaim",
+                "metadata": {
+                    "name": claim_name, "namespace": ns,
+                    "labels": dict((rct["metadata"].get("labels") or {})),
+                    "annotations": {
+                        "resource.kubernetes.io/pod-claim-name":
+                            entry["name"],
+                        "sim/owner-pod": pod["metadata"]["name"]},
+                },
+                "spec": (rct.get("spec") or {}).get("spec") or {},
+            }
+            try:
+                self._client.create(RESOURCECLAIMS, claim, namespace=ns)
+            except ConflictError:
+                pass
+            known[entry["name"]] = claim_name
+            changed = True
+        if changed:
+            pod.setdefault("status", {})["resourceClaimStatuses"] = [
+                {"name": k, "resourceClaimName": v}
+                for k, v in sorted(known.items())]
+            self._client.update_status(PODS, pod, ns)
+
+    # -- allocation + binding -------------------------------------------
+
+    def _schedule(self, pod: Dict) -> None:
+        ns = pod["metadata"].get("namespace", "default")
+        claims = self._pod_claims(pod, ns)
+        if claims is None:
+            return  # some claim object missing; retry next tick
+        node_name = pod["spec"].get("nodeName")
+        candidates = ([node_name] if node_name
+                      else self._candidate_nodes(pod))
+        for node in candidates:
+            if self._try_allocate_all(claims, node):
+                if not node_name:
+                    pod["spec"]["nodeName"] = node
+                    self._client.update(PODS, pod, ns)
+                return
+
+    def _pod_claims(self, pod: Dict, ns: str) -> Optional[List[Dict]]:
+        statuses = {s["name"]: s["resourceClaimName"] for s in
+                    ((pod.get("status") or {})
+                     .get("resourceClaimStatuses") or [])}
+        out = []
+        for entry in (pod["spec"].get("resourceClaims") or []):
+            name = entry.get("resourceClaimName") or statuses.get(
+                entry["name"])
+            if name is None:
+                # Template-backed claim not created yet.
+                if entry.get("resourceClaimTemplateName"):
+                    return None
+                continue
+            try:
+                out.append(self._client.get(RESOURCECLAIMS, name, ns))
+            except NotFoundError:
+                return None
+        return out
+
+    def _candidate_nodes(self, pod: Dict) -> List[str]:
+        selector = pod["spec"].get("nodeSelector") or {}
+        names = []
+        for node in self._client.list(NODES):
+            labels = node["metadata"].get("labels") or {}
+            if all(labels.get(k) == v for k, v in selector.items()):
+                names.append(node["metadata"]["name"])
+        return names
+
+    def _try_allocate_all(self, claims: List[Dict], node: str) -> bool:
+        """Allocate every unallocated claim on `node`; all-or-nothing per
+        pod (claims already allocated elsewhere pin the pod implicitly:
+        a shared pre-allocated claim simply must exist on this node)."""
+        taken = self._allocated_devices()
+        staged: List[Tuple[Dict, Dict]] = []
+        for claim in claims:
+            alloc = (claim.get("status") or {}).get("allocation")
+            if alloc:
+                # Shared claim already allocated: usable only if it landed
+                # on this node's pool.
+                pools = {r.get("pool") for r in
+                         (alloc.get("devices") or {}).get("results") or []}
+                if pools and node not in pools:
+                    return False
+                continue
+            allocation = self._allocate(claim, node, taken)
+            if allocation is None:
+                return False
+            staged.append((claim, allocation))
+        for claim, allocation in staged:
+            claim.setdefault("status", {})["allocation"] = allocation
+            self._client.update_status(RESOURCECLAIMS, claim,
+                                       claim["metadata"].get("namespace"))
+        return True
+
+    def _allocated_devices(self) -> Set[Tuple[str, str, str]]:
+        taken = set()
+        for claim in self._client.list(RESOURCECLAIMS):
+            alloc = (claim.get("status") or {}).get("allocation") or {}
+            for r in (alloc.get("devices") or {}).get("results") or []:
+                taken.add((r.get("driver", ""), r.get("pool", ""),
+                           r.get("device", "")))
+        return taken
+
+    def _allocate(self, claim: Dict, node: str,
+                  taken: Set[Tuple[str, str, str]]) -> Optional[Dict]:
+        devices = (claim.get("spec") or {}).get("devices") or {}
+        results = []
+        for req in devices.get("requests") or []:
+            exact = req.get("exactly") or req  # v1 wrapper or flat
+            class_name = exact.get("deviceClassName", "")
+            count = int(exact.get("count") or 1)
+            match = self._class_selector(class_name)
+            if match is None:
+                return None
+            driver, dev_type = match
+            picked = self._pick_devices(node, driver, dev_type, count, taken)
+            if picked is None:
+                return None
+            for dev in picked:
+                taken.add((driver, node, dev))
+                results.append({"request": req["name"], "driver": driver,
+                                "pool": node, "device": dev})
+        if not results:
+            return None
+        config = [{"source": "FromClaim", **entry}
+                  for entry in devices.get("config") or []]
+        return {"devices": {"results": results, "config": config},
+                "nodeSelector": {"nodeSelectorTerms": [{"matchFields": [
+                    {"key": "metadata.name", "operator": "In",
+                     "values": [node]}]}]}}
+
+    def _class_selector(self, name: str) -> Optional[Tuple[str, str]]:
+        try:
+            dc = self._client.get(DEVICECLASSES, name)
+        except NotFoundError:
+            return None
+        for sel in (dc.get("spec") or {}).get("selectors") or []:
+            expr = (sel.get("cel") or {}).get("expression", "")
+            m = _CEL_RE.search(expr)
+            if m:
+                return m.group(1), m.group(2)
+        return None
+
+    def _pick_devices(self, node: str, driver: str, dev_type: str,
+                      count: int,
+                      taken: Set[Tuple[str, str, str]]) -> Optional[List[str]]:
+        available = []
+        for sl in self._client.list(RESOURCESLICES):
+            spec = sl.get("spec") or {}
+            if spec.get("nodeName") != node or spec.get("driver") != driver:
+                continue
+            for dev in spec.get("devices") or []:
+                attrs = dev.get("attributes") or {}
+                if (attrs.get("type") or {}).get("string") != dev_type:
+                    continue
+                if (driver, node, dev["name"]) in taken:
+                    continue
+                available.append(dev["name"])
+        if len(available) < count:
+            return None
+        return available[:count]

@@ -1,0 +1,164 @@
+"""WorkloadController: DaemonSet/Deployment -> Pod stamping + status.
+
+The kube-controller-manager analog the CD machinery needs: the CD
+controller stamps per-CD DaemonSets whose nodeSelector is the CD label;
+something must turn those into pods as nodes get labeled, keep
+status.numberReady fresh (the controller flips the CD Ready on it,
+daemonset.go:362-389), and delete pods when labels go away (the
+workload-following teardown).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, List, Optional
+
+from tpu_dra.k8s.client import ApiClient, ApiError, ConflictError, NotFoundError
+from tpu_dra.k8s.resources import DAEMONSETS, DEPLOYMENTS, NODES, PODS
+
+log = logging.getLogger("simcluster.workloads")
+
+
+class WorkloadController:
+    def __init__(self, client: ApiClient, interval: float = 0.2):
+        self._client = client
+        self._interval = interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="sim-workloads")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                self.reconcile_once()
+            except Exception:  # noqa: BLE001
+                log.exception("workload reconcile failed")
+
+    # ------------------------------------------------------------------
+
+    def reconcile_once(self) -> None:
+        nodes = self._client.list(NODES)
+        pods = self._client.list(PODS)
+        for ds in self._client.list(DAEMONSETS):
+            try:
+                self._reconcile_daemonset(ds, nodes, pods)
+            except ConflictError:
+                continue
+        for dep in self._client.list(DEPLOYMENTS):
+            try:
+                self._reconcile_deployment(dep, pods)
+            except ConflictError:
+                continue
+
+    # -- DaemonSets -----------------------------------------------------
+
+    def _reconcile_daemonset(self, ds: Dict, nodes: List[Dict],
+                             pods: List[Dict]) -> None:
+        ns = ds["metadata"].get("namespace", "default")
+        name = ds["metadata"]["name"]
+        selector = (ds["spec"]["template"]["spec"]
+                    .get("nodeSelector") or {})
+        want_nodes = {
+            n["metadata"]["name"] for n in nodes
+            if all((n["metadata"].get("labels") or {}).get(k) == v
+                   for k, v in selector.items())}
+        owned = {p["metadata"]["name"]: p for p in pods
+                 if p["metadata"].get("namespace") == ns
+                 and (p["metadata"].get("labels") or {}).get(
+                     "sim/owner") == f"ds-{name}"}
+        for node in sorted(want_nodes):
+            pod_name = f"{name}-{node}"
+            if pod_name not in owned:
+                self._create_pod(ds, pod_name, ns, f"ds-{name}",
+                                 node_name=node)
+        for pod_name, pod in owned.items():
+            if pod["spec"].get("nodeName") not in want_nodes:
+                # Node left the selector (label removed): workload-following
+                # teardown.
+                self._delete_pod(pod_name, ns)
+        ready = sum(1 for p in owned.values()
+                    if self._pod_ready(p)
+                    and p["spec"].get("nodeName") in want_nodes)
+        status = {"desiredNumberScheduled": len(want_nodes),
+                  "currentNumberScheduled": len(owned),
+                  "numberReady": ready}
+        if (ds.get("status") or {}) != status:
+            ds["status"] = status
+            try:
+                self._client.update_status(DAEMONSETS, ds, ns)
+            except ApiError:
+                pass
+
+    # -- Deployments ----------------------------------------------------
+
+    def _reconcile_deployment(self, dep: Dict, pods: List[Dict]) -> None:
+        ns = dep["metadata"].get("namespace", "default")
+        name = dep["metadata"]["name"]
+        replicas = int(dep["spec"].get("replicas", 1))
+        owned = {p["metadata"]["name"]: p for p in pods
+                 if p["metadata"].get("namespace") == ns
+                 and (p["metadata"].get("labels") or {}).get(
+                     "sim/owner") == f"deploy-{name}"}
+        for i in range(replicas):
+            pod_name = f"{name}-{i}"
+            if pod_name not in owned:
+                self._create_pod(dep, pod_name, ns, f"deploy-{name}")
+        for pod_name in list(owned):
+            idx = pod_name.rsplit("-", 1)[-1]
+            if idx.isdigit() and int(idx) >= replicas:
+                self._delete_pod(pod_name, ns)
+        ready = sum(1 for p in owned.values() if self._pod_ready(p))
+        status = {"replicas": len(owned), "readyReplicas": ready,
+                  "availableReplicas": ready}
+        if (dep.get("status") or {}) != status:
+            dep["status"] = status
+            try:
+                self._client.update_status(DEPLOYMENTS, dep, ns)
+            except ApiError:
+                pass
+
+    # -- shared ---------------------------------------------------------
+
+    def _create_pod(self, owner: Dict, pod_name: str, ns: str,
+                    owner_tag: str, node_name: Optional[str] = None) -> None:
+        template = owner["spec"]["template"]
+        labels = dict(template.get("metadata", {}).get("labels") or {})
+        labels["sim/owner"] = owner_tag
+        spec = dict(template["spec"])
+        if node_name:
+            spec = {**spec, "nodeName": node_name}
+        pod = {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": pod_name, "namespace": ns,
+                         "labels": labels},
+            "spec": spec,
+        }
+        try:
+            self._client.create(PODS, pod, namespace=ns)
+            log.info("stamped pod %s/%s (owner %s)", ns, pod_name, owner_tag)
+        except ConflictError:
+            pass
+
+    def _delete_pod(self, name: str, ns: str) -> None:
+        try:
+            self._client.delete(PODS, name, ns)
+            log.info("deleted pod %s/%s", ns, name)
+        except NotFoundError:
+            pass
+
+    @staticmethod
+    def _pod_ready(pod: Dict) -> bool:
+        for cond in (pod.get("status") or {}).get("conditions") or []:
+            if cond.get("type") == "Ready":
+                return cond.get("status") == "True"
+        return False

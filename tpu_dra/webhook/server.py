@@ -37,7 +37,8 @@ OWNED_DRIVERS = (apitypes.TPU_DRIVER_NAME,
 # v1beta2 introduced prioritized-list requests (the one structural break in
 # the resource.k8s.io version history; v1beta2 and v1 share the v1 shape).
 _V1BETA1_REQUEST_FIELDS = ("deviceClassName", "selectors", "allocationMode",
-                           "count", "adminAccess", "tolerations")
+                           "count", "adminAccess", "tolerations",
+                           "capacity")
 
 
 class ConversionError(ValueError):
@@ -167,7 +168,7 @@ class AdmissionHandler:
             if driver not in OWNED_DRIVERS:
                 continue  # not ours: admit
             for r in (entry or {}).get("requests") or []:
-                if names and r not in names:
+                if r not in names:
                     errors.append(
                         f"config[{i}]: targets unknown request {r!r}")
             params = opaque.get("parameters")
