@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# Race-hunting tier (the reference's `go test -race` analog, Makefile:96):
+#
+#   hack/race.sh [ITERATIONS]
+#
+# 1. Builds the threaded C++ daemons under ThreadSanitizer and drives them
+#    with concurrent clients (TSAN_OPTIONS halt_on_error: any report fails).
+# 2. Repeat-runs the heavily threaded Python suites (informers, workqueues,
+#    three-process CD convergence, watchdogs) N times — the flake surface
+#    scales with iterations, not wall-clock.
+set -euo pipefail
+REPO_ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+N="${1:-3}"
+
+echo ">> TSan build + drive"
+make -C "$REPO_ROOT/native" tsan -s
+export TSAN_OPTIONS="halt_on_error=1 exitcode=66"
+TSAN_COORD="$REPO_ROOT/native/build-tsan/tpu-multiprocess-coordinator" \
+TSAN_DAEMON="$REPO_ROOT/native/build-tsan/tpu-slice-daemon" \
+  python "$REPO_ROOT/hack/tsan_drive.py"
+
+echo ">> ${N}x repeat of the threaded Python suites"
+for i in $(seq 1 "$N"); do
+  echo "-- iteration $i/$N"
+  python -m pytest "$REPO_ROOT/tests/test_cd_integration.py" \
+    "$REPO_ROOT/tests/test_stress_failover.py" \
+    "$REPO_ROOT/tests/test_multiprocess_e2e.py" -q -p no:cacheprovider
+done
+echo ">> race tier green"

@@ -1,0 +1,140 @@
+#!/usr/bin/env python3
+"""Drive the TSan builds of the native daemons with hostile concurrency.
+
+tpu-multiprocess-coordinator: N threads hammer register/release/query over
+its unix socket while probes run; any TSan report makes the binary exit 66
+(TSAN_OPTIONS halt_on_error=1 exitcode=66 set by hack/race.sh).
+
+tpu-slice-daemon: concurrent --check probes plus an idle client against
+the serve loop.
+"""
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+COORD = os.environ["TSAN_COORD"]
+DAEMON = os.environ["TSAN_DAEMON"]
+THREADS = 8
+SECONDS = 5.0
+
+
+def hammer_coordinator(sock_dir: str, stop: threading.Event) -> None:
+    while not stop.is_set():
+        try:
+            s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            s.settimeout(2)
+            s.connect(os.path.join(sock_dir, "coordinator.sock"))
+            s.sendall(b"Q\n")
+            s.recv(128)
+            s.sendall(f"R {os.getpid()}\n".encode())
+            reply = s.recv(128).decode()
+            if reply.startswith("OK"):
+                lease = reply.split()[1]
+                s.sendall(f"U {lease}\n".encode())
+                s.recv(128)
+            s.sendall(b"L\n")
+            s.recv(256)
+            s.close()
+        except OSError:
+            time.sleep(0.01)
+
+
+def main() -> int:
+    rc = 0
+    with tempfile.TemporaryDirectory(dir="/tmp") as tmp:
+        d = os.path.join(tmp, "c")
+        proc = subprocess.Popen(
+            [COORD, "--dir", d, "--chips", "0", "--max-clients", "4"],
+            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+        deadline = time.time() + 10
+        sock = os.path.join(d, "pipe", "coordinator.sock")
+        while time.time() < deadline and not os.path.exists(sock):
+            time.sleep(0.05)
+        stop = threading.Event()
+        threads = [threading.Thread(target=hammer_coordinator,
+                                    args=(os.path.join(d, "pipe"), stop),
+                                    daemon=True) for _ in range(THREADS)]
+        for t in threads:
+            t.start()
+        # Idle client while hammering (serve-loop robustness under TSan).
+        idle = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        idle.connect(sock)
+        for _ in range(int(SECONDS / 0.5)):
+            check = subprocess.run([COORD, "--check", "--dir", d],
+                                   capture_output=True, timeout=15)
+            if check.returncode == 66:
+                print("TSan report in coordinator --check", file=sys.stderr)
+                rc = 1
+            time.sleep(0.5)
+        stop.set()
+        for t in threads:
+            t.join(timeout=2)
+        idle.close()
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+        if proc.returncode == 66:
+            print("TSan report in coordinator:", file=sys.stderr)
+            print((proc.stderr.read() or b"").decode()[-3000:],
+                  file=sys.stderr)
+            rc = 1
+
+        # slice daemon: serve + concurrent checks + idle client
+        port = _free_port()
+        cfg = os.path.join(tmp, "daemon.cfg")
+        nodes_cfg = os.path.join(tmp, "nodes.cfg")
+        open(nodes_cfg, "w").close()
+        with open(cfg, "w") as f:
+            f.write(f"node_ip=127.0.0.1\nport={port}\n"
+                    f"nodes_config={nodes_cfg}\nslice_id=s0\n"
+                    f"worker_index=0\n")
+        dproc = subprocess.Popen(
+            [DAEMON, "--config", cfg],
+            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+        time.sleep(0.5)
+        idle2 = socket.socket()
+        try:
+            idle2.connect(("127.0.0.1", port))
+        except OSError:
+            pass
+        checks = []
+        for _ in range(10):
+            checks.append(subprocess.Popen(
+                [DAEMON, "--check", "--port", str(port)],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+        for c in checks:
+            c.wait(timeout=15)
+            if c.returncode == 66:
+                print("TSan report in slice-daemon --check", file=sys.stderr)
+                rc = 1
+        idle2.close()
+        dproc.terminate()
+        try:
+            dproc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            dproc.kill()
+            dproc.wait()
+        if dproc.returncode == 66:
+            print("TSan report in slice-daemon:", file=sys.stderr)
+            print((dproc.stderr.read() or b"").decode()[-3000:],
+                  file=sys.stderr)
+            rc = 1
+    print("tsan drive:", "FAIL" if rc else "clean")
+    return rc
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -108,6 +108,9 @@ class Log {
   ~Log() {
     if (f_) fclose(f_);
   }
+  // Called from the main thread AND every connection thread: needs its
+  // own lock (shared FILE*) and gmtime_r (gmtime's static buffer is a
+  // data race — found by the TSan tier, hack/race.sh).
   void Line(const char* fmt, ...) {
     char msg[512];
     va_list ap;
@@ -115,8 +118,10 @@ class Log {
     vsnprintf(msg, sizeof(msg), fmt, ap);
     va_end(ap);
     time_t now = time(nullptr);
+    struct tm tm_buf {};
     char ts[32];
-    strftime(ts, sizeof(ts), "%Y-%m-%dT%H:%M:%S", gmtime(&now));
+    strftime(ts, sizeof(ts), "%Y-%m-%dT%H:%M:%S", gmtime_r(&now, &tm_buf));
+    std::lock_guard<std::mutex> l(mu_);
     if (f_) {
       fprintf(f_, "%s %s\n", ts, msg);
       fflush(f_);
@@ -125,6 +130,7 @@ class Log {
   }
 
  private:
+  std::mutex mu_;
   FILE* f_;
 };
 
@@ -312,7 +318,9 @@ class Coordinator {
 
   Options opts_;
   Log* log_;
-  int listen_fd_ = -1;
+  // Closed by Stop() while Serve() loops on accept: atomic so the
+  // shutdown handoff is not a data race (TSan tier, hack/race.sh).
+  std::atomic<int> listen_fd_{-1};
   std::thread serve_thread_;
   std::atomic<int> active_conns_{0};
   std::mutex mu_;
@@ -397,19 +405,24 @@ int main(int argc, char** argv) {
   mkdir(opts.dir.c_str(), 0755);
   mkdir((opts.dir + "/pipe").c_str(), 0755);
   mkdir((opts.dir + "/log").c_str(), 0755);
-  Log log(opts.dir + "/log/coordinator.log");
-  Coordinator c(opts, &log);
-  if (!c.Start()) {
+  // Heap-allocated and never freed ON PURPOSE: connection threads are
+  // detached, and Stop()'s drain wait is bounded — a client wedged in
+  // write() can still touch the Coordinator/Log after Stop() returns.
+  // Leaking both keeps every reachable object valid until _exit; the OS
+  // reclaims at process teardown (this is the whole process's lifetime).
+  Log* log = new Log(opts.dir + "/log/coordinator.log");
+  Coordinator* c = new Coordinator(opts, log);
+  if (!c->Start()) {
     fprintf(stderr,
             "tpu-multiprocess-coordinator: failed to start in %s: %s\n",
             opts.dir.c_str(), strerror(errno));
     return 1;
   }
-  log.Line("serving on %s (chips=%s max_clients=%d)",
-           SocketPath(opts.dir).c_str(), opts.chips.c_str(),
-           opts.max_clients);
+  log->Line("serving on %s (chips=%s max_clients=%d)",
+            SocketPath(opts.dir).c_str(), opts.chips.c_str(),
+            opts.max_clients);
   while (!g_stop) usleep(100 * 1000);
-  c.Stop();
-  log.Line("stopped");
+  c->Stop();
+  log->Line("stopped");
   return 0;
 }

@@ -238,10 +238,14 @@ class Daemon {
   }
 
   Config cfg_;
-  int listen_fd_ = -1;
+  // Closed by Stop() while Serve() loops on accept: atomic so the
+  // shutdown handoff is not a data race (TSan tier, hack/race.sh).
+  std::atomic<int> listen_fd_{-1};
   std::thread server_thread_, sweep_thread_;
   std::mutex mu_;
-  bool ready_ = false;
+  // Written by Start()/Stop() on the main thread, read by connection
+  // handlers — atomic, not plain (TSan tier finding, hack/race.sh).
+  std::atomic<bool> ready_{false};
   int reachable_ = 0;
   int total_peers_ = 0;
 };
