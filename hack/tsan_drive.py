@@ -63,13 +63,23 @@ def main() -> int:
         # Idle client while hammering (serve-loop robustness under TSan).
         idle = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         idle.connect(sock)
+        # Vacuous-pass guard: the drive must actually observe READY
+        # responses — a daemon that failed to start would otherwise make
+        # every probe fail with a non-66 code the old logic ignored.
+        ready_seen = 0
         for _ in range(int(SECONDS / 0.5)):
             check = subprocess.run([COORD, "--check", "--dir", d],
                                    capture_output=True, timeout=15)
             if check.returncode == 66:
                 print("TSan report in coordinator --check", file=sys.stderr)
                 rc = 1
+            elif check.returncode == 0:
+                ready_seen += 1
             time.sleep(0.5)
+        if ready_seen == 0:
+            print("coordinator never answered READY — no race coverage",
+                  file=sys.stderr)
+            rc = 1
         stop.set()
         for t in threads:
             t.join(timeout=2)
@@ -109,11 +119,18 @@ def main() -> int:
             checks.append(subprocess.Popen(
                 [DAEMON, "--check", "--port", str(port)],
                 stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+        daemon_ready = 0
         for c in checks:
             c.wait(timeout=15)
             if c.returncode == 66:
                 print("TSan report in slice-daemon --check", file=sys.stderr)
                 rc = 1
+            elif c.returncode == 0:
+                daemon_ready += 1
+        if daemon_ready == 0:
+            print("slice-daemon never answered READY — no race coverage",
+                  file=sys.stderr)
+            rc = 1
         idle2.close()
         dproc.terminate()
         try:

@@ -424,5 +424,9 @@ int main(int argc, char** argv) {
   while (!g_stop) usleep(100 * 1000);
   c->Stop();
   log->Line("stopped");
-  return 0;
+  // _exit, not return: a plain return runs exit()'s stdio teardown, which
+  // fcloses the leaked Log's FILE* — exactly what a still-wedged detached
+  // connection thread must not observe. _exit keeps every leaked object
+  // (and stream) intact until the process is gone.
+  _exit(0);
 }

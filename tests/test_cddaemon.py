@@ -9,6 +9,7 @@ real C++ tpu-slice-daemon binary.
 import os
 import signal
 import socket
+import subprocess
 import time
 
 import pytest
@@ -253,6 +254,35 @@ class TestNativeDaemon:
         finally:
             pm_a.stop()
             pm_b.stop()
+
+    def test_idle_client_does_not_wedge_probes(self, tmp_path):
+        """A connected-but-silent client (port scanner, stalled TCP) must
+        not block the serve loop: --check stays READY and bounded
+        (slice_daemon.cc SO_RCVTIMEO on accepted fds; the probe-robustness
+        posture of cd-daemon main.go:381-405)."""
+        port = free_port()
+        pm = ProcessManager([DAEMON_BIN, "--config",
+                             self._write_cfg(tmp_path, port)])
+        pm.ensure_started()
+        idle = None
+        try:
+            assert self._wait_ready(port)
+            idle = socket.create_connection(("127.0.0.1", port), 2)
+            # Send nothing; wait out the 1s receive timeout so the probe
+            # below isn't racing it.
+            time.sleep(1.2)
+            t0 = time.monotonic()
+            res = subprocess.run(
+                [DAEMON_BIN, "--check", "--port", str(port)],
+                capture_output=True, text=True, timeout=10)
+            elapsed = time.monotonic() - t0
+            assert res.returncode == 0, res.stdout + res.stderr
+            assert "READY" in res.stdout
+            assert elapsed < 5.0
+        finally:
+            if idle is not None:
+                idle.close()
+            pm.stop()
 
 
 @pytest.mark.skipif(not os.path.exists(DAEMON_BIN),

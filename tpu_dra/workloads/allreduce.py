@@ -42,11 +42,17 @@ def allreduce_bandwidth(nbytes_per_device: int = 64 << 20,
     """Time `psum` over all (or the given) devices.
 
     Returns {algo_gbps, bus_gbps, n_devices, payload_mib, mean_s}.
-    Single-device degenerates to an on-chip reduction (no ICI traffic);
-    bus_gbps is reported as 0 in that case to avoid a misleading number.
+    Single-device degenerates to an identity (no collective at all);
+    both rates are reported as 0 in that case to avoid misleading numbers.
     """
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
+    if n == 1:
+        # The psum is an identity XLA compiles away entirely: there is
+        # nothing to measure, so skip the compile+timing and return an
+        # all-zero record rather than payload/epsilon nonsense.
+        return {"algo_gbps": 0.0, "bus_gbps": 0.0, "n_devices": 1.0,
+                "payload_mib": nbytes_per_device / (1 << 20), "mean_s": 0.0}
     x = device_put_sharded_uniform(nbytes_per_device, devices)
     # Single source of truth for the mesh: the one the input is sharded on.
     mesh = x.sharding.mesh
@@ -94,7 +100,7 @@ def allreduce_bandwidth(nbytes_per_device: int = 64 << 20,
     mean_s = max((t_big - t_small) / iters, 1e-9)
 
     algo_gbps = payload / mean_s / 1e9
-    bus_gbps = algo_gbps * (2 * (n - 1) / n) if n > 1 else 0.0
+    bus_gbps = algo_gbps * (2 * (n - 1) / n)
     return {
         "algo_gbps": algo_gbps,
         "bus_gbps": bus_gbps,
