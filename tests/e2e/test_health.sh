@@ -1,0 +1,54 @@
+#!/usr/bin/env bash
+# Device health: inject a critical accel event on one node and watch the
+# driver yank the chip from the published ResourceSlice — the cluster-tier
+# view of the NVML-event flow (reference §3.5, device_health.go:36-342 ->
+# driver.go:237-301). Sim-mode only: injection writes the node's fake
+# sysfs health_events file (on a real cluster this is a hardware fault).
+source "$(dirname "$0")/helpers.sh"
+
+if [ "${E2E_MODE:-sim}" != "sim" ]; then
+  log "SKIP test_health (event injection requires sim mode)"
+  exit 0
+fi
+
+WORKDIR=$(python - <<'EOF'
+import json, os
+print(json.load(open(os.environ["KUBECTL_SHIM_STATE"]))["workdir"])
+EOF
+)
+
+count_devices() {  # count_devices <node>
+  k get resourceslice "$1-tpu.dev" -o json \
+    | python -c "import json,sys; d=json.load(sys.stdin); print(len([x for x in d['spec']['devices'] if x['attributes']['type']['string']=='chip']))"
+}
+
+slice_up() { k get resourceslice n0-tpu.dev -o name >/dev/null 2>&1; }
+wait_until 120 "n0 chip slice published" slice_up
+
+before=$(count_devices n0)
+log "n0 publishes $before chips; injecting critical event on chip 0"
+[ "$before" -ge 2 ] || die "expected >=2 chips on n0, got $before"
+
+# Code 72 is not in the benign skip-list (health.py DEFAULT_SKIPPED_CODES).
+echo "0 72 ecc uncorrectable-hbm-parity" \
+  >> "$WORKDIR/n0/fs/sys/class/accel/health_events"
+
+chips_dropped() {
+  local now
+  now=$(count_devices n0) || return 1
+  [ "$now" -lt "$before" ]
+}
+wait_until 60 "chip yanked from n0's ResourceSlice" chips_dropped
+after=$(count_devices n0)
+log "n0 now publishes $after chips (was $before)"
+
+# The healthy node must be untouched.
+[ "$(count_devices n1)" -ge 2 ] || die "healthy node n1 lost devices"
+
+log "recovery: chip serviced -> re-admitted (the reference needs a restart)"
+echo "0 0 recovered serviced" \
+  >> "$WORKDIR/n0/fs/sys/class/accel/health_events"
+chips_restored() { [ "$(count_devices n0)" -eq "$before" ]; }
+wait_until 60 "chip re-admitted to n0's ResourceSlice" chips_restored
+
+log "OK test_health"

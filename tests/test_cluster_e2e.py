@@ -33,3 +33,95 @@ def test_cluster_e2e_suite():
     tail = "\n".join(res.stdout.splitlines()[-60:])
     assert res.returncode == 0, f"e2e suite failed:\n{tail}\n{res.stderr[-2000:]}"
     assert "FAILED" not in res.stdout
+
+
+@pytest.mark.skipif(os.environ.get("TPU_DRA_SKIP_CLUSTER_E2E") == "1",
+                    reason="cluster e2e disabled by env")
+def test_multislice_cd_injects_megascale_env():
+    """Heterogeneous ComputeDomain (two nodes on different ICI slices):
+    the channel prepare must inject the multislice/DCN (megascale)
+    rendezvous env — distinct MEGASCALE_SLICE_IDs, a shared coordinator —
+    driven end-to-end through the simcluster with the real driver
+    subprocesses (§2.10 DCN fan-out; cd-daemon heterogeneous support,
+    reference main.go:205-213)."""
+    import time
+
+    from tpu_dra.deploy.helmlite import render_chart
+    from tpu_dra.k8s.resources import COMPUTEDOMAINS, PODS, RESOURCESLICES
+    from tpu_dra.simcluster import SimCluster
+
+    # mkdtemp under /tmp, NOT pytest's deep tmp tree: the kubelet registry
+    # socket path must stay under the AF_UNIX 107-char limit.
+    import tempfile
+    work = tempfile.mkdtemp(prefix="scms-", dir="/tmp")
+    cluster = SimCluster(work, num_nodes=2, chips_per_node=2,
+                         slice_ids=["slice-A", "slice-B"]).start()
+    try:
+        cluster.install(render_chart(
+            os.path.join(REPO, "deployments", "helm", "tpu-dra-driver"),
+            namespace="tpu-dra-driver"))
+        api = cluster.api
+
+        def wait(pred, timeout=240):
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                try:
+                    if pred():
+                        return True
+                except Exception:  # noqa: BLE001
+                    pass
+                time.sleep(0.5)
+            return False
+
+        assert wait(lambda: len(api.list(RESOURCESLICES)) >= 4), \
+            "driver slices never published"
+
+        api.create(COMPUTEDOMAINS, {
+            "apiVersion": "resource.tpu.dev/v1beta1",
+            "kind": "ComputeDomain",
+            "metadata": {"name": "ms", "namespace": "default"},
+            "spec": {"numNodes": 2, "channel": {
+                "resourceClaimTemplate": {"name": "ms-ch"}}},
+        }, namespace="default")
+        for i in range(2):
+            api.create(PODS, {
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": f"ms-{i}", "namespace": "default"},
+                "spec": {
+                    "restartPolicy": "Never", "nodeName": f"n{i}",
+                    "containers": [{
+                        "name": "ctr", "image": "x",
+                        "command": ["python", "-c",
+                                    "import os, sys, time; "
+                                    "print('MS', os.environ.get('MEGASCALE_NUM_SLICES'), "
+                                    "os.environ.get('MEGASCALE_SLICE_ID'), "
+                                    "os.environ.get('MEGASCALE_COORDINATOR_ADDRESS')); "
+                                    "sys.stdout.flush(); time.sleep(600)"],
+                        "resources": {"claims": [{"name": "ch"}]}}],
+                    "resourceClaims": [{
+                        "name": "ch",
+                        "resourceClaimTemplateName": "ms-ch"}],
+                }}, namespace="default")
+
+        # Generous bound: the channel prepare retries in ~45s envelopes
+        # until both daemons register, and the first envelope often burns
+        # fully before the DS pods exist.
+        assert wait(lambda: all(
+            (p.get("status") or {}).get("phase") == "Running"
+            for p in api.list(PODS, namespace="default")), timeout=360), \
+            "multislice workloads never ran"
+
+        lines = {}
+        for p in api.list(PODS, namespace="default"):
+            logf = os.path.join(work, p["spec"]["nodeName"], "pods",
+                                p["metadata"]["uid"], "logs", "ctr.log")
+            lines[p["metadata"]["name"]] = open(logf).read().strip()
+        ms0 = lines["ms-0"].split()  # MS <num> <sliceid> <coord>
+        ms1 = lines["ms-1"].split()
+        assert ms0[1] == ms1[1] == "2", lines       # two slices
+        assert {ms0[2], ms1[2]} == {"0", "1"}, lines  # distinct slice ids
+        assert ms0[3] == ms1[3] != "None", lines    # one shared coordinator
+    finally:
+        cluster.stop()
+        import shutil
+        shutil.rmtree(work, ignore_errors=True)

@@ -373,6 +373,60 @@ class TestHealthEvents:
         assert all(not n.startswith("chip-2-ss") for n in names)
         assert "chip-0" in names
 
+    def test_recovered_chip_readmitted(self, harness):
+        """Improvement over the reference (restart required to re-add a
+        yanked GPU, driver.go:263-264): a `recovered` health record puts
+        the chip's devices back into the published slice."""
+        cluster, backend = harness["cluster"], harness["backend"]
+        n_before = len(cluster.list(RESOURCESLICES)[0]["spec"]["devices"])
+        backend.inject_health_event(HealthEvent(2, 200, "hbm_ecc", "fatal"))
+        assert cluster.wait_for(lambda: len(
+            cluster.list(RESOURCESLICES)[0]["spec"]["devices"]) < n_before)
+        backend.inject_health_event(
+            HealthEvent(2, 0, "recovered", "serviced"))
+        assert cluster.wait_for(lambda: len(
+            cluster.list(RESOURCESLICES)[0]["spec"]["devices"]) == n_before)
+        names = [d["name"] for d in
+                 cluster.list(RESOURCESLICES)[0]["spec"]["devices"]]
+        assert "chip-2" in names
+
+    def test_board_level_recovery_readmits_all(self, harness):
+        """chip_index -1 addresses all chips in both directions."""
+        cluster, backend = harness["cluster"], harness["backend"]
+        n_before = len(cluster.list(RESOURCESLICES)[0]["spec"]["devices"])
+        backend.inject_health_event(HealthEvent(-1, 200, "pcie", "fatal"))
+        assert cluster.wait_for(lambda: len(
+            cluster.list(RESOURCESLICES)[0]["spec"]["devices"]) == 0)
+        backend.inject_health_event(
+            HealthEvent(-1, 0, "recovered", "board serviced"))
+        assert cluster.wait_for(lambda: len(
+            cluster.list(RESOURCESLICES)[0]["spec"]["devices"]) == n_before)
+
+    def test_recovery_not_filtered_by_skip_list(self, harness):
+        """A recovery record tagged with a benign/skipped code must still
+        re-admit — the skip list only guards the yank direction."""
+        cluster, backend = harness["cluster"], harness["backend"]
+        n_before = len(cluster.list(RESOURCESLICES)[0]["spec"]["devices"])
+        backend.inject_health_event(HealthEvent(1, 200, "hbm_ecc", "fatal"))
+        assert cluster.wait_for(lambda: len(
+            cluster.list(RESOURCESLICES)[0]["spec"]["devices"]) < n_before)
+        backend.inject_health_event(
+            HealthEvent(1, 31, "recovered", "code-tagged recovery"))
+        assert cluster.wait_for(lambda: len(
+            cluster.list(RESOURCESLICES)[0]["spec"]["devices"]) == n_before)
+
+    def test_recovered_without_fault_is_noop(self, harness):
+        """A spurious recovery for a healthy chip must not republish."""
+        cluster, backend = harness["cluster"], harness["backend"]
+        slices = cluster.list(RESOURCESLICES)
+        gen_before = slices[0]["spec"]["pool"]["generation"]
+        backend.inject_health_event(
+            HealthEvent(0, 0, "recovered", "spurious"))
+        import time
+        time.sleep(0.4)
+        assert (cluster.list(RESOURCESLICES)[0]["spec"]["pool"]["generation"]
+                == gen_before)
+
     def test_skipped_codes_ignored(self, harness):
         cluster, backend = harness["cluster"], harness["backend"]
         n_before = len(cluster.list(RESOURCESLICES)[0]["spec"]["devices"])

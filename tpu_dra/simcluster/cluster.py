@@ -30,13 +30,20 @@ class SimCluster:
     """
 
     def __init__(self, workdir: str, *, num_nodes: int = 2,
-                 chips_per_node: int = 4, slice_id: str = "slice-A"):
+                 chips_per_node: int = 4, slice_id: str = "slice-A",
+                 slice_ids: Optional[List[str]] = None):
+        """slice_ids: per-node ICI slice identity (topology/slice_id in the
+        fake sysfs). Different ids across nodes make a ComputeDomain
+        heterogeneous — the multislice/DCN (megascale) path."""
         self.workdir = workdir
         self.server = FakeApiServer()
         self.nodes: Dict[str, NodeSim] = {}
         self._num_nodes = num_nodes
         self._chips = chips_per_node
-        self._slice_id = slice_id
+        self._slice_ids = (list(slice_ids) if slice_ids
+                           else [slice_id] * num_nodes)
+        if len(self._slice_ids) != num_nodes:
+            raise ValueError("slice_ids must have one entry per node")
         self.scheduler: Optional[Scheduler] = None
         self.workloads: Optional[WorkloadController] = None
         self.api: Optional[HttpApiClient] = None
@@ -57,7 +64,8 @@ class SimCluster:
             name = f"n{i}"
             node_dir = os.path.join(self.workdir, name)
             hostfs = os.path.join(node_dir, "fs")
-            chips = default_fake_chips(self._chips, "v5e", self._slice_id, i)
+            chips = default_fake_chips(self._chips, "v5e",
+                                       self._slice_ids[i], i)
             make_fake_sysfs(hostfs, chips)
             self.api.create(NODES, {
                 "apiVersion": "v1", "kind": "Node",
@@ -114,6 +122,9 @@ def main(argv=None) -> int:
     ap.add_argument("--workdir", required=True)
     ap.add_argument("--nodes", type=int, default=2)
     ap.add_argument("--chips-per-node", type=int, default=4)
+    ap.add_argument("--slice-ids", default="",
+                    help="comma-separated per-node slice ids (different "
+                         "ids = heterogeneous/multislice topology)")
     ap.add_argument("--state-file", default="")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args(argv)
@@ -122,8 +133,11 @@ def main(argv=None) -> int:
         level=logging.DEBUG if args.verbose else logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s")
 
+    slice_ids = ([s.strip() for s in args.slice_ids.split(",") if s.strip()]
+                 or None)
     cluster = SimCluster(args.workdir, num_nodes=args.nodes,
-                         chips_per_node=args.chips_per_node).start()
+                         chips_per_node=args.chips_per_node,
+                         slice_ids=slice_ids).start()
     state = {"url": cluster.url, "workdir": args.workdir,
              "pid": os.getpid()}
     if args.state_file:

@@ -454,6 +454,21 @@ class DeviceState:
                 affected.append(name)
         return affected
 
+    def mark_healthy(self, chip_index: int) -> List[str]:
+        """Reverse of mark_unhealthy: a recovery event re-admits the chip's
+        devices to the inventory. The reference cannot do this — a yanked
+        GPU stays gone until driver restart (driver.go:263-264); the accel
+        health stream's explicit 'recovered' records make re-add safe."""
+        # Collect first, discard after: the chip's devices (chip +
+        # subslices) share one uuid, and discarding inside the loop would
+        # report only the first match.
+        affected = [name for name, dev in self.allocatable.items()
+                    if dev.chip.index == chip_index
+                    and dev.chip.uuid in self._unhealthy_uuids]
+        for name in affected:
+            self._unhealthy_uuids.discard(self.allocatable[name].chip.uuid)
+        return affected
+
     def healthy_devices(self) -> List[Dict]:
         """resourceapi device list excluding unhealthy chips (the republish
         path drops yanked devices, driver.go:283-293)."""

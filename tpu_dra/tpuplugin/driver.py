@@ -23,7 +23,7 @@ from tpu_dra.kubeletplugin.server import (
 )
 from tpu_dra.native.tpuinfo import HealthEvent, TpuInfoBackend
 from tpu_dra.tpuplugin.device_state import DeviceState
-from tpu_dra.tpuplugin.health import DeviceHealthMonitor
+from tpu_dra.tpuplugin.health import DeviceHealthMonitor, RECOVERED_KIND
 
 log = logging.getLogger("tpu_dra.tpuplugin")
 
@@ -138,16 +138,33 @@ class TpuDriver(DriverCallbacks):
         """deviceHealthEvents analog (driver.go:237-301): yank affected
         devices and republish through the retry queue — a failed republish
         is retried with backoff rather than dropped (the reference documents
-        the no-retry behavior as a known gap, driver.go:283-293). Like the
-        reference, re-adding a recovered chip requires a restart
+        the no-retry behavior as a known gap, driver.go:283-293).
+
+        Improvement over the reference: an explicit `recovered` record in
+        the accel health stream re-admits the chip and republishes — the
+        reference requires a driver restart to re-add a yanked GPU
         (driver.go:263-264)."""
-        if event.chip_index >= 0:
-            affected = self._state.mark_unhealthy(event.chip_index)
+        if event.kind == RECOVERED_KIND:
+            if event.chip_index >= 0:
+                affected = self._state.mark_healthy(event.chip_index)
+            else:
+                # chip_index < 0 addresses all chips, mirroring the yank
+                # path (board-level service record).
+                affected = []
+                for chip in self._state._backend.chips():
+                    affected += self._state.mark_healthy(chip.index)
+            if not affected:
+                return  # chip was never yanked: nothing to republish
+            log.info("health recovery for chip %d: re-admitting devices %s",
+                     event.chip_index, affected)
         else:
-            affected = []
-            for chip in self._state._backend.chips():
-                affected += self._state.mark_unhealthy(chip.index)
-        log.warning("health event %s (code %d): yanking devices %s",
-                    event.kind, event.code, affected)
+            if event.chip_index >= 0:
+                affected = self._state.mark_unhealthy(event.chip_index)
+            else:
+                affected = []
+                for chip in self._state._backend.chips():
+                    affected += self._state.mark_unhealthy(chip.index)
+            log.warning("health event %s (code %d): yanking devices %s",
+                        event.kind, event.code, affected)
         self._publish_queue.enqueue(
             None, lambda _obj: self.publish_resources(), key="publish")

@@ -24,6 +24,11 @@ from tpu_dra.native.tpuinfo import HealthEvent, TpuInfoBackend
 # hardware faults — hardware-fault-range codes are never skipped by default.
 DEFAULT_SKIPPED_CODES = frozenset({13, 31, 43, 45, 68})
 
+# Event kind signalling a previously-faulted chip is serviceable again;
+# the driver re-admits it to the inventory (a capability the reference
+# lacks: restart required, driver.go:263-264).
+RECOVERED_KIND = "recovered"
+
 # The reference waits 5s per NVML eventSet.Wait iteration; we use a shorter
 # quantum so stop() is responsive — the loop re-enters the wait immediately,
 # so event latency is unchanged.
@@ -57,6 +62,9 @@ class DeviceHealthMonitor:
             event = self._backend.wait_health_event(WAIT_TIMEOUT_S)
             if event is None:
                 continue
-            if event.code in self._skip:
+            # The skip list exists to stop benign codes from YANKING
+            # chips; recovery records must never be filtered by it (a
+            # swallowed recovery strands the chip out of the inventory).
+            if event.kind != RECOVERED_KIND and event.code in self._skip:
                 continue
             self._on_unhealthy(event)
