@@ -340,12 +340,17 @@ class FakeBackend(TpuInfoBackend):
 
     def inject_health_event(self, event: HealthEvent) -> None:
         self._events.put(event)
-        if event.kind not in ("info",):
-            with self._lock:
-                for idx in ([event.chip_index] if event.chip_index >= 0
-                            else list(self._chips)):
-                    if idx in self._chips:
-                        self._chips[idx] = replace(self._chips[idx], healthy=False)
+        # Mirror the driver's semantics in the fake's own chip model:
+        # faults mark unhealthy, 'recovered' restores, 'info' is neutral.
+        if event.kind == "info":
+            return
+        healthy = event.kind == "recovered"
+        with self._lock:
+            for idx in ([event.chip_index] if event.chip_index >= 0
+                        else list(self._chips)):
+                if idx in self._chips:
+                    self._chips[idx] = replace(self._chips[idx],
+                                               healthy=healthy)
 
     def set_chip(self, chip: Chip) -> None:
         with self._lock:

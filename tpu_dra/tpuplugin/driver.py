@@ -144,26 +144,23 @@ class TpuDriver(DriverCallbacks):
         the accel health stream re-admits the chip and republishes — the
         reference requires a driver restart to re-add a yanked GPU
         (driver.go:263-264)."""
-        if event.kind == RECOVERED_KIND:
-            if event.chip_index >= 0:
-                affected = self._state.mark_healthy(event.chip_index)
-            else:
-                # chip_index < 0 addresses all chips, mirroring the yank
-                # path (board-level service record).
-                affected = []
-                for chip in self._state._backend.chips():
-                    affected += self._state.mark_healthy(chip.index)
+        recovered = event.kind == RECOVERED_KIND
+        mark = (self._state.mark_healthy if recovered
+                else self._state.mark_unhealthy)
+        if event.chip_index >= 0:
+            affected = mark(event.chip_index)
+        else:
+            # chip_index < 0 addresses all chips (board-level record).
+            affected = []
+            for chip in self._state._backend.chips():
+                affected += mark(chip.index)
+        if recovered:
             if not affected:
                 return  # chip was never yanked: nothing to republish
-            log.info("health recovery for chip %d: re-admitting devices %s",
-                     event.chip_index, affected)
+            log.info("health recovery (%s): re-admitting devices %s",
+                     "all chips" if event.chip_index < 0
+                     else f"chip {event.chip_index}", affected)
         else:
-            if event.chip_index >= 0:
-                affected = self._state.mark_unhealthy(event.chip_index)
-            else:
-                affected = []
-                for chip in self._state._backend.chips():
-                    affected += self._state.mark_unhealthy(chip.index)
             log.warning("health event %s (code %d): yanking devices %s",
                         event.kind, event.code, affected)
         self._publish_queue.enqueue(

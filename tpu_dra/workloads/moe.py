@@ -1,0 +1,131 @@
+"""Expert-parallel MoE feed-forward (the `ep` axis of SURVEY §2.10).
+
+Top-1 token-choice routing with experts sharded over an `expert` mesh
+axis. The design is TPU-first, not a port:
+
+- Dense one-hot dispatch/combine einsums rather than scatter/gather —
+  static shapes, MXU-friendly, XLA fuses the mask into the matmuls
+  (pallas_guide.md: avoid dynamic shapes inside jit).
+- shard_map over the expert axis: each device holds its local experts'
+  weights and the FULL token batch (replicated), computes its local
+  expert outputs, and a single psum combines — the all-to-all dispatch
+  degenerates to one reduction because dispatch masks zero out foreign
+  tokens. For the capacity-bound variant the mask also enforces per-expert
+  token capacity, dropping overflow (standard Switch-style routing).
+- No data-dependent Python control flow: routing is argmax + one-hot,
+  capacity is cumsum + mask (lax-friendly, compiles once).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def init_moe_params(key, d_model: int, d_ff: int, n_experts: int,
+                    dtype=jnp.bfloat16) -> Dict:
+    kr, ku, kd = jax.random.split(key, 3)
+    scale_in = 1.0 / np.sqrt(d_model)
+    scale_out = 1.0 / np.sqrt(d_ff)
+    return {
+        "router": (jax.random.normal(kr, (d_model, n_experts), jnp.float32)
+                   * scale_in),
+        "w_up": (jax.random.normal(ku, (n_experts, d_model, d_ff), jnp.float32)
+                 * scale_in).astype(dtype),
+        "w_down": (jax.random.normal(kd, (n_experts, d_ff, d_model),
+                                     jnp.float32) * scale_out).astype(dtype),
+    }
+
+
+def route_top1(x, router_w, n_experts: int, capacity: int):
+    """Returns (dispatch [B,S,E,C], combine [B,S,E,C], aux_loss).
+
+    Dense dispatch/combine tensors (Switch Transformer style): position c
+    of expert e holds token (b,s) iff that token routed to e within
+    capacity. Router math in fp32 (small, precision-sensitive).
+    """
+    logits = x.astype(jnp.float32) @ router_w  # [B,S,E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)                      # [B,S]
+    onehot = jax.nn.one_hot(expert, n_experts, dtype=jnp.float32)
+    # Position within the expert's capacity, in (b,s) order.
+    pos = jnp.cumsum(onehot.reshape(-1, n_experts), axis=0) * \
+        onehot.reshape(-1, n_experts) - 1.0
+    pos = pos.reshape(onehot.shape)                          # [B,S,E]
+    keep = (pos >= 0) & (pos < capacity)
+    pos_cap = jnp.clip(pos, 0, capacity - 1).astype(jnp.int32)
+    dispatch = (jax.nn.one_hot(pos_cap, capacity, dtype=jnp.float32)
+                * (onehot * keep)[..., None])                # [B,S,E,C]
+    gate = jnp.max(probs * onehot, axis=-1)                  # [B,S]
+    combine = dispatch * gate[..., None, None]
+    # Load-balancing aux loss (mean prob * mean assignment per expert).
+    density = onehot.mean(axis=(0, 1))
+    density_proxy = probs.mean(axis=(0, 1))
+    aux = (density * density_proxy).sum() * (n_experts ** 2)
+    return dispatch, combine, aux
+
+
+def moe_ffn(params: Dict, x, *, capacity_factor: float = 1.25):
+    """Reference (unsharded) MoE FFN: x [B,S,D] -> [B,S,D]."""
+    n_experts = params["router"].shape[-1]
+    B, S, D = x.shape
+    capacity = max(1, int(capacity_factor * B * S / n_experts))
+    dispatch, combine, aux = route_top1(x, params["router"], n_experts,
+                                        capacity)
+    # Dispatch tokens to expert buffers: [E, C, D].
+    buffers = jnp.einsum("bsec,bsd->ecd", dispatch, x.astype(jnp.float32))
+    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", buffers,
+                               params["w_up"].astype(jnp.float32)))
+    out_buf = jnp.einsum("ecf,efd->ecd", h,
+                         params["w_down"].astype(jnp.float32))
+    out = jnp.einsum("bsec,ecd->bsd", combine, out_buf)
+    return out.astype(x.dtype), aux
+
+
+def make_expert_parallel_ffn(mesh: Mesh, axis_name: str = "expert",
+                             capacity_factor: float = 1.25):
+    """Jitted expert-parallel MoE FFN over `mesh`'s expert axis.
+
+    Expert weights are sharded on their leading (expert) dim; activations
+    are replicated. Each device computes its local experts' contribution;
+    one psum combines — dispatch masks make foreign-expert terms zero.
+    """
+    def body(params, x):
+        n_local = params["w_up"].shape[0]
+        n_experts = n_local * jax.lax.psum(1, axis_name)
+        my = jax.lax.axis_index(axis_name)
+        B, S, _ = x.shape
+        capacity = max(1, int(capacity_factor * B * S / n_experts))
+        dispatch, combine, aux = route_top1(x, params["router"], n_experts,
+                                            capacity)
+        # Slice MY experts out of the dense dispatch/combine tensors.
+        sl = jax.lax.dynamic_slice_in_dim(dispatch, my * n_local, n_local, 2)
+        cb = jax.lax.dynamic_slice_in_dim(combine, my * n_local, n_local, 2)
+        buffers = jnp.einsum("bsec,bsd->ecd", sl, x.astype(jnp.float32))
+        h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", buffers,
+                                   params["w_up"].astype(jnp.float32)))
+        out_buf = jnp.einsum("ecf,efd->ecd", h,
+                             params["w_down"].astype(jnp.float32))
+        out = jnp.einsum("bsec,ecd->bsd", cb, out_buf)
+        return jax.lax.psum(out, axis_name).astype(x.dtype), aux
+
+    param_specs = {"router": P(), "w_up": P(axis_name, None, None),
+                   "w_down": P(axis_name, None, None)}
+    fn = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(param_specs, P()),
+        out_specs=(P(), P()),
+        check_vma=False)
+    return jax.jit(fn)
+
+
+def shard_moe_params(params: Dict, mesh: Mesh,
+                     axis_name: str = "expert") -> Dict:
+    specs = {"router": P(), "w_up": P(axis_name, None, None),
+             "w_down": P(axis_name, None, None)}
+    return {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+            for k, v in params.items()}

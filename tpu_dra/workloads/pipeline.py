@@ -1,0 +1,106 @@
+"""Pipeline parallelism (`pp` of SURVEY §2.10): GPipe-style microbatch
+schedule over a `stage` mesh axis.
+
+TPU-first shape: one jit, `lax.scan` over schedule ticks (static trip
+count — no data-dependent Python control flow), `lax.ppermute` moves
+activations across the stage boundary each tick (rides ICI when the
+stage axis is laid out along it), and per-stage weights live sharded on
+the leading (stage) dimension so each device touches only its own
+block's parameters.
+
+Schedule: with S stages and M microbatches, tick t has stage s working
+on microbatch (t - s) when 0 <= t - s < M; the bubble is the standard
+(S - 1) / (M + S - 1) GPipe fraction.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def init_stage_params(key, n_stages: int, d_model: int,
+                      dtype=jnp.float32):
+    """One square gelu-MLP block per stage: [S, D, D]."""
+    scale = 1.0 / np.sqrt(d_model)
+    return (jax.random.normal(key, (n_stages, d_model, d_model),
+                              jnp.float32) * scale).astype(dtype)
+
+
+def stage_fn(w, x):
+    """The per-stage block; swap for any (w, x) -> y computation."""
+    return jax.nn.gelu(x @ w)
+
+
+def pipeline_reference(weights, microbatches,
+                       fn: Callable = stage_fn):
+    """Sequential ground truth: run every stage over every microbatch."""
+    out = microbatches
+    for s in range(weights.shape[0]):
+        out = jax.vmap(lambda x, w=weights[s]: fn(w, x))(out)
+    return out
+
+
+def make_pipeline_forward(mesh: Mesh, axis_name: str = "stage",
+                          fn: Callable = stage_fn):
+    """Jitted pipeline-parallel forward over `mesh`'s stage axis.
+
+    Takes (weights [S, D, D] stage-sharded, microbatches [M, B, D]
+    replicated) -> [M, B, D] outputs (replicated; produced on the last
+    stage and broadcast so callers see one coherent array).
+    """
+    n_stages = mesh.shape[axis_name]
+    fwd_perm = [(i, i + 1) for i in range(n_stages - 1)]
+
+    def body(w, mbs):
+        # w: [1, D, D] (this stage's block); mbs: [M, B, D].
+        s = jax.lax.axis_index(axis_name)
+        M = mbs.shape[0]
+        ticks = M + n_stages - 1
+        zero = jnp.zeros_like(mbs[0])
+
+        def tick(carry, t):
+            recv, outs = carry
+            mb_idx = jnp.clip(t, 0, M - 1)
+            first_in = jax.lax.dynamic_index_in_dim(
+                mbs, mb_idx, keepdims=False)
+            x_in = jnp.where(s == 0, first_in, recv)
+            active = (t >= s) & (t - s < M)
+            y = jnp.where(active, fn(w[0], x_in), zero)
+            # Last stage writes its finished microbatch into the output
+            # accumulator; everyone else contributes zeros there.
+            out_idx = jnp.clip(t - s, 0, M - 1)
+            contribution = jnp.where((s == n_stages - 1) & active, y, zero)
+            outs = jax.lax.dynamic_update_index_in_dim(
+                outs,
+                jax.lax.dynamic_index_in_dim(outs, out_idx, keepdims=False)
+                + contribution,
+                out_idx, axis=0)
+            # Boundary transfer: stage i's output becomes stage i+1's
+            # input next tick. Stage S-1 sends nowhere; stage 0 receives
+            # zeros (it reads mbs instead).
+            sent = (jax.lax.ppermute(y, axis_name, fwd_perm)
+                    if fwd_perm else zero)
+            return (sent, outs), None
+
+        init = (zero, jnp.zeros_like(mbs))
+        (_, outs), _ = jax.lax.scan(tick, init, jnp.arange(ticks))
+        # Only the last stage holds real outputs; psum broadcasts them
+        # (every other stage's accumulator is all zeros).
+        return jax.lax.psum(outs, axis_name)
+
+    shard = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(axis_name, None, None), P()),
+        out_specs=P(),
+        check_vma=False)
+    return jax.jit(shard)
+
+
+def shard_stage_params(weights, mesh: Mesh, axis_name: str = "stage"):
+    return jax.device_put(
+        weights, NamedSharding(mesh, P(axis_name, None, None)))
