@@ -1,0 +1,96 @@
+#!/usr/bin/env bash
+# Admission: the validating webhook is live in the request path — an
+# invalid opaque config is rejected at apply time, a valid one admits.
+# Reference analog: tests/bats specs rc-opaque-cfg-unknown-field.yaml.tmpl
+# + cmd/webhook admission tests, exercised against the running cluster.
+source "$(dirname "$0")/helpers.sh"
+
+NS=adm-e2e
+cat <<EOF | k apply -f -
+apiVersion: v1
+kind: Namespace
+metadata:
+  name: $NS
+EOF
+
+bad_claim() {
+  cat <<EOF
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaim
+metadata:
+  name: bad-claim
+  namespace: $NS
+spec:
+  devices:
+    requests:
+    - name: tpu
+      exactly:
+        deviceClassName: tpu.dev
+    config:
+    - requests: [tpu]
+      opaque:
+        driver: tpu.dev
+        parameters:
+          apiVersion: resource.tpu.dev/v1beta1
+          kind: TpuConfig
+          bogusField: true
+EOF
+}
+
+# failurePolicy is Ignore, so rejections only start once the webhook pod
+# is up and its Service endpoint is published; poll until the bad claim
+# is actually denied.
+denied() {
+  local out
+  out=$(bad_claim | k apply -f - 2>&1) && return 1
+  echo "$out" | grep -qi "admission webhook denied"
+}
+wait_until 120 "webhook denies the invalid claim" denied
+k delete resourceclaim bad-claim -n $NS --ignore-not-found >/dev/null 2>&1
+
+log "valid claim admits"
+cat <<EOF | k apply -f -
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaim
+metadata:
+  name: good-claim
+  namespace: $NS
+spec:
+  devices:
+    requests:
+    - name: tpu
+      exactly:
+        deviceClassName: tpu.dev
+    config:
+    - requests: [tpu]
+      opaque:
+        driver: tpu.dev
+        parameters:
+          apiVersion: resource.tpu.dev/v1beta1
+          kind: TpuConfig
+EOF
+k delete resourceclaim good-claim -n $NS --ignore-not-found
+
+log "foreign-driver config passes through untouched"
+cat <<EOF | k apply -f -
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaim
+metadata:
+  name: foreign-claim
+  namespace: $NS
+spec:
+  devices:
+    requests:
+    - name: dev
+      exactly:
+        deviceClassName: tpu.dev
+    config:
+    - requests: [dev]
+      opaque:
+        driver: other-vendor.example
+        parameters:
+          anything: goes
+EOF
+k delete resourceclaim foreign-claim -n $NS --ignore-not-found
+
+log "OK test_admission"

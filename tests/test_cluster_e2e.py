@@ -17,7 +17,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # deliberately retries until the domain converges, plus failover heal).
 DEFAULT_SUITES = os.environ.get(
     "TPU_DRA_E2E_SUITES",
-    "test_basics test_tpu_claims test_stress test_multiprocess "
+    "test_basics test_admission test_tpu_claims test_stress test_multiprocess "
     "test_cd_lifecycle")
 
 

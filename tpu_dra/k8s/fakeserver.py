@@ -87,8 +87,14 @@ class FakeApiServer:
     usable as --kube-api-url / KUBE_API_URL."""
 
     def __init__(self, cluster: Optional[FakeCluster] = None,
-                 addr: str = "127.0.0.1", port: int = 0):
+                 addr: str = "127.0.0.1", port: int = 0,
+                 admission_hook=None):
+        """admission_hook(gvr, obj, operation) -> Optional[str]: when set,
+        runs before create/update like the real admission chain; a
+        returned string denies the request (the simcluster wires a caller
+        that POSTs AdmissionReviews to registered webhooks)."""
         self.cluster = cluster or FakeCluster()
+        self.admission_hook = admission_hook
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -176,14 +182,29 @@ class FakeApiServer:
                 except (BrokenPipeError, ConnectionResetError):
                     return
 
+            def _admission_denial(self, gvr, obj, operation):
+                """Runs the admission chain; returns a denial message or
+                None (the shared seam for CREATE/UPDATE/PATCH-as-UPDATE)."""
+                if outer.admission_hook is None:
+                    return None
+                return outer.admission_hook(gvr, obj, operation)
+
+            def _deny(self, message: str):
+                return self._error(
+                    400, f"admission webhook denied the request: {message}",
+                    reason="Invalid")
+
             def do_POST(self):  # noqa: N802
                 parsed = _parse_path(urllib.parse.urlparse(self.path).path)
                 if parsed is None:
                     return self._error(404, "unknown path")
                 gvr, ns, _name, _sub = parsed
                 try:
-                    created = outer.cluster.create(gvr, self._body(),
-                                                   namespace=ns)
+                    body = self._body()
+                    deny = self._admission_denial(gvr, body, "CREATE")
+                    if deny:
+                        return self._deny(deny)
+                    created = outer.cluster.create(gvr, body, namespace=ns)
                     return self._send_json(201, created)
                 except ApiError as e:
                     return self._api_error(e)
@@ -194,12 +215,15 @@ class FakeApiServer:
                     return self._error(404, "unknown path")
                 gvr, ns, _name, sub = parsed
                 try:
+                    body = self._body()
                     if sub == "status":
-                        out = outer.cluster.update_status(gvr, self._body(),
+                        out = outer.cluster.update_status(gvr, body,
                                                           namespace=ns)
                     else:
-                        out = outer.cluster.update(gvr, self._body(),
-                                                   namespace=ns)
+                        deny = self._admission_denial(gvr, body, "UPDATE")
+                        if deny:
+                            return self._deny(deny)
+                        out = outer.cluster.update(gvr, body, namespace=ns)
                     return self._send_json(200, out)
                 except ApiError as e:
                     return self._api_error(e)
@@ -210,7 +234,19 @@ class FakeApiServer:
                     return self._error(404, "unknown path")
                 gvr, ns, name, _sub = parsed
                 try:
-                    out = outer.cluster.patch(gvr, name, self._body(),
+                    patch = self._body()
+                    if outer.admission_hook is not None:
+                        # Admission sees the POST-patch object, like the
+                        # real apiserver (PATCH is an UPDATE there).
+                        import copy as _copy
+
+                        from tpu_dra.k8s.fake import _merge_patch
+                        current = outer.cluster.get(gvr, name, ns)
+                        merged = _merge_patch(_copy.deepcopy(current), patch)
+                        deny = self._admission_denial(gvr, merged, "UPDATE")
+                        if deny:
+                            return self._deny(deny)
+                    out = outer.cluster.patch(gvr, name, patch,
                                               namespace=ns)
                     return self._send_json(200, out)
                 except ApiError as e:

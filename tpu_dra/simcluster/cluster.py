@@ -35,8 +35,13 @@ class SimCluster:
         """slice_ids: per-node ICI slice identity (topology/slice_id in the
         fake sysfs). Different ids across nodes make a ComputeDomain
         heterogeneous — the multislice/DCN (megascale) path."""
+        from tpu_dra.simcluster.admission import WebhookCaller
+
         self.workdir = workdir
         self.server = FakeApiServer()
+        # Wire the admission chain: registered validating webhooks are
+        # actually called on create/update, like the real apiserver.
+        self.server.admission_hook = WebhookCaller(self.server.cluster)
         self.nodes: Dict[str, NodeSim] = {}
         self._num_nodes = num_nodes
         self._chips = chips_per_node

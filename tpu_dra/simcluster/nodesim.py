@@ -173,6 +173,50 @@ class NodeSim:
             return
         self._running[uid] = rp
         self._set_status(pod, phase="Running", ready=False)
+        self._publish_endpoints(pod, rp)
+
+    def _publish_endpoints(self, pod: Dict, rp: _RunningPod) -> None:
+        """Endpoints-controller analog: annotate Services selecting this
+        pod with the pod's actual (port-remapped) endpoint so the sim's
+        admission chain can dial registered webhooks."""
+        from tpu_dra.k8s.resources import SERVICES
+        from tpu_dra.simcluster.admission import ENDPOINT_ANNOTATION
+
+        ns = pod["metadata"].get("namespace", "default")
+        labels = pod["metadata"].get("labels") or {}
+        try:
+            services = self._client.list(SERVICES, namespace=ns)
+        except ApiError:
+            return
+        for svc in services:
+            selector = (svc.get("spec") or {}).get("selector") or {}
+            if not selector or not all(labels.get(k) == v
+                                       for k, v in selector.items()):
+                continue
+            ports = (svc["spec"].get("ports") or [{}])
+            target = str(ports[0].get("targetPort", ports[0].get("port", "")))
+            # Scheme from the serving container's TLS config, independent
+            # of whether its port needed remapping.
+            scheme, mapped = "http", target
+            for proc in rp.procs:
+                env = getattr(proc, "_env", {})
+                if env.get("TLS_CERT_FILE"):
+                    scheme = "https"
+                mapped = getattr(proc, "_port_map", {}).get(target, mapped)
+            endpoint = f"{scheme}://127.0.0.1:{mapped}"
+            current = (svc["metadata"].get("annotations") or {}).get(
+                ENDPOINT_ANNOTATION)
+            if current == endpoint:
+                continue  # already published: no RV churn
+            try:
+                self._client.patch(SERVICES, svc["metadata"]["name"],
+                                   {"metadata": {"annotations": {
+                                       ENDPOINT_ANNOTATION: endpoint}}},
+                                   namespace=ns)
+                log.info("service %s/%s -> %s", ns,
+                         svc["metadata"]["name"], endpoint)
+            except ApiError:
+                pass
 
     def _resolve_claims(self, pod: Dict, ns: str) -> Optional[List[Dict]]:
         statuses = {s["name"]: s["resourceClaimName"] for s in
@@ -434,6 +478,9 @@ class NodeSim:
             if ready != rp.ready:
                 rp.ready = ready
                 self._set_status(pod, phase="Running", ready=ready)
+            # Re-publish endpoints each probe tick: a Service created
+            # after its backing pod started must still get annotated.
+            self._publish_endpoints(pod, rp)
 
     def _probe_ok(self, proc: subprocess.Popen) -> bool:
         ctr = proc._ctr  # type: ignore[attr-defined]
