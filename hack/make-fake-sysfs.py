@@ -20,10 +20,13 @@ if __name__ == "__main__":
     ap.add_argument("--out", required=True)
     ap.add_argument("--nodes", type=int, default=2)
     ap.add_argument("--chips", type=int, default=4)
+    # v5p default (2 TensorCores/chip) to match the simcluster: the
+    # subslice demo needs chips that can be subdivided.
+    ap.add_argument("--generation", default="v5p")
     ap.add_argument("--slice-id", default="slice-A")
     args = ap.parse_args()
     for i in range(args.nodes):
         root = os.path.join(args.out, f"n{i}")
         make_fake_sysfs(root, default_fake_chips(
-            args.chips, "v5e", args.slice_id, i))
+            args.chips, args.generation, args.slice_id, i))
         print(f"wrote {root}")

@@ -43,7 +43,7 @@ EOF
 denied() {
   local out
   out=$(bad_claim | k apply -f - 2>&1) && return 1
-  echo "$out" | grep -qi "admission webhook denied"
+  echo "$out" | grep -qi "denied the request"
 }
 wait_until 120 "webhook denies the invalid claim" denied
 k delete resourceclaim bad-claim -n $NS --ignore-not-found >/dev/null 2>&1

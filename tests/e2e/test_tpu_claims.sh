@@ -28,4 +28,19 @@ k logs pod0 -n tpu-test3 | grep -q "TPU_VISIBLE_CHIPS=" \
   || die "tpu-test3 pod missing chip env"
 k delete -f "$REPO_ROOT/demo/specs/tpu-test3.yaml" --ignore-not-found
 
+log "tpu-test4: one claim, four chips"
+k apply -f "$REPO_ROOT/demo/specs/tpu-test4.yaml"
+wait_until 120 "tpu-test4 pods Succeeded" all_pods_phase tpu-test4 Succeeded
+chips=$(k logs pod0 -n tpu-test4 | sed -n 's/.*TPU_VISIBLE_CHIPS= *//p' | head -1)
+n=$(echo "$chips" | tr ',' '\n' | grep -c .)
+[ "$n" -eq 4 ] || die "tpu-test4 expected 4 visible chips, got '$chips'"
+k delete -f "$REPO_ROOT/demo/specs/tpu-test4.yaml" --ignore-not-found
+
+log "tpu-test5: TensorCore subslice (MIG analog)"
+k apply -f "$REPO_ROOT/demo/specs/tpu-test5.yaml"
+wait_until 120 "tpu-test5 pods Succeeded" all_pods_phase tpu-test5 Succeeded
+k logs pod0 -n tpu-test5 | grep -q "TPU_VISIBLE_CHIPS=" \
+  || die "tpu-test5 pod missing chip env"
+k delete -f "$REPO_ROOT/demo/specs/tpu-test5.yaml" --ignore-not-found
+
 log "OK test_tpu_claims"

@@ -190,9 +190,9 @@ class FakeApiServer:
                 return outer.admission_hook(gvr, obj, operation)
 
             def _deny(self, message: str):
-                return self._error(
-                    400, f"admission webhook denied the request: {message}",
-                    reason="Invalid")
+                # The hook supplies the full apiserver-format message
+                # ('admission webhook "<name>" denied the request: ...').
+                return self._error(400, message, reason="Invalid")
 
             def do_POST(self):  # noqa: N802
                 parsed = _parse_path(urllib.parse.urlparse(self.path).path)
@@ -238,11 +238,10 @@ class FakeApiServer:
                     if outer.admission_hook is not None:
                         # Admission sees the POST-patch object, like the
                         # real apiserver (PATCH is an UPDATE there).
-                        import copy as _copy
-
+                        # cluster.get already returns a copy.
                         from tpu_dra.k8s.fake import _merge_patch
-                        current = outer.cluster.get(gvr, name, ns)
-                        merged = _merge_patch(_copy.deepcopy(current), patch)
+                        merged = _merge_patch(
+                            outer.cluster.get(gvr, name, ns), patch)
                         deny = self._admission_denial(gvr, merged, "UPDATE")
                         if deny:
                             return self._deny(deny)

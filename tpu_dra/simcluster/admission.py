@@ -48,7 +48,11 @@ class WebhookCaller:
                     continue
                 deny = self._call_webhook(wh, gvr, obj, operation)
                 if deny:
-                    return f"{wh.get('name', 'webhook')}: {deny}"
+                    # Real apiserver denial format, so clients (and the
+                    # e2e suite) see identical text against kind or sim.
+                    return (f'admission webhook '
+                            f'"{wh.get("name", "webhook")}" denied the '
+                            f'request: {deny}')
         return None
 
     @staticmethod

@@ -31,10 +31,14 @@ class SimCluster:
 
     def __init__(self, workdir: str, *, num_nodes: int = 2,
                  chips_per_node: int = 4, slice_id: str = "slice-A",
-                 slice_ids: Optional[List[str]] = None):
+                 slice_ids: Optional[List[str]] = None,
+                 generation: str = "v5p"):
         """slice_ids: per-node ICI slice identity (topology/slice_id in the
         fake sysfs). Different ids across nodes make a ComputeDomain
-        heterogeneous — the multislice/DCN (megascale) path."""
+        heterogeneous — the multislice/DCN (megascale) path.
+        generation: fake chip generation; default v5p (2 TensorCores per
+        chip) so the subslice (MIG-analog) inventory is non-empty —
+        single-core generations like v5e have nothing to subdivide."""
         from tpu_dra.simcluster.admission import WebhookCaller
 
         self.workdir = workdir
@@ -45,6 +49,7 @@ class SimCluster:
         self.nodes: Dict[str, NodeSim] = {}
         self._num_nodes = num_nodes
         self._chips = chips_per_node
+        self._generation = generation
         self._slice_ids = (list(slice_ids) if slice_ids
                            else [slice_id] * num_nodes)
         if len(self._slice_ids) != num_nodes:
@@ -69,7 +74,7 @@ class SimCluster:
             name = f"n{i}"
             node_dir = os.path.join(self.workdir, name)
             hostfs = os.path.join(node_dir, "fs")
-            chips = default_fake_chips(self._chips, "v5e",
+            chips = default_fake_chips(self._chips, self._generation,
                                        self._slice_ids[i], i)
             make_fake_sysfs(hostfs, chips)
             self.api.create(NODES, {
@@ -127,6 +132,7 @@ def main(argv=None) -> int:
     ap.add_argument("--workdir", required=True)
     ap.add_argument("--nodes", type=int, default=2)
     ap.add_argument("--chips-per-node", type=int, default=4)
+    ap.add_argument("--generation", default="v5p")
     ap.add_argument("--slice-ids", default="",
                     help="comma-separated per-node slice ids (different "
                          "ids = heterogeneous/multislice topology)")
@@ -142,7 +148,8 @@ def main(argv=None) -> int:
                  or None)
     cluster = SimCluster(args.workdir, num_nodes=args.nodes,
                          chips_per_node=args.chips_per_node,
-                         slice_ids=slice_ids).start()
+                         slice_ids=slice_ids,
+                         generation=args.generation).start()
     state = {"url": cluster.url, "workdir": args.workdir,
              "pid": os.getpid()}
     if args.state_file:

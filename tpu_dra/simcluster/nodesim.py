@@ -195,14 +195,25 @@ class NodeSim:
                 continue
             ports = (svc["spec"].get("ports") or [{}])
             target = str(ports[0].get("targetPort", ports[0].get("port", "")))
-            # Scheme from the serving container's TLS config, independent
-            # of whether its port needed remapping.
-            scheme, mapped = "http", target
+            # Scheme and port must come from the SAME container — the one
+            # actually serving the target port (a TLS webhook container
+            # must not force https onto a sibling's plain-HTTP port).
+            serving = None
             for proc in rp.procs:
-                env = getattr(proc, "_env", {})
-                if env.get("TLS_CERT_FILE"):
-                    scheme = "https"
-                mapped = getattr(proc, "_port_map", {}).get(target, mapped)
+                ctr = getattr(proc, "_ctr", {}) or {}
+                ctr_ports = {str(p.get("containerPort", ""))
+                             for p in ctr.get("ports") or []}
+                if target in ctr_ports or \
+                        target in getattr(proc, "_port_map", {}):
+                    serving = proc
+                    break
+            serving = serving or (rp.procs[0] if rp.procs else None)
+            if serving is None:
+                continue
+            env = getattr(serving, "_env", {}) or {}
+            scheme = "https" if env.get("TLS_CERT_FILE") else "http"
+            mapped = (getattr(serving, "_port_map", {}) or {}).get(
+                target, target)
             endpoint = f"{scheme}://127.0.0.1:{mapped}"
             current = (svc["metadata"].get("annotations") or {}).get(
                 ENDPOINT_ANNOTATION)
@@ -458,14 +469,17 @@ class NodeSim:
                 if time.monotonic() >= rp.restart_at:
                     rp.restart_at = None
                     for i, p in enumerate(rp.procs):
-                        ctr = p._ctr  # type: ignore[attr-defined]
-                        rp.procs[i] = subprocess.Popen(
+                        np_ = subprocess.Popen(
                             p.args, env=p._env,  # type: ignore
                             stdout=p._logfile,   # type: ignore
                             stderr=subprocess.STDOUT)
-                        rp.procs[i]._ctr = ctr        # type: ignore
-                        rp.procs[i]._logfile = p._logfile  # type: ignore
-                        rp.procs[i]._env = p._env     # type: ignore
+                        # Carry ALL sim bookkeeping across the restart —
+                        # losing _port_map/_mounts would break probe-port
+                        # resolution and endpoint publishing afterwards.
+                        for attr in ("_ctr", "_logfile", "_env",
+                                     "_port_map", "_mounts"):
+                            setattr(np_, attr, getattr(p, attr, None))
+                        rp.procs[i] = np_
                 return
             del self._running[rp.uid]
             self._unprepare_all(rp)
