@@ -22,7 +22,9 @@ if __name__ == "__main__":
     ap.add_argument("--chips", type=int, default=4)
     # v5p default (2 TensorCores/chip) to match the simcluster: the
     # subslice demo needs chips that can be subdivided.
-    ap.add_argument("--generation", default="v5p")
+    from tpu_dra.native.tpuinfo import GEN_SPECS  # noqa: E402
+    ap.add_argument("--generation", default="v5p",
+                    choices=sorted(GEN_SPECS))
     ap.add_argument("--slice-id", default="slice-A")
     args = ap.parse_args()
     for i in range(args.nodes):

@@ -71,6 +71,42 @@ t0=$SECONDS
 wait_until "$HEAL_BOUND" "CD Ready again" cd_ready
 log "healed in $((SECONDS - t0))s"
 
+log "fault 2: delete a workload pod (the 'force-delete worker pod' case);"
+log "its channel release shrinks the domain, re-creating it re-joins"
+k delete pod wl-0 -n $NS
+node_gone() {
+  # Distinguish 'n0 absent' from 'get failed': a transient apiserver
+  # error must not count as deregistration.
+  local out
+  out=$(k get cd $CD -n $NS -o json) || return 1
+  ! echo "$out" | grep -q '"name": "n0"'
+}
+wait_until 120 "n0 deregistered from CD status" node_gone
+
+cat <<EOF | k apply -f -
+apiVersion: v1
+kind: Pod
+metadata:
+  name: wl-0
+  namespace: $NS
+spec:
+  restartPolicy: Never
+  nodeName: n0
+  containers:
+  - name: ctr
+    image: x
+    command: ["python", "-c", "import time; time.sleep(900)"]
+    resources:
+      claims: [{name: ch}]
+  resourceClaims:
+  - name: ch
+    resourceClaimTemplateName: ${CD}-channel
+EOF
+t0=$SECONDS
+wait_until "$HEAL_BOUND" "CD Ready after worker re-join" cd_ready
+wait_until 120 "wl-0 Running again" pod_phase_is wl-0 $NS Running
+log "worker re-join healed in $((SECONDS - t0))s"
+
 for i in 0 1; do k delete pod wl-$i -n $NS --ignore-not-found; done
 k delete cd $CD -n $NS
 log "OK test_cd_failover"

@@ -114,6 +114,54 @@ class TestScheduler:
         assert c.get(PODS, "p1", "default")["spec"]["nodeName"] == "n0"
         assert c.get(PODS, "p2", "default")["spec"]["nodeName"] == "n0"
 
+    def test_chip_and_subslice_mutually_exclusive(self):
+        """Partitionable-device semantics (the DRA counter analog): a
+        whole-chip allocation blocks its subslices and vice versa, while
+        sibling subslices of one chip can coexist."""
+        c = make_cluster_with_inventory(chips=1)
+        c.create(DEVICECLASSES, {
+            "apiVersion": "resource.k8s.io/v1", "kind": "DeviceClass",
+            "metadata": {"name": "tpu-subslice.tpu.dev"},
+            "spec": {"selectors": [{"cel": {"expression":
+                'device.driver == "tpu.dev" && '
+                'device.attributes["tpu.dev"].type == "subslice"'}}]}})
+        sl = c.get(
+            __import__("tpu_dra.k8s.resources", fromlist=["RESOURCESLICES"]
+                       ).RESOURCESLICES, "n0-tpu.dev")
+        sl["spec"]["devices"] += [
+            {"name": f"chip-0-ss-1c-{i}",
+             "attributes": {"type": {"string": "subslice"}}}
+            for i in range(2)]
+        c.update(__import__("tpu_dra.k8s.resources",
+                            fromlist=["RESOURCESLICES"]).RESOURCESLICES, sl)
+
+        def claim(name, cls):
+            c.create(RESOURCECLAIMS, {
+                "apiVersion": "resource.k8s.io/v1", "kind": "ResourceClaim",
+                "metadata": {"name": name, "namespace": "default"},
+                "spec": {"devices": {"requests": [
+                    {"name": "r", "exactly": {"deviceClassName": cls}}]}},
+            }, namespace="default")
+            c.create(PODS, pod_with_claim(
+                f"p-{name}", {"resourceClaimName": name}),
+                namespace="default")
+
+        s = Scheduler(c)
+        # Subslice first: sibling subslice still fits, whole chip doesn't.
+        claim("ss1", "tpu-subslice.tpu.dev")
+        claim("whole", "tpu.dev")
+        claim("ss2", "tpu-subslice.tpu.dev")
+        for _ in range(4):
+            s.reconcile_once()
+        alloc = {cl["metadata"]["name"]:
+                 (cl.get("status") or {}).get("allocation")
+                 for cl in c.list(RESOURCECLAIMS, namespace="default")}
+        assert alloc["ss1"] and alloc["ss2"], alloc
+        assert alloc["whole"] is None, alloc
+        names = {alloc["ss1"]["devices"]["results"][0]["device"],
+                 alloc["ss2"]["devices"]["results"][0]["device"]}
+        assert len(names) == 2 and all("-ss" in n for n in names)
+
     def test_count_request(self):
         c = make_cluster_with_inventory(chips=4)
         c.create(RESOURCECLAIMS, {

@@ -46,13 +46,18 @@ class WebhookCaller:
                 if not self._rules_match(wh.get("rules") or [], gvr,
                                          operation):
                     continue
-                deny = self._call_webhook(wh, gvr, obj, operation)
-                if deny:
-                    # Real apiserver denial format, so clients (and the
-                    # e2e suite) see identical text against kind or sim.
-                    return (f'admission webhook '
-                            f'"{wh.get("name", "webhook")}" denied the '
-                            f'request: {deny}')
+                outcome = self._call_webhook(wh, gvr, obj, operation)
+                if outcome is None:
+                    continue
+                kind_, msg = outcome
+                name = wh.get("name", "webhook")
+                # Real apiserver message formats, so clients (and the e2e
+                # suite) see identical text against kind or sim — and an
+                # infra failure is NOT misreported as a policy denial.
+                if kind_ == "deny":
+                    return (f'admission webhook "{name}" denied the '
+                            f'request: {msg}')
+                return f'failed calling webhook "{name}": {msg}'
         return None
 
     @staticmethod
@@ -68,15 +73,16 @@ class WebhookCaller:
         return False
 
     def _call_webhook(self, wh: Dict, gvr: GVR, obj: Dict,
-                      operation: str) -> Optional[str]:
+                      operation: str):
+        """Returns None (allowed), ('deny', msg) for a policy denial, or
+        ('error', msg) for an infra failure under failurePolicy Fail."""
         fail_policy = wh.get("failurePolicy", "Fail")
         cc = wh.get("clientConfig") or {}
         endpoint = self._resolve_endpoint(cc)
         if endpoint is None:
             if fail_policy == "Ignore":
                 return None
-            return ("webhook endpoint unavailable and failurePolicy is "
-                    "Fail")
+            return ("error", "webhook endpoint unavailable")
         review = {
             "apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
             "request": {
@@ -106,11 +112,12 @@ class WebhookCaller:
             log.warning("webhook call %s failed: %s", url, e)
             if fail_policy == "Ignore":
                 return None
-            return f"webhook call failed: {e}"
+            return ("error", str(e))
         response = out.get("response") or {}
         if response.get("allowed"):
             return None
-        return (response.get("status") or {}).get("message", "denied")
+        return ("deny",
+                (response.get("status") or {}).get("message", "denied"))
 
     def _resolve_endpoint(self, client_config: Dict) -> Optional[str]:
         if client_config.get("url"):

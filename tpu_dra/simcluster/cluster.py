@@ -132,7 +132,9 @@ def main(argv=None) -> int:
     ap.add_argument("--workdir", required=True)
     ap.add_argument("--nodes", type=int, default=2)
     ap.add_argument("--chips-per-node", type=int, default=4)
-    ap.add_argument("--generation", default="v5p")
+    from tpu_dra.native.tpuinfo import GEN_SPECS
+    ap.add_argument("--generation", default="v5p",
+                    choices=sorted(GEN_SPECS))
     ap.add_argument("--slice-ids", default="",
                     help="comma-separated per-node slice ids (different "
                          "ids = heterogeneous/multislice topology)")

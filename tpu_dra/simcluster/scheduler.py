@@ -208,14 +208,42 @@ class Scheduler:
                                        claim["metadata"].get("namespace"))
         return True
 
+    @staticmethod
+    def _parent_of(device: str) -> str:
+        """Subslice devices ('chip-N-ss...') partition their parent chip
+        ('chip-N'); everything else is its own parent."""
+        return device.split("-ss")[0] if "-ss" in device else device
+
     def _allocated_devices(self) -> Set[Tuple[str, str, str]]:
+        """Names in use, expanded with partition semantics (the DRA
+        partitionable-device counter analog): a whole-chip allocation
+        blocks its subslices and vice versa, while two different
+        subslices of one chip can coexist (MIG-style)."""
         taken = set()
         for claim in self._client.list(RESOURCECLAIMS):
             alloc = (claim.get("status") or {}).get("allocation") or {}
             for r in (alloc.get("devices") or {}).get("results") or []:
-                taken.add((r.get("driver", ""), r.get("pool", ""),
-                           r.get("device", "")))
+                key = (r.get("driver", ""), r.get("pool", ""))
+                name = r.get("device", "")
+                taken.add((*key, name))
+                parent = self._parent_of(name)
+                if parent != name:
+                    # Subslice in use: the WHOLE chip is unavailable, but
+                    # sibling subslices stay allocatable.
+                    taken.add((*key, parent))
+                else:
+                    # Whole chip in use: all of its subslices are too.
+                    taken.add((*key, f"{name}-ss*"))
         return taken
+
+    def _is_taken(self, taken: Set[Tuple[str, str, str]], driver: str,
+                  pool: str, name: str) -> bool:
+        if (driver, pool, name) in taken:
+            return True
+        parent = self._parent_of(name)
+        if parent != name and (driver, pool, f"{parent}-ss*") in taken:
+            return True  # parent chip wholly claimed
+        return False
 
     def _allocate(self, claim: Dict, node: str,
                   taken: Set[Tuple[str, str, str]]) -> Optional[Dict]:
@@ -234,6 +262,9 @@ class Scheduler:
                 return None
             for dev in picked:
                 taken.add((driver, node, dev))
+                parent = self._parent_of(dev)
+                taken.add((driver, node, parent) if parent != dev
+                          else (driver, node, f"{dev}-ss*"))
                 results.append({"request": req["name"], "driver": driver,
                                 "pool": node, "device": dev})
         if not results:
@@ -269,7 +300,7 @@ class Scheduler:
                 attrs = dev.get("attributes") or {}
                 if (attrs.get("type") or {}).get("string") != dev_type:
                     continue
-                if (driver, node, dev["name"]) in taken:
+                if self._is_taken(taken, driver, node, dev["name"]):
                     continue
                 available.append(dev["name"])
         if len(available) < count:
