@@ -286,6 +286,18 @@ int main(int argc, char** argv) {
     }
   }
   if (check) return RunCheck(check_port);
+
+  // Handlers before any config I/O (and only on the run path — the
+  // --check probe keeps default dispositions so Ctrl-C still kills it):
+  // the wrapper's update loop may SIGUSR1 us the moment we exist, and the
+  // default disposition for SIGUSR1 is process death. Observed in the
+  // wild as "child exited unexpectedly (rc=-10)" during startup
+  // (BENCH_r03). Reference keeps the same ordering discipline in its
+  // daemon wrapper (cmd/compute-domain-daemon/process.go:170-203).
+  signal(SIGTERM, OnSignal);
+  signal(SIGINT, OnSignal);
+  signal(SIGUSR1, OnSignal);
+
   if (config_path.empty()) {
     fprintf(stderr, "tpu-slice-daemon: --config required\n");
     return 2;
@@ -297,10 +309,6 @@ int main(int argc, char** argv) {
             config_path.c_str());
     return 1;
   }
-
-  signal(SIGTERM, OnSignal);
-  signal(SIGINT, OnSignal);
-  signal(SIGUSR1, OnSignal);
 
   Daemon d(cfg);
   if (!d.Start()) {

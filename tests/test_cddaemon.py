@@ -197,9 +197,65 @@ class TestProcessManager:
             pid1 = pm._proc.pid
             pm.restart()
             assert pm._proc.pid != pid1
+            pm.mark_ready()
             pm.signal(signal.SIGUSR1)  # sleep dies on SIGUSR1
             time.sleep(0.1)
             assert pm._proc.poll() is not None
+        finally:
+            pm.stop()
+
+    def test_signal_held_until_ready(self):
+        """A signal sent before the child is confirmed ready must not be
+        delivered (the BENCH_r03 rc=-10 startup race): `sleep` has no
+        SIGUSR1 handler, so surviving the signal proves it was held; dying
+        after mark_ready() proves the held signal was then delivered."""
+        pm = ProcessManager(["sleep", "60"], watchdog_interval=10)
+        pm.ensure_started()
+        try:
+            pm.signal(signal.SIGUSR1)
+            pm.signal(signal.SIGUSR1)  # coalesced, not queued twice
+            time.sleep(0.2)
+            assert pm.running(), "pre-ready signal reached the child"
+            pm.mark_ready()
+            deadline = time.monotonic() + 2
+            while time.monotonic() < deadline and pm._proc.poll() is None:
+                time.sleep(0.05)
+            assert pm._proc.poll() is not None, "held signal never delivered"
+        finally:
+            pm.stop()
+
+    def test_stale_probe_cannot_confirm_restarted_child(self):
+        """A READY probe answered by child A must not confirm child B
+        spawned after the probe (mark_ready pid guard): confirming B from
+        A's probe would flush held signals into B's exec window."""
+        pm = ProcessManager(["sleep", "60"], watchdog_interval=10)
+        pm.ensure_started()
+        try:
+            stale_pid = pm.pid()
+            pm.restart()
+            pm.mark_ready(stale_pid)  # stale confirmation: ignored
+            pm.signal(signal.SIGUSR1)
+            time.sleep(0.2)
+            assert pm.running(), "stale probe confirmed the new child"
+            pm.mark_ready(pm.pid())  # fresh confirmation delivers the hold
+            deadline = time.monotonic() + 2
+            while time.monotonic() < deadline and pm._proc.poll() is None:
+                time.sleep(0.05)
+            assert pm._proc.poll() is not None
+        finally:
+            pm.stop()
+
+    def test_restart_rearms_signal_hold(self):
+        """_spawn_locked resets the ready confirmation: signals after a
+        restart are held again until the next mark_ready()."""
+        pm = ProcessManager(["sleep", "60"], watchdog_interval=10)
+        pm.ensure_started()
+        try:
+            pm.mark_ready()
+            pm.restart()
+            pm.signal(signal.SIGUSR1)
+            time.sleep(0.2)
+            assert pm.running(), "post-restart signal was not held"
         finally:
             pm.stop()
 
@@ -254,6 +310,38 @@ class TestNativeDaemon:
         finally:
             pm_a.stop()
             pm_b.stop()
+
+    def test_startup_signal_hammer(self, tmp_path):
+        """Hammer ensure_started + SIGUSR1 (the membership-change nudge)
+        in a loop: the daemon must never die to its own reload signal.
+        Reproduces the BENCH_r03 startup race — SIGUSR1 landing before
+        slice_daemon.cc installed its handler killed the child (rc=-10)
+        and cost a watchdog restart. Fixed on both sides: handlers are the
+        first statement of main(), and ProcessManager holds signals until
+        the first READY probe confirms the child."""
+        for i in range(10):
+            port = free_port()
+            sub = tmp_path / f"h{i}"
+            sub.mkdir()
+            pm = ProcessManager(
+                [DAEMON_BIN, "--config", self._write_cfg(sub, port)],
+                watchdog_interval=0.05)
+            pm.ensure_started()
+            try:
+                # Immediately nudge, as the update loop does when the CD
+                # membership lands before the daemon has booted.
+                for _ in range(3):
+                    pm.signal(signal.SIGUSR1)
+                assert self._wait_ready(port), f"iteration {i}: never READY"
+                pm.mark_ready()  # flushes held signals into the live child
+                pm.signal(signal.SIGUSR1)
+                time.sleep(0.1)
+                assert pm.running(), f"iteration {i}: daemon died"
+                assert pm.restarts == 0, (
+                    f"iteration {i}: watchdog restarted ({pm.restarts}x) — "
+                    "startup signal race regressed")
+            finally:
+                pm.stop()
 
     def test_idle_client_does_not_wedge_probes(self, tmp_path):
         """A connected-but-silent client (port scanner, stalled TCP) must

@@ -220,7 +220,16 @@ class DaemonRunner:
         """Probe the local daemon and mirror readiness into the per-node CD
         status (the PodManager startup-probe mirror, podmanager.go:35-120)."""
         while not self._stop.wait(1.0):
+            probed_pid = self.process.pid()
             ready = probe_ready(self.ns.port)
+            if ready:
+                # Unblocks held SIGUSR1s (process.py): the native daemon
+                # answered a probe, so its signal handlers are installed.
+                # Every tick, not on-change: a watchdog restart resets the
+                # hold and the port coming back looks like no change. The
+                # pid snapshot stops a probe answered by a since-restarted
+                # child from confirming its replacement mid-exec.
+                self.process.mark_ready(probed_pid)
             if ready != self._last_ready:
                 try:
                     self.cd.set_node_status(ready)

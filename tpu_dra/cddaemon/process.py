@@ -27,6 +27,13 @@ class ProcessManager:
         self._stop = threading.Event()
         self._watchdog: Optional[threading.Thread] = None
         self.restarts = 0
+        # Non-fatal signals are held until the child is confirmed alive
+        # (mark_ready(), driven by the wrapper's first successful READY
+        # probe): a SIGUSR1 delivered in the exec->handler-install window
+        # kills the child with its default disposition. Observed as
+        # "child exited unexpectedly (rc=-10)" in BENCH_r03.
+        self._confirmed_ready = False
+        self._pending_signals: List[int] = []
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -45,6 +52,7 @@ class ProcessManager:
 
     def _spawn_locked(self) -> None:
         log.info("starting: %s", " ".join(self._argv))
+        self._confirmed_ready = False
         self._proc = subprocess.Popen(self._argv)
 
     def stop(self, grace: float = 5.0) -> None:
@@ -79,10 +87,47 @@ class ProcessManager:
                 self.restarts += 1
 
     def signal(self, sig: int = signal.SIGUSR1) -> None:
-        """Forward a signal (SIGUSR1 = re-resolve peers, main.go:368)."""
+        """Forward a signal (SIGUSR1 = re-resolve peers, main.go:368).
+
+        Held (coalesced) until mark_ready() if the current child has not
+        yet been confirmed ready; a membership-change nudge is idempotent,
+        so one deferred delivery is equivalent to many.
+        """
         with self._lock:
+            if not self._confirmed_ready:
+                if sig not in self._pending_signals:
+                    self._pending_signals.append(sig)
+                return
             if self._proc is not None and self._proc.poll() is None:
                 self._proc.send_signal(sig)
+
+    def pid(self) -> Optional[int]:
+        """Pid of the current child, or None. Snapshot this *before* a
+        readiness probe and pass it to mark_ready() so a probe answered by
+        a child that has since been restarted cannot confirm its
+        replacement."""
+        with self._lock:
+            return None if self._proc is None else self._proc.pid
+
+    def mark_ready(self, pid: Optional[int] = None) -> None:
+        """The child answered its readiness probe: safe to deliver held
+        signals (its handlers are necessarily installed by then).
+
+        ``pid``: the pid() snapshot taken before the probe. If the child
+        has been replaced since (watchdog restart), the confirmation is
+        stale — ignoring it keeps held signals out of the new child's
+        exec window, which is the exact race this hold exists to close.
+        """
+        with self._lock:
+            if self._proc is None:
+                return
+            if pid is not None and self._proc.pid != pid:
+                return
+            self._confirmed_ready = True
+            pending, self._pending_signals = self._pending_signals, []
+            for sig in pending:
+                if self._proc.poll() is None:
+                    self._proc.send_signal(sig)
 
     def running(self) -> bool:
         with self._lock:
