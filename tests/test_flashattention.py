@@ -6,6 +6,9 @@ import numpy as np
 import pytest
 
 from tpu_dra.workloads.flashattention import attend, flash_attention
+from tpu_dra.workloads.model import (
+    ModelConfig, TransformerLM, init_params, loss_fn,
+)
 from tpu_dra.workloads.ringattention import reference_attention
 
 
@@ -39,14 +42,133 @@ class TestFlashAttention:
                                    np.asarray(got, np.float32),
                                    rtol=5e-2, atol=5e-2)
 
-    def test_rejects_indivisible_seq(self):
-        q, k, v = _qkv(s=192)
-        with pytest.raises(ValueError, match="not divisible"):
-            flash_attention(q, k, v, block_q=128, block_k=128)
-
     def test_attend_dispatch_cpu_falls_back(self):
         q, k, v = _qkv(s=64)
         want = reference_attention(q, k, v)
         got = attend(q, k, v)
         np.testing.assert_allclose(np.asarray(want), np.asarray(got),
                                    rtol=2e-5, atol=2e-5)
+
+    def test_causal_pad_to_block(self):
+        """Indivisible causal seq lens are zero-padded, exactly: the
+        train path runs S = max_seq - 1 after the label shift."""
+        q, k, v = _qkv(s=200, seed=5)
+        want = reference_attention(q, k, v, causal=True)
+        got = flash_attention(q, k, v, causal=True, block_q=128,
+                              block_k=128, interpret=True)
+        assert got.shape == want.shape
+        np.testing.assert_allclose(np.asarray(want), np.asarray(got),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_noncausal_pad_still_rejected(self):
+        q, k, v = _qkv(s=200)
+        with pytest.raises(ValueError, match="not divisible"):
+            flash_attention(q, k, v, causal=False, block_q=128, block_k=128)
+
+    @pytest.mark.parametrize("s", [57, 255, 300])
+    def test_causal_pad_lane_aligns_any_length(self, s):
+        """Causal seqs lane-align before block-clamping (Mosaic wants
+        8/128-aligned block dims): default blocks, any length, exact."""
+        q, k, v = _qkv(s=s, seed=s)
+        want = reference_attention(q, k, v, causal=True)
+        got = flash_attention(q, k, v, causal=True, interpret=True)
+        assert got.shape == want.shape
+        np.testing.assert_allclose(np.asarray(want), np.asarray(got),
+                                   rtol=2e-5, atol=2e-5)
+
+
+class TestFlashBackward:
+    """Custom-VJP backward kernels vs autodiff of the reference."""
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_grads_match_reference(self, causal):
+        q, k, v = _qkv(s=256, seed=7)
+
+        def ref_loss(q, k, v):
+            out = reference_attention(q, k, v, causal=causal)
+            return jnp.sum(out * jnp.cos(out))  # non-trivial cotangent
+
+        def flash_loss(q, k, v):
+            out = flash_attention(q, k, v, causal=causal, interpret=True)
+            return jnp.sum(out * jnp.cos(out))
+
+        want = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+        got = jax.grad(flash_loss, argnums=(0, 1, 2))(q, k, v)
+        for name, w, g in zip("qkv", want, got):
+            np.testing.assert_allclose(
+                np.asarray(w), np.asarray(g), rtol=2e-4, atol=2e-4,
+                err_msg=f"d{name} mismatch")
+
+    def test_grads_with_padding(self):
+        q, k, v = _qkv(s=200, seed=9)
+
+        def ref_loss(q, k, v):
+            return jnp.sum(reference_attention(q, k, v, causal=True) ** 2)
+
+        def flash_loss(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=True,
+                                           interpret=True) ** 2)
+
+        want = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+        got = jax.grad(flash_loss, argnums=(0, 1, 2))(q, k, v)
+        for name, w, g in zip("qkv", want, got):
+            np.testing.assert_allclose(
+                np.asarray(w), np.asarray(g), rtol=2e-4, atol=2e-4,
+                err_msg=f"d{name} mismatch")
+
+    def test_grads_bf16(self):
+        q, k, v = _qkv(s=256, dtype=jnp.bfloat16, seed=11)
+
+        def mk(impl_fn):
+            def loss(q, k, v):
+                return jnp.sum(impl_fn(q, k, v).astype(jnp.float32) ** 2)
+            return jax.grad(loss, argnums=(0, 1, 2))
+
+        want = mk(lambda q, k, v: reference_attention(q, k, v))(q, k, v)
+        got = mk(lambda q, k, v: flash_attention(
+            q, k, v, interpret=True))(q, k, v)
+        for name, w, g in zip("qkv", want, got):
+            assert g.dtype == jnp.bfloat16
+            np.testing.assert_allclose(
+                np.asarray(w, np.float32), np.asarray(g, np.float32),
+                rtol=8e-2, atol=8e-2, err_msg=f"d{name} mismatch")
+
+
+class TestModelParity:
+    """Model-level parity: the flagship TransformerLM with the flash
+    kernel vs the jnp reference path — logits and grads (VERDICT r3 #2)."""
+
+    def _cfg(self, impl):
+        return ModelConfig(vocab=128, d_model=64, n_heads=2, n_layers=2,
+                           d_ff=128, max_seq=256, attn_impl=impl)
+
+    def test_logits_and_loss_parity_bf16(self):
+        cfg_f = self._cfg("flash_interpret")
+        cfg_r = self._cfg("reference")
+        params = init_params(jax.random.PRNGKey(0), cfg_f)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 256), 0,
+                                    cfg_f.vocab)
+        # max_seq-1 after the label shift: exercises the causal pad path.
+        logits_f = np.asarray(
+            TransformerLM(cfg_f).forward(params, tokens[:, :-1]))
+        logits_r = np.asarray(
+            TransformerLM(cfg_r).forward(params, tokens[:, :-1]))
+        rel = (np.linalg.norm(logits_f - logits_r)
+               / np.linalg.norm(logits_r))
+        assert rel <= 1e-2, f"flash vs reference logits rel err {rel}"
+
+    def test_grad_parity_bf16(self):
+        cfg_f = self._cfg("flash_interpret")
+        cfg_r = self._cfg("reference")
+        params = init_params(jax.random.PRNGKey(0), cfg_f)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 256), 0,
+                                    cfg_f.vocab)
+        gf = jax.grad(lambda p: loss_fn(TransformerLM(cfg_f), p, tokens))(
+            params)
+        gr = jax.grad(lambda p: loss_fn(TransformerLM(cfg_r), p, tokens))(
+            params)
+        flat_f, flat_r = jax.tree.leaves(gf), jax.tree.leaves(gr)
+        for wf, wr in zip(flat_f, flat_r):
+            scale = max(float(jnp.abs(wr).max()), 1e-6)
+            rel = float(jnp.abs(wf - wr).max()) / scale
+            assert rel <= 2e-2, f"grad rel err {rel} (shape {wf.shape})"

@@ -103,6 +103,11 @@ class DeviceState:
         self._cdi.create_standard_device_spec_file(backend.chips())
         self._checkpoint = self._ckpt_mgr.load_or_init()
 
+    def chip_indices(self) -> List[int]:
+        """Indices of all chips on this node (board-level health events
+        address every chip; the driver must not reach into _backend)."""
+        return [c.index for c in self._backend.chips()]
+
     # ------------------------------------------------------------------
     # Prepare
     # ------------------------------------------------------------------

@@ -152,8 +152,8 @@ class TpuDriver(DriverCallbacks):
         else:
             # chip_index < 0 addresses all chips (board-level record).
             affected = []
-            for chip in self._state._backend.chips():
-                affected += mark(chip.index)
+            for index in self._state.chip_indices():
+                affected += mark(index)
         if recovered:
             if not affected:
                 return  # chip was never yanked: nothing to republish

@@ -1,17 +1,25 @@
-"""Pallas flash-attention forward for TPU (the model's hot op).
+"""Pallas flash attention (fwd + bwd) for TPU — the model's hot op.
 
-Tiled causal attention: the [S, S] score matrix never materializes in HBM.
-Grid is (batch*heads, q_blocks); each program streams K/V blocks for one
-Q tile through VMEM with the online-softmax recurrence, accumulating in
-fp32 while matmuls run bf16/f32 on the MXU.
+Tiled causal attention: the [S, S] score matrix never materializes in HBM,
+in either direction. Forward streams K/V blocks for one Q tile through VMEM
+with the online-softmax recurrence and saves the per-row logsumexp; the
+custom-VJP backward recomputes probabilities tile-by-tile from (q, k, lse)
+— the flash-attention recompute trick — so the backward is two more tiled
+kernels (dq; dk/dv) instead of an O(S^2) HBM round trip.
 
 Design (pallas_guide.md): blocks sized to MXU/VREG tiling (128 lanes),
-`lax.fori_loop` over K/V blocks with a causal upper bound computed from the
-program id (no wasted blocks above the diagonal), fp32 scratch accumulators
-in VMEM, `interpret=True` path so numerics are testable on CPU.
+`lax.fori_loop` over blocks with causal bounds computed from the program id
+(no wasted blocks above/below the diagonal), fp32 accumulators, matmuls on
+the MXU, `interpret=True` path so numerics are testable on CPU.
 
 `attend()` picks this kernel on TPU and the plain jnp reference elsewhere,
-so the workload model runs everywhere and is fast where it matters.
+so the workload model runs everywhere and is fast where it matters. Causal
+inputs whose length is not a lane multiple are zero-padded on the right —
+exact for causal masking (padded keys sit above every real diagonal) — so
+the training path (seq-1 positions after label shift) stays on the kernel.
+
+This is the perf surface of the flagship workload (the analog of the
+reference's NCCL/nvbandwidth numbers, tests/bats/test_cd_mnnvl_workload.bats).
 """
 
 from __future__ import annotations
@@ -24,19 +32,36 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 NEG_INF = -1e30
+LANES = 128
+# Swept on v5e at the flagship shape (B8 S1024 H16 D128): grad-path time
+# 128->11.9ms, 256->7.6ms, 512->8.4ms. 256 balances MXU occupancy per
+# program against causal-block wastage; the jnp reference grad was 11.6ms.
+DEFAULT_BLOCK = 256
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, seq_len: int,
-                  causal: bool, sm_scale: float):
+def _dot(a, b, *, trans_b: bool = False, trans_a: bool = False):
+    """Matmul in the operands' own dtype (bf16 stays bf16 — the MXU's
+    fast path; fp32 operands would quarter v5e throughput) with fp32
+    accumulation."""
+    ca = 0 if trans_a else a.ndim - 1
+    cb = 1 if trans_b else 0
+    return jax.lax.dot_general(a, b, (((ca,), (cb,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
+                seq_len: int, causal: bool, sm_scale: float):
     """One Q tile vs all (needed) K/V tiles.
 
-    Refs (VMEM): q [block_q, d]; k, v [seq_len, d]; o [block_q, d].
+    Refs (VMEM): q [block_q, d]; k, v [seq_len, d]; o [block_q, d];
+    lse [1, block_q] fp32 — the per-row logsumexp saved for the backward.
+    (lse/delta ride a [BH, 1, S] layout: Mosaic requires a block's last
+    two dims to be (8k, 128m) or full-size, and (1, block_q) qualifies.)
     """
     block_q, d = q_ref.shape
-    q_block_idx = pl.program_id(1)
-    q_start = q_block_idx * block_q
+    q_start = pl.program_id(1) * block_q
 
-    q = q_ref[...].astype(jnp.float32) * sm_scale
+    q = q_ref[...]  # native dtype: scores matmul rides the bf16 MXU path
 
     acc = jnp.zeros((block_q, d), jnp.float32)
     row_max = jnp.full((block_q,), NEG_INF, jnp.float32)
@@ -53,9 +78,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, seq_len: int,
     def body(kb, carry):
         acc, row_max, denom = carry
         k_start = kb * block_k
-        k_blk = k_ref[pl.dslice(k_start, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[pl.dslice(k_start, block_k), :].astype(jnp.float32)
-        scores = q @ k_blk.T  # [block_q, block_k] on the MXU
+        k_blk = k_ref[pl.dslice(k_start, block_k), :]
+        v_blk = v_ref[pl.dslice(k_start, block_k), :]
+        scores = _dot(q, k_blk, trans_b=True) * sm_scale  # fp32 [bq, bk]
         if causal:
             q_pos = q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -66,55 +91,267 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, seq_len: int,
         new_max = jnp.maximum(row_max, blk_max)
         correction = jnp.exp(row_max - new_max)
         p = jnp.exp(scores - new_max[:, None])
-        acc = acc * correction[:, None] + p @ v_blk
+        acc = acc * correction[:, None] + _dot(p.astype(v_blk.dtype), v_blk)
         denom = denom * correction + jnp.sum(p, axis=1)
         return acc, new_max, denom
 
     acc, row_max, denom = jax.lax.fori_loop(0, last, body,
                                             (acc, row_max, denom))
     o_ref[...] = (acc / denom[:, None]).astype(o_ref.dtype)
+    lse_ref[0, :] = row_max + jnp.log(denom)
 
 
-def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 128,
-                    block_k: int = 128, interpret: bool = False):
-    """q, k, v: [B, S, H, D] -> [B, S, H, D]. S must divide by the blocks
-    (pad upstream; the workload model uses power-of-two seq lens)."""
-    b, s, h, d = q.shape
-    block_q = min(block_q, s)
-    block_k = min(block_k, s)
-    if s % block_q or s % block_k:
-        raise ValueError(f"seq len {s} not divisible by blocks "
-                         f"({block_q}, {block_k})")
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   *, block_k: int, seq_len: int, causal: bool,
+                   sm_scale: float):
+    """dQ for one Q tile: stream K/V tiles, recompute P from (q, k, lse).
+
+    dS_ij = P_ij * (dO_i . V_j - delta_i);  dQ_i = sm_scale * sum_j dS_ij K_j
+    where delta_i = dO_i . O_i (precomputed outside, one fused reduce).
+    """
+    block_q, d = q_ref.shape
+    q_start = pl.program_id(1) * block_q
+
+    q = q_ref[...]
+    do = do_ref[...]
+    lse = lse_ref[0, :].astype(jnp.float32)
+    delta = delta_ref[0, :].astype(jnp.float32)
+
+    num_k_blocks = seq_len // block_k
+    if causal:
+        last = jnp.minimum(num_k_blocks,
+                           (q_start + block_q + block_k - 1) // block_k)
+    else:
+        last = num_k_blocks
+
+    def body(kb, acc):
+        k_start = kb * block_k
+        k_blk = k_ref[pl.dslice(k_start, block_k), :]
+        v_blk = v_ref[pl.dslice(k_start, block_k), :]
+        scores = _dot(q, k_blk, trans_b=True) * sm_scale
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            scores = jnp.where(q_pos >= k_pos, scores, NEG_INF)
+        p = jnp.exp(scores - lse[:, None])  # masked entries exp(-inf) = 0
+        dp = _dot(do, v_blk, trans_b=True)
+        ds = p * (dp - delta[:, None])
+        return acc + _dot(ds.astype(k_blk.dtype), k_blk)
+
+    acc = jax.lax.fori_loop(0, last, body, jnp.zeros((block_q, d),
+                                                     jnp.float32))
+    dq_ref[...] = (acc * sm_scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, block_q: int, seq_len: int,
+                    causal: bool, sm_scale: float):
+    """dK/dV for one K/V tile: stream Q/dO tiles from the diagonal down.
+
+    dV_j = sum_i P_ij dO_i;  dK_j = sm_scale * sum_i dS_ij Q_i.
+    """
+    block_k, d = k_ref.shape
+    k_start = pl.program_id(1) * block_k
+
+    k_t = k_ref[...]
+    v_t = v_ref[...]
+
+    num_q_blocks = seq_len // block_q
+    # Causal: Q blocks strictly left of this K tile's diagonal see none of it.
+    first = k_start // block_q if causal else 0
+
+    def body(qb, carry):
+        dk_acc, dv_acc = carry
+        q_start = qb * block_q
+        q_blk = q_ref[pl.dslice(q_start, block_q), :]
+        do_blk = do_ref[pl.dslice(q_start, block_q), :]
+        lse_blk = lse_ref[0, pl.dslice(q_start, block_q)].astype(jnp.float32)
+        delta_blk = delta_ref[0, pl.dslice(q_start, block_q)].astype(
+            jnp.float32)
+        scores = _dot(q_blk, k_t, trans_b=True) * sm_scale  # [bq, bk] fp32
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            scores = jnp.where(q_pos >= k_pos, scores, NEG_INF)
+        p = jnp.exp(scores - lse_blk[:, None])
+        p_cast = p.astype(do_blk.dtype)
+        dv_acc = dv_acc + _dot(p_cast, do_blk, trans_a=True)  # p^T dO
+        dp = _dot(do_blk, v_t, trans_b=True)
+        ds = p * (dp - delta_blk[:, None])
+        dk_acc = dk_acc + _dot(ds.astype(q_blk.dtype), q_blk, trans_a=True)
+        return dk_acc, dv_acc
+
+    dk_acc, dv_acc = jax.lax.fori_loop(
+        first, num_q_blocks, body,
+        (jnp.zeros((block_k, d), jnp.float32),
+         jnp.zeros((block_k, d), jnp.float32)))
+    dk_ref[...] = (dk_acc * sm_scale).astype(dk_ref.dtype)
+    dv_ref[...] = dv_acc.astype(dv_ref.dtype)
+
+
+def _fwd_call(q, k, v, causal, block_q, block_k, interpret):
+    """q, k, v: [BH, S, D] -> (out [BH, S, D], lse [BH, S] fp32)."""
+    bh, s, d = q.shape
     sm_scale = 1.0 / math.sqrt(d)
+    kernel = functools.partial(_fwd_kernel, block_k=block_k, seq_len=s,
+                               causal=causal, sm_scale=sm_scale)
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, s // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda b, qi: (b, qi, 0)),
+            pl.BlockSpec((None, s, d), lambda b, qi: (b, 0, 0)),
+            pl.BlockSpec((None, s, d), lambda b, qi: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_q, d), lambda b, qi: (b, qi, 0)),
+            pl.BlockSpec((None, 1, block_q), lambda b, qi: (b, 0, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, 1, s), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    out, _ = _fwd_call(q, k, v, causal, block_q, block_k, interpret)
+    return out
+
+
+def _flash_fwd_rule(q, k, v, causal, block_q, block_k, interpret):
+    out, lse = _fwd_call(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd_rule(causal, block_q, block_k, interpret, res, dout):
+    q, k, v, out, lse = res
+    bh, s, d = q.shape
+    sm_scale = 1.0 / math.sqrt(d)
+    # delta_i = dO_i . O_i: one fused elementwise+reduce in HBM; tiny next
+    # to the matmuls and XLA fuses it with the incoming cotangent.
+    # [BH, 1, S] like lse (Mosaic block-shape constraint, see _fwd_kernel).
+    delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)[:, None, :]
+
+    dq_kernel = functools.partial(_bwd_dq_kernel, block_k=block_k,
+                                  seq_len=s, causal=causal,
+                                  sm_scale=sm_scale)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(bh, s // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda b, qi: (b, qi, 0)),
+            pl.BlockSpec((None, s, d), lambda b, qi: (b, 0, 0)),
+            pl.BlockSpec((None, s, d), lambda b, qi: (b, 0, 0)),
+            pl.BlockSpec((None, block_q, d), lambda b, qi: (b, qi, 0)),
+            pl.BlockSpec((None, 1, block_q), lambda b, qi: (b, 0, qi)),
+            pl.BlockSpec((None, 1, block_q), lambda b, qi: (b, 0, qi)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda b, qi: (b, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        interpret=interpret,
+    )(q, k, v, dout, lse, delta)
+
+    dkv_kernel = functools.partial(_bwd_dkv_kernel, block_q=block_q,
+                                   seq_len=s, causal=causal,
+                                   sm_scale=sm_scale)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(bh, s // block_k),
+        in_specs=[
+            pl.BlockSpec((None, s, d), lambda b, ki: (b, 0, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, ki: (b, ki, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, ki: (b, ki, 0)),
+            pl.BlockSpec((None, s, d), lambda b, ki: (b, 0, 0)),
+            pl.BlockSpec((None, 1, s), lambda b, ki: (b, 0, 0)),
+            pl.BlockSpec((None, 1, s), lambda b, ki: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_k, d), lambda b, ki: (b, ki, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, ki: (b, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, s, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(q, k, v, dout, lse, delta)
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(q, k, v, *, causal: bool = True,
+                    block_q: int = DEFAULT_BLOCK,
+                    block_k: int = DEFAULT_BLOCK, interpret: bool = False):
+    """q, k, v: [B, S, H, D] -> [B, S, H, D]. Differentiable (custom VJP
+    with tiled backward kernels). Causal inputs are zero-padded up to the
+    block size — exact, since padded keys are above every real row's
+    diagonal and padded rows are sliced off; non-causal S must divide by
+    the blocks (padded keys would shift its softmax)."""
+    b, s, h, d = q.shape
+    if causal:
+        # Lane-align first (Mosaic tiling wants 8/128-aligned or full-size
+        # block dims), then block-align so the grid divides evenly.
+        s_eff = s + (-s) % LANES
+        block_q = min(block_q, s_eff)
+        block_k = min(block_k, s_eff)
+        lcm = block_q * block_k // math.gcd(block_q, block_k)
+        pad = (s_eff + (-s_eff) % lcm) - s
+    else:
+        block_q = min(block_q, s)
+        block_k = min(block_k, s)
+        if s % block_q or s % block_k:
+            raise ValueError(f"seq len {s} not divisible by blocks "
+                             f"({block_q}, {block_k})")
+        pad = 0
+    if pad:
+        zeros = ((0, 0), (0, pad), (0, 0), (0, 0))
+        q, k, v = (jnp.pad(x, zeros) for x in (q, k, v))
+        s += pad
 
     # [B,S,H,D] -> [B*H, S, D]: one grid row per (batch, head).
     def to_bh(x):
         return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, s, d)
 
-    qb, kb, vb = to_bh(q), to_bh(k), to_bh(v)
-
-    kernel = functools.partial(_flash_kernel, block_k=block_k, seq_len=s,
-                               causal=causal, sm_scale=sm_scale)
-    out = pl.pallas_call(
-        kernel,
-        grid=(b * h, s // block_q),
-        in_specs=[
-            pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((None, s, d), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((None, s, d), lambda bh, qi: (bh, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((None, block_q, d),
-                               lambda bh, qi: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
-        interpret=interpret,
-    )(qb, kb, vb)
-    return jnp.transpose(out.reshape(b, h, s, d), (0, 2, 1, 3))
+    out = _flash(to_bh(q), to_bh(k), to_bh(v), causal, block_q, block_k,
+                 interpret)
+    out = jnp.transpose(out.reshape(b, h, s, d), (0, 2, 1, 3))
+    return out[:, :s - pad] if pad else out
 
 
-def attend(q, k, v, *, causal: bool = True):
-    """Dispatch: pallas kernel on TPU, jnp reference elsewhere."""
-    on_tpu = any(d.platform == "tpu" for d in jax.devices())
-    if on_tpu and q.shape[1] >= 128 and q.shape[1] % 128 == 0:
-        return flash_attention(q, k, v, causal=causal)
+def attend(q, k, v, *, causal: bool = True, impl: str = "auto"):
+    """Attention entrypoint for the workload models.
+
+    impl: "auto" (pallas kernel on TPU, jnp reference elsewhere),
+    "flash" (force the kernel), "flash_interpret" (kernel in interpret
+    mode — CPU-testable numerics), "reference" (plain jnp).
+    """
     from tpu_dra.workloads.ringattention import reference_attention
-    return reference_attention(q, k, v, causal=causal)
+    if impl == "reference":
+        return reference_attention(q, k, v, causal=causal)
+    if impl == "auto":
+        on_tpu = any(dev.platform == "tpu" for dev in jax.devices())
+        if not (on_tpu and q.shape[1] >= LANES):
+            return reference_attention(q, k, v, causal=causal)
+        if not causal:
+            # Non-causal can't be zero-padded (padded keys would shift the
+            # softmax): kernel only when a block size divides S evenly.
+            for blk in (DEFAULT_BLOCK, LANES):
+                if q.shape[1] % blk == 0:
+                    return flash_attention(q, k, v, causal=False,
+                                           block_q=blk, block_k=blk)
+            return reference_attention(q, k, v, causal=False)
+        return flash_attention(q, k, v, causal=True)
+    if impl in ("flash", "flash_interpret"):
+        return flash_attention(q, k, v, causal=causal,
+                               interpret=impl == "flash_interpret")
+    raise ValueError(f"unknown attention impl {impl!r}")

