@@ -1,0 +1,62 @@
+#!/usr/bin/env bash
+# Create a GKE cluster for the TPU DRA driver: a CPU default pool for the
+# control-plane components (controller, webhook) plus a TPU v5e nodepool
+# the kubelet plugins land on.
+#
+# Reference analog: demo/clusters/gke/create-cluster.sh (GPU A100 pool +
+# driver-installation DaemonSet). TPU-native differences: TPU slices are
+# provisioned as nodepools with a fixed chip topology (no driver installer
+# DaemonSet — libtpu ships on the node image), and DRA needs the
+# resource.k8s.io APIs enabled on the control plane.
+#
+# Environment knobs (all optional):
+#   PROJECT_ID     gcloud project   (default: current gcloud config)
+#   CLUSTER_NAME   default tpu-dra-driver-cluster
+#   REGION         default us-central2   (v5e availability)
+#   ZONE           default ${REGION}-b
+#   CLUSTER_VERSION  GKE version with DRA support (default 1.34)
+#   TPU_MACHINE    default ct5lp-hightpu-4t  (single-host, 4 chips)
+#   TPU_TOPOLOGY   default 2x2               (matches ct5lp-hightpu-4t)
+#   TPU_NODES      default 4  (4 x 4-chip hosts = a v5e-16 slice for the
+#                              ComputeDomain / cd-allreduce demos)
+set -euo pipefail
+
+: "${PROJECT_ID:=$(gcloud config list --format 'value(core.project)' 2>/dev/null)}"
+if [ -z "${PROJECT_ID}" ]; then
+  echo "PROJECT_ID not set and no gcloud default project configured" >&2
+  echo "run: gcloud config set project <your-project>" >&2
+  exit 1
+fi
+
+CLUSTER_NAME=${CLUSTER_NAME:-tpu-dra-driver-cluster}
+REGION=${REGION:-us-central2}
+ZONE=${ZONE:-${REGION}-b}
+CLUSTER_VERSION=${CLUSTER_VERSION:-1.34}
+TPU_MACHINE=${TPU_MACHINE:-ct5lp-hightpu-4t}
+TPU_TOPOLOGY=${TPU_TOPOLOGY:-2x2}
+TPU_NODES=${TPU_NODES:-4}
+
+echo ">> creating cluster ${CLUSTER_NAME} (${ZONE}, GKE ${CLUSTER_VERSION})"
+gcloud container clusters create "${CLUSTER_NAME}" \
+  --project "${PROJECT_ID}" \
+  --zone "${ZONE}" \
+  --cluster-version "${CLUSTER_VERSION}" \
+  --machine-type e2-standard-8 \
+  --num-nodes 2 \
+  --enable-kubernetes-unstable-apis=resource.k8s.io/v1beta1/deviceclasses,resource.k8s.io/v1beta1/resourceclaims,resource.k8s.io/v1beta1/resourceclaimtemplates,resource.k8s.io/v1beta1/resourceslices
+
+echo ">> creating TPU nodepool: ${TPU_NODES} x ${TPU_MACHINE} (topology ${TPU_TOPOLOGY})"
+gcloud container node-pools create tpu-pool \
+  --project "${PROJECT_ID}" \
+  --zone "${ZONE}" \
+  --cluster "${CLUSTER_NAME}" \
+  --machine-type "${TPU_MACHINE}" \
+  --tpu-topology "${TPU_TOPOLOGY}" \
+  --num-nodes "${TPU_NODES}" \
+  --node-taints google.com/tpu=present:NoSchedule
+
+echo ">> fetching credentials"
+gcloud container clusters get-credentials "${CLUSTER_NAME}" \
+  --project "${PROJECT_ID}" --zone "${ZONE}"
+
+echo ">> cluster ready; next: ./install-tpu-dra-driver.sh"

@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Tear down the cluster created by create-cluster.sh.
+# Reference analog: demo/clusters/gke/delete-cluster.sh.
+set -euo pipefail
+
+: "${PROJECT_ID:=$(gcloud config list --format 'value(core.project)' 2>/dev/null)}"
+if [ -z "${PROJECT_ID}" ]; then
+  echo "PROJECT_ID not set and no gcloud default project configured" >&2
+  exit 1
+fi
+
+CLUSTER_NAME=${CLUSTER_NAME:-tpu-dra-driver-cluster}
+REGION=${REGION:-us-central2}
+ZONE=${ZONE:-${REGION}-b}
+
+echo ">> deleting cluster ${CLUSTER_NAME} (${ZONE})"
+gcloud container clusters delete "${CLUSTER_NAME}" \
+  --project "${PROJECT_ID}" --zone "${ZONE}" --quiet
+echo ">> deleted"

@@ -1,0 +1,49 @@
+#!/usr/bin/env bash
+# Install the TPU DRA driver into the current kubectl context (a cluster
+# from create-cluster.sh) with the GKE values overlay.
+#
+# Reference analog: demo/clusters/gke/install-dra-driver-gpu.sh (helm
+# upgrade -i with inline sets). This repo's chart renders identically via
+# helm or the dependency-free hack/render-chart.py; both paths below.
+#
+# Env knobs:
+#   IMAGE_REPO  container image repository (required for a real install;
+#               build from deployments/container/Dockerfile and push to
+#               e.g. an Artifact Registry repo your nodes can pull)
+#   IMAGE_TAG   default "latest"
+#   NAMESPACE   default tpu-dra-driver
+set -euo pipefail
+
+HERE="$(cd "$(dirname "$0")" && pwd)"
+REPO_ROOT="$(cd "${HERE}/../../.." && pwd)"
+NAMESPACE=${NAMESPACE:-tpu-dra-driver}
+IMAGE_REPO=${IMAGE_REPO:?set IMAGE_REPO to a registry path GKE nodes can pull}
+IMAGE_TAG=${IMAGE_TAG:-latest}
+
+kubectl create namespace "${NAMESPACE}" --dry-run=client -o yaml \
+  | kubectl apply -f -
+
+if command -v helm >/dev/null; then
+  helm upgrade -i tpu-dra-driver \
+    "${REPO_ROOT}/deployments/helm/tpu-dra-driver" \
+    --namespace "${NAMESPACE}" \
+    -f "${HERE}/values-gke.yaml" \
+    --set image.repository="${IMAGE_REPO}" \
+    --set image.tag="${IMAGE_TAG}" \
+    --wait
+else
+  python "${REPO_ROOT}/hack/render-chart.py" \
+    -n "${NAMESPACE}" \
+    -f "${HERE}/values-gke.yaml" \
+    --set image.repository="${IMAGE_REPO}" \
+    --set image.tag="${IMAGE_TAG}" \
+    | kubectl apply -f -
+fi
+
+echo ">> waiting for driver pods"
+kubectl rollout status -n "${NAMESPACE}" ds/tpu-dra-driver-kubelet-plugin \
+  --timeout=300s
+kubectl rollout status -n "${NAMESPACE}" deploy/tpu-dra-driver-controller \
+  --timeout=300s
+
+echo ">> installed; try: kubectl apply -f ${REPO_ROOT}/demo/specs/tpu-test1.yaml"

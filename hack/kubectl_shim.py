@@ -83,7 +83,16 @@ def cmd_apply(args) -> int:
             api.create(gvr, doc, namespace=ns)
             verb = "created"
         except AlreadyExistsError:
-            current = api.get(gvr, doc["metadata"]["name"], ns)
+            try:
+                current = api.get(gvr, doc["metadata"]["name"], ns)
+            except NotFoundError:
+                # Exists but not individually addressable (the fake server
+                # has no GET route for some cluster-scoped kinds, e.g.
+                # namespaces): re-apply is a no-op, like kubectl's
+                # "unchanged".
+                print(f"{doc['kind'].lower()}/{doc['metadata']['name']} "
+                      "unchanged")
+                continue
             doc["metadata"]["resourceVersion"] = \
                 current["metadata"].get("resourceVersion")
             api.update(gvr, doc, ns)

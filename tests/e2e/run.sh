@@ -6,7 +6,7 @@
 set -u
 HERE="$(cd "$(dirname "$0")" && pwd)"
 
-SUITES=${E2E_SUITES:-"test_basics test_admission test_tpu_claims test_stress test_multiprocess test_health test_cd_lifecycle test_cd_failover"}
+SUITES=${E2E_SUITES:-"test_basics test_admission test_tpu_claims test_stress test_multiprocess test_health test_debug test_cd_lifecycle test_cd_failover test_updowngrade"}
 
 failed=0
 for s in $SUITES; do

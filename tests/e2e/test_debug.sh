@@ -1,0 +1,128 @@
+#!/usr/bin/env bash
+# Debug/observability surface: SIGUSR2 stack dumps work in a live driver
+# pod, and the chart's LOG_VERBOSITY reaches both the driver pods and the
+# stamped per-CD daemon pods. Reference analogs:
+# tests/bats/test_basics.bats:89-100 (SIGUSR2 goroutine dump),
+# tests/bats/test_cd_logging.bats (verbosity plumb-through).
+source "$(dirname "$0")/helpers.sh"
+
+DRIVER_NS=tpu-dra-driver
+
+plugin_pod() {
+  k get pods -n $DRIVER_NS -o name | sed 's|.*/||' \
+    | grep kubelet-plugin | head -1
+}
+
+wait_until 120 "a kubelet-plugin pod exists" sh -c \
+  '[ -n "$('"${KUBECTL}"' get pods -n tpu-dra-driver -o name | grep kubelet-plugin)" ]'
+POD=$(plugin_pod)
+[ -n "$POD" ] || die "no kubelet-plugin pod"
+
+log "SIGUSR2 produces a thread-stack dump in pod $POD"
+DUMP=/tmp/thread-stacks.dump
+if [ "${E2E_MODE:-sim}" = "kind" ]; then
+  # Real cluster: signal pid 1 inside the container, like the reference.
+  k exec "$POD" -n $DRIVER_NS -c tpu-plugin -- sh -c "rm -f $DUMP" \
+    || die "exec rm failed"
+  k exec "$POD" -n $DRIVER_NS -c tpu-plugin -- sh -c "kill -USR2 1" \
+    || die "exec kill failed"
+  dump_present() {
+    k exec "$POD" -n $DRIVER_NS -c tpu-plugin -- sh -c "test -s $DUMP"
+  }
+else
+  # Sim: the pod's process runs on this host; its pid is published as
+  # containerID sim://<pid> (nodesim._set_status).
+  CID=$(jp pod "$POD" $DRIVER_NS '.status.containerStatuses[0].containerID')
+  case "$CID" in
+    sim://*) PID=${CID#sim://} ;;
+    *) die "unexpected containerID $CID" ;;
+  esac
+  rm -f $DUMP
+  kill -USR2 "$PID" || die "signal failed"
+  dump_present() { test -s $DUMP; }
+fi
+wait_until 30 "stack dump at $DUMP" dump_present
+if [ "${E2E_MODE:-sim}" != "kind" ]; then
+  grep -q "thread" $DUMP || die "dump has no thread stacks"
+fi
+
+log "LOG_VERBOSITY reaches the driver pods"
+env_verbosity() {  # env_verbosity <kind> <name> <ns>  (pod spec or DS template)
+  k get "$1" "$2" -n "$3" -o json | python -c '
+import json, sys
+doc = json.load(sys.stdin)
+spec = doc["spec"]
+if "template" in spec:
+    spec = spec["template"]["spec"]
+for c in spec["containers"]:
+    for e in c.get("env") or []:
+        if e.get("name") == "LOG_VERBOSITY":
+            print(e.get("value", ""))
+            raise SystemExit
+'
+}
+DS_NAME=$(k get ds -n $DRIVER_NS -o name | sed 's|.*/||' \
+  | grep kubelet-plugin | head -1)
+WANT_V=$(env_verbosity ds "$DS_NAME" $DRIVER_NS)
+[ -n "$WANT_V" ] || die "kubelet-plugin DS has no LOG_VERBOSITY env"
+GOT_V=$(env_verbosity pod "$POD" $DRIVER_NS)
+[ "$GOT_V" = "$WANT_V" ] || die "driver pod LOG_VERBOSITY=$GOT_V want $WANT_V"
+
+log "LOG_VERBOSITY reaches stamped CD daemon pods"
+NS=debug-e2e
+CD=debug-cd
+cat <<EOF | k apply -f -
+apiVersion: v1
+kind: Namespace
+metadata:
+  name: $NS
+---
+apiVersion: resource.tpu.dev/v1beta1
+kind: ComputeDomain
+metadata:
+  name: $CD
+  namespace: $NS
+spec:
+  numNodes: 1
+  channel:
+    resourceClaimTemplate:
+      name: ${CD}-channel
+EOF
+
+# The daemon DS only materializes pods on labeled nodes; a channel claim
+# pulls the label. One tiny workload triggers it.
+cat <<EOF | k apply -f -
+apiVersion: v1
+kind: Pod
+metadata:
+  name: dbg-wl
+  namespace: $NS
+spec:
+  restartPolicy: Never
+  nodeName: n0
+  containers:
+  - name: ctr
+    image: x
+    command: ["python", "-c", "import time; time.sleep(300)"]
+    resources:
+      claims: [{name: ch}]
+  resourceClaims:
+  - name: ch
+    resourceClaimTemplateName: ${CD}-channel
+EOF
+
+daemon_pod() {
+  k get pods -n $DRIVER_NS -o name | sed 's|.*/||' \
+    | grep tpu-cd-daemon | head -1
+}
+wait_until 180 "CD daemon pod lands" sh -c '[ -n "$('"${KUBECTL}"' get pods -n tpu-dra-driver -o name | grep tpu-cd-daemon)" ]'
+DPOD=$(daemon_pod)
+GOT_DV=$(env_verbosity pod "$DPOD" $DRIVER_NS)
+[ "$GOT_DV" = "$WANT_V" ] || die "daemon pod LOG_VERBOSITY=$GOT_DV want $WANT_V"
+
+log "teardown"
+k delete pod dbg-wl -n $NS --ignore-not-found >/dev/null 2>&1
+k delete cd $CD -n $NS >/dev/null 2>&1
+wait_until 120 "CD deleted" sh -c "! ${KUBECTL} get cd $CD -n $NS -o name >/dev/null 2>&1"
+
+log "OK test_debug"

@@ -1,0 +1,211 @@
+#!/usr/bin/env bash
+# Chart up/downgrade with state in flight. Reference analog:
+# tests/bats/test_cd_updowngrade.bats:1-65 — upgrade a RUNNING install
+# (new pod templates + re-applied CRD) while claims are prepared and a
+# ComputeDomain is Ready, prove everything survives, then downgrade back.
+#
+# The V1-checkpoint leg forces the on-disk claim checkpoint to the OLD
+# (v1) format before the upgrade, so the restarted plugin exercises the
+# v1 -> latest conversion against real prepared state (checkpoint.py;
+# reference checkpointv.go:9-81) — the unit tier only round-trips it in
+# memory (tests/test_e2e_prepare.py).
+source "$(dirname "$0")/helpers.sh"
+
+DRIVER_NS=tpu-dra-driver
+NS=updown-e2e
+CD=updown-cd
+
+render() {  # render [extra --set flags...]
+  PYTHONPATH="${PYTHONPATH:-$REPO_ROOT}" \
+    python "$REPO_ROOT/hack/render-chart.py" -n $DRIVER_NS "$@"
+}
+
+driver_pods_ready() {
+  all_pods_phase $DRIVER_NS Running || return 1
+  local n c=0 conds
+  n=$(k get pods -n $DRIVER_NS -o name | wc -l)
+  conds=$(k get pods -n $DRIVER_NS -o "jsonpath={.status.conditions[0].status}")
+  for s in $conds; do
+    [ "$s" = "True" ] || return 1
+    c=$((c + 1))
+  done
+  [ "$c" -eq "$n" ]
+}
+
+plugin_has_verbosity() {  # plugin_has_verbosity <v>: every kubelet-plugin pod
+  local want=$1 pods p v
+  pods=$(k get pods -n $DRIVER_NS -o name | sed 's|.*/||' | grep kubelet-plugin)
+  [ -n "$pods" ] || return 1
+  for p in $pods; do
+    v=$(k get pod "$p" -n $DRIVER_NS -o json | python -c '
+import json, sys
+pod = json.load(sys.stdin)
+for c in pod["spec"]["containers"]:
+    for e in c.get("env") or []:
+        if e.get("name") == "LOG_VERBOSITY":
+            print(e.get("value", "")); raise SystemExit
+')
+    [ "$v" = "$want" ] || return 1
+  done
+}
+
+log "preflight: install is up"
+wait_until 120 "driver pods Ready" driver_pods_ready
+
+log "put state in flight: a prepared chip claim + a Ready ComputeDomain"
+cat <<EOF | k apply -f -
+apiVersion: v1
+kind: Namespace
+metadata:
+  name: $NS
+---
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaimTemplate
+metadata:
+  name: one-chip
+  namespace: $NS
+spec:
+  spec:
+    devices:
+      requests:
+      - name: tpu
+        exactly:
+          deviceClassName: tpu.dev
+---
+apiVersion: v1
+kind: Pod
+metadata:
+  name: holder
+  namespace: $NS
+spec:
+  restartPolicy: Never
+  nodeName: n0
+  containers:
+  - name: ctr
+    image: x
+    command: ["python", "-c", "import time; time.sleep(900)"]
+    resources:
+      claims: [{name: tpu}]
+  resourceClaims:
+  - name: tpu
+    resourceClaimTemplateName: one-chip
+---
+apiVersion: resource.tpu.dev/v1beta1
+kind: ComputeDomain
+metadata:
+  name: $CD
+  namespace: $NS
+spec:
+  numNodes: 1
+  channel:
+    resourceClaimTemplate:
+      name: ${CD}-channel
+EOF
+wait_until 60 "workload RCT" k get rct "${CD}-channel" -n $NS -o name
+cat <<EOF | k apply -f -
+apiVersion: v1
+kind: Pod
+metadata:
+  name: cd-wl
+  namespace: $NS
+spec:
+  restartPolicy: Never
+  nodeName: n1
+  containers:
+  - name: ctr
+    image: x
+    command: ["python", "-c", "import time; time.sleep(900)"]
+    resources:
+      claims: [{name: ch}]
+  resourceClaims:
+  - name: ch
+    resourceClaimTemplateName: ${CD}-channel
+EOF
+
+wait_until 120 "holder pod Running" pod_phase_is holder $NS Running
+cd_ready() { [ "$(jp cd $CD $NS .status.status)" = "Ready" ]; }
+wait_until 240 "CD Ready" cd_ready
+
+log "force the node checkpoint to the old V1 format (downgrade-on-disk)"
+rewrite_v1='
+import os, sys
+from tpu_dra.tpuplugin.checkpoint import CheckpointManager
+path = sys.argv[1]
+m = CheckpointManager(os.path.dirname(path))
+cp = m.load()
+assert cp is not None and cp.claims, "no prepared claims to downgrade"
+m.store(cp, version="v1")
+import json
+doc = json.load(open(path))
+assert doc["data"]["version"] == "v1", doc["data"]["version"]
+print(f"downgraded {path} to v1 with {len(cp.claims)} claim(s)")
+'
+if [ "${E2E_MODE:-sim}" = "kind" ]; then
+  PPOD=$(k get pods -n $DRIVER_NS -o name | sed 's|.*/||' \
+    | grep kubelet-plugin | head -1)
+  k exec "$PPOD" -n $DRIVER_NS -c tpu-plugin -- \
+    python -c "$rewrite_v1" /var/lib/kubelet/plugins/tpu.dev/checkpoint.json \
+    || die "v1 rewrite failed in pod"
+else
+  WORK="$(dirname "${KUBECTL_SHIM_STATE:?sim mode needs KUBECTL_SHIM_STATE}")"
+  # n0 = the holder pod's node; "plugins/tpu.dev" excludes the CD
+  # plugin's own checkpoint (plugins/compute-domain.tpu.dev).
+  CKPT=$(find "$WORK" -path "*/n0/*plugins/tpu.dev/checkpoint.json" | head -1)
+  [ -n "$CKPT" ] || die "no checkpoint.json under $WORK"
+  PYTHONPATH="${PYTHONPATH:-$REPO_ROOT}" python -c "$rewrite_v1" "$CKPT" \
+    || die "v1 rewrite failed"
+fi
+
+log "UPGRADE: re-apply the chart with a changed template (logVerbosity 5) + CRD"
+render --set logVerbosity=5 | k apply -f - >/dev/null
+wait_until 180 "upgraded plugin pods rolled in" plugin_has_verbosity 5
+wait_until 180 "driver pods Ready after upgrade" driver_pods_ready
+
+log "prepared claim survived the upgrade (holder still Running)"
+pod_phase_is holder $NS Running || die "holder pod lost its claim"
+
+log "CD converges back to Ready after the upgrade"
+wait_until 240 "CD Ready post-upgrade" cd_ready
+
+log "new prepares work on the upgraded install"
+cat <<EOF | k apply -f -
+apiVersion: v1
+kind: Pod
+metadata:
+  name: fresh
+  namespace: $NS
+spec:
+  restartPolicy: Never
+  nodeName: n1
+  containers:
+  - name: ctr
+    image: x
+    command: ["python", "-c", "import os; print('CHIPS', os.environ.get('TPU_VISIBLE_CHIPS'))"]
+    resources:
+      claims: [{name: tpu}]
+  resourceClaims:
+  - name: tpu
+    resourceClaimTemplateName: one-chip
+EOF
+wait_until 120 "fresh pod Succeeded" pod_phase_is fresh $NS Succeeded
+k logs fresh -n $NS | grep -q "CHIPS" || die "fresh pod missing chip env"
+
+log "unprepare of the pre-upgrade claim works (V1->latest conversion)"
+k delete pod holder -n $NS --ignore-not-found
+wait_until 120 "holder gone" \
+  sh -c "! ${KUBECTL} get pod holder -n $NS -o name >/dev/null 2>&1"
+
+log "DOWNGRADE: re-apply the original chart"
+render | k apply -f - >/dev/null
+wait_until 180 "downgraded plugin pods rolled in" plugin_has_verbosity 4
+wait_until 180 "driver pods Ready after downgrade" driver_pods_ready
+wait_until 240 "CD Ready post-downgrade" cd_ready
+
+log "teardown"
+k delete pod cd-wl -n $NS --ignore-not-found >/dev/null 2>&1
+k delete pod fresh -n $NS --ignore-not-found >/dev/null 2>&1
+k delete cd $CD -n $NS >/dev/null 2>&1
+wait_until 120 "CD deleted" \
+  sh -c "! ${KUBECTL} get cd $CD -n $NS -o name >/dev/null 2>&1"
+
+log "OK test_updowngrade"

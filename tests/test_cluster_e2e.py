@@ -62,7 +62,11 @@ def test_multislice_cd_injects_megascale_env():
             namespace="tpu-dra-driver"))
         api = cluster.api
 
-        def wait(pred, timeout=240):
+        def wait(pred, timeout=420):
+            # Generous: late in a full sequential suite run this test
+            # competes with leftover daemon threads and a warm JAX heap;
+            # the CD convergence it drives takes ~100s alone but has been
+            # observed to need >240s under that load.
             deadline = time.time() + timeout
             while time.time() < deadline:
                 try:

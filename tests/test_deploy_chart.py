@@ -63,6 +63,43 @@ class TestDefaultRender:
                          apitypes.DEVICE_CLASS_DAEMON,
                          apitypes.DEVICE_CLASS_CHANNEL}
 
+    def test_gke_values_overlay(self):
+        """demo/clusters/gke/values-gke.yaml: kubelet plugins pinned to
+        GKE TPU nodes (the default kind/sim selector nulled out — helm
+        null-deletion), controller kept on the CPU pool."""
+        overlay_path = os.path.join(
+            os.path.dirname(__file__), "..", "demo", "clusters", "gke",
+            "values-gke.yaml")
+        with open(overlay_path) as f:
+            overlay = yaml.safe_load(f)
+        docs = render(overlay)
+        ds = next(d for d in docs if d["kind"] == "DaemonSet")
+        spec = ds["spec"]["template"]["spec"]
+        assert spec["nodeSelector"] == {
+            "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice"}
+        assert any(t.get("key") == "google.com/tpu"
+                   for t in spec["tolerations"])
+        ctrl = next(d for d in docs if d["kind"] == "Deployment"
+                    and "controller" in d["metadata"]["name"])
+        assert ctrl["spec"]["template"]["spec"]["nodeSelector"] == {
+            "cloud.google.com/gke-nodepool": "default-pool"}
+
+    def test_chip_class_extended_resource_name_v1_only(self):
+        """extendedResourceName is a resource.k8s.io/v1 field: present on
+        the chip class by default (v1 is pinned), absent when the operator
+        overrides to a pre-GA API version. Reference:
+        deviceclass-gpu.yaml:13."""
+        chip = by_kind_name(render())[("DeviceClass", "tpu.dev")]
+        assert chip["spec"]["extendedResourceName"] == "tpu.dev/tpu"
+        # Only the whole-chip class maps to the extended resource; a
+        # subslice is not one schedulable "tpu.dev/tpu" unit.
+        sub = by_kind_name(render())[("DeviceClass", "tpu-subslice.tpu.dev")]
+        assert "extendedResourceName" not in sub["spec"]
+        old = by_kind_name(render(
+            {"resourceApiVersion": "resource.k8s.io/v1beta2"}))[
+            ("DeviceClass", "tpu.dev")]
+        assert "extendedResourceName" not in old["spec"]
+
     def test_device_class_cel_uses_driver_names(self):
         for d in render():
             if d["kind"] != "DeviceClass":

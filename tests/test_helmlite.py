@@ -93,3 +93,20 @@ class TestIncludeScoping:
         src = ('{{- define "t" -}}{{ .v }}{{- end -}}'
                '{{ include "t" (dict "v" "val") }}')
         assert render(src) == "val"
+
+
+class TestDeepMerge:
+    def test_null_override_deletes_default_key(self):
+        """Helm semantics: an explicit null in -f values deletes the
+        chart-default key — how demo/clusters/gke/values-gke.yaml swaps
+        the kubelet plugin's nodeSelector for GKE's TPU label."""
+        from tpu_dra.deploy.helmlite import _deep_merge
+        base = {"sel": {"a": "1", "b": "2"}, "keep": True}
+        out = _deep_merge(base, {"sel": {"a": None, "c": "3"}})
+        assert out == {"sel": {"b": "2", "c": "3"}, "keep": True}
+        # Base untouched (merge is copy-on-write).
+        assert base["sel"] == {"a": "1", "b": "2"}
+
+    def test_null_for_missing_key_is_noop(self):
+        from tpu_dra.deploy.helmlite import _deep_merge
+        assert _deep_merge({"x": 1}, {"y": None}) == {"x": 1}

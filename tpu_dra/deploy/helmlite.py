@@ -635,7 +635,12 @@ def _fail(ctx, msg):
 def _deep_merge(base: Dict, override: Dict) -> Dict:
     out = dict(base)
     for k, v in (override or {}).items():
-        if isinstance(v, dict) and isinstance(out.get(k), dict):
+        if v is None:
+            # Helm semantics: an explicit null in an override DELETES the
+            # default key (how overlays drop a default nodeSelector entry,
+            # e.g. demo/clusters/gke/values-gke.yaml).
+            out.pop(k, None)
+        elif isinstance(v, dict) and isinstance(out.get(k), dict):
             out[k] = _deep_merge(out[k], v)
         else:
             out[k] = v

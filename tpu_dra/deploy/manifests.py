@@ -35,14 +35,20 @@ def namespace(ns: str = DEFAULT_NAMESPACE) -> Dict:
 # DeviceClasses (CEL selectors over published device attributes)
 # ---------------------------------------------------------------------------
 
-def _device_class(name: str, driver: str, device_type: str) -> Dict:
+def _device_class(name: str, driver: str, device_type: str,
+                  extended_resource: str = "") -> Dict:
     cel = (f'device.driver == "{driver}" && '
            f'device.attributes["{driver}"].type == "{device_type}"')
+    spec: Dict = {"selectors": [{"cel": {"expression": cel}}]}
+    if extended_resource:
+        # v1-only field (the static manifests pin v1); chart parity:
+        # templates/deviceclass-tpu.yaml.
+        spec = {"extendedResourceName": extended_resource, **spec}
     return {
         "apiVersion": "resource.k8s.io/v1",
         "kind": "DeviceClass",
         "metadata": {"name": name},
-        "spec": {"selectors": [{"cel": {"expression": cel}}]},
+        "spec": spec,
     }
 
 
@@ -50,7 +56,8 @@ def device_classes() -> List[Dict]:
     tpu = apitypes.TPU_DRIVER_NAME
     cd = apitypes.COMPUTE_DOMAIN_DRIVER_NAME
     return [
-        _device_class("tpu.dev", tpu, "chip"),
+        _device_class("tpu.dev", tpu, "chip",
+                      extended_resource="tpu.dev/tpu"),
         _device_class("tpu-subslice.tpu.dev", tpu, "subslice"),
         _device_class(apitypes.DEVICE_CLASS_DAEMON, cd, "daemon"),
         _device_class(apitypes.DEVICE_CLASS_CHANNEL, cd, "channel"),

@@ -204,8 +204,12 @@ class DRAPluginServer:
         self.registration_registered = threading.Event()
         self._server: Optional[grpc.Server] = None
         self._reg_server: Optional[grpc.Server] = None
+        self._stopped = False
+        # Serializes start_registration() against stop(): they run on
+        # different threads (publish retry queue vs driver shutdown).
+        self._reg_lock = threading.Lock()
 
-    def start(self) -> None:
+    def start(self, register: bool = True) -> None:
         for sock in [self.dra_socket]:
             if os.path.exists(sock):
                 os.unlink(sock)
@@ -214,8 +218,23 @@ class DRAPluginServer:
             handlers=[_dra_service(self._callbacks)])
         self._server.add_insecure_port(f"unix://{self.dra_socket}")
         self._server.start()
+        if register:
+            self.start_registration()
 
-        if self._registry_dir:
+    def start_registration(self) -> None:
+        """Expose the plugin-watcher socket. Separate from start() so the
+        driver can gate kubelet registration on the first successful
+        ResourceSlice publish (the reference Helper's sequencing,
+        driver.go:73-116): kubelet should not route claims here before the
+        scheduler can see this node's inventory. Idempotent, and refuses
+        after stop(): the gated first publish runs on the retry queue,
+        whose worker can still be mid-callback when the driver shuts down
+        — starting a registration server then would leak it (nothing will
+        ever stop it) and advertise a dead plugin to kubelet."""
+        with self._reg_lock:
+            if self._stopped or self._reg_server is not None \
+                    or not self._registry_dir:
+                return
             reg_sock = os.path.join(
                 self._registry_dir, f"{self.driver_name}-reg.sock")
             if os.path.exists(reg_sock):
@@ -231,6 +250,8 @@ class DRAPluginServer:
             self.registration_socket = reg_sock
 
     def stop(self, grace: float = 2.0) -> None:
+        with self._reg_lock:
+            self._stopped = True
         if self._server:
             self._server.stop(grace).wait()
         if self._reg_server:

@@ -172,7 +172,8 @@ class NodeSim:
                              message=str(e))
             return
         self._running[uid] = rp
-        self._set_status(pod, phase="Running", ready=False)
+        self._set_status(pod, phase="Running", ready=False,
+                         pids=self._pids(rp))
         self._publish_endpoints(pod, rp)
 
     def _publish_endpoints(self, pod: Dict, rp: _RunningPod) -> None:
@@ -491,10 +492,16 @@ class NodeSim:
             ready = all(self._probe_ok(p) for p in rp.procs)
             if ready != rp.ready:
                 rp.ready = ready
-                self._set_status(pod, phase="Running", ready=ready)
+                self._set_status(pod, phase="Running", ready=ready,
+                                 pids=self._pids(rp))
             # Re-publish endpoints each probe tick: a Service created
             # after its backing pod started must still get annotated.
             self._publish_endpoints(pod, rp)
+
+    @staticmethod
+    def _pids(rp: _RunningPod) -> Dict[str, int]:
+        return {p._ctr["name"]: p.pid  # type: ignore[attr-defined]
+                for p in rp.procs if p.poll() is None}
 
     def _probe_ok(self, proc: subprocess.Popen) -> bool:
         ctr = proc._ctr  # type: ignore[attr-defined]
@@ -589,7 +596,8 @@ class NodeSim:
             channel.close()
 
     def _set_status(self, pod: Dict, *, phase: str, ready: bool,
-                    message: str = "") -> None:
+                    message: str = "",
+                    pids: Optional[Dict[str, int]] = None) -> None:
         ns = pod["metadata"].get("namespace", "default")
         try:
             fresh = self._client.get(PODS, pod["metadata"]["name"], ns)
@@ -603,9 +611,16 @@ class NodeSim:
             "status": "True" if ready else "False",
             **({"message": message} if message else {}),
         }]
+        # containerID carries the sim process pid (`sim://<pid>`) — the
+        # containerd://<hash> analog. The e2e debug suite resolves it to
+        # deliver signals the way `kubectl exec kill` would on a real
+        # cluster (tests/e2e/test_debug.sh; reference
+        # tests/bats/test_basics.bats:89-100).
         status["containerStatuses"] = [
             {"name": c["name"], "ready": ready,
-             "state": {"running": {}} if phase == "Running" else {}}
+             "state": {"running": {}} if phase == "Running" else {},
+             **({"containerID": f"sim://{pids[c['name']]}"}
+                if pids and c["name"] in pids else {})}
             for c in fresh["spec"].get("containers") or []]
         try:
             self._client.update_status(PODS, fresh, ns)

@@ -10,6 +10,8 @@ workload-following teardown).
 
 from __future__ import annotations
 
+import hashlib
+import json
 import logging
 import threading
 from typing import Dict, List, Optional
@@ -18,6 +20,13 @@ from tpu_dra.k8s.client import ApiClient, ApiError, ConflictError, NotFoundError
 from tpu_dra.k8s.resources import DAEMONSETS, DEPLOYMENTS, NODES, PODS
 
 log = logging.getLogger("simcluster.workloads")
+
+
+def _template_hash(owner: Dict) -> str:
+    """Stable hash of a DS/Deployment pod template — the pod-template-hash
+    analog that lets the sim roll pods on chart upgrades."""
+    payload = json.dumps(owner["spec"]["template"], sort_keys=True)
+    return hashlib.sha1(payload.encode()).hexdigest()[:10]
 
 
 class WorkloadController:
@@ -49,16 +58,32 @@ class WorkloadController:
     def reconcile_once(self) -> None:
         nodes = self._client.list(NODES)
         pods = self._client.list(PODS)
-        for ds in self._client.list(DAEMONSETS):
+        daemonsets = self._client.list(DAEMONSETS)
+        deployments = self._client.list(DEPLOYMENTS)
+        for ds in daemonsets:
             try:
                 self._reconcile_daemonset(ds, nodes, pods)
             except ConflictError:
                 continue
-        for dep in self._client.list(DEPLOYMENTS):
+        for dep in deployments:
             try:
                 self._reconcile_deployment(dep, pods)
             except ConflictError:
                 continue
+        # Orphan GC (the reference CleanupManager analog,
+        # cd-controller cleanup.go:97-133): a stamped pod whose owning
+        # DS/Deployment is gone would otherwise linger forever — e.g. a
+        # per-CD daemon pod after its CD (and thus its DaemonSet) was
+        # deleted mid-flight.
+        owners = {(d["metadata"].get("namespace", "default"),
+                   f"ds-{d['metadata']['name']}") for d in daemonsets}
+        owners |= {(d["metadata"].get("namespace", "default"),
+                    f"deploy-{d['metadata']['name']}") for d in deployments}
+        for p in pods:
+            tag = (p["metadata"].get("labels") or {}).get("sim/owner")
+            ns = p["metadata"].get("namespace", "default")
+            if tag and (ns, tag) not in owners:
+                self._delete_pod(p["metadata"]["name"], ns)
 
     # -- DaemonSets -----------------------------------------------------
 
@@ -76,6 +101,7 @@ class WorkloadController:
                  if p["metadata"].get("namespace") == ns
                  and (p["metadata"].get("labels") or {}).get(
                      "sim/owner") == f"ds-{name}"}
+        tmpl_hash = _template_hash(ds)
         for node in sorted(want_nodes):
             pod_name = f"{name}-{node}"
             if pod_name not in owned:
@@ -85,6 +111,14 @@ class WorkloadController:
             if pod["spec"].get("nodeName") not in want_nodes:
                 # Node left the selector (label removed): workload-following
                 # teardown.
+                self._delete_pod(pod_name, ns)
+            elif (pod["metadata"]["labels"].get("sim/template-hash")
+                  != tmpl_hash):
+                # Template changed (chart upgrade): roll the pod — delete
+                # now, the next reconcile recreates it from the new
+                # template (the DaemonSet RollingUpdate analog; the CD
+                # controller's own template-hash convergence depends on
+                # this, controller.py).
                 self._delete_pod(pod_name, ns)
         ready = sum(1 for p in owned.values()
                     if self._pod_ready(p)
@@ -109,14 +143,18 @@ class WorkloadController:
                  if p["metadata"].get("namespace") == ns
                  and (p["metadata"].get("labels") or {}).get(
                      "sim/owner") == f"deploy-{name}"}
+        tmpl_hash = _template_hash(dep)
         for i in range(replicas):
             pod_name = f"{name}-{i}"
             if pod_name not in owned:
                 self._create_pod(dep, pod_name, ns, f"deploy-{name}")
-        for pod_name in list(owned):
+        for pod_name, pod in list(owned.items()):
             idx = pod_name.rsplit("-", 1)[-1]
             if idx.isdigit() and int(idx) >= replicas:
                 self._delete_pod(pod_name, ns)
+            elif (pod["metadata"]["labels"].get("sim/template-hash")
+                  != tmpl_hash):
+                self._delete_pod(pod_name, ns)  # roll on template change
         ready = sum(1 for p in owned.values() if self._pod_ready(p))
         status = {"replicas": len(owned), "readyReplicas": ready,
                   "availableReplicas": ready}
@@ -134,6 +172,7 @@ class WorkloadController:
         template = owner["spec"]["template"]
         labels = dict(template.get("metadata", {}).get("labels") or {})
         labels["sim/owner"] = owner_tag
+        labels["sim/template-hash"] = _template_hash(owner)
         spec = dict(template["spec"])
         if node_name:
             spec = {**spec, "nodeName": node_name}

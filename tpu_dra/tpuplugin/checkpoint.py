@@ -110,12 +110,24 @@ class CheckpointManager:
     def store(self, cp: Checkpoint, version: str = "v2") -> None:
         doc = cp.to_v1_doc() if version == "v1" else cp.to_v2_doc()
         payload = json.dumps(doc, sort_keys=True, separators=(",", ":"))
-        envelope = {"checksum": zlib.crc32(payload.encode()), "data": doc}
+        # Envelope assembled around the already-serialized payload (it is
+        # the checksum's exact input, so embedding it verbatim both avoids
+        # a second serialization and makes the checksum self-evidently
+        # consistent). "checksum" < "data": key order matches the sorted
+        # output load() re-derives.
+        envelope = ('{"checksum": %d, "data": %s}'
+                    % (zlib.crc32(payload.encode()), payload))
         tmp = self._path + ".tmp"
         with open(tmp, "w") as f:
-            json.dump(envelope, f, sort_keys=True)
+            f.write(envelope)
             f.flush()
-            os.fsync(f.fileno())
+            # Data-only sync: the durability point for the claim state
+            # machine (prepare's store-before-side-effects contract).
+            # File metadata is irrelevant here and the plain fsync was the
+            # single largest cost in the claim-to-ready hot path
+            # (bench prepare_breakdown: ~0.28ms of a ~0.42ms store).
+            # fdatasync is POSIX-but-not-macOS; fall back to fsync there.
+            getattr(os, "fdatasync", os.fsync)(f.fileno())
         os.replace(tmp, self._path)
 
     def load(self) -> Optional[Checkpoint]:

@@ -52,6 +52,8 @@ class TpuDriver(DriverCallbacks):
         # after a health event must not strand a dead chip in the inventory
         # (closes the known gap the reference documents at driver.go:283-293).
         self._publish_queue = WorkQueue(default_prep_unprep_rate_limiter())
+        # Set once the initial ResourceSlice publish lands (start()).
+        self.first_published = threading.Event()
         self._health: Optional[DeviceHealthMonitor] = None
         if featuregates.enabled(featuregates.TPUDeviceHealthCheck):
             self._health = DeviceHealthMonitor(
@@ -60,12 +62,26 @@ class TpuDriver(DriverCallbacks):
 
     # -- lifecycle ----------------------------------------------------------
 
-    def start(self) -> None:
-        self.server.start()
+    def start(self, publish_wait: float = 5.0) -> None:
+        """Bring up the DRA socket, then run the initial ResourceSlice
+        publish through the retry queue and gate kubelet REGISTRATION on
+        its first success (Helper sequencing, driver.go:73-116): an API
+        server blip at plugin start backs off instead of crashing the pod,
+        and kubelet is not told about a driver whose inventory the
+        scheduler cannot see yet.
+
+        publish_wait: best-effort block for the first publish so callers
+        observe the steady state; on timeout the queue keeps retrying in
+        the background (0 to not wait).
+        """
+        self.server.start(register=False)
         self._publish_queue.run_in_thread()
         if self._health:
             self._health.start()
-        self.publish_resources()
+        self._publish_queue.enqueue(
+            None, lambda _obj: self._publish_and_register(), key="publish")
+        if publish_wait:
+            self.first_published.wait(publish_wait)
 
     def shutdown(self) -> None:
         if self._health:
@@ -132,6 +148,17 @@ class TpuDriver(DriverCallbacks):
                               devices, pool_generation=self._pool_generation)
             self._pool_generation += 1
 
+    def _publish_and_register(self) -> None:
+        """Single callback behind the "publish" queue key: every enqueue —
+        startup AND health republish — goes through here, because the
+        queue's latest-wins semantics would otherwise let a health event
+        supersede a still-retrying startup publish and silently drop the
+        registration gate."""
+        self.publish_resources()
+        if not self.first_published.is_set():
+            self.server.start_registration()
+            self.first_published.set()
+
     # -- health -------------------------------------------------------------
 
     def _on_unhealthy_event(self, event: HealthEvent) -> None:
@@ -164,4 +191,4 @@ class TpuDriver(DriverCallbacks):
             log.warning("health event %s (code %d): yanking devices %s",
                         event.kind, event.code, affected)
         self._publish_queue.enqueue(
-            None, lambda _obj: self.publish_resources(), key="publish")
+            None, lambda _obj: self._publish_and_register(), key="publish")

@@ -38,10 +38,6 @@ class ModelConfig:
     d_ff: int = 512
     max_seq: int = 128
     dtype: Any = jnp.bfloat16
-    # Softmax accumulation dtype for the *reference* (materializing)
-    # attention path. The flash kernel always accumulates fp32 online —
-    # and never materializes [S,S] — so on TPU this knob is inert.
-    softmax_dtype: Any = jnp.bfloat16
     # Attention dispatch (flashattention.attend): "auto" = pallas flash
     # kernel on TPU, jnp reference elsewhere; tests force
     # "flash_interpret" / "reference" for CPU parity checks.
