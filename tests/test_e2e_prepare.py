@@ -138,6 +138,24 @@ class TestPrepareBasic:
         assert env["TPU_VISIBLE_CHIPS"] == "1"
         assert env["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "1,1,1"
 
+    def test_prepare_breakdown_recorded(self, harness):
+        """Per-phase wall times land in last_prepare_breakdown after a
+        non-idempotent prepare (the bench's prepare_breakdown_* source):
+        every phase present, each bounded by the recorded total."""
+        claim = make_claim(harness["cluster"], ["chip-1"])
+        assert grpc_prepare(harness, claim).error == ""
+        bd = harness["state"].last_prepare_breakdown
+        assert set(bd) == {"checkpoint_start", "decode", "sharing",
+                           "guards", "cdi_write", "checkpoint_final",
+                           "total"}
+        for phase, ms in bd.items():
+            assert 0 <= ms <= bd["total"] + 1e-6, (phase, bd)
+        # Idempotent re-prepare takes the completed-claim fast path and
+        # must NOT overwrite the recorded breakdown.
+        before = dict(bd)
+        assert grpc_prepare(harness, claim).error == ""
+        assert harness["state"].last_prepare_breakdown == before
+
     def test_multi_chip_claim(self, harness):
         """gpu-test4 analog: multi-chip claim on one host."""
         claim = make_claim(harness["cluster"], ["chip-0", "chip-2", "chip-3"])
