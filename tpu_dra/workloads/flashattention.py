@@ -105,17 +105,24 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
 
     acc, row_max, denom = jax.lax.fori_loop(0, last, body,
                                             (acc, row_max, denom))
+    # denom >= 1 always: causal rows include their own diagonal (masking
+    # uses a finite sentinel, so even a fully-masked row would sum
+    # exp(0) terms), and entirely-future blocks never reach the kernel
+    # (ring attention routes them around it, ringattention.future_fn).
     o_ref[...] = (acc / denom[:, None]).astype(o_ref.dtype)
     lse_ref[0, :] = row_max + jnp.log(denom)
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   *, block_k: int, seq_len: int, causal: bool,
-                   sm_scale: float):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dlse_ref, dq_ref, *, block_k: int, seq_len: int,
+                   causal: bool, sm_scale: float):
     """dQ for one Q tile: stream K/V tiles, recompute P from (q, k, lse).
 
-    dS_ij = P_ij * (dO_i . V_j - delta_i);  dQ_i = sm_scale * sum_j dS_ij K_j
-    where delta_i = dO_i . O_i (precomputed outside, one fused reduce).
+    dS_ij = P_ij * (dO_i . V_j - delta_i + dlse_i);
+    dQ_i = sm_scale * sum_j dS_ij K_j, where delta_i = dO_i . O_i
+    (precomputed outside, one fused reduce) and dlse is the cotangent of
+    the exposed logsumexp output (d lse_i / d s_ij = P_ij — this is what
+    lets ring attention merge per-step partials differentiably).
     """
     block_q, d = q_ref.shape
     q_start = pl.program_id(1) * block_q
@@ -123,7 +130,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     q = q_ref[...]
     do = do_ref[...]
     lse = lse_ref[0, :].astype(jnp.float32)
-    delta = delta_ref[0, :].astype(jnp.float32)
+    # Fold the two per-row linear terms once, outside the K loop.
+    corr = (dlse_ref[0, :].astype(jnp.float32)
+            - delta_ref[0, :].astype(jnp.float32))
 
     num_k_blocks = seq_len // block_k
     if causal:
@@ -145,7 +154,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             scores = jnp.where(q_pos >= k_pos, scores, NEG_INF)
         p = jnp.exp(scores - lse[:, None])  # masked entries exp(-inf) = 0
         dp = _dot(do, v_blk, trans_b=True)
-        ds = p * (dp - delta[:, None])
+        ds = p * (dp + corr[:, None])
         return acc + _dot(ds.astype(k_blk.dtype), k_blk)
 
     acc = jax.lax.fori_loop(0, last, body, jnp.zeros((block_q, d),
@@ -154,11 +163,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, block_q: int, seq_len: int,
-                    causal: bool, sm_scale: float):
+                    dlse_ref, dk_ref, dv_ref, *, block_q: int,
+                    seq_len: int, causal: bool, sm_scale: float):
     """dK/dV for one K/V tile: stream Q/dO tiles from the diagonal down.
 
-    dV_j = sum_i P_ij dO_i;  dK_j = sm_scale * sum_i dS_ij Q_i.
+    dV_j = sum_i P_ij dO_i;  dK_j = sm_scale * sum_i dS_ij Q_i,
+    with dS_ij = P_ij * (dP_ij - delta_i + dlse_i) as in _bwd_dq_kernel.
     """
     block_k, d = k_ref.shape
     k_start = pl.program_id(1) * block_k
@@ -176,8 +186,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         q_blk = q_ref[pl.dslice(q_start, block_q), :]
         do_blk = do_ref[pl.dslice(q_start, block_q), :]
         lse_blk = lse_ref[0, pl.dslice(q_start, block_q)].astype(jnp.float32)
-        delta_blk = delta_ref[0, pl.dslice(q_start, block_q)].astype(
-            jnp.float32)
+        corr_blk = (
+            dlse_ref[0, pl.dslice(q_start, block_q)].astype(jnp.float32)
+            - delta_ref[0, pl.dslice(q_start, block_q)].astype(jnp.float32))
         scores = _dot(q_blk, k_t, trans_b=True) * sm_scale  # [bq, bk] fp32
         if causal:
             q_pos = q_start + jax.lax.broadcasted_iota(
@@ -189,7 +200,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         p_cast = p.astype(do_blk.dtype)
         dv_acc = dv_acc + _dot(p_cast, do_blk, trans_a=True)  # p^T dO
         dp = _dot(do_blk, v_t, trans_b=True)
-        ds = p * (dp - delta_blk[:, None])
+        ds = p * (dp + corr_blk[:, None])
         dk_acc = dk_acc + _dot(ds.astype(q_blk.dtype), q_blk, trans_a=True)
         return dk_acc, dv_acc
 
@@ -229,17 +240,24 @@ def _fwd_call(q, k, v, causal, block_q, block_k, interpret):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash(q, k, v, causal, block_q, block_k, interpret):
-    out, _ = _fwd_call(q, k, v, causal, block_q, block_k, interpret)
-    return out
+    """[BH, S, D] primitive returning (out, lse [BH, 1, S] fp32).
+
+    Both outputs are differentiable: an out-only consumer gets a zero
+    dlse cotangent from JAX and the backward degenerates to plain flash;
+    ring attention consumes BOTH (partials are merged by lse weights)."""
+    return _fwd_call(q, k, v, causal, block_q, block_k, interpret)
 
 
 def _flash_fwd_rule(q, k, v, causal, block_q, block_k, interpret):
     out, lse = _fwd_call(q, k, v, causal, block_q, block_k, interpret)
-    return out, (q, k, v, out, lse)
+    return (out, lse), (q, k, v, out, lse)
 
 
-def _flash_bwd_rule(causal, block_q, block_k, interpret, res, dout):
+def _flash_bwd_rule(causal, block_q, block_k, interpret, res, cts):
     q, k, v, out, lse = res
+    dout, dlse = cts
+    dout = dout.astype(q.dtype)
+    dlse = dlse.astype(jnp.float32)
     bh, s, d = q.shape
     sm_scale = 1.0 / math.sqrt(d)
     # delta_i = dO_i . O_i: one fused elementwise+reduce in HBM; tiny next
@@ -261,11 +279,12 @@ def _flash_bwd_rule(causal, block_q, block_k, interpret, res, dout):
             pl.BlockSpec((None, block_q, d), lambda b, qi: (b, qi, 0)),
             pl.BlockSpec((None, 1, block_q), lambda b, qi: (b, 0, qi)),
             pl.BlockSpec((None, 1, block_q), lambda b, qi: (b, 0, qi)),
+            pl.BlockSpec((None, 1, block_q), lambda b, qi: (b, 0, qi)),
         ],
         out_specs=pl.BlockSpec((None, block_q, d), lambda b, qi: (b, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
         interpret=interpret,
-    )(q, k, v, dout, lse, delta)
+    )(q, k, v, dout, lse, delta, dlse)
 
     dkv_kernel = functools.partial(_bwd_dkv_kernel, block_q=block_q,
                                    seq_len=s, causal=causal,
@@ -280,6 +299,7 @@ def _flash_bwd_rule(causal, block_q, block_k, interpret, res, dout):
             pl.BlockSpec((None, s, d), lambda b, ki: (b, 0, 0)),
             pl.BlockSpec((None, 1, s), lambda b, ki: (b, 0, 0)),
             pl.BlockSpec((None, 1, s), lambda b, ki: (b, 0, 0)),
+            pl.BlockSpec((None, 1, s), lambda b, ki: (b, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((None, block_k, d), lambda b, ki: (b, ki, 0)),
@@ -290,21 +310,26 @@ def _flash_bwd_rule(causal, block_q, block_k, interpret, res, dout):
             jax.ShapeDtypeStruct((bh, s, d), v.dtype),
         ],
         interpret=interpret,
-    )(q, k, v, dout, lse, delta)
+    )(q, k, v, dout, lse, delta, dlse)
     return dq, dk, dv
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
-def flash_attention(q, k, v, *, causal: bool = True,
-                    block_q: int = DEFAULT_BLOCK,
-                    block_k: int = DEFAULT_BLOCK, interpret: bool = False):
-    """q, k, v: [B, S, H, D] -> [B, S, H, D]. Differentiable (custom VJP
-    with tiled backward kernels). Causal inputs are zero-padded up to the
-    block size — exact, since padded keys are above every real row's
-    diagonal and padded rows are sliced off; non-causal S must divide by
-    the blocks (padded keys would shift its softmax)."""
+def flash_attention_with_lse(q, k, v, *, causal: bool = True,
+                             block_q: int = DEFAULT_BLOCK,
+                             block_k: int = DEFAULT_BLOCK,
+                             interpret: bool = False):
+    """q, k, v: [B, S, H, D] -> (out [B, S, H, D], lse [B, H, S] fp32).
+
+    Differentiable in BOTH outputs (joint custom VJP): lse is the per-row
+    logsumexp of the scaled scores, which makes per-call results
+    mergeable — ring attention combines ring-step partials as
+    o = sum_i o_i * exp(lse_i - logsumexp_i(lse_i)). Causal inputs are
+    zero-padded up to the block size — exact, since padded keys are above
+    every real row's diagonal and padded rows are sliced off; non-causal
+    S must divide by the blocks (padded keys would shift its softmax)."""
     b, s, h, d = q.shape
     if causal:
         # Lane-align first (Mosaic tiling wants 8/128-aligned or full-size
@@ -330,10 +355,25 @@ def flash_attention(q, k, v, *, causal: bool = True,
     def to_bh(x):
         return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, s, d)
 
-    out = _flash(to_bh(q), to_bh(k), to_bh(v), causal, block_q, block_k,
-                 interpret)
+    out, lse = _flash(to_bh(q), to_bh(k), to_bh(v), causal, block_q,
+                      block_k, interpret)
     out = jnp.transpose(out.reshape(b, h, s, d), (0, 2, 1, 3))
-    return out[:, :s - pad] if pad else out
+    lse = lse.reshape(b, h, s)
+    if pad:
+        out, lse = out[:, :s - pad], lse[..., :s - pad]
+    return out, lse
+
+
+def flash_attention(q, k, v, *, causal: bool = True,
+                    block_q: int = DEFAULT_BLOCK,
+                    block_k: int = DEFAULT_BLOCK, interpret: bool = False):
+    """q, k, v: [B, S, H, D] -> [B, S, H, D]. Differentiable (custom VJP
+    with tiled backward kernels); see flash_attention_with_lse for the
+    padding/divisibility contract."""
+    out, _ = flash_attention_with_lse(q, k, v, causal=causal,
+                                      block_q=block_q, block_k=block_k,
+                                      interpret=interpret)
+    return out
 
 
 def attend(q, k, v, *, causal: bool = True, impl: str = "auto"):

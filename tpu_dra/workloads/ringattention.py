@@ -30,44 +30,103 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 NEG_INF = -1e30
 
 
-def _block_attend(q, k, v, q_offset, kv_offset, causal):
-    """Scores of one (q-block, kv-block) pair with causal masking in GLOBAL
-    sequence coordinates. q: [B,Sq,H,D]; k,v: [B,Sk,H,D].
-    Returns (scores [B,H,Sq,Sk], values v) ready for the online update."""
+def _jnp_partial(q, k, v, causal):
+    """(out [B,Sq,H,D], lse [B,H,Sq]) of q against one K/V block, plain
+    jnp (the CPU-mesh / odd-shape path). lse is over scaled scores —
+    flash_attention_with_lse's convention, so partials merge either way."""
     d = q.shape[-1]
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(d)
-    scores = scores.astype(jnp.float32)
+    scores = (jnp.einsum("bqhd,bkhd->bhqk", q, k)
+              / math.sqrt(d)).astype(jnp.float32)
     if causal:
         sq, sk = q.shape[1], k.shape[1]
-        q_pos = q_offset + jnp.arange(sq)[:, None]
-        k_pos = kv_offset + jnp.arange(sk)[None, :]
-        scores = jnp.where(q_pos >= k_pos, scores, NEG_INF)
-    return scores
-
-
-def _online_update(state, scores, v):
-    """Flash-attention online-softmax accumulation step.
-    state: (acc [B,H,Sq,D] f32, row_max [B,H,Sq] f32, denom [B,H,Sq] f32).
-    """
-    acc, row_max, denom = state
+        mask = jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
+        scores = jnp.where(mask, scores, NEG_INF)
     block_max = jnp.max(scores, axis=-1)
-    new_max = jnp.maximum(row_max, block_max)
-    correction = jnp.exp(row_max - new_max)
-    p = jnp.exp(scores - new_max[..., None])  # [B,H,Sq,Sk] f32
-    pv = jnp.einsum("bhqk,bkhd->bhqd", p.astype(v.dtype), v).astype(jnp.float32)
-    acc = acc * correction[..., None] + pv
-    denom = denom * correction + jnp.sum(p, axis=-1)
-    return acc, new_max, denom
+    p = jnp.exp(scores - block_max[..., None])
+    denom = jnp.sum(p, axis=-1)
+    lse = block_max + jnp.log(jnp.maximum(denom, 1e-30))
+    out = jnp.einsum("bhqk,bkhd->bqhd", (p / denom[..., None]).astype(v.dtype),
+                     v).astype(q.dtype)
+    return out, lse
 
 
-def ring_attention(q, k, v, *, axis_name: str, causal: bool = True):
+def _flash_partial(q, k, v, causal, interpret):
+    from tpu_dra.workloads.flashattention import flash_attention_with_lse
+    # Explicit block size that divides s_local: the past-block case is
+    # non-causal, which cannot be zero-padded, and the kernel's default
+    # block (256) does not divide every lane-aligned length (e.g. 384).
+    s = q.shape[1]
+    blk = 256 if s % 256 == 0 else 128
+    return flash_attention_with_lse(q, k, v, causal=causal,
+                                    block_q=min(blk, s), block_k=min(blk, s),
+                                    interpret=interpret)
+
+
+def _ring_flash_ok(s_local: int, d: int) -> bool:
+    """Flash per-step partials need a block size dividing s_local (the
+    past-block case is non-causal, which cannot be zero-padded)."""
+    return s_local % 128 == 0 and d >= 8
+
+
+def ring_attention(q, k, v, *, axis_name: str, causal: bool = True,
+                   impl: str = "auto"):
     """Per-device body (inside shard_map): q,k,v are the LOCAL sequence
     blocks [B, S_local, H, D]. K/V rotate ring-wise; every device sees all
-    blocks after axis_size steps."""
+    blocks after axis_size steps.
+
+    Each ring step computes a PARTIAL softmax attention of the local Q
+    against the visiting K/V block — three statically-shaped cases (the
+    visiting block is entirely in the future / on the diagonal / entirely
+    in the past, so the causal structure never depends on traced offsets)
+    — and partials merge by their logsumexp:
+        new_lse = logaddexp(acc_lse, lse_b)
+        acc_o   = acc_o * e^(acc_lse - new_lse) + o_b * e^(lse_b - new_lse)
+    With impl="flash" the per-step partial is the pallas kernel
+    (flash_attention_with_lse, joint (out, lse) VJP), so no device ever
+    materializes even the LOCAL [S_local, S_local] score matrix — memory
+    is O(block) and context length scales with ring size times what one
+    chip's flash kernel handles.
+
+    impl: "auto" (flash on TPU when shapes allow), "flash",
+    "flash_interpret" (CPU-testable), "jnp".
+    """
     axis_size = jax.lax.psum(1, axis_name)
     my_index = jax.lax.axis_index(axis_name)
     b, s_local, h, d = q.shape
-    q_offset = my_index * s_local
+
+    if impl == "auto":
+        on_tpu = any(dev.platform == "tpu" for dev in jax.devices())
+        use_flash = on_tpu and _ring_flash_ok(s_local, d)
+        interpret = False
+    elif impl in ("flash", "flash_interpret"):
+        if not _ring_flash_ok(s_local, d):
+            raise ValueError(
+                f"flash ring needs s_local % 128 == 0 (got {s_local})")
+        use_flash = True
+        interpret = impl == "flash_interpret"
+    elif impl == "jnp":
+        use_flash, interpret = False, False
+    else:
+        raise ValueError(f"unknown ring attention impl {impl!r}")
+
+    def partial_fn(cs):
+        if use_flash:
+            return lambda qq, kk, vv: _flash_partial(qq, kk, vv, cs,
+                                                     interpret)
+        return lambda qq, kk, vv: _jnp_partial(qq, kk, vv, cs)
+
+    def future_fn(qq, kk, vv):
+        # Visiting block is entirely in the future: contributes nothing.
+        # (o=0, lse=NEG_INF) is the identity of the logsumexp merge.
+        # The lse constant needs an explicit pcast: switch branches must
+        # agree on varying-axis typing and the real branches' lse is
+        # device-varying (zeros_like(qq) already inherits qq's typing).
+        return (jnp.zeros_like(qq),
+                jax.lax.pcast(jnp.full((b, h, s_local), NEG_INF,
+                                       jnp.float32),
+                              axis_name, to="varying"))
+
+    branches = [future_fn, partial_fn(True), partial_fn(False)]
 
     # pcast to varying: the fresh carries are device-invariant but the
     # loop produces device-varying values; shard_map's typed carries must
@@ -75,43 +134,60 @@ def ring_attention(q, k, v, *, axis_name: str, causal: bool = True):
     def _varying(x):
         return jax.lax.pcast(x, axis_name, to="varying")
 
-    acc = _varying(jnp.zeros((b, h, s_local, d), jnp.float32))
-    row_max = _varying(jnp.full((b, h, s_local), NEG_INF, jnp.float32))
-    denom = _varying(jnp.zeros((b, h, s_local), jnp.float32))
+    acc_o = _varying(jnp.zeros((b, s_local, h, d), jnp.float32))
+    acc_lse = _varying(jnp.full((b, h, s_local), NEG_INF, jnp.float32))
 
     def step(i, carry):
-        acc, row_max, denom, k_blk, v_blk = carry
+        acc_o, acc_lse, k_blk, v_blk = carry
         # Block i arrived from neighbor (my_index + i) mod axis_size.
         kv_index = (my_index + i) % axis_size
-        scores = _block_attend(q, k_blk, v_blk, q_offset,
-                               kv_index * s_local, causal)
-        acc, row_max, denom = _online_update((acc, row_max, denom),
-                                             scores, v_blk)
+        if causal:
+            # 0: future (kv > my), 1: diagonal (causal within the block),
+            # 2: past (fully visible).
+            case = jnp.where(kv_index > my_index, 0,
+                             jnp.where(kv_index == my_index, 1, 2))
+        else:
+            case = jnp.int32(2)
+        o_b, lse_b = jax.lax.switch(case, branches, q, k_blk, v_blk)
+
+        # Merge partials by logsumexp weight. NEG_INF is a FINITE
+        # sentinel (-1e30): (-1e30) - (-1e30) stays 0, so the
+        # before-first-contribution merges are NaN-free by construction.
+        new_lse = jnp.logaddexp(acc_lse, lse_b)
+        w_old = jnp.exp(acc_lse - new_lse)
+        w_new = jnp.exp(lse_b - new_lse)
+        to_bshd = lambda w: jnp.transpose(w, (0, 2, 1))[..., None]  # noqa: E731
+        acc_o = (acc_o * to_bshd(w_old)
+                 + o_b.astype(jnp.float32) * to_bshd(w_new))
+        acc_lse = new_lse
+
         # Rotate K/V one hop around the ring (device p -> p-1, so the
         # NEXT step sees the block of my_index+i+1). The final rotation
         # is redundant but keeps the loop body uniform for the compiler.
         perm = [(p, (p - 1) % axis_size) for p in range(axis_size)]
         k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
         v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
-        return acc, row_max, denom, k_blk, v_blk
+        return acc_o, acc_lse, k_blk, v_blk
 
-    acc, row_max, denom, _, _ = jax.lax.fori_loop(
-        0, axis_size, step, (acc, row_max, denom, k, v))
-    out = acc / denom[..., None]
-    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)  # [B,Sq,H,D]
+    acc_o, acc_lse, _, _ = jax.lax.fori_loop(
+        0, axis_size, step, (acc_o, acc_lse, k, v))
+    return acc_o.astype(q.dtype)  # [B,Sq,H,D]
 
 
 def make_ring_attention(mesh: Mesh, axis_name: str = "data",
-                        causal: bool = True):
+                        causal: bool = True, impl: str = "auto"):
     """Jitted sequence-parallel attention over `mesh`'s `axis_name` axis.
     Inputs/outputs [B, S, H, D] sharded on S."""
     seq_sharding = NamedSharding(mesh, P(None, axis_name, None, None))
     spec = P(None, axis_name, None, None)
 
     body = functools.partial(ring_attention, axis_name=axis_name,
-                             causal=causal)
+                             causal=causal, impl=impl)
+    # check_vma=False: pallas_call results carry no varying-axis typing
+    # (their ShapeDtypeStructs would need explicit vma), so the typed-
+    # carry check cannot see through the flash per-step partials.
     fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
-                       out_specs=spec)
+                       out_specs=spec, check_vma=False)
     return jax.jit(fn, in_shardings=(seq_sharding,) * 3,
                    out_shardings=seq_sharding)
 
