@@ -379,6 +379,222 @@ class TestCheckpointRecovery:
         assert cp2.claims[uid].devices[0]["device"] == "chip-3"
 
 
+class TestCheckpointSlots:
+    """Two-slot in-place store (checkpoint.py CheckpointManager doc):
+    torn-write recovery, downgrade view of the primary file, legacy
+    single-file load, and seq seeding across manager instances."""
+
+    def _mgr(self, tmp_path):
+        from tpu_dra.tpuplugin.checkpoint import CheckpointManager
+        return CheckpointManager(str(tmp_path / "cp"))
+
+    def _cp(self, uid, state="PrepareCompleted"):
+        from tpu_dra.tpuplugin.checkpoint import Checkpoint, PreparedClaim
+        cp = Checkpoint()
+        cp.claims[uid] = PreparedClaim(uid=uid, state=state,
+                                       devices=[{"device": "chip-0"}])
+        return cp
+
+    def test_torn_primary_recovers_side_slot(self, tmp_path):
+        mgr = self._mgr(tmp_path)
+        mgr.store(self._cp("u1"))               # primary, seq 1
+        mgr.store(self._cp("u2"), intent=True)  # side, seq 2 (newest)
+        mgr.close()
+        # Tear the primary mid-overwrite.
+        with open(mgr.path, "r+b") as f:
+            f.write(b'{"checksum": 1, "seq": 9, "data": {"tru')
+        cp = self._mgr(tmp_path).load()
+        assert list(cp.claims) == ["u2"]
+
+    def test_intent_store_keeps_primary_settled(self, tmp_path):
+        """An old single-file loader (downgrade) reading checkpoint.json
+        must see the latest *terminal* state, never an in-flight intent."""
+        import json
+        mgr = self._mgr(tmp_path)
+        mgr.store(self._cp("settled"))
+        mgr.store(self._cp("inflight", state="PrepareStarted"), intent=True)
+        with open(mgr.path) as f:
+            doc = json.load(f)["data"]
+        assert list(doc["preparedClaims"]) == ["settled"]
+        # The new loader prefers the newer intent record.
+        assert list(mgr.load().claims) == ["inflight"]
+
+    def test_legacy_single_file_loads(self, tmp_path):
+        import json
+        import zlib
+        d = tmp_path / "cp"
+        d.mkdir()
+        payload = json.dumps(
+            {"preparedClaims": {"old": {"devices": []}}, "version": "v1"},
+            sort_keys=True, separators=(",", ":"))
+        (d / "checkpoint.json").write_text(
+            '{"checksum": %d, "data": %s}'
+            % (zlib.crc32(payload.encode()), payload))
+        cp = self._mgr(tmp_path).load()
+        assert cp.claims["old"].state == "PrepareCompleted"
+
+    def test_fresh_manager_supersedes_stale_side_slot(self, tmp_path):
+        """A manager that stores before loading (e.g. a downgrade tool
+        force-writing V1) must still win over an older side slot."""
+        mgr = self._mgr(tmp_path)
+        for _ in range(5):
+            mgr.store(self._cp("stale"), intent=True)
+        mgr.close()
+        mgr2 = self._mgr(tmp_path)
+        mgr2.store(self._cp("forced"), version="v1")
+        assert list(self._mgr(tmp_path).load().claims) == ["forced"]
+
+    def test_torn_primary_after_terminal_runs_is_not_stale(self, tmp_path):
+        """Terminal stores write side-then-primary with identical content,
+        so a torn primary recovers the LAST settled state — never an
+        older one (the leak scenario: resurrecting claims kubelet already
+        unprepared, which it would never unprepare again)."""
+        mgr = self._mgr(tmp_path)
+        mgr.store(self._cp("a"))
+        mgr.store(self._cp("b"))
+        mgr.store(self._cp("c"))   # terminal run: side slot tracks primary
+        mgr.close()
+        with open(mgr.path, "r+b") as f:
+            f.write(b'{"torn')
+        cp = self._mgr(tmp_path).load()
+        assert list(cp.claims) == ["c"]
+
+    def test_legacy_primary_beats_stale_side_slot(self, tmp_path):
+        """Downgrade-then-reupgrade: the old driver rewrote checkpoint.json
+        rename-style (no seq). Its last word must win over a pre-downgrade
+        side slot, whatever that slot's seq."""
+        import json
+        import zlib
+        mgr = self._mgr(tmp_path)
+        for _ in range(7):
+            mgr.store(self._cp("pre-downgrade"), intent=True)
+        mgr.close()
+        payload = json.dumps(
+            {"preparedClaims": {"old-driver": {"devices": []}},
+             "version": "v1"}, sort_keys=True, separators=(",", ":"))
+        with open(mgr.path, "w") as f:
+            f.write('{"checksum": %d, "data": %s}'
+                    % (zlib.crc32(payload.encode()), payload))
+        assert list(self._mgr(tmp_path).load().claims) == ["old-driver"]
+
+    def test_load_or_init_migrates_legacy_primary(self, tmp_path):
+        """Upgrade from a rename-scheme driver: load_or_init rewrites the
+        legacy primary through the slot scheme at startup, so intent
+        records written before the first terminal store are not
+        out-ranked by the (otherwise authoritative) legacy primary."""
+        import json
+        import zlib
+        d = tmp_path / "cp"
+        d.mkdir()
+        payload = json.dumps(
+            {"preparedClaims": {"settled": {"devices": []}},
+             "version": "v1"}, sort_keys=True, separators=(",", ":"))
+        (d / "checkpoint.json").write_text(
+            '{"checksum": %d, "data": %s}'
+            % (zlib.crc32(payload.encode()), payload))
+        mgr = self._mgr(tmp_path)
+        cp = mgr.load_or_init()
+        assert list(cp.claims) == ["settled"]
+        with open(mgr.path) as f:
+            assert "seq" in json.load(f)  # migrated in place
+        # Crash mid-prepare right after upgrade: the intent must win.
+        cp.claims["inflight"] = __import__(
+            "tpu_dra.tpuplugin.checkpoint", fromlist=["PreparedClaim"]
+        ).PreparedClaim(uid="inflight", state="PrepareStarted")
+        mgr.store(cp, intent=True)
+        mgr.close()
+        assert "inflight" in self._mgr(tmp_path).load().claims
+
+    def test_mangled_seq_degrades_to_other_slot(self, tmp_path):
+        """seq lives outside the checksum; a non-numeric seq must make
+        that slot 'corrupt', not crash load()."""
+        import json
+        mgr = self._mgr(tmp_path)
+        mgr.store(self._cp("good"))
+        mgr.close()
+        side = mgr.path + ".b"
+        doc = json.load(open(side))
+        doc["seq"] = "x"
+        with open(side, "w") as f:
+            json.dump(doc, f)
+        assert list(self._mgr(tmp_path).load().claims) == ["good"]
+
+    def test_all_slots_corrupt_raises(self, tmp_path):
+        import pytest
+        from tpu_dra.tpuplugin.checkpoint import CheckpointError
+        mgr = self._mgr(tmp_path)
+        mgr.store(self._cp("a"))
+        mgr.store(self._cp("b"), intent=True)
+        mgr.close()
+        for p in (mgr.path, mgr.path + ".b", mgr.path + ".c"):
+            with open(p, "w") as f:
+                f.write("not json")
+        with pytest.raises(CheckpointError):
+            self._mgr(tmp_path).load()
+
+    def test_torn_intent_loses_only_inflight_store(self, tmp_path):
+        """Side slots ping-pong: claim A's intent (older side slot)
+        survives a torn write of claim B's intent (newer side slot)."""
+        from tpu_dra.tpuplugin.checkpoint import PreparedClaim
+        mgr = self._mgr(tmp_path)
+        cp = self._cp("A", state="PrepareStarted")
+        mgr.store(cp, intent=True)                     # side slot 1
+        cp.claims["B"] = PreparedClaim(uid="B", state="PrepareStarted")
+        mgr.store(cp, intent=True)                     # side slot 2
+        mgr.close()
+        # Find and tear the newest slot (the one holding A+B).
+        import json
+        slots = {p: json.load(open(p))["seq"]
+                 for p in (mgr.path + ".b", mgr.path + ".c")}
+        newest = max(slots, key=slots.get)
+        with open(newest, "r+b") as f:
+            f.write(b'{"torn')
+        cp2 = self._mgr(tmp_path).load()
+        assert list(cp2.claims) == ["A"]
+
+    def test_checksum_corrupt_slot_is_overwritten_first(self, tmp_path):
+        """A checksum-corrupt side slot must seed seq 0 (not its stale
+        on-disk seq) so ping-pong overwrites IT next, never the last
+        good side slot."""
+        import json
+        mgr = self._mgr(tmp_path)
+        mgr.store(self._cp("s1"), intent=True)
+        mgr.store(self._cp("s2"), intent=True)
+        mgr.close()
+        slots = {p: json.load(open(p))["seq"]
+                 for p in (mgr.path + ".b", mgr.path + ".c")}
+        newest = max(slots, key=slots.get)
+        oldest = min(slots, key=slots.get)
+        doc = json.load(open(newest))
+        doc["checksum"] = (doc["checksum"] + 1) & 0xFFFFFFFF
+        with open(newest, "w") as f:
+            json.dump(doc, f)
+        mgr2 = self._mgr(tmp_path)
+        mgr2.store(self._cp("s3"), intent=True)
+        mgr2.close()
+        # s3 landed on the corrupt slot; the good one (s1) is untouched.
+        assert json.load(open(oldest))["seq"] == slots[oldest]
+        assert "s3" in json.load(open(newest))["data"]["preparedClaims"]
+
+    def test_load_or_init_repairs_torn_slot(self, tmp_path):
+        """A slot torn by a crash must not survive restart: load_or_init
+        re-stores, restoring the every-slot-valid invariant instead of
+        running indefinitely one tear away from total state loss."""
+        import json
+        mgr = self._mgr(tmp_path)
+        mgr.store(self._cp("x"))
+        mgr.close()
+        with open(mgr.path, "r+b") as f:     # torn terminal write
+            f.write(b'{"torn')
+        mgr2 = self._mgr(tmp_path)
+        cp = mgr2.load_or_init()
+        assert list(cp.claims) == ["x"]
+        mgr2.close()
+        # The primary was rewritten valid (downgrade readers included).
+        doc = json.load(open(mgr.path))
+        assert "seq" in doc and "x" in doc["data"]["preparedClaims"]
+
+
 class TestStartupPublishRetry:
     def test_api_server_down_at_start(self, tmp_path):
         """Initial ResourceSlice publish rides the retry queue and gates

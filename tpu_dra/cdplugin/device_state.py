@@ -138,7 +138,10 @@ class DeviceState:
                     "pool": self._node_name,
                     "cdi_ids": [self._cdi.get_claim_device(uid)],
                 } for r in results]
-                self._ckpt_mgr.store(self._checkpoint)
+                # Transient mid-prepare record: side slot (the primary
+                # keeps only settled state for downgrade readers — see
+                # tpuplugin/checkpoint.py CheckpointManager).
+                self._ckpt_mgr.store(self._checkpoint, intent=True)
 
             # Label first (this is what summons the daemon pod), then wait.
             self._cd.add_node_label(config.domain_id)
@@ -188,7 +191,9 @@ class DeviceState:
                 "pool": self._node_name,
                 "cdi_ids": [self._cdi.get_claim_device(uid)],
             } for r in results]
-            self._ckpt_mgr.store(self._checkpoint)
+            # Mid-prepare intent record: side slot only (see
+            # tpuplugin/checkpoint.py CheckpointManager).
+            self._ckpt_mgr.store(self._checkpoint, intent=True)
 
         domain_dir = self._cd.prepare_daemon_dir(cd, self._slice_id)
         env = {

@@ -96,60 +96,184 @@ class Checkpoint:
 
 
 class CheckpointManager:
-    """Atomic file persistence with crc32 integrity (the kubelet
-    checkpointmanager-with-checksum analog)."""
+    """Multi-slot in-place persistence with crc32 + sequence integrity.
+
+    The kubelet checkpointmanager analog writes tmp-file + rename per save;
+    on this path the rename and fresh-file block allocation made fdatasync
+    behave like a full fsync (~0.23ms vs ~0.09ms for a same-size in-place
+    overwrite, measured on the bench host) — and the checkpoint is stored
+    TWICE per prepare (intent, then completed), squarely on the
+    claim-to-ready hot path (SURVEY §3.2). So instead:
+
+    - Every store writes the FULL state, in place, padded to a 4KiB
+      multiple so repeat stores never change the file size (pure data
+      overwrite -> cheap fdatasync).
+    - The envelope carries a monotonic ``seq``; load() picks the highest
+      valid-checksum slot.
+    - Slots: the legacy-named primary ``checkpoint.json`` plus two side
+      slots (``.b``/``.c``). Stores ping-pong between the side slots, so
+      a torn write destroys at most the slot being written while the
+      OTHER side slot still holds the previous full state — in-place
+      overwrite never risks more than the in-flight store (matching the
+      rename scheme's guarantee, plus recovery the rename scheme lacks).
+    - Intent records (``PrepareStarted``, mid-prepare) write one side
+      slot — a single cheap fdatasync on the claim-to-ready hot path.
+      Terminal states (completed prepare, unprepare) write a side slot
+      first and then the primary, so a torn primary recovers the
+      *identical* settled state.
+    - A downgraded driver that only knows the single-file layout reads
+      the primary = the latest settled state. If it then writes its own
+      rename-style (seq-less) checkpoints, load() treats such a legacy
+      primary as authoritative over any leftover side slots from before
+      the downgrade (the old driver's last word is the truth);
+      load_or_init() migrates it into the slot scheme immediately.
+    """
+
+    SLOT_PAD = 4096
 
     def __init__(self, directory: str, filename: str = "checkpoint.json"):
         os.makedirs(directory, exist_ok=True)
         self._path = os.path.join(directory, filename)
+        self._side_paths = (self._path + ".b", self._path + ".c")
+        self._fds: Dict[str, int] = {}
+        self._sizes: Dict[str, int] = {}
+        # Seed per-slot seqs from whatever is on disk so a manager that
+        # stores before loading (e.g. a tool force-writing a downgrade
+        # image) still supersedes stale slots from an earlier process,
+        # and so side-slot ping-pong resumes on the older slot. Uses the
+        # checksum-validating _load_slot: a corrupt slot seeds 0, sorting
+        # it FIRST for overwrite — otherwise its stale-but-high seq would
+        # steer the next store onto the last good side slot.
+        self._slot_seqs: Dict[str, int] = {}
+        for p in (self._path, *self._side_paths):
+            r = self._load_slot(p)
+            self._slot_seqs[p] = (r[0] or 0) if isinstance(r, tuple) else 0
+        self._seq = max(self._slot_seqs.values())
 
     @property
     def path(self) -> str:
         return self._path
 
-    def store(self, cp: Checkpoint, version: str = "v2") -> None:
+    def close(self) -> None:
+        for fd in self._fds.values():
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+        self._fds.clear()
+        self._sizes.clear()
+
+    def _write_slot(self, path: str, data: bytes) -> None:
+        padded = data + b" " * (-len(data) % self.SLOT_PAD)
+        fd = self._fds.get(path)
+        if fd is None:
+            fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o600)
+            self._fds[path] = fd
+            self._sizes[path] = os.fstat(fd).st_size
+        off = 0
+        while off < len(padded):  # POSIX permits short writes
+            n = os.pwrite(fd, padded[off:], off)
+            if n <= 0:
+                raise CheckpointError(f"short write to {path} at {off}")
+            off += n
+        if self._sizes[path] != len(padded):
+            os.ftruncate(fd, len(padded))
+            self._sizes[path] = len(padded)
+        # Data-only sync: the durability point for the claim state machine
+        # (store-before-side-effects). fdatasync is POSIX-but-not-macOS;
+        # fall back to fsync there.
+        getattr(os, "fdatasync", os.fsync)(fd)
+
+    def store(self, cp: Checkpoint, version: str = "v2",
+              intent: bool = False) -> None:
+        """Persist the full state. ``intent=True`` marks a transient
+        mid-operation record (side slot only, one write); terminal stores
+        write side-then-primary (see class doc for the crash analysis)."""
         doc = cp.to_v1_doc() if version == "v1" else cp.to_v2_doc()
         payload = json.dumps(doc, sort_keys=True, separators=(",", ":"))
+        self._seq += 1
         # Envelope assembled around the already-serialized payload (it is
         # the checksum's exact input, so embedding it verbatim both avoids
         # a second serialization and makes the checksum self-evidently
-        # consistent). "checksum" < "data": key order matches the sorted
-        # output load() re-derives.
-        envelope = ('{"checksum": %d, "data": %s}'
-                    % (zlib.crc32(payload.encode()), payload))
-        tmp = self._path + ".tmp"
-        with open(tmp, "w") as f:
-            f.write(envelope)
-            f.flush()
-            # Data-only sync: the durability point for the claim state
-            # machine (prepare's store-before-side-effects contract).
-            # File metadata is irrelevant here and the plain fsync was the
-            # single largest cost in the claim-to-ready hot path
-            # (bench prepare_breakdown: ~0.28ms of a ~0.42ms store).
-            # fdatasync is POSIX-but-not-macOS; fall back to fsync there.
-            getattr(os, "fdatasync", os.fsync)(f.fileno())
-        os.replace(tmp, self._path)
+        # consistent).
+        envelope = ('{"checksum": %d, "seq": %d, "data": %s}'
+                    % (zlib.crc32(payload.encode()), self._seq,
+                       payload)).encode()
+        # Ping-pong: overwrite the STALER side slot, so the fresher one
+        # still holds the previous state if this write tears.
+        side = min(self._side_paths, key=lambda p: self._slot_seqs[p])
+        self._write_slot(side, envelope)
+        self._slot_seqs[side] = self._seq
+        if not intent:
+            self._write_slot(self._path, envelope)
+            self._slot_seqs[self._path] = self._seq
 
-    def load(self) -> Optional[Checkpoint]:
-        """None when no checkpoint exists (first start)."""
+    def _load_slot(self, path: str):
+        """-> (seq | None-for-legacy, doc) or None (absent/empty) or
+        'corrupt'. The doc is NOT deserialized into a Checkpoint here so
+        version-compat policy stays in load()."""
         try:
-            with open(self._path) as f:
-                envelope = json.load(f)
+            with open(path) as f:
+                raw = f.read()
         except FileNotFoundError:
             return None
-        except json.JSONDecodeError as e:
-            raise CheckpointError(f"corrupt checkpoint {self._path}: {e}") from e
-        doc = envelope.get("data")
+        if not raw.strip():
+            return None
+        try:
+            envelope = json.loads(raw)
+        except json.JSONDecodeError:
+            return "corrupt"
+        doc = envelope.get("data") if isinstance(envelope, dict) else None
         if doc is None:
-            raise CheckpointError(f"checkpoint {self._path} missing data")
+            return "corrupt"
         payload = json.dumps(doc, sort_keys=True, separators=(",", ":"))
         if zlib.crc32(payload.encode()) != envelope.get("checksum"):
-            raise CheckpointError(f"checkpoint {self._path} checksum mismatch")
-        return Checkpoint.from_doc(doc)
+            return "corrupt"
+        seq = envelope.get("seq")
+        if seq is not None:
+            # seq sits outside the checksum (which covers only `data`, for
+            # legacy compatibility both ways): a mangled seq must degrade
+            # to "corrupt slot", not crash slot selection.
+            try:
+                seq = int(seq)
+            except (ValueError, TypeError):
+                return "corrupt"
+        return seq, doc
+
+    def load(self) -> Optional[Checkpoint]:
+        """None when no checkpoint exists (first start). A *legacy*
+        (seq-less, rename-scheme) primary is authoritative: it means a
+        downgraded driver wrote last, and whatever side slots remain
+        predate the downgrade. Otherwise the highest-seq valid slot
+        wins. Raises only when every present slot is corrupt."""
+        results = {p: self._load_slot(p)
+                   for p in (self._path, *self._side_paths)}
+        primary = results[self._path]
+        if isinstance(primary, tuple) and primary[0] is None:
+            return Checkpoint.from_doc(primary[1])
+        valid = [r for r in results.values()
+                 if isinstance(r, tuple) and r[0] is not None]
+        if valid:
+            seq, doc = max(valid, key=lambda r: r[0])
+            self._seq = max(self._seq, seq)
+            return Checkpoint.from_doc(doc)
+        corrupt = [p for p, r in results.items() if r == "corrupt"]
+        if corrupt:
+            raise CheckpointError(
+                f"checkpoint corrupt, no valid slot: {', '.join(corrupt)}")
+        return None
 
     def load_or_init(self) -> Checkpoint:
+        """Load at process start, initializing an empty checkpoint on
+        first run — and ALWAYS re-storing what was loaded. The store
+        repairs whatever the load tolerated (a slot torn by a crash, a
+        stale loser slot) so the every-slot-valid invariant is restored
+        before new in-place overwrites put it at risk again, and it
+        migrates a legacy (seq-less, rename-scheme) primary into the
+        slot scheme so a post-upgrade crash cannot out-rank newer intent
+        records with the legacy file."""
         cp = self.load()
         if cp is None:
             cp = Checkpoint()
-            self.store(cp)
+        self.store(cp)
         return cp

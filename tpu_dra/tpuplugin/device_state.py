@@ -138,7 +138,9 @@ class DeviceState:
                 name=claim["metadata"].get("name", ""),
                 namespace=claim["metadata"].get("namespace", ""))
             t0 = time.perf_counter()
-            self._ckpt_mgr.store(self._checkpoint)
+            # Transient mid-prepare record: side slot (checkpoint.py —
+            # terminal states land on the primary for downgrade safety).
+            self._ckpt_mgr.store(self._checkpoint, intent=True)
             timings["checkpoint_start"] = time.perf_counter() - t0
 
             records: List[Dict] = []

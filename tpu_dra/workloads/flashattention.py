@@ -376,19 +376,28 @@ def flash_attention(q, k, v, *, causal: bool = True,
     return out
 
 
-def attend(q, k, v, *, causal: bool = True, impl: str = "auto"):
+def attend(q, k, v, *, causal: bool = True, impl: str = "auto",
+           platform: str = ""):
     """Attention entrypoint for the workload models.
 
     impl: "auto" (pallas kernel on TPU, jnp reference elsewhere),
     "flash" (force the kernel), "flash_interpret" (kernel in interpret
     mode — CPU-testable numerics), "reference" (plain jnp).
+
+    platform: the caller's statement of what the computation runs on
+    ("tpu"/"cpu") — callers that hold a Mesh must pass it (model.py
+    make_train_step does). A traced body cannot see its own devices, and
+    the jax.devices() fallback reflects the DEFAULT backend, which is
+    wrong for e.g. a CPU mesh on a TPU-equipped host.
     """
     from tpu_dra.workloads.ringattention import reference_attention
     if impl == "reference":
         return reference_attention(q, k, v, causal=causal)
     if impl == "auto":
-        on_tpu = any(dev.platform == "tpu" for dev in jax.devices())
-        if not (on_tpu and q.shape[1] >= LANES):
+        if not platform:
+            platform = ("tpu" if any(dev.platform == "tpu"
+                                     for dev in jax.devices()) else "cpu")
+        if not (platform == "tpu" and q.shape[1] >= LANES):
             return reference_attention(q, k, v, causal=causal)
         if not causal:
             # Non-causal can't be zero-padded (padded keys would shift the

@@ -42,6 +42,11 @@ class ModelConfig:
     # kernel on TPU, jnp reference elsewhere; tests force
     # "flash_interpret" / "reference" for CPU parity checks.
     attn_impl: str = "auto"
+    # Platform pin for "auto" ("tpu"/"cpu"; "" = sniff the default
+    # backend). make_train_step sets this from the mesh's devices — a
+    # traced forward cannot see what it runs on, and the default-backend
+    # sniff is wrong for e.g. a CPU mesh on a TPU-equipped host.
+    attn_platform: str = ""
     # Per-block rematerialization: "none" | "dots" | "full". Measured on
     # v5e at the flagship shape (d2048/L8/S1024/B8): none -> MFU 0.647,
     # dots_saveable -> 0.596, full -> 0.536. The flash kernel's backward
@@ -131,7 +136,8 @@ def _block(params, x, positions, cfg: ModelConfig):
     v = v.reshape(B, S, cfg.n_heads, cfg.d_head)
     # Hot op: tiled flash kernel on TPU (fwd + custom-VJP bwd, [S,S] never
     # in HBM), jnp reference elsewhere — see flashattention.attend.
-    ctx = attend(q, k, v, causal=True, impl=cfg.attn_impl).reshape(B, S, D)
+    ctx = attend(q, k, v, causal=True, impl=cfg.attn_impl,
+                 platform=cfg.attn_platform).reshape(B, S, D)
     x = x + ctx @ params["wo"].astype(cfg.dtype)
 
     h = _rmsnorm(x, params["ln2_scale"])
@@ -186,6 +192,12 @@ def make_train_step(model: TransformerLM, mesh: Mesh, lr: float = 1e-3):
     'data' via the psum XLA inserts for the replicated-param out-sharding.
     """
     cfg = model.cfg
+    if cfg.attn_impl == "auto" and not cfg.attn_platform:
+        # Pin "auto" attention to the MESH's platform (see ModelConfig).
+        on_tpu = all(d.platform == "tpu" for d in mesh.devices.flat)
+        cfg = dataclasses.replace(cfg,
+                                  attn_platform="tpu" if on_tpu else "cpu")
+        model = TransformerLM(cfg)
     specs = param_specs(cfg)
     p_shard = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
                            is_leaf=lambda x: isinstance(x, P))

@@ -69,7 +69,7 @@ def _ring_flash_ok(s_local: int, d: int) -> bool:
 
 
 def ring_attention(q, k, v, *, axis_name: str, causal: bool = True,
-                   impl: str = "auto"):
+                   impl: str = "auto", platform: str = ""):
     """Per-device body (inside shard_map): q,k,v are the LOCAL sequence
     blocks [B, S_local, H, D]. K/V rotate ring-wise; every device sees all
     blocks after axis_size steps.
@@ -95,13 +95,21 @@ def ring_attention(q, k, v, *, axis_name: str, causal: bool = True,
     b, s_local, h, d = q.shape
 
     if impl == "auto":
-        on_tpu = any(dev.platform == "tpu" for dev in jax.devices())
-        use_flash = on_tpu and _ring_flash_ok(s_local, d)
+        # `platform` is the caller's statement of what the mesh runs on
+        # (make_ring_attention passes it from mesh.devices). This traced
+        # body cannot see its own devices, and jax.devices() reflects the
+        # DEFAULT backend — wrong for e.g. a CPU mesh on a TPU host — so
+        # it is only the last-resort fallback for direct callers.
+        if not platform:
+            platform = ("tpu" if any(dev.platform == "tpu"
+                                     for dev in jax.devices()) else "cpu")
+        use_flash = platform == "tpu" and _ring_flash_ok(s_local, d)
         interpret = False
     elif impl in ("flash", "flash_interpret"):
         if not _ring_flash_ok(s_local, d):
             raise ValueError(
-                f"flash ring needs s_local % 128 == 0 (got {s_local})")
+                "flash ring needs s_local % 128 == 0 and head dim >= 8 "
+                f"(got s_local={s_local}, d={d})")
         use_flash = True
         interpret = impl == "flash_interpret"
     elif impl == "jnp":
@@ -181,8 +189,12 @@ def make_ring_attention(mesh: Mesh, axis_name: str = "data",
     seq_sharding = NamedSharding(mesh, P(None, axis_name, None, None))
     spec = P(None, axis_name, None, None)
 
+    # Resolve "auto" against the MESH's devices, not the default backend:
+    # a CPU mesh on a TPU-equipped host must not pick the Mosaic kernel.
+    on_tpu = all(d.platform == "tpu" for d in mesh.devices.flat)
     body = functools.partial(ring_attention, axis_name=axis_name,
-                             causal=causal, impl=impl)
+                             causal=causal, impl=impl,
+                             platform="tpu" if on_tpu else "cpu")
     # check_vma=False: pallas_call results carry no varying-axis typing
     # (their ShapeDtypeStructs would need explicit vma), so the typed-
     # carry check cannot see through the flash per-step partials.
