@@ -33,6 +33,7 @@ from jax.experimental import pallas as pl
 
 NEG_INF = -1e30
 LANES = 128
+ROPE_BASE = 10000.0
 # Swept on v5e at the flagship shape (B8 S1024 H16 D128): grad-path time
 # 128->11.9ms, 256->7.6ms, 512->8.4ms. 256 balances MXU occupancy per
 # program against causal-block wastage; the jnp reference grad was 11.6ms.
@@ -47,6 +48,79 @@ LANES = 128
 DEFAULT_BLOCK = 256
 
 
+def default_platform() -> str:
+    """Last-resort "auto" dispatch fallback for callers with no mesh in
+    hand: what the DEFAULT jax backend is. Callers that hold a Mesh must
+    pass its platform explicitly instead (a traced body cannot see its
+    own devices, and the default backend is wrong for e.g. a CPU mesh on
+    a TPU-equipped host)."""
+    return ("tpu" if any(dev.platform == "tpu" for dev in jax.devices())
+            else "cpu")
+
+
+def rope_half(x, positions):
+    """Half-split-pairing rotary embedding: plane j rotates dims
+    (j, j+D/2) by positions * ROPE_BASE^(-2j/D). x: [B, S, H, D],
+    positions: [B, S] (or broadcastable). fp32 math, x.dtype out.
+
+    This is the jnp reference for the IN-KERNEL rotation below
+    (_rope_tile): the kernels fuse RoPE into the attention tiles so
+    roped q/k never round-trip HBM. Half-split pairing (not GPT-J-style
+    even/odd interleave) because contiguous half-slices are the cheap
+    shape for VMEM lane slicing; as an architecture choice the pairings
+    are equally expressive, they just must match everywhere.
+    """
+    d = x.shape[-1]
+    half = d // 2
+    freqs = jnp.exp(jnp.arange(0, half, dtype=jnp.float32)
+                    * (-2.0 * math.log(ROPE_BASE) / d))
+    angles = positions[..., None, None].astype(jnp.float32) * freqs
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., :half], xf[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin,
+                            x1 * sin + x2 * cos], axis=-1).astype(x.dtype)
+
+
+def _rope_tables(s: int, d: int):
+    """Full-width rope tables, computed OUTSIDE the kernels (ordinary XLA
+    ops, one fused [S, D] pass) and passed in as operands: in-kernel
+    transcendentals cost ~40ms/step at the flagship shape (5 sin/cos
+    tiles per program, re-derived per K block), tables cost ~0.5MB VMEM.
+
+    cos_t[p, j] = cos(theta(p, j mod D/2)); sinm_t carries the rotation's
+    sign pattern (-sin on the first half, +sin on the second), so both
+    halves apply as  roped = x * cos_t + roll(x, D/2) * sinm_t
+    — multiply-add plus one lane rotate, no shuffle-heavy interleaving.
+    The INVERSE rotation (the VJP) is the same expression with -sinm_t.
+    """
+    half = d // 2
+    j = jnp.arange(half, dtype=jnp.float32)
+    freqs = jnp.exp(j * (-2.0 * math.log(ROPE_BASE) / d))   # [half]
+    ang = jnp.arange(s, dtype=jnp.float32)[:, None] * freqs  # [S, half]
+    cos = jnp.cos(ang)
+    sin = jnp.sin(ang)
+    cos_t = jnp.concatenate([cos, cos], axis=1)
+    sinm_t = jnp.concatenate([-sin, sin], axis=1)
+    return cos_t, sinm_t
+
+
+def _rope_apply(x, start, cos_ref, sinm_ref, *, inverse: bool = False):
+    """In-kernel rope_half for a [rows, d] tile whose global row r sits at
+    position start + r, using the precomputed [S, D] tables. inverse=True
+    applies the transpose rotation (R(-theta)) — the VJP of the forward
+    rotation, mapping accumulated dq/dk (w.r.t. ROPED q/k) back to the
+    unroped inputs."""
+    rows, d = x.shape
+    cos = cos_ref[pl.dslice(start, rows), :]
+    sinm = sinm_ref[pl.dslice(start, rows), :]
+    if inverse:
+        sinm = -sinm
+    xf = x.astype(jnp.float32)
+    rolled = jnp.roll(xf, d // 2, axis=-1)
+    return (xf * cos + rolled * sinm).astype(x.dtype)
+
+
 def _dot(a, b, *, trans_b: bool = False, trans_a: bool = False):
     """Matmul in the operands' own dtype (bf16 stays bf16 — the MXU's
     fast path; fp32 operands would quarter v5e throughput) with fp32
@@ -57,19 +131,31 @@ def _dot(a, b, *, trans_b: bool = False, trans_a: bool = False):
                                preferred_element_type=jnp.float32)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
-                seq_len: int, causal: bool, sm_scale: float):
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, block_k: int,
+                seq_len: int, causal: bool, sm_scale: float, rope: bool):
     """One Q tile vs all (needed) K/V tiles.
 
     Refs (VMEM): q [block_q, d]; k, v [seq_len, d]; o [block_q, d];
     lse [1, block_q] fp32 — the per-row logsumexp saved for the backward.
     (lse/delta ride a [BH, 1, S] layout: Mosaic requires a block's last
     two dims to be (8k, 128m) or full-size, and (1, block_q) qualifies.)
+
+    rope=True fuses rope_half into the tiles (positions = row index)
+    using precomputed [S, D] cos/sin table refs (inserted before the
+    outputs in *rest), so roped q/k exist only in VMEM — the external
+    rope's HBM round trips (~9ms/step at the flagship shape) become
+    multiply-adds that overlap the MXU matmuls.
     """
+    if rope:
+        cos_ref, sinm_ref, o_ref, lse_ref = rest
+    else:
+        o_ref, lse_ref = rest
     block_q, d = q_ref.shape
     q_start = pl.program_id(1) * block_q
 
     q = q_ref[...]  # native dtype: scores matmul rides the bf16 MXU path
+    if rope:
+        q = _rope_apply(q, q_start, cos_ref, sinm_ref)
 
     acc = jnp.zeros((block_q, d), jnp.float32)
     row_max = jnp.full((block_q,), NEG_INF, jnp.float32)
@@ -88,6 +174,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
         k_start = kb * block_k
         k_blk = k_ref[pl.dslice(k_start, block_k), :]
         v_blk = v_ref[pl.dslice(k_start, block_k), :]
+        if rope:
+            k_blk = _rope_apply(k_blk, k_start, cos_ref, sinm_ref)
         scores = _dot(q, k_blk, trans_b=True) * sm_scale  # fp32 [bq, bk]
         if causal:
             q_pos = q_start + jax.lax.broadcasted_iota(
@@ -114,8 +202,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dlse_ref, dq_ref, *, block_k: int, seq_len: int,
-                   causal: bool, sm_scale: float):
+                   dlse_ref, *rest, block_k: int, seq_len: int,
+                   causal: bool, sm_scale: float, rope: bool):
     """dQ for one Q tile: stream K/V tiles, recompute P from (q, k, lse).
 
     dS_ij = P_ij * (dO_i . V_j - delta_i + dlse_i);
@@ -123,11 +211,22 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     (precomputed outside, one fused reduce) and dlse is the cotangent of
     the exposed logsumexp output (d lse_i / d s_ij = P_ij — this is what
     lets ring attention merge per-step partials differentiably).
+
+    With rope: q/k are re-roped in-tile (residuals store the UNroped
+    inputs), the accumulated gradient is w.r.t. roped q, and the chain
+    rule through the rotation is one inverse rotation at the end
+    (d/dq = R(pos)^T dq_roped = R(-pos) dq_roped).
     """
+    if rope:
+        cos_ref, sinm_ref, dq_ref = rest
+    else:
+        (dq_ref,) = rest
     block_q, d = q_ref.shape
     q_start = pl.program_id(1) * block_q
 
     q = q_ref[...]
+    if rope:
+        q = _rope_apply(q, q_start, cos_ref, sinm_ref)
     do = do_ref[...]
     lse = lse_ref[0, :].astype(jnp.float32)
     # Fold the two per-row linear terms once, outside the K loop.
@@ -145,6 +244,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k_start = kb * block_k
         k_blk = k_ref[pl.dslice(k_start, block_k), :]
         v_blk = v_ref[pl.dslice(k_start, block_k), :]
+        if rope:
+            k_blk = _rope_apply(k_blk, k_start, cos_ref, sinm_ref)
         scores = _dot(q, k_blk, trans_b=True) * sm_scale
         if causal:
             q_pos = q_start + jax.lax.broadcasted_iota(
@@ -159,21 +260,33 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     acc = jax.lax.fori_loop(0, last, body, jnp.zeros((block_q, d),
                                                      jnp.float32))
-    dq_ref[...] = (acc * sm_scale).astype(dq_ref.dtype)
+    acc = acc * sm_scale
+    if rope:
+        acc = _rope_apply(acc, q_start, cos_ref, sinm_ref, inverse=True)
+    dq_ref[...] = acc.astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dlse_ref, dk_ref, dv_ref, *, block_q: int,
-                    seq_len: int, causal: bool, sm_scale: float):
+                    dlse_ref, *rest, block_q: int,
+                    seq_len: int, causal: bool, sm_scale: float,
+                    rope: bool):
     """dK/dV for one K/V tile: stream Q/dO tiles from the diagonal down.
 
     dV_j = sum_i P_ij dO_i;  dK_j = sm_scale * sum_i dS_ij Q_i,
     with dS_ij = P_ij * (dP_ij - delta_i + dlse_i) as in _bwd_dq_kernel.
+    With rope, dK is inverse-rotated at the end (see _bwd_dq_kernel);
+    dV is untouched (v is never roped).
     """
+    if rope:
+        cos_ref, sinm_ref, dk_ref, dv_ref = rest
+    else:
+        dk_ref, dv_ref = rest
     block_k, d = k_ref.shape
     k_start = pl.program_id(1) * block_k
 
     k_t = k_ref[...]
+    if rope:
+        k_t = _rope_apply(k_t, k_start, cos_ref, sinm_ref)
     v_t = v_ref[...]
 
     num_q_blocks = seq_len // block_q
@@ -184,6 +297,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_acc, dv_acc = carry
         q_start = qb * block_q
         q_blk = q_ref[pl.dslice(q_start, block_q), :]
+        if rope:
+            q_blk = _rope_apply(q_blk, q_start, cos_ref, sinm_ref)
         do_blk = do_ref[pl.dslice(q_start, block_q), :]
         lse_blk = lse_ref[0, pl.dslice(q_start, block_q)].astype(jnp.float32)
         corr_blk = (
@@ -208,16 +323,32 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         first, num_q_blocks, body,
         (jnp.zeros((block_k, d), jnp.float32),
          jnp.zeros((block_k, d), jnp.float32)))
-    dk_ref[...] = (dk_acc * sm_scale).astype(dk_ref.dtype)
+    dk_acc = dk_acc * sm_scale
+    if rope:
+        dk_acc = _rope_apply(dk_acc, k_start, cos_ref, sinm_ref,
+                             inverse=True)
+    dk_ref[...] = dk_acc.astype(dk_ref.dtype)
     dv_ref[...] = dv_acc.astype(dv_ref.dtype)
 
 
-def _fwd_call(q, k, v, causal, block_q, block_k, interpret):
+def _rope_operands(s, d, rope):
+    """(extra_inputs, extra_specs) for the rope tables — the [S, D]
+    tables ride constant index maps, so Mosaic keeps them VMEM-resident
+    across the grid like K/V."""
+    if not rope:
+        return (), ()
+    cos_t, sinm_t = _rope_tables(s, d)
+    spec = pl.BlockSpec((s, d), lambda b, i: (0, 0))
+    return (cos_t, sinm_t), (spec, spec)
+
+
+def _fwd_call(q, k, v, causal, block_q, block_k, interpret, rope):
     """q, k, v: [BH, S, D] -> (out [BH, S, D], lse [BH, S] fp32)."""
     bh, s, d = q.shape
     sm_scale = 1.0 / math.sqrt(d)
     kernel = functools.partial(_fwd_kernel, block_k=block_k, seq_len=s,
-                               causal=causal, sm_scale=sm_scale)
+                               causal=causal, sm_scale=sm_scale, rope=rope)
+    rope_in, rope_specs = _rope_operands(s, d, rope)
     return pl.pallas_call(
         kernel,
         grid=(bh, s // block_q),
@@ -225,6 +356,7 @@ def _fwd_call(q, k, v, causal, block_q, block_k, interpret):
             pl.BlockSpec((None, block_q, d), lambda b, qi: (b, qi, 0)),
             pl.BlockSpec((None, s, d), lambda b, qi: (b, 0, 0)),
             pl.BlockSpec((None, s, d), lambda b, qi: (b, 0, 0)),
+            *rope_specs,
         ],
         out_specs=[
             pl.BlockSpec((None, block_q, d), lambda b, qi: (b, qi, 0)),
@@ -235,25 +367,25 @@ def _fwd_call(q, k, v, causal, block_q, block_k, interpret):
             jax.ShapeDtypeStruct((bh, 1, s), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(q, k, v, *rope_in)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, block_q, block_k, interpret, rope):
     """[BH, S, D] primitive returning (out, lse [BH, 1, S] fp32).
 
     Both outputs are differentiable: an out-only consumer gets a zero
     dlse cotangent from JAX and the backward degenerates to plain flash;
     ring attention consumes BOTH (partials are merged by lse weights)."""
-    return _fwd_call(q, k, v, causal, block_q, block_k, interpret)
+    return _fwd_call(q, k, v, causal, block_q, block_k, interpret, rope)
 
 
-def _flash_fwd_rule(q, k, v, causal, block_q, block_k, interpret):
-    out, lse = _fwd_call(q, k, v, causal, block_q, block_k, interpret)
+def _flash_fwd_rule(q, k, v, causal, block_q, block_k, interpret, rope):
+    out, lse = _fwd_call(q, k, v, causal, block_q, block_k, interpret, rope)
     return (out, lse), (q, k, v, out, lse)
 
 
-def _flash_bwd_rule(causal, block_q, block_k, interpret, res, cts):
+def _flash_bwd_rule(causal, block_q, block_k, interpret, rope, res, cts):
     q, k, v, out, lse = res
     dout, dlse = cts
     dout = dout.astype(q.dtype)
@@ -266,9 +398,10 @@ def _flash_bwd_rule(causal, block_q, block_k, interpret, res, cts):
     delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)[:, None, :]
 
+    rope_in, rope_specs = _rope_operands(s, d, rope)
     dq_kernel = functools.partial(_bwd_dq_kernel, block_k=block_k,
                                   seq_len=s, causal=causal,
-                                  sm_scale=sm_scale)
+                                  sm_scale=sm_scale, rope=rope)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(bh, s // block_q),
@@ -280,15 +413,16 @@ def _flash_bwd_rule(causal, block_q, block_k, interpret, res, cts):
             pl.BlockSpec((None, 1, block_q), lambda b, qi: (b, 0, qi)),
             pl.BlockSpec((None, 1, block_q), lambda b, qi: (b, 0, qi)),
             pl.BlockSpec((None, 1, block_q), lambda b, qi: (b, 0, qi)),
+            *rope_specs,
         ],
         out_specs=pl.BlockSpec((None, block_q, d), lambda b, qi: (b, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
         interpret=interpret,
-    )(q, k, v, dout, lse, delta, dlse)
+    )(q, k, v, dout, lse, delta, dlse, *rope_in)
 
     dkv_kernel = functools.partial(_bwd_dkv_kernel, block_q=block_q,
                                    seq_len=s, causal=causal,
-                                   sm_scale=sm_scale)
+                                   sm_scale=sm_scale, rope=rope)
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(bh, s // block_k),
@@ -300,6 +434,7 @@ def _flash_bwd_rule(causal, block_q, block_k, interpret, res, cts):
             pl.BlockSpec((None, 1, s), lambda b, ki: (b, 0, 0)),
             pl.BlockSpec((None, 1, s), lambda b, ki: (b, 0, 0)),
             pl.BlockSpec((None, 1, s), lambda b, ki: (b, 0, 0)),
+            *rope_specs,
         ],
         out_specs=[
             pl.BlockSpec((None, block_k, d), lambda b, ki: (b, ki, 0)),
@@ -310,7 +445,7 @@ def _flash_bwd_rule(causal, block_q, block_k, interpret, res, cts):
             jax.ShapeDtypeStruct((bh, s, d), v.dtype),
         ],
         interpret=interpret,
-    )(q, k, v, dout, lse, delta, dlse)
+    )(q, k, v, dout, lse, delta, dlse, *rope_in)
     return dq, dk, dv
 
 
@@ -320,7 +455,8 @@ _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 def flash_attention_with_lse(q, k, v, *, causal: bool = True,
                              block_q: int = DEFAULT_BLOCK,
                              block_k: int = DEFAULT_BLOCK,
-                             interpret: bool = False):
+                             interpret: bool = False,
+                             rope: bool = False):
     """q, k, v: [B, S, H, D] -> (out [B, S, H, D], lse [B, H, S] fp32).
 
     Differentiable in BOTH outputs (joint custom VJP): lse is the per-row
@@ -329,7 +465,13 @@ def flash_attention_with_lse(q, k, v, *, causal: bool = True,
     o = sum_i o_i * exp(lse_i - logsumexp_i(lse_i)). Causal inputs are
     zero-padded up to the block size — exact, since padded keys are above
     every real row's diagonal and padded rows are sliced off; non-causal
-    S must divide by the blocks (padded keys would shift its softmax)."""
+    S must divide by the blocks (padded keys would shift its softmax).
+
+    rope=True applies rope_half to q/k INSIDE the kernels with positions
+    = sequence index (padded rows get out-of-range positions, harmless:
+    padded keys are causally masked and padded rows are sliced off).
+    Ring attention must keep rope outside (its visiting K blocks carry
+    other shards' global positions, which the kernel cannot know)."""
     b, s, h, d = q.shape
     if causal:
         # Lane-align first (Mosaic tiling wants 8/128-aligned or full-size
@@ -356,7 +498,7 @@ def flash_attention_with_lse(q, k, v, *, causal: bool = True,
         return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, s, d)
 
     out, lse = _flash(to_bh(q), to_bh(k), to_bh(v), causal, block_q,
-                      block_k, interpret)
+                      block_k, interpret, rope)
     out = jnp.transpose(out.reshape(b, h, s, d), (0, 2, 1, 3))
     lse = lse.reshape(b, h, s)
     if pad:
@@ -366,23 +508,28 @@ def flash_attention_with_lse(q, k, v, *, causal: bool = True,
 
 def flash_attention(q, k, v, *, causal: bool = True,
                     block_q: int = DEFAULT_BLOCK,
-                    block_k: int = DEFAULT_BLOCK, interpret: bool = False):
+                    block_k: int = DEFAULT_BLOCK, interpret: bool = False,
+                    rope: bool = False):
     """q, k, v: [B, S, H, D] -> [B, S, H, D]. Differentiable (custom VJP
     with tiled backward kernels); see flash_attention_with_lse for the
-    padding/divisibility contract."""
+    padding/divisibility and fused-rope contracts."""
     out, _ = flash_attention_with_lse(q, k, v, causal=causal,
                                       block_q=block_q, block_k=block_k,
-                                      interpret=interpret)
+                                      interpret=interpret, rope=rope)
     return out
 
 
 def attend(q, k, v, *, causal: bool = True, impl: str = "auto",
-           platform: str = ""):
+           platform: str = "", rope: bool = False):
     """Attention entrypoint for the workload models.
 
     impl: "auto" (pallas kernel on TPU, jnp reference elsewhere),
     "flash" (force the kernel), "flash_interpret" (kernel in interpret
     mode — CPU-testable numerics), "reference" (plain jnp).
+
+    rope=True fuses rope_half (positions = sequence index) into whichever
+    path is chosen — in-kernel on the flash path, external on the jnp
+    path — so all impls compute the same function.
 
     platform: the caller's statement of what the computation runs on
     ("tpu"/"cpu") — callers that hold a Mesh must pass it (model.py
@@ -391,24 +538,34 @@ def attend(q, k, v, *, causal: bool = True, impl: str = "auto",
     wrong for e.g. a CPU mesh on a TPU-equipped host.
     """
     from tpu_dra.workloads.ringattention import reference_attention
-    if impl == "reference":
+
+    def fallback(q, k, v, causal):
+        # Non-kernel path computes the SAME function: rope applied
+        # externally with the matching (half-split) pairing.
+        if rope:
+            positions = jnp.arange(q.shape[1])[None, :]
+            q, k = rope_half(q, positions), rope_half(k, positions)
         return reference_attention(q, k, v, causal=causal)
+
+    if impl == "reference":
+        return fallback(q, k, v, causal)
     if impl == "auto":
         if not platform:
-            platform = ("tpu" if any(dev.platform == "tpu"
-                                     for dev in jax.devices()) else "cpu")
+            platform = default_platform()
         if not (platform == "tpu" and q.shape[1] >= LANES):
-            return reference_attention(q, k, v, causal=causal)
+            return fallback(q, k, v, causal)
         if not causal:
             # Non-causal can't be zero-padded (padded keys would shift the
             # softmax): kernel only when a block size divides S evenly.
             for blk in (DEFAULT_BLOCK, LANES):
                 if q.shape[1] % blk == 0:
                     return flash_attention(q, k, v, causal=False,
-                                           block_q=blk, block_k=blk)
-            return reference_attention(q, k, v, causal=False)
-        return flash_attention(q, k, v, causal=True)
+                                           block_q=blk, block_k=blk,
+                                           rope=rope)
+            return fallback(q, k, v, causal=False)
+        return flash_attention(q, k, v, causal=True, rope=rope)
     if impl in ("flash", "flash_interpret"):
         return flash_attention(q, k, v, causal=causal,
-                               interpret=impl == "flash_interpret")
+                               interpret=impl == "flash_interpret",
+                               rope=rope)
     raise ValueError(f"unknown attention impl {impl!r}")

@@ -114,30 +114,22 @@ def _rmsnorm(x, scale):
     return (x * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * scale.astype(x.dtype)
 
 
-def _rope(x, positions):
-    """Rotary position embedding; x: [B, S, H, D]."""
-    d = x.shape[-1]
-    freqs = jnp.exp(-jnp.arange(0, d, 2, dtype=jnp.float32)
-                    * (math.log(10000.0) / d))
-    angles = positions[:, :, None, None].astype(jnp.float32) * freqs
-    cos, sin = jnp.cos(angles), jnp.sin(angles)
-    x1, x2 = x[..., 0::2], x[..., 1::2]
-    rotated = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
-    return rotated.reshape(x.shape).astype(x.dtype)
-
-
-def _block(params, x, positions, cfg: ModelConfig):
+def _block(params, x, cfg: ModelConfig):
     B, S, D = x.shape
     h = _rmsnorm(x, params["ln1_scale"])
     qkv = h @ params["wqkv"].astype(cfg.dtype)
     q, k, v = jnp.split(qkv, 3, axis=-1)
-    q = _rope(q.reshape(B, S, cfg.n_heads, cfg.d_head), positions)
-    k = _rope(k.reshape(B, S, cfg.n_heads, cfg.d_head), positions)
+    q = q.reshape(B, S, cfg.n_heads, cfg.d_head)
+    k = k.reshape(B, S, cfg.n_heads, cfg.d_head)
     v = v.reshape(B, S, cfg.n_heads, cfg.d_head)
     # Hot op: tiled flash kernel on TPU (fwd + custom-VJP bwd, [S,S] never
-    # in HBM), jnp reference elsewhere — see flashattention.attend.
+    # in HBM), jnp reference elsewhere — see flashattention.attend. RoPE
+    # (half-split pairing, flashattention.rope_half) is fused into the
+    # attention: in-kernel on the flash path — roped q/k never touch HBM
+    # (~9ms/step external at the flagship shape) — and applied externally
+    # on the jnp path, so every impl computes the same function.
     ctx = attend(q, k, v, causal=True, impl=cfg.attn_impl,
-                 platform=cfg.attn_platform).reshape(B, S, D)
+                 platform=cfg.attn_platform, rope=True).reshape(B, S, D)
     x = x + ctx @ params["wo"].astype(cfg.dtype)
 
     h = _rmsnorm(x, params["ln2_scale"])
@@ -155,9 +147,8 @@ class TransformerLM:
         cfg = self.cfg
         B, S = tokens.shape
         x = params["embed"].astype(cfg.dtype)[tokens]
-        positions = jnp.broadcast_to(jnp.arange(S), (B, S))
 
-        block = lambda p, v: _block(p, v, positions, cfg)  # noqa: E731
+        block = lambda p, v: _block(p, v, cfg)  # noqa: E731
         if cfg.remat == "full":
             block = jax.checkpoint(block)
         elif cfg.remat == "dots":
@@ -192,9 +183,9 @@ def make_train_step(model: TransformerLM, mesh: Mesh, lr: float = 1e-3):
     'data' via the psum XLA inserts for the replicated-param out-sharding.
     """
     cfg = model.cfg
+    on_tpu = all(d.platform == "tpu" for d in mesh.devices.flat)
     if cfg.attn_impl == "auto" and not cfg.attn_platform:
         # Pin "auto" attention to the MESH's platform (see ModelConfig).
-        on_tpu = all(d.platform == "tpu" for d in mesh.devices.flat)
         cfg = dataclasses.replace(cfg,
                                   attn_platform="tpu" if on_tpu else "cpu")
         model = TransformerLM(cfg)
@@ -209,9 +200,15 @@ def make_train_step(model: TransformerLM, mesh: Mesh, lr: float = 1e-3):
         new_params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
         return new_params, loss
 
+    # Donate the incoming params: every caller chains (params, loss) =
+    # step(params, ...), so the old buffers are dead and XLA can update
+    # in place (2.1GB of fp32 masters at the flagship shape). CPU PJRT
+    # doesn't implement donation and would warn each compile — skip there.
+    donate = (0,) if on_tpu else ()
     return jax.jit(step,
                    in_shardings=(p_shard, batch_shard),
-                   out_shardings=(p_shard, NamedSharding(mesh, P())))
+                   out_shardings=(p_shard, NamedSharding(mesh, P())),
+                   donate_argnums=donate)
 
 
 def shard_params(params: Params, mesh: Mesh, cfg: ModelConfig) -> Params:

@@ -96,13 +96,11 @@ def ring_attention(q, k, v, *, axis_name: str, causal: bool = True,
 
     if impl == "auto":
         # `platform` is the caller's statement of what the mesh runs on
-        # (make_ring_attention passes it from mesh.devices). This traced
-        # body cannot see its own devices, and jax.devices() reflects the
-        # DEFAULT backend — wrong for e.g. a CPU mesh on a TPU host — so
-        # it is only the last-resort fallback for direct callers.
+        # (make_ring_attention passes it from mesh.devices); the default-
+        # backend sniff is only the fallback for direct callers.
         if not platform:
-            platform = ("tpu" if any(dev.platform == "tpu"
-                                     for dev in jax.devices()) else "cpu")
+            from tpu_dra.workloads.flashattention import default_platform
+            platform = default_platform()
         use_flash = platform == "tpu" and _ring_flash_ok(s_local, d)
         interpret = False
     elif impl in ("flash", "flash_interpret"):
