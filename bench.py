@@ -91,18 +91,23 @@ def pick_backend(jax_probe):
     return FakeBackend(), "fake"
 
 
-def _make_claim(cluster, chips, name):
+def _make_claim(cluster, chips, name, configs=None, devices=None):
+    """Allocated ResourceClaim as the scheduler would produce. `chips`
+    are chip indices (exclusive whole-chip devices); `devices` overrides
+    with explicit device names (e.g. subslices); `configs` carries
+    opaque per-claim config (sharing strategies)."""
     from tpu_dra.api.types import TPU_DRIVER_NAME
     from tpu_dra.k8s import RESOURCECLAIMS
 
+    devices = devices if devices is not None else [f"chip-{c}" for c in chips]
     return cluster.create(RESOURCECLAIMS, {
         "apiVersion": "resource.k8s.io/v1", "kind": "ResourceClaim",
         "metadata": {"name": name, "namespace": "default"},
         "spec": {"devices": {"requests": [{"name": "tpu"}]}},
         "status": {"allocation": {"devices": {"results": [
             {"request": "tpu", "driver": TPU_DRIVER_NAME,
-             "pool": "bench-node", "device": f"chip-{c}"} for c in chips],
-            "config": []}}},
+             "pool": "bench-node", "device": d} for d in devices],
+            "config": configs or []}}},
     })
 
 
@@ -116,13 +121,16 @@ def bench_claim_to_ready(backend, n_cycles: int = 40):
     from tpu_dra.tpuplugin.device_state import DeviceState
     from tpu_dra.tpuplugin.driver import TpuDriver
 
+    from tpu_dra.tpuplugin.sharing import TimeSlicingManager
+
     cluster = FakeCluster()
     tmp = tempfile.mkdtemp(prefix="tpu-dra-bench-")
     cdi = CDIHandler(os.path.join(tmp, "cdi"),
                      driver_root=os.path.join(tmp, "drv"))
     state = DeviceState(backend=backend, cdi=cdi,
                         checkpoints=CheckpointManager(os.path.join(tmp, "p")),
-                        driver_name=TPU_DRIVER_NAME, node_name="bench-node")
+                        driver_name=TPU_DRIVER_NAME, node_name="bench-node",
+                        ts_manager=TimeSlicingManager(backend))
     driver = TpuDriver(state=state, client=cluster,
                        driver_name=TPU_DRIVER_NAME, node_name="bench-node",
                        plugin_dir=os.path.join(tmp, "p"),
@@ -141,21 +149,64 @@ def bench_claim_to_ready(backend, n_cycles: int = 40):
                 raise RuntimeError(f"prepare failed: {resp.claims[uid].error}")
 
         chips = [c.index for c in backend.chips()]
-        lat_ms = []
-        phase_ms: dict = {}
-        for i in range(n_cycles):
+
+        def cycle(tag, configs=None, devices=None, breakdown=None):
+            """One full wire-level prepare->unprepare cycle; returns the
+            prepare latency in ms."""
             obj = _make_claim(cluster, chips,
-                              f"bench-{i}-{uuid.uuid4().hex[:6]}")
+                              f"bench-{tag}-{uuid.uuid4().hex[:6]}",
+                              configs=configs, devices=devices)
             t0 = time.perf_counter()
             grpc_prepare(obj)
-            lat_ms.append((time.perf_counter() - t0) * 1e3)
-            for k, v in state.last_prepare_breakdown.items():
-                phase_ms.setdefault(k, []).append(v)
+            lat = (time.perf_counter() - t0) * 1e3
+            if breakdown is not None:
+                for k, v in state.last_prepare_breakdown.items():
+                    breakdown.setdefault(k, []).append(v)
             ureq = dra.NodeUnprepareResourcesRequest()
             uc = ureq.claims.add()
             uc.uid = obj["metadata"]["uid"]
             uc.name, uc.namespace = obj["metadata"]["name"], "default"
             unprepare(ureq)
+            return lat
+
+        lat_ms = []
+        phase_ms: dict = {}
+        for i in range(n_cycles):
+            lat_ms.append(cycle(str(i), breakdown=phase_ms))
+
+        def config_cycle(tag, configs=None, devices=None):
+            """claim-to-ready p50 for one BASELINE.md allocation config
+            (exclusive is the main loop above; these cover the time-sliced
+            and subslice (MIG-analog) configs; the multi-node CD config is
+            bench_cd_convergence; multiprocess is excluded — its prepare
+            legitimately blocks on a per-claim coordinator Deployment)."""
+            n = max(3, n_cycles // 3)
+            lats = sorted(cycle(f"{tag}-{i}", configs=configs,
+                                devices=devices) for i in range(n))
+            return statistics.median(lats)
+
+        from tpu_dra.api.types import API_VERSION
+        from tpu_dra.infra import featuregates
+        # Snapshot-and-restore: reset() would wipe gate overrides the
+        # embedding process set before calling this phase.
+        gates_before = featuregates.Features.overrides_snapshot()
+        featuregates.Features.set_from_string("TimeSlicingSettings=true")
+        try:
+            ts_cfg = [{"source": "FromClaim", "requests": [], "opaque": {
+                "driver": TPU_DRIVER_NAME, "parameters": {
+                    "apiVersion": API_VERSION, "kind": "TpuConfig",
+                    "sharing": {"strategy": "TimeSlicing",
+                                "timeSlicingConfig": {"interval": "Short"}},
+                }}}]
+            p50_ts = config_cycle("ts", configs=ts_cfg)
+        finally:
+            featuregates.Features.restore_overrides(gates_before)
+        # Subslices exist only on multi-core chips (v5p 2 cores; v5e is
+        # single-core -> no proper-subset placements to claim).
+        from tpu_dra.tpuplugin.deviceinfo import subslice_placements
+        placements = subslice_placements(backend.chips()[0])
+        p50_sub = (config_cycle("sub", devices=[placements[0].name])
+                   if placements else None)
 
         # One claim stays prepared so the psum phase runs on the devices the
         # driver actually allocated (its CDI env is the workload's view).
@@ -175,6 +226,10 @@ def bench_claim_to_ready(backend, n_cycles: int = 40):
     out = {
         "claim_to_ready_p50_ms": statistics.median(lat_ms),
         "claim_to_ready_p95_ms": lat_ms[int(0.95 * (len(lat_ms) - 1))],
+        "claim_to_ready_p50_timeslice_ms": round(p50_ts, 3),
+        # None = no subslice devices on this generation (single-core chips)
+        "claim_to_ready_p50_subslice_ms": (round(p50_sub, 3)
+                                           if p50_sub is not None else None),
         "n_chips": len(chips),
         "visible_chips": env.get("TPU_VISIBLE_CHIPS", ""),
     }
@@ -323,6 +378,98 @@ def bench_psum(jax_probe, visible_chips: str):
     return r
 
 
+def _train_step_rate(jax_probe, cfg, batch, steps):
+    """Measure one train-step config: (step_s, final loss, state).
+
+    Timing: n chained train steps + a scalar loss fetch. The scalar
+    fetch is the only synchronization that holds on every PJRT backend
+    (block_until_ready is a no-op on remote-tunnel platforms); its
+    constant round-trip cancels in the two-point measurement."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from tpu_dra.workloads.model import (
+        TransformerLM, init_params, make_train_step, shard_params,
+    )
+
+    device = jax_probe["devices"][0]
+    mesh = Mesh(np.array([device]).reshape(1, 1), ("data", "model"))
+    with jax.default_device(device):
+        params = shard_params(init_params(jax.random.PRNGKey(0), cfg),
+                              mesh, cfg)
+        tokens = jnp.asarray(
+            np.random.RandomState(0).randint(0, cfg.vocab,
+                                             (batch, cfg.max_seq)),
+            dtype=jnp.int32)
+    step = make_train_step(TransformerLM(cfg), mesh)
+    state = {"params": params}
+
+    def run(n):
+        t0 = time.perf_counter()
+        loss = None
+        for _ in range(n):
+            state["params"], loss = step(state["params"], tokens)
+        loss_v = float(loss)
+        return time.perf_counter() - t0, loss_v
+
+    run(1)  # compile + warm
+    t_small, _ = run(1)
+    t_big, loss_v = run(1 + steps)
+    return max((t_big - t_small) / steps, 1e-9), loss_v, state
+
+
+def _flops_per_token(cfg, n_params: int):
+    """(flops_per_token, matmul_params): standard 6*N fwd+bwd matmul
+    accounting over *matmul-participating* params plus causal attention
+    score/value matmuls (6*L*S*D per token). The input embedding table is
+    excluded from the 6N term: its forward op is a gather, not a matmul
+    (the unembed projection is a real matmul and stays). Counting the
+    gather table inflated round-2 MFU by ~12%. Shared by bench_mfu and
+    bench_long_context so their MFU numbers stay comparable."""
+    matmul_params = n_params - cfg.vocab * cfg.d_model
+    return (6 * matmul_params
+            + 6 * cfg.n_layers * cfg.max_seq * cfg.d_model), matmul_params
+
+
+def bench_long_context(jax_probe, steps: int = 4):
+    """Single-chip long-context train step: the flagship model at
+    S=8192 (flash kernel + fused rope — the [S,S] score matrix would be
+    256MB/head here; the kernel keeps attention O(block)). Beyond one
+    chip's VMEM window the SP path takes over (ring attention,
+    __graft_entry__.dryrun_multichip); this phase pins the single-chip
+    end of that curve."""
+    import math as _math
+
+    from tpu_dra.native.tpuinfo import PEAK_BF16_TFLOPS
+    from tpu_dra.workloads.model import ModelConfig
+
+    if jax_probe["platform"] != "tpu":
+        return {}
+    cfg = ModelConfig(vocab=32768, d_model=2048, n_heads=16, n_layers=8,
+                      d_ff=8192, max_seq=8192)
+    step_s, loss_v, state = _train_step_rate(jax_probe, cfg, batch=1,
+                                             steps=steps)
+    assert _math.isfinite(loss_v), f"non-finite long-ctx loss: {loss_v}"
+    import jax
+    n_params = sum(x.size for x in jax.tree.leaves(state["params"]))
+    tokens_per_step = cfg.max_seq - 1
+    flops_per_token, _ = _flops_per_token(cfg, n_params)
+    out = {
+        "long_ctx_seq": cfg.max_seq,
+        "long_ctx_step_s": round(step_s, 4),
+        "long_ctx_tokens_per_s": round(tokens_per_step / step_s, 1),
+    }
+    gen = jax_probe["generation"]
+    if gen in PEAK_BF16_TFLOPS:
+        out["long_ctx_mfu"] = round(
+            flops_per_token * tokens_per_step / step_s / 1e12
+            / PEAK_BF16_TFLOPS[gen], 4)
+    return out
+
+
 def bench_mfu(jax_probe, steps: int = 10):
     """Single-chip model throughput: TransformerLM train step, realistic
     size, on the first (real) device. Reports tokens/s, achieved model
@@ -349,49 +496,13 @@ def bench_mfu(jax_probe, steps: int = 10):
                           d_ff=512, max_seq=128)
         batch = 4
 
-    device = jax_probe["devices"][0]
-    mesh = Mesh(np.array([device]).reshape(1, 1), ("data", "model"))
-    with jax.default_device(device):
-        params = shard_params(init_params(jax.random.PRNGKey(0), cfg),
-                              mesh, cfg)
-        tokens = jnp.asarray(
-            np.random.RandomState(0).randint(0, cfg.vocab,
-                                             (batch, cfg.max_seq)),
-            dtype=jnp.int32)
-    step = make_train_step(TransformerLM(cfg), mesh)
-
-    state = {"params": params}
-
-    def run(n):
-        """Time n chained train steps + a scalar loss fetch. The scalar
-        fetch is the only synchronization that holds on every PJRT backend
-        (block_until_ready is a no-op on remote-tunnel platforms); its
-        constant round-trip cancels in the two-point measurement."""
-        t0 = time.perf_counter()
-        loss = None
-        for _ in range(n):
-            state["params"], loss = step(state["params"], tokens)
-        loss_v = float(loss)
-        return time.perf_counter() - t0, loss_v
-
-    run(1)  # compile + warm
-    t_small, _ = run(1)
-    t_big, loss_v = run(1 + steps)
-    step_s = max((t_big - t_small) / steps, 1e-9)
+    step_s, loss_v, state = _train_step_rate(jax_probe, cfg, batch, steps)
     assert math.isfinite(loss_v), f"non-finite loss: {loss_v}"
 
     n_params = sum(x.size for x in jax.tree.leaves(state["params"]))
     # Trained tokens per step: the loss consumes seq-1 positions.
     tokens_per_step = batch * (cfg.max_seq - 1)
-    # Standard matmul-FLOPs accounting: 6*N per trained token (fwd+bwd)
-    # over *matmul-participating* params plus causal attention score/value
-    # matmuls, 6*L*S*D per token. The input embedding table is excluded
-    # from the 6N term: its forward op is a gather, not a matmul (the
-    # unembed projection is a real matmul and stays). Counting the gather
-    # table inflated round-2 MFU by ~12%.
-    matmul_params = n_params - cfg.vocab * cfg.d_model
-    flops_per_token = (6 * matmul_params
-                       + 6 * cfg.n_layers * cfg.max_seq * cfg.d_model)
+    flops_per_token, matmul_params = _flops_per_token(cfg, n_params)
     step_tflops = flops_per_token * tokens_per_step / step_s / 1e12
     out = {
         "mfu_model_params": int(n_params),
@@ -444,6 +555,10 @@ def main():
             out.update(bench_mfu(jax_probe))
         except Exception as e:  # noqa: BLE001 — MFU phase is best-effort
             out["mfu_error"] = str(e)
+        try:
+            out.update(bench_long_context(jax_probe))
+        except Exception as e:  # noqa: BLE001 — best-effort
+            out["long_ctx_error"] = str(e)
 
     result = {
         "metric": "claim_to_ready_p50_ms",

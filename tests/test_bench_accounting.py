@@ -51,3 +51,27 @@ class TestMfuAccounting:
         out = bench.bench_mfu(probe, steps=2)
         assert out["mfu_matmul_params"] == out["mfu_model_params"] - 512 * 128
         assert out["step_tflops_per_s"] > 0
+
+    def test_long_context_phase_is_tpu_only(self):
+        """The S=8192 flagship config would take minutes on CPU; the
+        phase must no-op there (it reports {} -> no keys in the line)."""
+        probe = {**bench.probe_jax(), "platform": "cpu", "generation": None}
+        assert bench.bench_long_context(probe) == {}
+
+
+class TestClaimToReadyConfigs:
+    def test_per_config_p50s_reported(self, tmp_path):
+        """BASELINE.md claim-to-ready row lists the allocation configs;
+        the bench reports p50 per config: exclusive (main), time-sliced,
+        and subslice where the generation has multi-core chips."""
+        from tpu_dra.native.tpuinfo import FakeBackend, default_fake_chips
+        out = bench.bench_claim_to_ready(
+            FakeBackend(default_fake_chips(1, "v5p")), n_cycles=3)
+        assert out["claim_to_ready_p50_timeslice_ms"] > 0
+        assert out["claim_to_ready_p50_subslice_ms"] > 0  # v5p: 2 cores
+
+    def test_subslice_config_none_on_single_core_chips(self):
+        from tpu_dra.native.tpuinfo import FakeBackend, default_fake_chips
+        out = bench.bench_claim_to_ready(
+            FakeBackend(default_fake_chips(1, "v5e")), n_cycles=3)
+        assert out["claim_to_ready_p50_subslice_ms"] is None

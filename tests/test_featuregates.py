@@ -56,6 +56,18 @@ class TestParsing:
         g.set_from_string(s)
         assert g.snapshot() == Features.snapshot()
 
+    def test_overrides_snapshot_restore(self):
+        """Temporary gate flips (bench's time-slicing phase) must restore
+        the process's prior overrides, not wipe them like reset()."""
+        Features.set_from_string("MultiprocessSupport=true")
+        before = Features.overrides_snapshot()
+        Features.set_from_string("TimeSlicingSettings=true,"
+                                 "MultiprocessSupport=false")
+        Features.restore_overrides(before)
+        assert Features.enabled("MultiprocessSupport")
+        assert not Features.enabled("TimeSlicingSettings")
+        assert Features.overrides_snapshot() == before
+
 
 class TestLockToDefault:
     def test_locked(self):

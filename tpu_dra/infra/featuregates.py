@@ -144,6 +144,18 @@ class FeatureGate:
         with self._lock:
             self._overrides.clear()
 
+    def overrides_snapshot(self) -> Dict[str, bool]:
+        """The explicit overrides only (unlike snapshot(), which folds in
+        defaults) — the value restore_overrides() round-trips, for code
+        that must temporarily flip gates without wiping what the process
+        set before it."""
+        with self._lock:
+            return dict(self._overrides)
+
+    def restore_overrides(self, overrides: Dict[str, bool]) -> None:
+        with self._lock:
+            self._overrides = dict(overrides)
+
 
 # Process-global gate registry, like the reference's package-level Features.
 Features = FeatureGate()

@@ -106,6 +106,13 @@ class DeviceState:
         self._cdi.create_standard_device_spec_file(backend.chips())
         self._checkpoint = self._ckpt_mgr.load_or_init()
 
+    @property
+    def backend(self):
+        """The chip-info backend (read-only seam for collaborators that
+        genuinely need hardware access, e.g. the health monitor — the
+        driver must not reach into _backend)."""
+        return self._backend
+
     def chip_indices(self) -> List[int]:
         """Indices of all chips on this node (board-level health events
         address every chip; the driver must not reach into _backend)."""

@@ -57,7 +57,7 @@ class TpuDriver(DriverCallbacks):
         self._health: Optional[DeviceHealthMonitor] = None
         if featuregates.enabled(featuregates.TPUDeviceHealthCheck):
             self._health = DeviceHealthMonitor(
-                state._backend, self._on_unhealthy_event,
+                state.backend, self._on_unhealthy_event,
                 additional_codes_to_ignore=additional_codes_to_ignore)
 
     # -- lifecycle ----------------------------------------------------------

@@ -331,13 +331,19 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[...] = dv_acc.astype(dv_ref.dtype)
 
 
-def _rope_operands(s, d, rope):
+def _rope_operands(s, d, rope, dtype):
     """(extra_inputs, extra_specs) for the rope tables — the [S, D]
     tables ride constant index maps, so Mosaic keeps them VMEM-resident
-    across the grid like K/V."""
+    across the grid like K/V. For bf16 inputs the tables are stored bf16
+    too (the rotation multiplies promote to fp32 in-kernel): fp32 tables
+    are 2x the VMEM — the difference between S=8192 fitting in the 16MB
+    scoped-vmem budget and an OOM — and bf16 cos/sin error is below the
+    bf16 matmul noise floor the scores already carry."""
     if not rope:
         return (), ()
     cos_t, sinm_t = _rope_tables(s, d)
+    if dtype == jnp.bfloat16:
+        cos_t, sinm_t = cos_t.astype(dtype), sinm_t.astype(dtype)
     spec = pl.BlockSpec((s, d), lambda b, i: (0, 0))
     return (cos_t, sinm_t), (spec, spec)
 
@@ -348,7 +354,7 @@ def _fwd_call(q, k, v, causal, block_q, block_k, interpret, rope):
     sm_scale = 1.0 / math.sqrt(d)
     kernel = functools.partial(_fwd_kernel, block_k=block_k, seq_len=s,
                                causal=causal, sm_scale=sm_scale, rope=rope)
-    rope_in, rope_specs = _rope_operands(s, d, rope)
+    rope_in, rope_specs = _rope_operands(s, d, rope, q.dtype)
     return pl.pallas_call(
         kernel,
         grid=(bh, s // block_q),
@@ -398,7 +404,7 @@ def _flash_bwd_rule(causal, block_q, block_k, interpret, rope, res, cts):
     delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)[:, None, :]
 
-    rope_in, rope_specs = _rope_operands(s, d, rope)
+    rope_in, rope_specs = _rope_operands(s, d, rope, q.dtype)
     dq_kernel = functools.partial(_bwd_dq_kernel, block_k=block_k,
                                   seq_len=s, causal=causal,
                                   sm_scale=sm_scale, rope=rope)
