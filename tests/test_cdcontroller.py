@@ -108,24 +108,32 @@ class TestStamping:
 
 
 class TestReadiness:
-    def _set_ds_ready(self, cluster, cd, ready, desired=None):
-        dsname = daemon_object_name(cd)
-        ds = cluster.get(DAEMONSETS, dsname, NS)
-        ds["status"] = {"numberReady": ready,
-                        "desiredNumberScheduled": desired
-                        if desired is not None else ready}
-        cluster.update_status(DAEMONSETS, ds)
+    """Readiness is counted from cd.status.nodes — the entries the
+    cd-daemons maintain (controller._update_readiness) — not the
+    DaemonSet's kubelet-aggregated numberReady."""
+
+    def _register_nodes(self, cluster, cd, ready, registered=None,
+                        name=None):
+        name = name or cd["metadata"]["name"]
+        fresh = get_cd(cluster, name)
+        n = registered if registered is not None else ready
+        fresh.setdefault("status", {})["nodes"] = [
+            {"name": f"node-{i}", "ipAddress": f"10.0.0.{i}",
+             "sliceID": "s0", "index": i,
+             "status": "Ready" if i < ready else "NotReady"}
+            for i in range(n)]
+        cluster.update_status(COMPUTEDOMAINS, fresh)
 
     def test_ready_when_numnodes_met(self, harness):
         cluster = harness["cluster"]
         cd = make_cd(cluster, num_nodes=2)
         assert cluster.wait_for(
             lambda: _exists(cluster, DAEMONSETS, daemon_object_name(cd), NS))
-        self._set_ds_ready(cluster, cd, 2)
+        self._register_nodes(cluster, cd, ready=2)
         assert cluster.wait_for(lambda: (get_cd(cluster).get("status") or {})
                                 .get("status") == "Ready")
         # Drop below numNodes -> NotReady
-        self._set_ds_ready(cluster, cd, 1, desired=2)
+        self._register_nodes(cluster, cd, ready=1, registered=2)
         assert cluster.wait_for(lambda: get_cd(cluster)["status"]["status"]
                                 == "NotReady")
 
@@ -134,10 +142,37 @@ class TestReadiness:
         cd = make_cd(cluster, name="cd-z", num_nodes=0, rct_name="rct-z")
         assert cluster.wait_for(
             lambda: _exists(cluster, DAEMONSETS, daemon_object_name(cd), NS))
-        self._set_ds_ready(cluster, cd, 3, desired=3)
+        self._register_nodes(cluster, cd, ready=3, name="cd-z")
         assert cluster.wait_for(
             lambda: (get_cd(cluster, "cd-z").get("status") or {})
             .get("status") == "Ready")
+        # A registered-but-not-ready node drops the open-ended CD to
+        # NotReady (every registered daemon must be ready).
+        self._register_nodes(cluster, cd, ready=2, registered=3, name="cd-z")
+        assert cluster.wait_for(
+            lambda: get_cd(cluster, "cd-z")["status"]["status"] == "NotReady")
+
+    def test_numnodes_zero_scheduled_lower_bound(self, harness):
+        """A daemon pod scheduled but not yet registered (image pull in
+        flight) must hold the open-ended CD NotReady: flipping Ready at
+        ready==registered would let an early channel prepare snapshot a
+        peer env missing the pending node."""
+        cluster = harness["cluster"]
+        cd = make_cd(cluster, name="cd-s", num_nodes=0, rct_name="rct-s")
+        assert cluster.wait_for(
+            lambda: _exists(cluster, DAEMONSETS, daemon_object_name(cd), NS))
+        ds = cluster.get(DAEMONSETS, daemon_object_name(cd), NS)
+        ds["status"] = {"numberReady": 0, "desiredNumberScheduled": 2}
+        cluster.update_status(DAEMONSETS, ds)
+        # One node registered+ready; DS says two are scheduled.
+        self._register_nodes(cluster, cd, ready=1, name="cd-s")
+        assert cluster.wait_for(
+            lambda: (get_cd(cluster, "cd-s").get("status") or {})
+            .get("status") == "NotReady")
+        # Second daemon registers ready -> Ready.
+        self._register_nodes(cluster, cd, ready=2, name="cd-s")
+        assert cluster.wait_for(
+            lambda: get_cd(cluster, "cd-s")["status"]["status"] == "Ready")
 
 
 class TestPodDeletion:

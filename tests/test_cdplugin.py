@@ -11,6 +11,7 @@ convergence that the reference can only test e2e (SURVEY §3.3).
 import json
 import os
 import threading
+import time
 import uuid
 
 import pytest
@@ -35,12 +36,12 @@ LABEL = apitypes.COMPUTE_DOMAIN_LABEL_KEY
 DRIVER = apitypes.COMPUTE_DOMAIN_DRIVER_NAME
 
 
-def make_cd(cluster, name="cd-1", namespace=NS):
+def make_cd(cluster, name="cd-1", namespace=NS, rct_name="rct"):
     return cluster.create(COMPUTEDOMAINS, {
         "apiVersion": apitypes.API_VERSION, "kind": "ComputeDomain",
         "metadata": {"name": name, "namespace": namespace},
         "spec": {"numNodes": 2, "channel": {
-            "resourceClaimTemplate": {"name": "rct"},
+            "resourceClaimTemplate": {"name": rct_name},
             "allocationMode": "Single"}},
     })
 
@@ -78,7 +79,9 @@ def _make_claim(cluster, devices, cfg, namespace, name):
 
 def register_node(cluster, cd, node="node-a", ip="10.0.0.1",
                   slice_id="slice-A", index=0, ready=True):
-    """Play the cd-daemon: insert the node into CD status."""
+    """Play the cd-daemon: insert the node into CD status. ready=True
+    also plays the controller's readiness flip (channel prepare gates on
+    domain-level Ready, not just this-node Ready — assert_node_ready)."""
     mgr = DaemonCDManager(
         cluster, cd_name=cd["metadata"]["name"],
         cd_namespace=cd["metadata"]["namespace"],
@@ -87,6 +90,11 @@ def register_node(cluster, cd, node="node-a", ip="10.0.0.1",
     mgr.ensure_node_info()
     if ready:
         mgr.set_node_status(True)
+        fresh = cluster.get(COMPUTEDOMAINS, cd["metadata"]["name"],
+                            cd["metadata"]["namespace"])
+        fresh.setdefault("status", {})["status"] = (
+            apitypes.COMPUTE_DOMAIN_STATUS_READY)
+        cluster.update_status(COMPUTEDOMAINS, fresh)
     return mgr
 
 
@@ -198,6 +206,45 @@ class TestChannelPrepare:
         res = prepare(harness, claim)
         assert res.error.startswith("permanent")
         assert "does not match" in res.error
+
+    def test_undersized_workload_degrades_after_settle_grace(self, harness,
+                                                             monkeypatch):
+        """A workload running fewer pods than spec.numNodes can never flip
+        the domain Ready (daemons are summoned by its own labels): after
+        the settle grace the gate degrades to this-node-Ready and the pod
+        starts with a best-effort peer env instead of wedging forever."""
+        from tpu_dra.cdplugin.device_state import DeviceState as DS
+        monkeypatch.setattr(DS, "DOMAIN_SETTLE_GRACE_S", 0.2)
+        cluster = harness["cluster"]
+        cd = make_cd(cluster)  # numNodes=2
+        # Only THIS node's daemon registers and is ready; play the daemon
+        # without the controller flip (domain stays NotReady).
+        mgr = register_node(cluster, cd, "node-a", "10.0.0.1", ready=False)
+        mgr.set_node_status(True)
+        claim = make_channel_claim(cluster, cd)
+        t0 = time.monotonic()
+        res = prepare(harness, claim)
+        assert res.error == ""
+        assert time.monotonic() - t0 >= 0.2  # held strict for the grace
+        env = claim_env(harness, claim["metadata"]["uid"])
+        assert env["TPU_PROCESS_COUNT"] == "1"  # best-effort snapshot
+
+    def test_per_cd_change_signal(self, harness):
+        """wait_for_change is keyed by CD uid: churn on OTHER CDs must not
+        wake a waiter (each spurious wake costs a claim fetch + prepare
+        attempt on a real cluster)."""
+        mgr = harness["state"]._cd
+        cluster = harness["cluster"]
+        cd_a = make_cd(cluster, name="cd-a", rct_name="rct-a")
+        cd_b = make_cd(cluster, name="cd-b", rct_name="rct-b")
+        assert cluster.wait_for(
+            lambda: mgr.get_by_uid(cd_a["metadata"]["uid"]) is not None)
+        gen_a = mgr.change_gen(cd_a["metadata"]["uid"])
+        # Churn B; A's generation must not move.
+        register_node(cluster, cd_b, "node-x", "10.9.9.9", ready=True)
+        assert cluster.wait_for(lambda: mgr.change_gen(
+            cd_b["metadata"]["uid"]) > 0)
+        assert mgr.change_gen(cd_a["metadata"]["uid"]) == gen_a
 
     def test_retry_budget_exhausts_when_never_ready(self, harness):
         cluster = harness["cluster"]

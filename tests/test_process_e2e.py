@@ -222,6 +222,133 @@ class TestProcessE2E:
                                             "team")), _diag(proc)
 
 
+class TestCrashRecovery:
+    """SIGKILL the plugin at arbitrary points inside a prepare storm,
+    restart over the same state dir, and assert the checkpoint's crash
+    contract: the process always comes back (a torn slot never bricks
+    startup — CheckpointManager slot scheme), completed claims survive
+    with their devices, and in-flight claims re-prepare idempotently.
+    This is the adversarial version of the hand-torn-file unit tests in
+    test_e2e_prepare.py::TestCheckpointSlots: real kill timing produces
+    whatever half-written state the syscall schedule allows."""
+
+    def _env(self, e2e, plugin_dir):
+        return {
+            "PLUGIN_DIR": plugin_dir,
+            "REGISTRY_DIR": str(e2e["tmp"] / "registry"),
+            "CDI_ROOT": str(e2e["tmp"] / "cdi"),
+            "TPU_DRIVER_ROOT": str(e2e["tmp"] / "drv"),
+        }
+
+    def _mk_claim(self, api, name, chip):
+        return api.create(RESOURCECLAIMS, {
+            "apiVersion": "resource.k8s.io/v1", "kind": "ResourceClaim",
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": {"devices": {"requests": [{"name": "tpu"}]}},
+            "status": {"allocation": {"devices": {"results": [
+                {"request": "tpu", "driver": apitypes.TPU_DRIVER_NAME,
+                 "pool": "node-a", "device": f"chip-{chip}"}],
+                "config": []}}},
+        })
+
+    def _grpc(self, plugin_dir, proc):
+        sock = os.path.join(plugin_dir, "dra.sock")
+        assert wait_for(lambda: os.path.exists(sock)), _diag(proc)
+        return kubelet_stubs(sock)
+
+    @staticmethod
+    def _rpc(fn, req, proc, timeout=20.0):
+        """Call with connect retries: after a SIGKILL the old socket file
+        lingers until the restarted server rebinds it."""
+        import grpc
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                return fn(req, timeout=15)
+            except grpc.RpcError:
+                if time.monotonic() > deadline:
+                    raise AssertionError(f"rpc never came up: {_diag(proc)}")
+                time.sleep(0.2)
+
+    def test_sigkill_storm_recovers(self, e2e):
+        import random
+
+        rng = random.Random(7)
+        api = e2e["api"]
+        plugin_dir = str(e2e["tmp"] / "plugin")
+        proc = e2e["spawn"]("tpu_dra.tpuplugin.main",
+                            extra_env=self._env(e2e, plugin_dir))
+        assert wait_for(lambda: api.list(RESOURCESLICES)), _diag(proc)
+
+        # An anchor claim completed before any crash: must survive all
+        # of them with its device intact.
+        anchor = self._mk_claim(api, "anchor", 0)
+        channel, prepare, unprepare = self._grpc(plugin_dir, proc)
+        req = dra.NodePrepareResourcesRequest()
+        c = req.claims.add()
+        c.uid, c.name, c.namespace = anchor["metadata"]["uid"], "anchor", "default"
+        resp = self._rpc(prepare, req, proc)
+        assert resp.claims[c.uid].error == "", resp.claims[c.uid].error
+        channel.close()
+
+        seq = 0
+        for round_i in range(3):
+            # Prepare storm in the foreground; kill mid-flight.
+            channel, prepare, unprepare = self._grpc(plugin_dir, proc)
+            deadline = time.monotonic() + rng.uniform(0.05, 0.4)
+            storm = []
+            try:
+                while time.monotonic() < deadline:
+                    seq += 1
+                    cl = self._mk_claim(api, f"storm-{seq}", seq % 4)
+                    storm.append(cl)
+                    r = dra.NodePrepareResourcesRequest()
+                    cc = r.claims.add()
+                    cc.uid = cl["metadata"]["uid"]
+                    cc.name, cc.namespace = cl["metadata"]["name"], "default"
+                    prepare(r, timeout=15)
+            except Exception:
+                pass  # the kill below may race a call already in flight
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=10)
+            channel.close()
+
+            # Restart over the same dirs: MUST come up (torn slots repair)
+            proc = e2e["spawn"]("tpu_dra.tpuplugin.main",
+                                extra_env=self._env(e2e, plugin_dir))
+            channel, prepare, unprepare = self._grpc(plugin_dir, proc)
+
+            # Anchor claim: still prepared, same device, idempotent.
+            r = dra.NodePrepareResourcesRequest()
+            cc = r.claims.add()
+            cc.uid = anchor["metadata"]["uid"]
+            cc.name, cc.namespace = "anchor", "default"
+            resp = self._rpc(prepare, r, proc)
+            assert resp.claims[cc.uid].error == "", (
+                f"round {round_i}: {resp.claims[cc.uid].error}")
+            got = [d.device_name for d in resp.claims[cc.uid].devices]
+            assert got == ["chip-0"], f"round {round_i}: {got}"
+
+            # Every storm claim re-prepares cleanly (completed ones are
+            # idempotent; in-flight ones redo), then unprepares.
+            for cl in storm:
+                r = dra.NodePrepareResourcesRequest()
+                cc = r.claims.add()
+                cc.uid = cl["metadata"]["uid"]
+                cc.name = cl["metadata"]["name"]
+                cc.namespace = "default"
+                resp = prepare(r, timeout=15)
+                assert resp.claims[cc.uid].error == "", (
+                    f"{cl['metadata']['name']}: {resp.claims[cc.uid].error}")
+                ur = dra.NodeUnprepareResourcesRequest()
+                uc = ur.claims.add()
+                uc.uid = cl["metadata"]["uid"]
+                uc.name, uc.namespace = cl["metadata"]["name"], "default"
+                uresp = unprepare(ur, timeout=15)
+                assert uresp.claims[uc.uid].error == ""
+            channel.close()
+
+
 def _exists(api, gvr, name, ns=None):
     try:
         api.get(gvr, name, ns)

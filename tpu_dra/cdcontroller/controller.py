@@ -6,7 +6,10 @@ follow computedomain.go:57-289:
 
 - add/update: add finalizer, stamp daemon RCT + DaemonSet (driver
   namespace) and the user-facing workload RCT (CD namespace); flip CD
-  status from DaemonSet readiness (daemonset.go:362-389).
+  status from the per-node readiness the cd-daemons maintain in
+  cd.status.nodes (_update_readiness — the daemonset.go:362-389 analog,
+  with the DaemonSet's desiredNumberScheduled as the open-ended lower
+  bound).
 - delete: ordered teardown — delete stamped objects, strip node labels,
   assert removal, then remove the finalizer (:237-271).
 - daemon pod deletion: drop that node from CD status by pod IP, flip
@@ -280,25 +283,44 @@ class Controller:
         log.info("daemonset %s/%s converged onto current template", ns, name)
 
     def _update_readiness(self, cd: Dict) -> None:
-        """daemonset.go:362-389: global CD status follows DaemonSet
-        readiness vs numNodes. With numNodes==0 (deprecated-field semantics,
-        SliceDaemonsWithDNSNames default) the CD is Ready once every
-        scheduled daemon is ready and at least one is."""
+        """daemonset.go:362-389 analog: global CD status vs numNodes. With
+        numNodes==0 (deprecated-field semantics, SliceDaemonsWithDNSNames
+        default) the CD is Ready once every registered daemon is ready and
+        at least one is.
+
+        Readiness is counted from cd.status.nodes — the per-node entries
+        the cd-daemons themselves maintain — rather than the DaemonSet's
+        kubelet-aggregated numberReady. Same convergence signal (each
+        daemon's startup probe drives both), one fewer freshness
+        dependency, and it is the SAME source the CD plugin's channel
+        gate reads (assert_node_ready), so "domain Ready" and "my peers
+        are all in the env snapshot" can never disagree. The DaemonSet
+        existence check stays: Ready must not flip before the CD's
+        infrastructure is stamped."""
         uid = cd["metadata"]["uid"]
         hits = self.ds_informer.get_by_index(CD_LABEL_INDEX, uid)
         if not hits:
             return
-        status = hits[0].get("status") or {}
-        ready = status.get("numberReady", 0)
-        desired = status.get("desiredNumberScheduled", 0)
+        nodes = (cd.get("status") or {}).get("nodes") or []
+        ready = sum(1 for n in nodes
+                    if n.get("status") == apitypes.COMPUTE_DOMAIN_STATUS_READY)
         num_nodes = (cd.get("spec") or {}).get("numNodes", 0)
         if num_nodes > 0:
             want = (apitypes.COMPUTE_DOMAIN_STATUS_READY
                     if ready >= num_nodes
                     else apitypes.COMPUTE_DOMAIN_STATUS_NOT_READY)
         else:
+            # Open-ended CD: every expected daemon ready and at least one.
+            # Expected = max(registered, DS desiredNumberScheduled): a
+            # scheduled-but-unregistered daemon (pod still pulling) must
+            # hold the domain NotReady, or an early channel prepare would
+            # snapshot a partial peer env. Harnesses with no kubelet
+            # maintaining DS status degrade to the registered count.
+            desired = (hits[0].get("status") or {}).get(
+                "desiredNumberScheduled", 0)
+            expected = max(len(nodes), desired)
             want = (apitypes.COMPUTE_DOMAIN_STATUS_READY
-                    if ready > 0 and ready >= desired
+                    if ready > 0 and ready >= expected
                     else apitypes.COMPUTE_DOMAIN_STATUS_NOT_READY)
         self._set_cd_status(uid, want)
 

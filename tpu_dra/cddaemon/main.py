@@ -218,8 +218,16 @@ class DaemonRunner:
 
     def _readiness_loop(self) -> None:
         """Probe the local daemon and mirror readiness into the per-node CD
-        status (the PodManager startup-probe mirror, podmanager.go:35-120)."""
-        while not self._stop.wait(1.0):
+        status (the PodManager startup-probe mirror, podmanager.go:35-120).
+
+        Adaptive cadence, like a kubelet startupProbe with a small period
+        vs. the steady-state readinessProbe: while NOT ready (startup, or
+        after a watchdog restart) probe every 50ms so workload claims
+        blocked on the readiness dance release at probe latency — a fixed
+        1s tick was the single largest term of CD convergence (bench
+        cd_convergence ~1.0s of which ~0.9s was waiting for this mirror).
+        Once ready, 1s is plenty to notice a died daemon."""
+        while not self._stop.wait(0.05 if not self._last_ready else 1.0):
             probed_pid = self.process.pid()
             ready = probe_ready(self.ns.port)
             if ready:

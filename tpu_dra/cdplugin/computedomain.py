@@ -12,6 +12,8 @@ from __future__ import annotations
 import logging
 import os
 import shutil
+import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 from tpu_dra.api import types as apitypes
@@ -41,6 +43,56 @@ class ComputeDomainManager:
         self._domains_root = os.path.join(driver_plugin_dir, "domains")
         self.informer = Informer(client, COMPUTEDOMAINS)
         self.informer.add_indexer(UID_INDEX, uid_index)
+        # Change signal for readiness waiters (wait_for_change): a CD
+        # add/update bumps that CD's generation and wakes sleepers, so
+        # the readiness dance converges at watch-event latency instead of
+        # the next poll tick. Generations are PER CD UID: a node with a
+        # prepare blocked on CD X must not pay a retry attempt (claim
+        # fetch + prepare pass) for every unrelated CD churning status.
+        self._change_cond = threading.Condition()
+        self._change_gens: Dict[str, int] = {}
+        self.informer.on_add(lambda obj: self._bump(obj))
+        self.informer.on_update(lambda old, new: self._bump(new))
+        # Deleted CDs drop their generation entry (bounded map in a
+        # node-lifetime daemon) — with a final bump so a waiter blocked
+        # on a CD that just vanished re-checks and fails fast.
+        self.informer.on_delete(lambda obj: self._bump(obj, drop=True))
+
+    def _bump(self, obj: Dict, drop: bool = False) -> None:
+        uid = (obj.get("metadata") or {}).get("uid", "")
+        with self._change_cond:
+            if drop:
+                self._change_gens.pop(uid, None)
+            else:
+                self._change_gens[uid] = self._change_gens.get(uid, 0) + 1
+            self._change_cond.notify_all()
+
+    def change_gen(self, cd_uid: str) -> int:
+        with self._change_cond:
+            return self._change_gens.get(cd_uid, 0)
+
+    def wait_for_change(self, cd_uid: str, seen_gen: Optional[int],
+                        timeout: float) -> int:
+        """Block until an event for THIS CD lands after `seen_gen` (or
+        timeout). Returns the current generation. Capture change_gen()
+        BEFORE checking state: an event between check and wait then
+        returns immediately instead of being missed. seen_gen=None (uid
+        not known yet, first failure) just sleeps the timeout.
+
+        Loops on the shared condition: notify_all fires for EVERY CD's
+        events, and a spurious wake must not be reported as a change —
+        the caller would pay a full retry attempt per unrelated event."""
+        deadline = time.monotonic() + timeout
+        with self._change_cond:
+            if seen_gen is None:
+                self._change_cond.wait(timeout)
+            else:
+                while self._change_gens.get(cd_uid, 0) == seen_gen:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._change_cond.wait(remaining)
+            return self._change_gens.get(cd_uid, 0)
 
     def start(self) -> None:
         self.informer.start()
@@ -69,28 +121,58 @@ class ComputeDomainManager:
         permanent — retrying cannot fix a cross-namespace reference."""
         cd = self.get_by_uid(cd_uid)
         if cd is None:
-            raise RetryableNotReady(f"computedomain {cd_uid} not found (yet)")
+            raise RetryableNotReady(f"computedomain {cd_uid} not found (yet)",
+                                    cd_uid=cd_uid)
         if cd["metadata"].get("namespace") != claim_namespace:
             raise PermanentError(
                 f"claim namespace {claim_namespace!r} does not match "
                 f"computedomain namespace {cd['metadata'].get('namespace')!r}")
         return cd
 
-    def assert_node_ready(self, cd_uid: str) -> Dict:
-        """Block the prepare until the CD status reports *this* node Ready
-        (the local-daemon release semantics of the DNS-names mode)."""
+    def assert_node_ready(self, cd_uid: str,
+                          require_domain_ready: bool = True) -> Dict:
+        """Block the prepare until the CD reports *this* node Ready — and,
+        while require_domain_ready, the domain itself Ready (the
+        controller flips that only once the expected membership is ready,
+        controller._update_readiness).
+
+        The domain-level gate matters here where it doesn't in the
+        reference: its channel device is a composition-independent
+        char-dev, while our workload env snapshots the CD's node list
+        (TPU_WORKER_HOSTNAMES, MEGASCALE_* topology) — preparing as soon
+        as the local daemon was up could inject a peer list missing nodes
+        that hadn't registered yet (seen as a missing-megascale-env race
+        in the multislice e2e once convergence got fast).
+
+        The caller BOUNDS the strict gate (device_state's settle grace):
+        daemons are summoned by channel prepares' own node labels, so a
+        workload running fewer pods than spec.numNodes would never flip
+        the domain Ready — an unconditional gate would wedge it in
+        ContainerCreating forever. After the grace the prepare degrades
+        to this-node-Ready with a best-effort env snapshot (the
+        pre-domain-gate behavior).
+        """
         cd = self.get_by_uid(cd_uid)
         if cd is None:
-            raise RetryableNotReady(f"computedomain {cd_uid} not found")
+            raise RetryableNotReady(f"computedomain {cd_uid} not found",
+                                    cd_uid=cd_uid)
         nodes = (cd.get("status") or {}).get("nodes") or []
         mine = next((n for n in nodes
                      if n.get("name") == self._node_name), None)
         if mine is None:
             raise RetryableNotReady(
-                f"node {self._node_name} not yet registered in cd {cd_uid}")
+                f"node {self._node_name} not yet registered in cd {cd_uid}",
+                cd_uid=cd_uid)
         if mine.get("status") != apitypes.COMPUTE_DOMAIN_STATUS_READY:
             raise RetryableNotReady(
-                f"node {self._node_name} not Ready in cd {cd_uid}")
+                f"node {self._node_name} not Ready in cd {cd_uid}",
+                cd_uid=cd_uid)
+        if (require_domain_ready
+                and (cd.get("status") or {}).get("status")
+                != apitypes.COMPUTE_DOMAIN_STATUS_READY):
+            raise RetryableNotReady(
+                f"cd {cd_uid} membership still settling (domain not Ready)",
+                cd_uid=cd_uid)
         return cd
 
     # -- node labeling (computedomain.go:280-332) ---------------------------
@@ -202,4 +284,10 @@ class ComputeDomainManager:
 
 
 class RetryableNotReady(Exception):
-    """Retried by the prepare envelope until the 45s budget runs out."""
+    """Retried by the prepare envelope until the 45s budget runs out.
+    Carries the CD uid (when known) so the retry can sleep on that CD's
+    change signal instead of the global ladder."""
+
+    def __init__(self, msg: str, cd_uid: str = ""):
+        super().__init__(msg)
+        self.cd_uid = cd_uid

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Dict, List, Optional
 
 from tpu_dra.api import scheme as apischeme
@@ -58,11 +59,31 @@ class DeviceState:
         # hung API server stalls kubelet's envelope either way. Ordering:
         # _label_lock is always taken outside self._lock.
         self._label_lock = threading.Lock()
+        # (first, last) attempt timestamps per claim (the domain-settle
+        # grace in _prepare_channel); in-memory only — a restart just
+        # re-grants the grace, which is the safe direction. Entries drop
+        # on success and on unprepare.
+        self._first_attempt: Dict[str, tuple] = {}
         self._checkpoint = self._ckpt_mgr.load_or_init()
 
     # ------------------------------------------------------------------
     # Prepare
     # ------------------------------------------------------------------
+
+    # How long a channel prepare insists on DOMAIN-level Ready before
+    # degrading to this-node-Ready with a best-effort env snapshot (see
+    # ComputeDomainManager.assert_node_ready). Generous vs the measured
+    # ~0.1s convergence; a fraction of kubelet's retry horizon.
+    DOMAIN_SETTLE_GRACE_S = 10.0
+    # Attempts further apart than this start a NEW grace window (a fresh
+    # kubelet envelope after a long gap re-arms the strict gate; within
+    # one envelope the retry ladder never pauses longer than ~7.5s).
+    ATTEMPT_GAP_RESET_S = 15.0
+
+    def wait_cd_change(self, cd_uid: str, seen_gen, timeout: float) -> int:
+        """See ComputeDomainManager.wait_for_change (event-driven retry
+        wake, keyed by CD uid)."""
+        return self._cd.wait_for_change(cd_uid, seen_gen, timeout)
 
     def prepare(self, claim: Dict) -> PrepareResult:
         """May raise RetryableNotReady (the driver retries inside its 45s
@@ -145,10 +166,26 @@ class DeviceState:
 
             # Label first (this is what summons the daemon pod), then wait.
             self._cd.add_node_label(config.domain_id)
-        cd = self._cd.assert_node_ready(config.domain_id)  # raises retryable
+        # Strict domain-Ready gate for the settle grace only, so a
+        # workload smaller than spec.numNodes (whose labels will never
+        # summon enough daemons to flip the domain) degrades to the
+        # node-Ready gate instead of wedging (assert_node_ready doc). A
+        # long gap between attempts re-arms the grace: a fresh kubelet
+        # envelope minutes later (slow daemon image pull the first time
+        # around) gets the strict gate again instead of snapshotting a
+        # partial peer env on its first attempt.
+        now = time.monotonic()
+        first, last = self._first_attempt.get(uid, (now, now))
+        if now - last > self.ATTEMPT_GAP_RESET_S:
+            first = now
+        self._first_attempt[uid] = (first, now)
+        strict = (now - first) < self.DOMAIN_SETTLE_GRACE_S
+        cd = self._cd.assert_node_ready(
+            config.domain_id, require_domain_ready=strict)  # raises retryable
 
         env = self._cd.workload_env(cd, channel_ids, config.allocation_mode)
         self._cdi.create_claim_spec_file(uid, env)
+        self._first_attempt.pop(uid, None)
         return self._complete(uid)
 
     def _assert_channels_free(self, claim_uid: str,
@@ -177,7 +214,8 @@ class DeviceState:
         cd = self._cd.get_by_uid(config.domain_id)
         if cd is None:
             raise RetryableNotReady(
-                f"computedomain {config.domain_id} not found")
+                f"computedomain {config.domain_id} not found",
+                cd_uid=config.domain_id)
         with self._lock:
             self._checkpoint.claims[uid] = PreparedClaim(
                 uid=uid, state=PREPARE_STARTED,
@@ -228,6 +266,7 @@ class DeviceState:
     # ------------------------------------------------------------------
 
     def unprepare(self, claim_uid: str) -> Optional[str]:
+        self._first_attempt.pop(claim_uid, None)
         # Whole-method serialization: see _label_lock in __init__.
         with self._label_lock:
             return self._unprepare_locked(claim_uid)

@@ -2,10 +2,11 @@
 
 The kube-controller-manager analog the CD machinery needs: the CD
 controller stamps per-CD DaemonSets whose nodeSelector is the CD label;
-something must turn those into pods as nodes get labeled, keep
-status.numberReady fresh (the controller flips the CD Ready on it,
-daemonset.go:362-389), and delete pods when labels go away (the
-workload-following teardown).
+something must turn those into pods as nodes get labeled, keep the DS
+status fresh (desiredNumberScheduled is the CD controller's lower bound
+for open-ended readiness; per-node readiness itself comes from
+cd.status.nodes — controller._update_readiness), and delete pods when
+labels go away (the workload-following teardown).
 """
 
 from __future__ import annotations
