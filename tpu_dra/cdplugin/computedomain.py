@@ -51,21 +51,50 @@ class ComputeDomainManager:
         # fetch + prepare pass) for every unrelated CD churning status.
         self._change_cond = threading.Condition()
         self._change_gens: Dict[str, int] = {}
+        self._membership_ts: Dict[str, float] = {}
+        self._last_membership: Dict[str, object] = {}
         self.informer.on_add(lambda obj: self._bump(obj))
-        self.informer.on_update(lambda old, new: self._bump(new))
+        self.informer.on_update(lambda old, new: self._bump(new, old=old))
         # Deleted CDs drop their generation entry (bounded map in a
         # node-lifetime daemon) — with a final bump so a waiter blocked
         # on a CD that just vanished re-checks and fails fast.
         self.informer.on_delete(lambda obj: self._bump(obj, drop=True))
 
-    def _bump(self, obj: Dict, drop: bool = False) -> None:
+    def _bump(self, obj: Dict, drop: bool = False,
+              old: Optional[Dict] = None) -> None:
         uid = (obj.get("metadata") or {}).get("uid", "")
         with self._change_cond:
             if drop:
                 self._change_gens.pop(uid, None)
+                self._membership_ts.pop(uid, None)
+                self._last_membership.pop(uid, None)
             else:
                 self._change_gens[uid] = self._change_gens.get(uid, 0) + 1
+                # Membership compared against OUR OWN last-seen value, not
+                # the handler's `old`: watch relists replay adds for every
+                # cached object (old=None), and stamping on those would
+                # re-arm the settle grace cluster-wide on each reconnect.
+                m = self._membership(obj)
+                if uid not in self._last_membership \
+                        or m != self._last_membership[uid]:
+                    # Membership progress (a node registered / flipped):
+                    # timestamped so the settle grace can distinguish "the
+                    # domain is still forming" from "nothing is coming".
+                    self._last_membership[uid] = m
+                    self._membership_ts[uid] = time.monotonic()
             self._change_cond.notify_all()
+
+    @staticmethod
+    def _membership(obj: Optional[Dict]):
+        if not obj:
+            return None
+        return sorted((n.get("name", ""), n.get("status", ""))
+                      for n in (obj.get("status") or {}).get("nodes") or [])
+
+    def last_membership_change(self, cd_uid: str, default: float = 0.0
+                               ) -> float:
+        with self._change_cond:
+            return self._membership_ts.get(cd_uid, default)
 
     def change_gen(self, cd_uid: str) -> int:
         with self._change_cond:
@@ -77,7 +106,9 @@ class ComputeDomainManager:
         timeout). Returns the current generation. Capture change_gen()
         BEFORE checking state: an event between check and wait then
         returns immediately instead of being missed. seen_gen=None (uid
-        not known yet, first failure) just sleeps the timeout.
+        not known before the first failure) waits from the CURRENT
+        generation — the only rung where an event landing mid-attempt can
+        be slept through, bounded by the ladder's 5ms first delay.
 
         Loops on the shared condition: notify_all fires for EVERY CD's
         events, and a spurious wake must not be reported as a change —
@@ -85,13 +116,12 @@ class ComputeDomainManager:
         deadline = time.monotonic() + timeout
         with self._change_cond:
             if seen_gen is None:
-                self._change_cond.wait(timeout)
-            else:
-                while self._change_gens.get(cd_uid, 0) == seen_gen:
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0:
-                        break
-                    self._change_cond.wait(remaining)
+                seen_gen = self._change_gens.get(cd_uid, 0)
+            while self._change_gens.get(cd_uid, 0) == seen_gen:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._change_cond.wait(remaining)
             return self._change_gens.get(cd_uid, 0)
 
     def start(self) -> None:

@@ -166,20 +166,27 @@ class DeviceState:
 
             # Label first (this is what summons the daemon pod), then wait.
             self._cd.add_node_label(config.domain_id)
-        # Strict domain-Ready gate for the settle grace only, so a
+        # Strict domain-Ready gate while the domain is SETTLING, so a
         # workload smaller than spec.numNodes (whose labels will never
         # summon enough daemons to flip the domain) degrades to the
-        # node-Ready gate instead of wedging (assert_node_ready doc). A
-        # long gap between attempts re-arms the grace: a fresh kubelet
-        # envelope minutes later (slow daemon image pull the first time
-        # around) gets the strict gate again instead of snapshotting a
-        # partial peer env on its first attempt.
+        # node-Ready gate instead of wedging (assert_node_ready doc).
+        # "Settling" = within the grace of this claim's first attempt OR
+        # of the CD's last membership change: registrations trickling in
+        # on a slow cluster keep re-arming the gate (degrading mid-trickle
+        # would snapshot a partial peer env — the flake this fixes), while
+        # a quiet domain that simply isn't growing degrades after one
+        # grace. A long gap between attempts also re-arms (a fresh kubelet
+        # envelope after the first one exhausted gets the strict gate
+        # back).
         now = time.monotonic()
         first, last = self._first_attempt.get(uid, (now, now))
         if now - last > self.ATTEMPT_GAP_RESET_S:
             first = now
         self._first_attempt[uid] = (first, now)
-        strict = (now - first) < self.DOMAIN_SETTLE_GRACE_S
+        settled_ref = max(first,
+                          self._cd.last_membership_change(config.domain_id,
+                                                          default=first))
+        strict = (now - settled_ref) < self.DOMAIN_SETTLE_GRACE_S
         cd = self._cd.assert_node_ready(
             config.domain_id, require_domain_ready=strict)  # raises retryable
 

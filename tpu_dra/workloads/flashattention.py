@@ -161,15 +161,20 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, block_k: int,
     row_max = jnp.full((block_q,), NEG_INF, jnp.float32)
     denom = jnp.zeros((block_q,), jnp.float32)
 
-    # Causal: K blocks strictly above the diagonal contribute nothing.
+    # Causal: K blocks strictly above the diagonal contribute nothing,
+    # and blocks strictly BELOW it (k_start+block_k-1 <= q_start) need no
+    # mask at all — the iota/compare/select only runs on the O(1)
+    # diagonal-straddling blocks, not the O(S) interior ones.
     num_k_blocks = seq_len // block_k
     if causal:
         last = jnp.minimum(num_k_blocks,
                            (q_start + block_q + block_k - 1) // block_k)
+        split = jnp.minimum(last, q_start // block_k)
     else:
         last = num_k_blocks
+        split = last
 
-    def body(kb, carry):
+    def body(kb, carry, *, masked):
         acc, row_max, denom = carry
         k_start = kb * block_k
         k_blk = k_ref[pl.dslice(k_start, block_k), :]
@@ -177,7 +182,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, block_k: int,
         if rope:
             k_blk = _rope_apply(k_blk, k_start, cos_ref, sinm_ref)
         scores = _dot(q, k_blk, trans_b=True) * sm_scale  # fp32 [bq, bk]
-        if causal:
+        if masked:
             q_pos = q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_pos = k_start + jax.lax.broadcasted_iota(
@@ -191,8 +196,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, block_k: int,
         denom = denom * correction + jnp.sum(p, axis=1)
         return acc, new_max, denom
 
-    acc, row_max, denom = jax.lax.fori_loop(0, last, body,
-                                            (acc, row_max, denom))
+    carry = jax.lax.fori_loop(0, split,
+                              functools.partial(body, masked=False),
+                              (acc, row_max, denom))
+    acc, row_max, denom = jax.lax.fori_loop(
+        split, last, functools.partial(body, masked=causal), carry)
     # denom >= 1 always: causal rows include their own diagonal (masking
     # uses a finite sentinel, so even a fully-masked row would sum
     # exp(0) terms), and entirely-future blocks never reach the kernel
@@ -237,17 +245,20 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     if causal:
         last = jnp.minimum(num_k_blocks,
                            (q_start + block_q + block_k - 1) // block_k)
+        # Interior blocks (fully below the diagonal) skip the mask work.
+        split = jnp.minimum(last, q_start // block_k)
     else:
         last = num_k_blocks
+        split = last
 
-    def body(kb, acc):
+    def body(kb, acc, *, masked):
         k_start = kb * block_k
         k_blk = k_ref[pl.dslice(k_start, block_k), :]
         v_blk = v_ref[pl.dslice(k_start, block_k), :]
         if rope:
             k_blk = _rope_apply(k_blk, k_start, cos_ref, sinm_ref)
         scores = _dot(q, k_blk, trans_b=True) * sm_scale
-        if causal:
+        if masked:
             q_pos = q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_pos = k_start + jax.lax.broadcasted_iota(
@@ -258,8 +269,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         ds = p * (dp + corr[:, None])
         return acc + _dot(ds.astype(k_blk.dtype), k_blk)
 
-    acc = jax.lax.fori_loop(0, last, body, jnp.zeros((block_q, d),
-                                                     jnp.float32))
+    acc = jax.lax.fori_loop(0, split, functools.partial(body, masked=False),
+                            jnp.zeros((block_q, d), jnp.float32))
+    acc = jax.lax.fori_loop(split, last,
+                            functools.partial(body, masked=causal), acc)
     acc = acc * sm_scale
     if rope:
         acc = _rope_apply(acc, q_start, cos_ref, sinm_ref, inverse=True)
@@ -290,10 +303,20 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     v_t = v_ref[...]
 
     num_q_blocks = seq_len // block_q
-    # Causal: Q blocks strictly left of this K tile's diagonal see none of it.
-    first = k_start // block_q if causal else 0
+    # Causal: Q blocks strictly left of this K tile's diagonal see none of
+    # it; Q blocks strictly BELOW it (q_start >= k_start + block_k - 1)
+    # see all of it and need no mask — the iota/select only runs on the
+    # O(1) diagonal-straddling blocks.
+    if causal:
+        first = k_start // block_q
+        split = jnp.minimum(
+            num_q_blocks,
+            (k_start + block_k - 1 + block_q - 1) // block_q)
+    else:
+        first = 0
+        split = 0
 
-    def body(qb, carry):
+    def body(qb, carry, *, masked):
         dk_acc, dv_acc = carry
         q_start = qb * block_q
         q_blk = q_ref[pl.dslice(q_start, block_q), :]
@@ -305,7 +328,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             dlse_ref[0, pl.dslice(q_start, block_q)].astype(jnp.float32)
             - delta_ref[0, pl.dslice(q_start, block_q)].astype(jnp.float32))
         scores = _dot(q_blk, k_t, trans_b=True) * sm_scale  # [bq, bk] fp32
-        if causal:
+        if masked:
             q_pos = q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_pos = k_start + jax.lax.broadcasted_iota(
@@ -319,10 +342,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_acc = dk_acc + _dot(ds.astype(q_blk.dtype), q_blk, trans_a=True)
         return dk_acc, dv_acc
 
-    dk_acc, dv_acc = jax.lax.fori_loop(
-        first, num_q_blocks, body,
+    carry = jax.lax.fori_loop(
+        first, split, functools.partial(body, masked=causal),
         (jnp.zeros((block_k, d), jnp.float32),
          jnp.zeros((block_k, d), jnp.float32)))
+    dk_acc, dv_acc = jax.lax.fori_loop(
+        split, num_q_blocks, functools.partial(body, masked=False), carry)
     dk_acc = dk_acc * sm_scale
     if rope:
         dk_acc = _rope_apply(dk_acc, k_start, cos_ref, sinm_ref,
