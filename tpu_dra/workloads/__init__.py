@@ -18,3 +18,6 @@ from tpu_dra.workloads.allreduce import (  # noqa: F401
 from tpu_dra.workloads.model import (  # noqa: F401
     ModelConfig, TransformerLM, init_params, loss_fn, make_train_step,
 )
+from tpu_dra.workloads.moe_model import (  # noqa: F401
+    MoEModelConfig, MoETransformerLM,
+)

@@ -114,7 +114,9 @@ def _rmsnorm(x, scale):
     return (x * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * scale.astype(x.dtype)
 
 
-def _block(params, x, cfg: ModelConfig):
+def attention_sublayer(params, x, cfg: ModelConfig):
+    """pre-norm attention + residual; shared by the dense and MoE model
+    families (moe_model._moe_block differs only in its FFN half)."""
     B, S, D = x.shape
     h = _rmsnorm(x, params["ln1_scale"])
     qkv = h @ params["wqkv"].astype(cfg.dtype)
@@ -130,8 +132,11 @@ def _block(params, x, cfg: ModelConfig):
     # on the jnp path, so every impl computes the same function.
     ctx = attend(q, k, v, causal=True, impl=cfg.attn_impl,
                  platform=cfg.attn_platform, rope=True).reshape(B, S, D)
-    x = x + ctx @ params["wo"].astype(cfg.dtype)
+    return x + ctx @ params["wo"].astype(cfg.dtype)
 
+
+def _block(params, x, cfg: ModelConfig):
+    x = attention_sublayer(params, x, cfg)
     h = _rmsnorm(x, params["ln2_scale"])
     up = jax.nn.gelu(h @ params["w_up"].astype(cfg.dtype))
     return x + up @ params["w_down"].astype(cfg.dtype)
@@ -175,12 +180,14 @@ def loss_fn(model: TransformerLM, params: Params, tokens: jax.Array) -> jax.Arra
     return jnp.mean(lse - target_logit)
 
 
-def make_train_step(model: TransformerLM, mesh: Mesh, lr: float = 1e-3):
-    """Build a jitted SGD train step with explicit in/out shardings.
+def build_train_step(model, mesh: Mesh, lr, loss, specs_fn, rebuild):
+    """Shared SGD train-step builder for the model families.
 
     Batch (and thus sequence blocks after reshape) shard on 'data';
-    parameters shard per `param_specs` on 'model'. Gradients reduce over
-    'data' via the psum XLA inserts for the replicated-param out-sharding.
+    parameters shard per `specs_fn(cfg)` on 'model'. Gradients reduce
+    over 'data' via the psum XLA inserts for the replicated-param
+    out-sharding. `loss(model, params, tokens)` is the objective;
+    `rebuild(cfg)` re-instantiates the model when the config is pinned.
     """
     cfg = model.cfg
     on_tpu = all(d.platform == "tpu" for d in mesh.devices.flat)
@@ -188,17 +195,17 @@ def make_train_step(model: TransformerLM, mesh: Mesh, lr: float = 1e-3):
         # Pin "auto" attention to the MESH's platform (see ModelConfig).
         cfg = dataclasses.replace(cfg,
                                   attn_platform="tpu" if on_tpu else "cpu")
-        model = TransformerLM(cfg)
-    specs = param_specs(cfg)
+        model = rebuild(cfg)
+    specs = specs_fn(cfg)
     p_shard = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
                            is_leaf=lambda x: isinstance(x, P))
     batch_shard = NamedSharding(mesh, P("data", None))
 
     def step(params, tokens):
-        loss, grads = jax.value_and_grad(
-            lambda p: loss_fn(model, p, tokens))(params)
+        loss_v, grads = jax.value_and_grad(
+            lambda p: loss(model, p, tokens))(params)
         new_params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
-        return new_params, loss
+        return new_params, loss_v
 
     # Donate the incoming params: every caller chains (params, loss) =
     # step(params, ...), so the old buffers are dead and XLA can update
@@ -211,10 +218,19 @@ def make_train_step(model: TransformerLM, mesh: Mesh, lr: float = 1e-3):
                    donate_argnums=donate)
 
 
-def shard_params(params: Params, mesh: Mesh, cfg: ModelConfig) -> Params:
+def make_train_step(model: TransformerLM, mesh: Mesh, lr: float = 1e-3):
+    """Jitted SGD step for the dense model (see build_train_step)."""
+    return build_train_step(model, mesh, lr, loss_fn, param_specs,
+                            TransformerLM)
+
+
+def shard_by_specs(params: Params, mesh: Mesh, specs: Params) -> Params:
     # Map over specs first: is_leaf applies to the first tree, and P must be
     # treated as a leaf (it is sequence-like and would otherwise traverse).
-    specs = param_specs(cfg)
     return jax.tree.map(
         lambda spec, arr: jax.device_put(arr, NamedSharding(mesh, spec)),
         specs, params, is_leaf=lambda x: isinstance(x, P))
+
+
+def shard_params(params: Params, mesh: Mesh, cfg: ModelConfig) -> Params:
+    return shard_by_specs(params, mesh, param_specs(cfg))

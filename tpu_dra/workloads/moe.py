@@ -69,20 +69,28 @@ def route_top1(x, router_w, n_experts: int, capacity: int):
     return dispatch, combine, aux
 
 
-def moe_ffn(params: Dict, x, *, capacity_factor: float = 1.25):
-    """Reference (unsharded) MoE FFN: x [B,S,D] -> [B,S,D]."""
+def moe_ffn(params: Dict, x, *, capacity_factor: float = 1.25,
+            compute_dtype=jnp.float32):
+    """Reference (unsharded) MoE FFN: x [B,S,D] -> [B,S,D].
+
+    Routing math stays fp32 (route_top1); the expert matmuls run in
+    `compute_dtype` — bf16 from the MoE transformer (the MXU fast path,
+    like the dense FFN's `h @ w.astype(cfg.dtype)`), fp32 by default for
+    the standalone/EP-parity tests. Dispatch/combine are exact 0/1-and-
+    gate tensors, safe to cast."""
     n_experts = params["router"].shape[-1]
     B, S, D = x.shape
     capacity = max(1, int(capacity_factor * B * S / n_experts))
     dispatch, combine, aux = route_top1(x, params["router"], n_experts,
                                         capacity)
+    cd = compute_dtype
     # Dispatch tokens to expert buffers: [E, C, D].
-    buffers = jnp.einsum("bsec,bsd->ecd", dispatch, x.astype(jnp.float32))
+    buffers = jnp.einsum("bsec,bsd->ecd", dispatch.astype(cd), x.astype(cd))
     h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", buffers,
-                               params["w_up"].astype(jnp.float32)))
+                               params["w_up"].astype(cd)))
     out_buf = jnp.einsum("ecf,efd->ecd", h,
-                         params["w_down"].astype(jnp.float32))
-    out = jnp.einsum("bsec,ecd->bsd", combine, out_buf)
+                         params["w_down"].astype(cd))
+    out = jnp.einsum("bsec,ecd->bsd", combine.astype(cd), out_buf)
     return out.astype(x.dtype), aux
 
 

@@ -1,0 +1,141 @@
+"""MoE transformer LM — the second model family (SURVEY §2.10 substrate).
+
+A sparse-FFN sibling of ``model.TransformerLM``: same attention path (the
+Pallas flash kernel with fused RoPE, flashattention.attend), but every
+``moe_every``-th block swaps the dense FFN for the Switch-style top-1
+expert FFN from ``moe.py``. Design is TPU-first:
+
+- Experts shard their LEADING dim over the mesh's 'model' axis (EP rides
+  the TP axis — the common deployment shape): expressed as PartitionSpecs
+  under pjit, the dense one-hot dispatch/combine einsums partition cleanly
+  and XLA inserts the expert all-reduce (SURVEY §2.10's `ep` axis without
+  hand-written collectives).
+- The router aux (load-balancing) loss joins the LM loss with a small
+  weight, summed over MoE blocks inside the traced step (no Python state).
+- Attention, rmsnorm, residuals, rematerialization, loss accounting and
+  donation semantics are shared with the dense model.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpu_dra.workloads import model as _dense
+from tpu_dra.workloads.model import ModelConfig
+from tpu_dra.workloads.moe import init_moe_params, moe_ffn
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEModelConfig(ModelConfig):
+    n_experts: int = 8
+    moe_every: int = 2           # block i uses MoE iff i % moe_every == 1
+    capacity_factor: float = 1.25
+    router_aux_weight: float = 1e-2
+
+    def is_moe_block(self, i: int) -> bool:
+        return i % self.moe_every == self.moe_every - 1
+
+
+def init_params(key, cfg: MoEModelConfig) -> Params:
+    """Dense-model params with MoE FFNs swapped in on MoE blocks."""
+    params = _dense.init_params(key, cfg)
+    keys = jax.random.split(jax.random.fold_in(key, 7), cfg.n_layers)
+    for i, bp in enumerate(params["blocks"]):
+        if cfg.is_moe_block(i):
+            del bp["w_up"], bp["w_down"]
+            bp["moe"] = init_moe_params(keys[i], cfg.d_model, cfg.d_ff,
+                                        cfg.n_experts, dtype=jnp.float32)
+    return params
+
+
+def param_specs(cfg: MoEModelConfig) -> Params:
+    """Dense specs + expert-leading-dim sharding on 'model' (EP on the TP
+    axis); the router is tiny and replicated."""
+    specs = _dense.param_specs(cfg)
+    moe_spec = {
+        "router": P(None, None),
+        "w_up": P("model", None, None),
+        "w_down": P("model", None, None),
+    }
+    for i, bs in enumerate(specs["blocks"]):
+        if cfg.is_moe_block(i):
+            del bs["w_up"], bs["w_down"]
+            bs["moe"] = dict(moe_spec)
+    return specs
+
+
+def _moe_block(params, x, cfg: MoEModelConfig):
+    """Attention sublayer shared with the dense block (model.py); the FFN
+    half is the expert layer. Expert matmuls run in cfg.dtype (bf16 on
+    the MXU fast path, like the dense FFN); routing stays fp32 inside
+    moe_ffn. Returns (x, aux_loss)."""
+    x = _dense.attention_sublayer(params, x, cfg)
+    h = _dense._rmsnorm(x, params["ln2_scale"])
+    out, aux = moe_ffn(params["moe"], h,
+                       capacity_factor=cfg.capacity_factor,
+                       compute_dtype=cfg.dtype)
+    return x + out, aux
+
+
+class MoETransformerLM:
+    """Functional model: forward(params, tokens) -> (logits, aux_loss)."""
+
+    def __init__(self, cfg: MoEModelConfig):
+        self.cfg = cfg
+
+    def forward(self, params: Params, tokens: jax.Array):
+        cfg = self.cfg
+        x = params["embed"].astype(cfg.dtype)[tokens]
+
+        def wrap(fn):
+            if cfg.remat == "full":
+                return jax.checkpoint(fn)
+            if cfg.remat == "dots":
+                return jax.checkpoint(
+                    fn, policy=jax.checkpoint_policies.dots_saveable)
+            if cfg.remat != "none":
+                raise ValueError(f"unknown remat policy {cfg.remat!r}")
+            return fn
+
+        aux_total = jnp.zeros((), jnp.float32)
+        for i, bp in enumerate(params["blocks"]):
+            if cfg.is_moe_block(i):
+                x, aux = wrap(lambda p, v: _moe_block(p, v, cfg))(bp, x)
+                aux_total = aux_total + aux
+            else:
+                x = wrap(lambda p, v: _dense._block(p, v, cfg))(bp, x)
+        x = _dense._rmsnorm(x, jnp.ones((cfg.d_model,)))
+        logits = (x @ params["unembed"].astype(cfg.dtype)).astype(jnp.float32)
+        return logits, aux_total
+
+
+def loss_fn(model: MoETransformerLM, params: Params,
+            tokens: jax.Array) -> jax.Array:
+    """LM cross-entropy (logsumexp form, as the dense model) plus the
+    weighted router load-balancing aux."""
+    logits, aux = model.forward(params, tokens[:, :-1])
+    targets = tokens[:, 1:]
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    target_logit = jnp.take_along_axis(
+        logits, targets[..., None], axis=-1)[..., 0]
+    nll = jnp.mean(lse - target_logit)
+    return nll + model.cfg.router_aux_weight * aux
+
+
+def make_train_step(model: MoETransformerLM, mesh: Mesh, lr: float = 1e-3):
+    """Jitted SGD step via the shared builder (model.build_train_step);
+    sharding layout mirrors the dense model's (batch on 'data', params
+    per param_specs, experts on 'model')."""
+    return _dense.build_train_step(model, mesh, lr, loss_fn, param_specs,
+                                   MoETransformerLM)
+
+
+def shard_params(params: Params, mesh: Mesh, cfg: MoEModelConfig) -> Params:
+    return _dense.shard_by_specs(params, mesh, param_specs(cfg))
