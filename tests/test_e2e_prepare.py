@@ -145,9 +145,10 @@ class TestPrepareBasic:
         claim = make_claim(harness["cluster"], ["chip-1"])
         assert grpc_prepare(harness, claim).error == ""
         bd = harness["state"].last_prepare_breakdown
-        assert set(bd) == {"checkpoint_start", "decode", "sharing",
-                           "guards", "cdi_write", "checkpoint_final",
-                           "total"}
+        # No checkpoint_start: the default (non-hazardous) config skips
+        # the durable intent store — its absence IS the fast path.
+        assert set(bd) == {"decode", "sharing", "guards", "cdi_write",
+                           "checkpoint_final", "total"}
         for phase, ms in bd.items():
             assert 0 <= ms <= bd["total"] + 1e-6, (phase, bd)
         # Idempotent re-prepare takes the completed-claim fast path and

@@ -287,3 +287,24 @@ class TestModelParity:
             scale = max(float(jnp.abs(wr).max()), 1e-6)
             rel = float(jnp.abs(wf - wr).max()) / scale
             assert rel <= 2e-2, f"grad rel err {rel} (shape {wf.shape})"
+
+
+class TestDefaultBlocks:
+    """The seq-dependent block chooser must never add padding (causal) or
+    break divisibility (non-causal, which cannot pad)."""
+
+    def test_long_aligned_gets_wide_bwd_blocks(self):
+        from tpu_dra.workloads.flashattention import (
+            LONG_SEQ_BWD_BLOCKS, default_blocks, default_bwd_blocks,
+        )
+        assert default_bwd_blocks(8192) == LONG_SEQ_BWD_BLOCKS
+        assert default_bwd_blocks(4096) == LONG_SEQ_BWD_BLOCKS
+        # The forward never widens (VMEM-bound at long S).
+        assert default_blocks(8192) == (256, 256)
+
+    def test_unaligned_long_seq_falls_back(self):
+        from tpu_dra.workloads.flashattention import default_bwd_blocks
+        # 4608 % 1024 != 0: wide blocks would force extra padding rows
+        # (causal) or a ValueError (non-causal) — must fall back.
+        assert default_bwd_blocks(4608) == (256, 256)
+        assert default_bwd_blocks(1024) == (256, 256)

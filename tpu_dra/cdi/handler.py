@@ -141,6 +141,18 @@ class CDIHandler:
         _atomic_write_json(path, spec)
         return path
 
+    def list_claim_uids(self) -> List[str]:
+        """UIDs of all transient per-claim specs currently on disk (startup
+        orphan GC: a crash between a prepare's CDI write and its checkpoint
+        store leaves a spec for a claim the checkpoint never learned of)."""
+        prefix = f"{self._vendor}-{CDI_CLASS_CLAIM}_"
+        try:
+            names = os.listdir(self._cdi_root)
+        except FileNotFoundError:
+            return []
+        return [n[len(prefix):-len(".json")] for n in names
+                if n.startswith(prefix) and n.endswith(".json")]
+
     def delete_claim_spec_file(self, claim_uid: str) -> None:
         try:
             os.unlink(self._claim_spec_path(claim_uid))

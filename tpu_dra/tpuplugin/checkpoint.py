@@ -119,9 +119,16 @@ class CheckpointManager:
     - Intent records (``PrepareStarted``, mid-prepare) write one side
       slot — a single cheap fdatasync on the claim-to-ready hot path.
       Terminal states (completed prepare, unprepare) write a side slot
-      first and then the primary, both in place, so a torn primary
-      recovers the *identical* settled state from the side slot — and
-      load_or_init() rewrites a damaged primary at the next start.
+      (data only, NOT synced) and then the primary with fdatasync — the
+      primary is the terminal store's sole durability point, so the hot
+      path pays exactly one device sync per store. The unsynced side
+      write keeps recovery fresh: if a LATER primary overwrite tears,
+      load() falls back to the most recent durable slot (this side copy
+      if it reached the device, else the previous intent record) rather
+      than an older settled state; and load_or_init() rewrites a damaged
+      primary at the next start. A tear in the side slot itself loses
+      nothing — its envelope fails the checksum and the synced primary
+      holds the identical state.
     - A downgraded driver that only knows the single-file layout reads
       the primary = the latest settled state. If it then writes its own
       rename-style (seq-less) checkpoints, load() treats such a legacy
@@ -164,7 +171,7 @@ class CheckpointManager:
         self._fds.clear()
         self._sizes.clear()
 
-    def _write_slot(self, path: str, data: bytes) -> None:
+    def _write_slot(self, path: str, data: bytes, sync: bool = True) -> None:
         padded = data + b" " * (-len(data) % self.SLOT_PAD)
         fd = self._fds.get(path)
         if fd is None:
@@ -193,8 +200,10 @@ class CheckpointManager:
             self._sizes[path] = len(padded)
         # Data-only sync: the durability point for the claim state machine
         # (store-before-side-effects). fdatasync is POSIX-but-not-macOS;
-        # fall back to fsync there.
-        getattr(os, "fdatasync", os.fsync)(fd)
+        # fall back to fsync there. sync=False callers (the terminal
+        # store's side-slot copy) get durability from a later synced slot.
+        if sync:
+            getattr(os, "fdatasync", os.fsync)(fd)
 
     def store(self, cp: Checkpoint, version: str = "v2",
               intent: bool = False) -> None:
@@ -207,14 +216,22 @@ class CheckpointManager:
         # Envelope assembled around the already-serialized payload (it is
         # the checksum's exact input, so embedding it verbatim both avoids
         # a second serialization and makes the checksum self-evidently
-        # consistent).
-        envelope = ('{"checksum": %d, "seq": %d, "data": %s}'
+        # consistent). `seqsum` covers the seq, which sits outside the
+        # data checksum (kept payload-only for legacy compatibility both
+        # ways): without it, a seq mangled into a different valid integer
+        # would silently reorder slot selection and could resurrect stale
+        # state. Legacy readers ignore the unknown key.
+        envelope = ('{"checksum": %d, "seq": %d, "seqsum": %d, "data": %s}'
                     % (zlib.crc32(payload.encode()), self._seq,
-                       payload)).encode()
+                       zlib.crc32(b"%d" % self._seq), payload)).encode()
         # Ping-pong: overwrite the STALER side slot, so the fresher one
         # still holds the previous state if this write tears.
         side = min(self._side_paths, key=lambda p: self._slot_seqs[p])
-        self._write_slot(side, envelope)
+        # Intent stores sync the side slot (it is their durability point);
+        # terminal stores leave it as a data-only recovery copy and sync
+        # the primary below — one fdatasync either way (hot-path cost,
+        # SURVEY §3.2).
+        self._write_slot(side, envelope, sync=intent)
         self._slot_seqs[side] = self._seq
         if not intent:
             # In place, like the sides: the PrepareCompleted store IS on
@@ -257,6 +274,12 @@ class CheckpointManager:
             try:
                 seq = int(seq)
             except (ValueError, TypeError):
+                return "corrupt"
+            # seqsum (when present — absent in pre-seqsum envelopes, whose
+            # seq stays best-effort) catches a seq mangled into a DIFFERENT
+            # valid integer, which would silently reorder slot selection.
+            seqsum = envelope.get("seqsum")
+            if seqsum is not None and seqsum != zlib.crc32(b"%d" % seq):
                 return "corrupt"
         return seq, doc
 

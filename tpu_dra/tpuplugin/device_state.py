@@ -105,6 +105,19 @@ class DeviceState:
         # (NewDeviceState analog, device_state.go:59-145).
         self._cdi.create_standard_device_spec_file(backend.chips())
         self._checkpoint = self._ckpt_mgr.load_or_init()
+        # Orphan claim-spec GC: non-hazardous prepares (no side effects
+        # beyond the CDI spec) skip the intent store, so a crash between
+        # their CDI write and terminal checkpoint store leaves a spec file
+        # for a claim the checkpoint never learned about. Reconcile here.
+        for uid in self._cdi.list_claim_uids():
+            if uid not in self._checkpoint.claims:
+                self._cdi.delete_claim_spec_file(uid)
+
+    def close(self) -> None:
+        """Release cached checkpoint slot fds. The manager assumes a
+        single writer per process; call this at driver shutdown (and from
+        test fixtures that create many states)."""
+        self._ckpt_mgr.close()
 
     @property
     def backend(self):
@@ -139,20 +152,38 @@ class DeviceState:
 
             timings: Dict[str, float] = {}
             t_total = time.perf_counter()
+            # Pure phase first (no side effects): parse allocation results
+            # and resolve opaque configs, so config errors return before
+            # any state is recorded and the hazard of this prepare is
+            # known before deciding whether an intent store is needed.
+            t0 = time.perf_counter()
+            try:
+                config_results = self._resolve_claim_configs(claim)
+            except Exception as e:  # noqa: BLE001 — report as claim error
+                return PrepareResult(error=f"prepare devices: {e}")
+            timings["decode"] = time.perf_counter() - t0
+
             # Record intent before touching hardware (crash consistency).
             self._checkpoint.claims[uid] = PreparedClaim(
                 uid=uid, state=PREPARE_STARTED,
                 name=claim["metadata"].get("name", ""),
                 namespace=claim["metadata"].get("namespace", ""))
-            t0 = time.perf_counter()
-            # Transient mid-prepare record: side slot (checkpoint.py —
-            # terminal states land on the primary for downgrade safety).
-            self._ckpt_mgr.store(self._checkpoint, intent=True)
-            timings["checkpoint_start"] = time.perf_counter() - t0
+            if any(self._config_hazard(cr.config) for cr in config_results):
+                # Transient mid-prepare record: side slot (checkpoint.py —
+                # terminal states land on the primary for downgrade
+                # safety). Non-hazardous prepares skip this durable intent
+                # entirely: their only side effect is the claim CDI spec,
+                # which startup orphan GC and the unconditional unprepare
+                # delete reconcile without a record — one device sync
+                # instead of two on the claim-to-ready hot path.
+                t0 = time.perf_counter()
+                self._ckpt_mgr.store(self._checkpoint, intent=True)
+                timings["checkpoint_start"] = time.perf_counter() - t0
 
             records: List[Dict] = []
             try:
-                self._prepare_devices(claim, records, timings)
+                self._prepare_devices(claim, config_results, records,
+                                      timings)
             except Exception as e:  # noqa: BLE001 — report as claim error
                 # Leave PrepareStarted with whatever was already applied
                 # recorded, so a later unprepare (or GC of an abandoned
@@ -173,22 +204,49 @@ class DeviceState:
             return PrepareResult(devices=[
                 _prepared_device_from_record(r) for r in records])
 
-    def _prepare_devices(self, claim: Dict, records: List[Dict],
+    def _resolve_claim_configs(self, claim: Dict) -> List["_ConfigResult"]:
+        """The pure phase of prepare: parse allocation results and resolve
+        opaque configs. Raises PrepareError; applies no side effects."""
+        allocation = ((claim.get("status") or {}).get("allocation") or {})
+        results = [r for r in (allocation.get("devices") or {}).get("results", [])
+                   if r.get("driver") == self._driver_name]
+        if not results:
+            raise PrepareError("claim has no allocation results for this driver")
+        return self._resolve_configs(allocation, results)
+
+    def _config_hazard(self, cfg: object) -> bool:
+        """Will applying `cfg` mutate state beyond the claim CDI spec file?
+        Hazardous configs (chip-mode changes, VFIO rebinds, coordinator
+        Deployments) need a durable PrepareStarted record before they run
+        so a crash mid-prepare can be rolled back. The predicate names
+        only the KNOWN-SAFE cases and answers True for everything else:
+        if a new side-effectful branch lands in _apply_sharing_config
+        without a matching entry here, the drift costs one extra intent
+        store — it can never lose a rollback record."""
+        if isinstance(cfg, apitypes.SubsliceConfig):
+            return False  # env-only: core ranges + HBM limit
+        if isinstance(cfg, apitypes.TpuConfig):
+            sharing = cfg.sharing
+            if sharing is None:
+                return False
+            if sharing.is_time_slicing():
+                # Mirrors _apply_sharing_config: gated off or manager-less
+                # time slicing applies nothing.
+                return (featuregates.enabled(
+                    featuregates.TimeSlicingSettings)
+                    and self._ts_manager is not None)
+            return True  # multiprocess / future strategies: fail safe
+        return True  # Passthrough and any unknown config kind
+
+    def _prepare_devices(self, claim: Dict,
+                         config_results: List["_ConfigResult"],
+                         records: List[Dict],
                          timings: Optional[Dict[str, float]] = None) -> None:
         """Appends to `records` incrementally so the caller can persist
         partial progress if a later step throws (crash/failure rollback)."""
         if timings is None:
             timings = {}
         uid = claim["metadata"]["uid"]
-        allocation = ((claim.get("status") or {}).get("allocation") or {})
-        results = [r for r in (allocation.get("devices") or {}).get("results", [])
-                   if r.get("driver") == self._driver_name]
-        if not results:
-            raise PrepareError("claim has no allocation results for this driver")
-
-        t0 = time.perf_counter()
-        config_results = self._resolve_configs(allocation, results)
-        timings["decode"] = time.perf_counter() - t0
 
         chip_indices: set = set()
         subslice_cores: Dict[int, set] = {}
@@ -445,6 +503,10 @@ class DeviceState:
         with self._lock:
             prepared = self._checkpoint.claims.get(claim_uid)
             if prepared is None:
+                # Unknown claim: still scrub any orphan CDI spec — a crash
+                # after a non-hazardous prepare's CDI write but before its
+                # terminal checkpoint store can leave one behind.
+                self._cdi.delete_claim_spec_file(claim_uid)
                 return None
             try:
                 self._unprepare_devices(claim_uid, prepared)

@@ -43,6 +43,10 @@ class TpuDriver(DriverCallbacks):
         self._driver_name = driver_name
         self._node_name = node_name
         self._pu_lock = Flock(flock_path or f"{plugin_dir}/pu.lock")
+        # Wall ms of the last _node_prepare_resource (flock + claim fetch
+        # + DeviceState.prepare): with the client-observed latency this
+        # attributes the gRPC wire share of claim-to-ready (bench).
+        self.last_prepare_ms: float = 0.0
         self._pool_generation = 1
         self._gen_lock = threading.Lock()
         self.server = DRAPluginServer(
@@ -88,6 +92,7 @@ class TpuDriver(DriverCallbacks):
             self._health.stop()
         self._publish_queue.shutdown()
         self.server.stop()
+        self._state.close()
 
     # -- DRA callbacks ------------------------------------------------------
 
@@ -123,7 +128,9 @@ class TpuDriver(DriverCallbacks):
                 return PrepareResult(
                     error=f"claim UID mismatch for {claim.namespace}/{claim.name}")
             result = self._state.prepare(obj)
-            claim_prepare_seconds.observe(time.monotonic() - t0)
+            elapsed = time.monotonic() - t0
+            claim_prepare_seconds.observe(elapsed)
+            self.last_prepare_ms = elapsed * 1e3
             return result
         finally:
             self._pu_lock.release()

@@ -46,6 +46,40 @@ ROPE_BASE = 10000.0
 # contiguous block copies, while XLA fuses the explicit transposes into
 # neighbors nearly for free.
 DEFAULT_BLOCK = 256
+# Long sequences want a different shape: swept on v5e at S=8192
+# (B1 H16 D128, rope, attention grad): (256,256) 20.4ms, (512,512) 11.0,
+# (256,1024) 11.5, (384,1024) 13.3, (512,768) 10.6, (512,1024) 9.9ms —
+# a wide K window cuts dkv grid rows (longer contiguous K streams, less
+# per-program ramp) and bq=512 keeps the fwd/dq VMEM footprint under the
+# 16MB scoped budget ((1024,*) OOMs with the full-seq K/V + rope tables
+# resident). Short sequences keep 256 (S=1024 sweep: 128→11.9, 256→7.6,
+# 512→8.4 ms).
+LONG_SEQ_THRESHOLD = 4096
+LONG_SEQ_BWD_BLOCKS = (512, 1024)
+
+
+def default_blocks(s: int):
+    """Forward (block_q, block_k) for sequence length `s`. The forward
+    keeps DEFAULT_BLOCK at every length: its VMEM high-water (full-seq
+    K/V + rope tables + blocks) sits near the 16MB scoped budget at long
+    S, and larger fwd blocks OOM inside fused model steps."""
+    del s
+    return DEFAULT_BLOCK, DEFAULT_BLOCK
+
+
+def default_bwd_blocks(s_eff: int):
+    """Backward (block_q, block_k) for an EFFECTIVE (lane-aligned padded)
+    length — where the long-seq win lives (the S=8192 sweep above is grad
+    time, dominated by the two bwd kernels). The wide blocks are only
+    chosen when they divide s_eff: otherwise they would force extra
+    padding rows (causal) or an outright divisibility error (non-causal,
+    which cannot pad) — for such lengths DEFAULT_BLOCK's smaller grid
+    waste beats the wide window's win. Callers with odd local lengths
+    (ring attention) pass explicit blocks instead."""
+    bq, bk = LONG_SEQ_BWD_BLOCKS
+    if s_eff >= LONG_SEQ_THRESHOLD and s_eff % bq == 0 and s_eff % bk == 0:
+        return bq, bk
+    return DEFAULT_BLOCK, DEFAULT_BLOCK
 
 
 def default_platform() -> str:
@@ -401,22 +435,28 @@ def _fwd_call(q, k, v, causal, block_q, block_k, interpret, rope):
     )(q, k, v, *rope_in)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, block_q, block_k, interpret, rope):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, causal, block_q, block_k, bwd_block_q, bwd_block_k,
+           interpret, rope):
     """[BH, S, D] primitive returning (out, lse [BH, 1, S] fp32).
 
     Both outputs are differentiable: an out-only consumer gets a zero
     dlse cotangent from JAX and the backward degenerates to plain flash;
-    ring attention consumes BOTH (partials are merged by lse weights)."""
+    ring attention consumes BOTH (partials are merged by lse weights).
+    bwd_block_{q,k} tile the two backward kernels independently of the
+    forward (long sequences want a wider bwd K window; the forward OOMs
+    VMEM there)."""
     return _fwd_call(q, k, v, causal, block_q, block_k, interpret, rope)
 
 
-def _flash_fwd_rule(q, k, v, causal, block_q, block_k, interpret, rope):
+def _flash_fwd_rule(q, k, v, causal, block_q, block_k, bwd_block_q,
+                    bwd_block_k, interpret, rope):
     out, lse = _fwd_call(q, k, v, causal, block_q, block_k, interpret, rope)
     return (out, lse), (q, k, v, out, lse)
 
 
-def _flash_bwd_rule(causal, block_q, block_k, interpret, rope, res, cts):
+def _flash_bwd_rule(causal, fwd_block_q, fwd_block_k, block_q, block_k,
+                    interpret, rope, res, cts):
     q, k, v, out, lse = res
     dout, dlse = cts
     dout = dout.astype(q.dtype)
@@ -484,8 +524,10 @@ _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
 def flash_attention_with_lse(q, k, v, *, causal: bool = True,
-                             block_q: int = DEFAULT_BLOCK,
-                             block_k: int = DEFAULT_BLOCK,
+                             block_q: int = 0,
+                             block_k: int = 0,
+                             bwd_block_q: int = 0,
+                             bwd_block_k: int = 0,
                              interpret: bool = False,
                              rope: bool = False):
     """q, k, v: [B, S, H, D] -> (out [B, S, H, D], lse [B, H, S] fp32).
@@ -502,22 +544,50 @@ def flash_attention_with_lse(q, k, v, *, causal: bool = True,
     = sequence index (padded rows get out-of-range positions, harmless:
     padded keys are causally masked and padded rows are sliced off).
     Ring attention must keep rope outside (its visiting K blocks carry
-    other shards' global positions, which the kernel cannot know)."""
+    other shards' global positions, which the kernel cannot know).
+
+    block_q/block_k tile the forward, bwd_block_q/bwd_block_k the two
+    backward kernels; 0 (default) = the swept optimum for this sequence
+    length (default_blocks / default_bwd_blocks). When forward blocks
+    are given explicitly but backward ones are not, the backward
+    inherits the forward's (callers with odd local lengths — ring
+    attention — chose dividing blocks on purpose)."""
     b, s, h, d = q.shape
+    explicit_fwd = bool(block_q or block_k)
+    if not block_q or not block_k:
+        dq_, dk_ = default_blocks(s)
+        block_q = block_q or dq_
+        block_k = block_k or dk_
+    # Lane-aligned length (causal pads up to it; non-causal cannot pad):
+    # bwd defaults are chosen against it so they never ADD padding beyond
+    # the forward's, nor break the non-causal divisibility contract.
+    s_eff = s + (-s) % LANES if causal else s
+    if not bwd_block_q or not bwd_block_k:
+        dq_, dk_ = (block_q, block_k) if explicit_fwd \
+            else default_bwd_blocks(s_eff)
+        bwd_block_q = bwd_block_q or dq_
+        bwd_block_k = bwd_block_k or dk_
     if causal:
         # Lane-align first (Mosaic tiling wants 8/128-aligned or full-size
         # block dims), then block-align so the grid divides evenly.
-        s_eff = s + (-s) % LANES
         block_q = min(block_q, s_eff)
         block_k = min(block_k, s_eff)
-        lcm = block_q * block_k // math.gcd(block_q, block_k)
+        bwd_block_q = min(bwd_block_q, s_eff)
+        bwd_block_k = min(bwd_block_k, s_eff)
+        lcm = 1
+        for blk in (block_q, block_k, bwd_block_q, bwd_block_k):
+            lcm = lcm * blk // math.gcd(lcm, blk)
         pad = (s_eff + (-s_eff) % lcm) - s
     else:
         block_q = min(block_q, s)
         block_k = min(block_k, s)
-        if s % block_q or s % block_k:
-            raise ValueError(f"seq len {s} not divisible by blocks "
-                             f"({block_q}, {block_k})")
+        bwd_block_q = min(bwd_block_q, s)
+        bwd_block_k = min(bwd_block_k, s)
+        for blk in (block_q, block_k, bwd_block_q, bwd_block_k):
+            if s % blk:
+                raise ValueError(f"seq len {s} not divisible by blocks "
+                                 f"({block_q}, {block_k}, {bwd_block_q}, "
+                                 f"{bwd_block_k})")
         pad = 0
     if pad:
         zeros = ((0, 0), (0, pad), (0, 0), (0, 0))
@@ -529,7 +599,7 @@ def flash_attention_with_lse(q, k, v, *, causal: bool = True,
         return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, s, d)
 
     out, lse = _flash(to_bh(q), to_bh(k), to_bh(v), causal, block_q,
-                      block_k, interpret, rope)
+                      block_k, bwd_block_q, bwd_block_k, interpret, rope)
     out = jnp.transpose(out.reshape(b, h, s, d), (0, 2, 1, 3))
     lse = lse.reshape(b, h, s)
     if pad:
@@ -538,14 +608,18 @@ def flash_attention_with_lse(q, k, v, *, causal: bool = True,
 
 
 def flash_attention(q, k, v, *, causal: bool = True,
-                    block_q: int = DEFAULT_BLOCK,
-                    block_k: int = DEFAULT_BLOCK, interpret: bool = False,
+                    block_q: int = 0,
+                    block_k: int = 0,
+                    bwd_block_q: int = 0,
+                    bwd_block_k: int = 0, interpret: bool = False,
                     rope: bool = False):
     """q, k, v: [B, S, H, D] -> [B, S, H, D]. Differentiable (custom VJP
     with tiled backward kernels); see flash_attention_with_lse for the
     padding/divisibility and fused-rope contracts."""
     out, _ = flash_attention_with_lse(q, k, v, causal=causal,
                                       block_q=block_q, block_k=block_k,
+                                      bwd_block_q=bwd_block_q,
+                                      bwd_block_k=bwd_block_k,
                                       interpret=interpret, rope=rope)
     return out
 
