@@ -174,6 +174,37 @@ class TestReadiness:
         assert cluster.wait_for(
             lambda: get_cd(cluster, "cd-s")["status"]["status"] == "Ready")
 
+    def test_numnodes_zero_ready_settle(self):
+        """Open-ended readiness holds through a settle window after the
+        last membership change: expected membership lags label-driven
+        daemon summoning, so the first node's readiness must not flip
+        the domain Ready while later participants may still be labeling
+        their nodes (residual race noted in the r4 advisor review)."""
+        import time as _time
+
+        cluster = FakeCluster()
+        controller = Controller(cluster, namespace=NS, image="img:test",
+                                gc_interval=3600.0, open_ready_settle_s=0.6)
+        controller.start()
+        try:
+            cd = make_cd(cluster, name="cd-t", num_nodes=0,
+                         rct_name="rct-t")
+            assert cluster.wait_for(lambda: _exists(
+                cluster, DAEMONSETS, daemon_object_name(cd), NS))
+            self._register_nodes(cluster, cd, ready=1, name="cd-t")
+            # Inside the settle window the domain must hold NotReady even
+            # though every registered daemon is ready.
+            _time.sleep(0.2)
+            assert (get_cd(cluster, "cd-t").get("status") or {}).get(
+                "status") != "Ready"
+            # Window elapses with no membership change -> Ready, without
+            # any further status traffic (the delayed re-enqueue fires).
+            assert cluster.wait_for(
+                lambda: (get_cd(cluster, "cd-t").get("status") or {}).get(
+                    "status") == "Ready", timeout=5.0)
+        finally:
+            controller.stop()
+
 
 class TestPodDeletion:
     def test_pod_delete_removes_node_from_status(self, harness):

@@ -239,11 +239,19 @@ class TestChannelPrepare:
         cd_b = make_cd(cluster, name="cd-b", rct_name="rct-b")
         assert cluster.wait_for(
             lambda: mgr.get_by_uid(cd_a["metadata"]["uid"]) is not None)
-        gen_a = mgr.change_gen(cd_a["metadata"]["uid"])
-        # Churn B; A's generation must not move.
+        # First churn on B also lets A's informer delivery settle (the
+        # list/watch add events for a just-created CD can still be in
+        # flight when get_by_uid first returns — snapshotting gen_a
+        # before they land made this test flaky).
         register_node(cluster, cd_b, "node-x", "10.9.9.9", ready=True)
         assert cluster.wait_for(lambda: mgr.change_gen(
             cd_b["metadata"]["uid"]) > 0)
+        gen_a = mgr.change_gen(cd_a["metadata"]["uid"])
+        gen_b = mgr.change_gen(cd_b["metadata"]["uid"])
+        # More churn on B; A's generation must not move.
+        register_node(cluster, cd_b, "node-y", "10.9.9.10", ready=True)
+        assert cluster.wait_for(lambda: mgr.change_gen(
+            cd_b["metadata"]["uid"]) > gen_b)
         assert mgr.change_gen(cd_a["metadata"]["uid"]) == gen_a
 
     def test_retry_budget_exhausts_when_never_ready(self, harness):

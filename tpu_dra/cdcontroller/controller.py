@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Dict, List, Optional
 
 from tpu_dra.api import types as apitypes
@@ -68,7 +69,8 @@ class Controller:
                  log_verbosity: int = 0, feature_gates: str = "",
                  max_nodes_per_slice_domain: int = 64,
                  gc_interval: float = 600.0,
-                 daemon_service_account: str = ""):
+                 daemon_service_account: str = "",
+                 open_ready_settle_s: float = 1.0):
         self._client = client
         self._namespace = namespace  # driver namespace (DS + daemon RCT home)
         self._image = image
@@ -79,6 +81,13 @@ class Controller:
         self._queue = WorkQueue(default_controller_rate_limiter(),
                                 log=lambda m: log.debug("%s", m))
         self._stop = threading.Event()
+        # Open-ended (numNodes==0) readiness settle: uid -> (node-name
+        # set, monotonic time of its last change). Expected membership of
+        # an open CD lags label-driven daemon summoning, so Ready only
+        # flips once the member set has been stable for
+        # open_ready_settle_s (late joiners re-arm the window).
+        self._open_settle_s = open_ready_settle_s
+        self._open_membership: dict = {}
 
         self.cd_informer = Informer(client, COMPUTEDOMAINS)
         self.cd_informer.add_indexer(UID_INDEX, uid_index)
@@ -322,6 +331,27 @@ class Controller:
             want = (apitypes.COMPUTE_DOMAIN_STATUS_READY
                     if ready > 0 and ready >= expected
                     else apitypes.COMPUTE_DOMAIN_STATUS_NOT_READY)
+            if want == apitypes.COMPUTE_DOMAIN_STATUS_READY:
+                # Residual race: expected lags label-driven daemon
+                # summoning, so the first node's readiness could flip an
+                # open-ended domain Ready before later participants have
+                # labeled their nodes — the same flake class the strict
+                # numNodes gate closes. Hold Ready until the member set
+                # has been stable for the settle window; a new member
+                # re-arms it (and its status update re-enqueues us).
+                sig = frozenset(n.get("name", "") for n in nodes)
+                now = time.monotonic()
+                prev = self._open_membership.get(uid)
+                if prev is None or prev[0] != sig:
+                    self._open_membership[uid] = (sig, now)
+                    changed_at = now
+                else:
+                    changed_at = prev[1]
+                remaining = self._open_settle_s - (now - changed_at)
+                if remaining > 0:
+                    want = apitypes.COMPUTE_DOMAIN_STATUS_NOT_READY
+                    self._queue.enqueue(uid, self._reconcile,
+                                        key=f"cd/{uid}", after=remaining)
         self._set_cd_status(uid, want)
 
     def _set_cd_status(self, uid: str, want: str) -> None:
@@ -449,3 +479,4 @@ class Controller:
     def _sweep_after_delete(self, uid: str) -> None:
         self._remove_node_labels(uid)
         self._cleanup.collect_uid(uid)
+        self._open_membership.pop(uid, None)

@@ -141,6 +141,11 @@ class CDIHandler:
         _atomic_write_json(path, spec)
         return path
 
+    def claim_spec_path(self, claim_uid: str) -> str:
+        """Public path accessor: harnesses (bench, dryrun) read the claim
+        env back from the spec exactly the way containerd would."""
+        return self._claim_spec_path(claim_uid)
+
     def list_claim_uids(self) -> List[str]:
         """UIDs of all transient per-claim specs currently on disk (startup
         orphan GC: a crash between a prepare's CDI write and its checkpoint

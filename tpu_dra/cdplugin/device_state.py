@@ -179,10 +179,21 @@ class DeviceState:
         # envelope after the first one exhausted gets the strict gate
         # back).
         now = time.monotonic()
-        first, last = self._first_attempt.get(uid, (now, now))
-        if now - last > self.ATTEMPT_GAP_RESET_S:
-            first = now
-        self._first_attempt[uid] = (first, now)
+        with self._lock:
+            # Under self._lock: prepare runs on gRPC handler threads, and
+            # the (first, last) read-modify-write is not atomic without
+            # it. Claims that never succeed and are never unprepared
+            # would otherwise pin entries for the daemon's lifetime —
+            # prune anything idle past the gap-reset horizon (its grace
+            # would restart anyway).
+            stale = [u for u, (_, l) in self._first_attempt.items()
+                     if now - l > self.ATTEMPT_GAP_RESET_S and u != uid]
+            for u in stale:
+                del self._first_attempt[u]
+            first, last = self._first_attempt.get(uid, (now, now))
+            if now - last > self.ATTEMPT_GAP_RESET_S:
+                first = now
+            self._first_attempt[uid] = (first, now)
         settled_ref = max(first,
                           self._cd.last_membership_change(config.domain_id,
                                                           default=first))

@@ -181,16 +181,21 @@ class WorkQueue:
 
     # -- producers ----------------------------------------------------------
 
-    def enqueue(self, obj: Any, callback: Callable[[Any], None], key: str = "") -> None:
+    def enqueue(self, obj: Any, callback: Callable[[Any], None],
+                key: str = "", after: Optional[float] = None) -> None:
+        """after: explicit delay in seconds, overriding the rate limiter —
+        for time-based re-evaluation (settle windows) rather than
+        failure backoff."""
         item = WorkItem(key=key, obj=obj, callback=callback)
         with self._cond:
             if key:
                 self._active_ops[key] = item
-            self._push_locked(item)
+            self._push_locked(item, after=after)
             self._cond.notify()
 
-    def _push_locked(self, item: WorkItem) -> None:
-        delay = self._rl.when(item.item_id)
+    def _push_locked(self, item: WorkItem,
+                     after: Optional[float] = None) -> None:
+        delay = self._rl.when(item.item_id) if after is None else after
         heapq.heappush(self._heap, (time.monotonic() + delay, next(self._seq), item))
 
     # -- consumer -----------------------------------------------------------

@@ -188,6 +188,12 @@ def build_train_step(model, mesh: Mesh, lr, loss, specs_fn, rebuild):
     over 'data' via the psum XLA inserts for the replicated-param
     out-sharding. `loss(model, params, tokens)` is the objective;
     `rebuild(cfg)` re-instantiates the model when the config is pinned.
+
+    ON TPU THE PARAMS ARGUMENT IS DONATED: callers must chain
+    `params, loss = step(params, tokens)` and never touch the old
+    params tree again — reusing it raises a donated-buffer error that
+    only manifests on TPU (CPU PJRT skips donation, so CPU-tier tests
+    cannot catch the misuse).
     """
     cfg = model.cfg
     on_tpu = all(d.platform == "tpu" for d in mesh.devices.flat)
