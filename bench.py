@@ -399,97 +399,18 @@ def bench_fake_v5p_configs(n_cycles: int = 30, warmup: int = 5):
 def bench_cd_convergence():
     """Full multi-node ComputeDomain claim-to-ready: controller + 2 CD
     kubelet plugins + 2 real C++ slice daemons converging through the fake
-    API server (SURVEY §3.3). The reference's only bound on this machinery
-    is the 300s failover budget; this measures actual convergence wall
-    time from CD creation to both workload claims prepared."""
-    import threading
+    API server (SURVEY §3.3), via the shared harness
+    (tpu_dra.testing.provision_two_node_cd — also the dryrun psum
+    probe's). The reference's only bound on this machinery is the 300s
+    failover budget; this measures actual convergence wall time from CD
+    creation to both workload claims prepared."""
+    from tpu_dra.testing import provision_two_node_cd
 
-    from tpu_dra.api import types as apitypes
-    from tpu_dra.cdcontroller import Controller
-    from tpu_dra.k8s import COMPUTEDOMAINS, FakeCluster, RESOURCECLAIMS
-    from tpu_dra.kubeletplugin.server import Claim
-    from tpu_dra.testing import DAEMON_BIN, FakeNode
-
-    if not os.path.exists(DAEMON_BIN):
-        return {"cd_convergence_error": "native daemon not built"}
-
-    # This phase benchmarks the control plane with two *simulated* nodes;
-    # fake chip inventory is deliberate here (the hardened auto-detect
-    # would otherwise refuse because this process's JAX has a real TPU).
-    saved_backend = os.environ.get("TPU_DRA_TPUINFO_BACKEND")
-    os.environ["TPU_DRA_TPUINFO_BACKEND"] = "fake"
-
-    tmp = None
-    controller = None
-    nodes = []
-    try:
-        tmp = tempfile.mkdtemp(prefix="tpu-dra-cdbench-")
-        cluster = FakeCluster()
-        controller = Controller(cluster, namespace="tpu-dra-driver",
-                                image="bench", gc_interval=3600.0)
-        controller.start()
-        nodes = [FakeNode(cluster, name, tmp, retry_timeout=30.0)
-                 for name in ("node-a", "node-b")]
-
-        t0 = time.perf_counter()
-        cd = cluster.create(COMPUTEDOMAINS, {
-            "apiVersion": apitypes.API_VERSION, "kind": "ComputeDomain",
-            "metadata": {"name": "bench-cd", "namespace": "bench"},
-            "spec": {"numNodes": 2, "channel": {
-                "resourceClaimTemplate": {"name": "bench-rct"}}},
-        })
-        results = {}
-
-        def kubelet(node):
-            claim = cluster.create(RESOURCECLAIMS, {
-                "apiVersion": "resource.k8s.io/v1", "kind": "ResourceClaim",
-                "metadata": {"name": f"w-{node.name}", "namespace": "bench"},
-                "spec": {"devices": {"requests": [{"name": "r0"}]}},
-                "status": {"allocation": {"devices": {
-                    "results": [{
-                        "request": "r0",
-                        "driver": apitypes.COMPUTE_DOMAIN_DRIVER_NAME,
-                        "pool": node.name, "device": "channel-0"}],
-                    "config": [{"requests": ["r0"], "opaque": {
-                        "driver": apitypes.COMPUTE_DOMAIN_DRIVER_NAME,
-                        "parameters": {
-                            "apiVersion": apitypes.API_VERSION,
-                            "kind": "ComputeDomainChannelConfig",
-                            "domainID": cd["metadata"]["uid"],
-                            "allocationMode": "Single"}}}]}}},
-            })
-            c = Claim(uid=claim["metadata"]["uid"],
-                      name=claim["metadata"]["name"], namespace="bench")
-            results[node.name] = node.driver.prepare_claims([c])[c.uid]
-
-        threads = [threading.Thread(target=kubelet, args=(n,))
-                   for n in nodes]
-        for t in threads:
-            t.start()
-        # Play the DaemonSet: start a daemon when its node gets labeled.
-        for node in nodes:
-            if not node.wait_labeled(cd["metadata"]["uid"]):
-                return {"cd_convergence_error":
-                        f"{node.name} never labeled"}
-            node.start_daemon(cd)
-        for t in threads:
-            t.join(timeout=40)
-        elapsed = time.perf_counter() - t0
-        errors = [f"{n}: {r.error}" for n, r in results.items() if r.error]
-        if errors or len(results) != 2:
-            return {"cd_convergence_error": "; ".join(errors) or "timeout"}
-        return {"cd_convergence_s": round(elapsed, 3)}
-    finally:
-        for node in nodes:
-            node.stop()
-        if controller is not None:
-            controller.stop()
-        if tmp is not None:
-            shutil.rmtree(tmp, ignore_errors=True)
-        if saved_backend is None:
-            os.environ.pop("TPU_DRA_TPUINFO_BACKEND", None)
-        else:
-            os.environ["TPU_DRA_TPUINFO_BACKEND"] = saved_backend
+    prov = provision_two_node_cd(namespace="bench", join_timeout=40.0)
+    if not prov.get("ok"):
+        return {"cd_convergence_error":
+                prov.get("error") or prov.get("skipped", "unknown")}
+    return {"cd_convergence_s": round(prov["elapsed_s"], 3)}
 
 
 def bench_psum(jax_probe, visible_chips: str):

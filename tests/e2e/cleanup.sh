@@ -1,0 +1,43 @@
+#!/usr/bin/env bash
+# Scrub state from a previous suite/run (reference analog:
+# tests/bats/cleanup-from-previous-run.sh + clean-state-dirs-all-nodes.sh).
+# Deletes every non-system namespace's workload objects, then waits for
+# the pods to actually drain — deletion is async, and a suite that
+# re-applies the same spec while the old pod still exists reads the OLD
+# pod's phase/logs (the residue class that poisons later suites).
+source "$(dirname "$0")/helpers.sh"
+
+_system_ns() {
+  case "$1" in
+    default|kube-system|kube-public|kube-node-lease|tpu-dra-driver)
+      return 0;;
+  esac
+  return 1
+}
+
+test_namespaces() {
+  local nsname
+  for nsname in $(k get namespaces -o name 2>/dev/null); do
+    _system_ns "${nsname##*/}" || echo "${nsname##*/}"
+  done
+}
+
+for ns in $(test_namespaces); do
+  for kind in pod computedomain resourceclaim resourceclaimtemplate; do
+    for obj in $(k get "${kind}s" -n "$ns" -o name 2>/dev/null); do
+      k delete "$kind" "${obj##*/}" -n "$ns" --ignore-not-found \
+        >/dev/null 2>&1 || true
+    done
+  done
+  k delete namespace "$ns" --ignore-not-found >/dev/null 2>&1 || true
+done
+
+drained() {
+  local ns n
+  for ns in $(test_namespaces); do
+    n=$(k get pods -n "$ns" -o name 2>/dev/null | grep -c .) || true
+    [ "${n:-0}" -eq 0 ] || return 1
+  done
+  return 0
+}
+wait_until 90 "previous-run pods drained" drained

@@ -1,15 +1,28 @@
 #!/usr/bin/env bash
-# Run the whole e2e suite against whatever cluster the sourced env file
-# points at. Usage:
+# Run the e2e suite against whatever cluster the sourced env file points
+# at. Usage:
 #   hack/e2e-up.sh /tmp/e2e-env.sh && source /tmp/e2e-env.sh && tests/e2e/run.sh
+#   tests/e2e/run.sh test_basics            # one suite (bats-tag analog)
+#   E2E_FASTFEEDBACK=1 tests/e2e/run.sh     # quick subset (fastfeedback)
 # or just `hack/e2e.sh` for up+run+down in one command.
 set -u
 HERE="$(cd "$(dirname "$0")" && pwd)"
 
 SUITES=${E2E_SUITES:-"test_basics test_admission test_tpu_claims test_stress test_multiprocess test_health test_debug test_cd_lifecycle test_cd_failover test_updowngrade"}
+if [ "${E2E_FASTFEEDBACK:-0}" = "1" ]; then
+  SUITES="test_basics test_admission test_tpu_claims"
+fi
+# Positional args select specific suites (the reference's bats-tag
+# selection, Makefile `fastfeedback`): `run.sh test_basics test_health`.
+[ $# -gt 0 ] && SUITES="$*"
 
 failed=0
 for s in $SUITES; do
+  # State isolation: scrub residue BEFORE each suite, so one suite's
+  # failure (or a previous run's leftovers) cannot poison the next —
+  # async pod deletion otherwise leaves old Succeeded pods that a
+  # re-applied spec happily reads phases/logs from.
+  bash "$HERE/cleanup.sh" || true
   echo "=== $s ==="
   if bash "$HERE/$s.sh"; then
     echo "=== $s PASSED ==="
@@ -19,4 +32,5 @@ for s in $SUITES; do
     [ "${E2E_FAIL_FAST:-1}" = "1" ] && break
   fi
 done
+bash "$HERE/cleanup.sh" || true
 exit $failed

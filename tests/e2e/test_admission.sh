@@ -49,7 +49,7 @@ wait_until 120 "webhook denies the invalid claim" denied
 k delete resourceclaim bad-claim -n $NS --ignore-not-found >/dev/null 2>&1
 
 log "valid claim admits"
-cat <<EOF | k apply -f -
+out=$(cat <<EOF | k apply -f - 2>&1
 apiVersion: resource.k8s.io/v1
 kind: ResourceClaim
 metadata:
@@ -69,10 +69,96 @@ spec:
           apiVersion: resource.tpu.dev/v1beta1
           kind: TpuConfig
 EOF
+) || die "valid claim was rejected: $out"
 k delete resourceclaim good-claim -n $NS --ignore-not-found
 
+log "v1beta1 claim (flat request, no 'exactly'): valid config admits"
+# The live conversion path (webhook resource.go:83-160 analog): v1beta1
+# requests are flat and must be lifted into the v1 'exactly' wrapper
+# before validation. Unit tests cover the handler; this drives it over
+# the wire through the cluster's admission chain.
+out=$(cat <<EOF | k apply -f - 2>&1
+apiVersion: resource.k8s.io/v1beta1
+kind: ResourceClaim
+metadata:
+  name: beta-good
+  namespace: $NS
+spec:
+  devices:
+    requests:
+    - name: tpu
+      deviceClassName: tpu.dev
+    config:
+    - requests: [tpu]
+      opaque:
+        driver: tpu.dev
+        parameters:
+          apiVersion: resource.tpu.dev/v1beta1
+          kind: TpuConfig
+          sharing:
+            strategy: TimeSlicing
+EOF
+) || die "valid v1beta1 claim was rejected: $out"
+k delete resourceclaim beta-good -n $NS --ignore-not-found
+
+log "v1beta1 claim with invalid opaque config is denied"
+beta_bad() {
+  cat <<EOF
+apiVersion: resource.k8s.io/v1beta1
+kind: ResourceClaim
+metadata:
+  name: beta-bad
+  namespace: $NS
+spec:
+  devices:
+    requests:
+    - name: tpu
+      deviceClassName: tpu.dev
+    config:
+    - requests: [tpu]
+      opaque:
+        driver: tpu.dev
+        parameters:
+          apiVersion: resource.tpu.dev/v1beta1
+          kind: TpuConfig
+          bogusField: true
+EOF
+}
+out=$(beta_bad | k apply -f - 2>&1) \
+  && die "invalid v1beta1 claim was admitted: $out"
+echo "$out" | grep -qi "denied the request" \
+  || die "v1beta1 rejection had wrong error: $out"
+
+log "v1-syntax inside a v1beta1 object is denied (wrong-version field)"
+beta_exactly() {
+  cat <<EOF
+apiVersion: resource.k8s.io/v1beta1
+kind: ResourceClaim
+metadata:
+  name: beta-exactly
+  namespace: $NS
+spec:
+  devices:
+    requests:
+    - name: tpu
+      exactly:
+        deviceClassName: tpu.dev
+    config:
+    - requests: [tpu]
+      opaque:
+        driver: tpu.dev
+        parameters:
+          apiVersion: resource.tpu.dev/v1beta1
+          kind: TpuConfig
+EOF
+}
+out=$(beta_exactly | k apply -f - 2>&1) \
+  && die "v1beta1 object with 'exactly' was admitted: $out"
+echo "$out" | grep -qi "exactly" \
+  || die "wrong-version rejection had wrong error: $out"
+
 log "foreign-driver config passes through untouched"
-cat <<EOF | k apply -f -
+out=$(cat <<EOF | k apply -f - 2>&1
 apiVersion: resource.k8s.io/v1
 kind: ResourceClaim
 metadata:
@@ -91,6 +177,7 @@ spec:
         parameters:
           anything: goes
 EOF
+) || die "foreign-driver claim was rejected: $out"
 k delete resourceclaim foreign-claim -n $NS --ignore-not-found
 
 log "OK test_admission"

@@ -1,8 +1,9 @@
 #!/usr/bin/env bash
 # Multiprocess sharing: the per-claim coordinator Deployment comes up with
 # the REAL tpu-multiprocess-coordinator binary, its readiness gates the
-# claim, and tenants see the coordination env. Reference analog:
-# MPS control-daemon flow (sharing.go:191-412) driven via gpu-test demos.
+# claim, tenants hold real leases over its socket, and unprepare reclaims
+# the Deployment. Reference analog: MPS control-daemon flow
+# (sharing.go:191-412) driven via gpu-test demos.
 source "$(dirname "$0")/helpers.sh"
 
 NS=tpu-test-multiprocess
@@ -11,15 +12,30 @@ k apply -f "$REPO_ROOT/demo/specs/tpu-test-multiprocess.yaml"
 log "tenant pods reach Succeeded (coordinator became ready)"
 wait_until 180 "multiprocess pods Succeeded" all_pods_phase $NS Succeeded
 
-log "coordinator Deployment exists and reports ready"
-coord_ready() {
-  local n
-  n=$(k get deploy -n tpu-dra-driver -o name | grep -c multiprocess) || return 1
-  [ "$n" -ge 1 ]
-}
-# The Deployment may already be torn down if unprepare ran; accept either
-# a ready coordinator or clean teardown after pod success.
-coord_ready || log "(coordinator already reclaimed by unprepare — OK)"
+# The full lifecycle, asserted stage by stage (the old "ready OR already
+# reclaimed" check accepted every state of the world):
+# 1. Tenants held REAL leases: the 'OK <lease>' reply can only come from
+#    the live coordinator over its unix socket, so this proves the
+#    Deployment existed and was serving while the pods ran.
+log "tenants held coordinator leases and saw the shared limits"
+for c in ctr0 ctr1; do
+  logs=$(k logs pod0 -n $NS -c $c)
+  echo "$logs" | grep -q "lease: OK" \
+    || die "tenant $c never got a coordinator lease: $logs"
+  echo "$logs" | grep -q "TPU_HBM_LIMIT_MAP" \
+    || die "tenant $c did not see limits.env: $logs"
+done
 
+# 2. Unprepare reclaims the coordinator: after the workload (and its
+#    claim) goes away, the per-claim Deployment must be torn down.
+log "unprepare reclaims the coordinator Deployment"
 k delete -f "$REPO_ROOT/demo/specs/tpu-test-multiprocess.yaml" --ignore-not-found
+coord_gone() {
+  local n
+  n=$(k get deploy -n tpu-dra-driver -o name 2>/dev/null \
+      | grep -c multiprocess) || true
+  [ "${n:-0}" -eq 0 ]
+}
+wait_until 120 "coordinator Deployment reclaimed" coord_gone
+
 log "OK test_multiprocess"

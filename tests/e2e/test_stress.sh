@@ -1,12 +1,21 @@
 #!/usr/bin/env bash
 # Stress: churn pods against one shared time-sliced claim across loops.
-# Reference analog: tests/bats/test_gpu_stress.bats (15 pods x 5 loops);
-# scaled to the sim's process budget.
+# Reference analog: tests/bats/test_gpu_stress.bats (15 pods x 5 loops).
+# Kind mode runs the full reference scale (every pod is a real container
+# there); sim mode scales to its per-pod subprocess budget. Per-loop
+# churn time is recorded and p95 reported (appended to
+# $E2E_STRESS_METRICS when set, as a bench side-metric).
 source "$(dirname "$0")/helpers.sh"
 
-PODS=${STRESS_PODS:-4}
-LOOPS=${STRESS_LOOPS:-3}
+if [ "${E2E_MODE:-sim}" = "kind" ]; then
+  PODS=${STRESS_PODS:-15}
+  LOOPS=${STRESS_LOOPS:-5}
+else
+  PODS=${STRESS_PODS:-4}
+  LOOPS=${STRESS_LOOPS:-3}
+fi
 NS=tpu-stress
+declare -a LOOP_S=()
 
 cat <<EOF | k apply -f -
 apiVersion: v1
@@ -38,6 +47,7 @@ EOF
 
 for loop in $(seq 1 "$LOOPS"); do
   log "stress loop $loop/$LOOPS: $PODS pods on one claim"
+  t0=$SECONDS
   for i in $(seq 1 "$PODS"); do
     cat <<EOF | k apply -f -
 apiVersion: v1
@@ -58,11 +68,25 @@ spec:
     resourceClaimName: shared
 EOF
   done
-  wait_until 120 "loop $loop pods Succeeded" all_pods_phase $NS Succeeded
+  wait_until 240 "loop $loop pods Succeeded" all_pods_phase $NS Succeeded
+  LOOP_S+=($((SECONDS - t0)))
   for i in $(seq 1 "$PODS"); do
     k delete pod "stress-$i" -n $NS --ignore-not-found
   done
+  # Drain before the next loop: re-created pods with the same names
+  # otherwise read the old Succeeded objects' phases.
+  pods_gone() { [ "$(k get pods -n $NS -o name 2>/dev/null | grep -c .)" -eq 0 ]; }
+  wait_until 90 "loop $loop pods drained" pods_gone
 done
+
+# Churn-time p95 across loops (apply -> all Succeeded, seconds).
+p95=$(printf '%s\n' "${LOOP_S[@]}" | sort -n | awk '
+  {v[NR]=$1} END {idx=int(0.95*(NR-1))+1; print v[idx]}')
+log "stress churn: pods=$PODS loops=$LOOPS per-loop s: ${LOOP_S[*]} (p95 ${p95}s)"
+if [ -n "${E2E_STRESS_METRICS:-}" ]; then
+  printf '{"stress_pods": %d, "stress_loops": %d, "churn_p95_s": %s}\n' \
+    "$PODS" "$LOOPS" "$p95" >> "$E2E_STRESS_METRICS"
+fi
 
 k delete resourceclaim shared -n $NS --ignore-not-found
 log "OK test_stress"

@@ -11,8 +11,11 @@ log0=$(k logs pod0 -n tpu-test1)
 log1=$(k logs pod1 -n tpu-test1)
 echo "$log0" | grep -q "TPU_VISIBLE_CHIPS=" || die "pod0 missing chip env"
 echo "$log1" | grep -q "TPU_VISIBLE_CHIPS=" || die "pod1 missing chip env"
-chip0=$(echo "$log0" | sed -n 's/.*TPU_VISIBLE_CHIPS= *//p' | head -1)
-chip1=$(echo "$log1" | sed -n 's/.*TPU_VISIBLE_CHIPS= *//p' | head -1)
+# Device identity is (pool, chip): chip indices repeat across nodes, and
+# the scheduler may legitimately spread the pods when one node's slice
+# publishes first (startup).
+chip0="$(jp pod pod0 tpu-test1 .spec.nodeName):$(echo "$log0" | sed -n 's/.*TPU_VISIBLE_CHIPS= *//p' | head -1)"
+chip1="$(jp pod pod1 tpu-test1 .spec.nodeName):$(echo "$log1" | sed -n 's/.*TPU_VISIBLE_CHIPS= *//p' | head -1)"
 [ "$chip0" != "$chip1" ] || die "exclusive claims got the same chip ($chip0)"
 k delete -f "$REPO_ROOT/demo/specs/tpu-test1.yaml" --ignore-not-found
 
@@ -42,5 +45,30 @@ wait_until 120 "tpu-test5 pods Succeeded" all_pods_phase tpu-test5 Succeeded
 k logs pod0 -n tpu-test5 | grep -q "TPU_VISIBLE_CHIPS=" \
   || die "tpu-test5 pod missing chip env"
 k delete -f "$REPO_ROOT/demo/specs/tpu-test5.yaml" --ignore-not-found
+
+log "tpu-test6: CEL attribute selection (gpu-test6 analog)"
+# Two containers in one pod, each CEL-pinned to a different subslice
+# (coreStart 0/1) of the chip at coords (0,0); a third, unsatisfiable
+# claim (generation == 'v99x') must keep its pod Pending.
+k apply -f "$REPO_ROOT/demo/specs/tpu-test6.yaml"
+wait_until 120 "tpu-test6 pod0 Succeeded" pod_phase_is pod0 tpu-test6 Succeeded
+log0=$(k logs pod0 -n tpu-test6 -c ctr0)
+log1=$(k logs pod0 -n tpu-test6 -c ctr1)
+echo "$log0" | grep -q "CTR0 .*CORES=0-0" \
+  || die "ctr0 did not get the coreStart=0 subslice: $log0"
+echo "$log1" | grep -q "CTR1 .*CORES=1-1" \
+  || die "ctr1 did not get the coreStart=1 subslice: $log1"
+chip0=$(echo "$log0" | sed -n 's/.*TPU_VISIBLE_CHIPS=\([^ ]*\).*/\1/p')
+chip1=$(echo "$log1" | sed -n 's/.*TPU_VISIBLE_CHIPS=\([^ ]*\).*/\1/p')
+[ -n "$chip0" ] && [ "$chip0" = "$chip1" ] \
+  || die "CEL-selected subslices did not share one chip ($chip0 vs $chip1)"
+# Negative control: the unsatisfiable selector must keep the pod Pending
+# (a selector-ignoring scheduler would have bound it by now).
+phase=$(pod_phase pod-unsatisfiable tpu-test6)
+[ "$phase" = "Pending" ] || [ -z "$phase" ] \
+  || die "unsatisfiable CEL claim was scheduled (phase=$phase)"
+alloc=$(jp resourceclaim no-such-generation tpu-test6 .status.allocation)
+[ -z "$alloc" ] || die "unsatisfiable claim got an allocation: $alloc"
+k delete -f "$REPO_ROOT/demo/specs/tpu-test6.yaml" --ignore-not-found
 
 log "OK test_tpu_claims"

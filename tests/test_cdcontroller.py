@@ -205,6 +205,42 @@ class TestReadiness:
         finally:
             controller.stop()
 
+    def test_numnodes_zero_restart_does_not_flap(self):
+        """A restarted controller over an already-Ready open-ended domain
+        adopts the member set as settled — re-arming the window would
+        flap every stable CD to NotReady on each controller roll."""
+        import time as _time
+
+        cluster = FakeCluster()
+        c1 = Controller(cluster, namespace=NS, image="img:test",
+                        gc_interval=3600.0, open_ready_settle_s=0.3)
+        c1.start()
+        try:
+            cd = make_cd(cluster, name="cd-r", num_nodes=0,
+                         rct_name="rct-r")
+            assert cluster.wait_for(lambda: _exists(
+                cluster, DAEMONSETS, daemon_object_name(cd), NS))
+            self._register_nodes(cluster, cd, ready=2, name="cd-r")
+            assert cluster.wait_for(
+                lambda: (get_cd(cluster, "cd-r").get("status") or {}).get(
+                    "status") == "Ready", timeout=5.0)
+        finally:
+            c1.stop()
+        # Restart with a LONG settle window: if the new controller
+        # re-armed it, the domain would flip NotReady and stick there.
+        c2 = Controller(cluster, namespace=NS, image="img:test",
+                        gc_interval=3600.0, open_ready_settle_s=30.0)
+        c2.start()
+        try:
+            c2.enqueue(cd["metadata"]["uid"])
+            deadline = _time.monotonic() + 1.5
+            while _time.monotonic() < deadline:
+                assert (get_cd(cluster, "cd-r").get("status") or {}).get(
+                    "status") == "Ready", "restart flapped a stable CD"
+                _time.sleep(0.1)
+        finally:
+            c2.stop()
+
 
 class TestPodDeletion:
     def test_pod_delete_removes_node_from_status(self, harness):

@@ -342,7 +342,15 @@ class Controller:
                 sig = frozenset(n.get("name", "") for n in nodes)
                 now = time.monotonic()
                 prev = self._open_membership.get(uid)
-                if prev is None or prev[0] != sig:
+                if prev is None and (cd.get("status") or {}).get(
+                        "status") == apitypes.COMPUTE_DOMAIN_STATUS_READY:
+                    # Controller restart over an already-Ready domain:
+                    # adopt the member set as settled — re-arming here
+                    # would flap every stable open-ended CD to NotReady
+                    # for a window whose membership never changed.
+                    changed_at = now - self._open_settle_s
+                    self._open_membership[uid] = (sig, changed_at)
+                elif prev is None or prev[0] != sig:
                     self._open_membership[uid] = (sig, now)
                     changed_at = now
                 else:

@@ -83,11 +83,19 @@ class WebhookCaller:
             if fail_policy == "Ignore":
                 return None
             return ("error", "webhook endpoint unavailable")
+        # The reviewed version is the version the CLIENT submitted (the
+        # real API server admits at request version, not storage
+        # version): a v1beta1-shaped claim must reach the webhook as
+        # v1beta1 so its conversion path runs (webhook resource.go:83-160
+        # analog).
+        obj_api = obj.get("apiVersion", "")
+        version = obj_api.split("/", 1)[1] if "/" in obj_api \
+            else gvr.version
         review = {
             "apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
             "request": {
                 "uid": obj.get("metadata", {}).get("uid", "sim-admission"),
-                "resource": {"group": gvr.group, "version": gvr.version,
+                "resource": {"group": gvr.group, "version": version,
                              "resource": gvr.plural},
                 "kind": {"kind": obj.get("kind", "")},
                 "operation": operation,

@@ -1,0 +1,254 @@
+"""CEL-subset evaluator for scheduler-side device selection.
+
+The upstream kube-scheduler evaluates full CEL over published device
+attributes when allocating DRA claims (SURVEY §1: DeviceClass selectors
+plus per-request selectors). The sim implements the subset the demo
+ladder and e2e tier actually use, so a wrong attribute name, a type
+mismatch, or a non-matching value FAILS selection instead of silently
+matching (VERDICT r4 missing #1; reference demo shape:
+demo/specs/quickstart/v1/gpu-test6.yaml:26-35):
+
+    device.driver == "tpu.dev"
+    device.attributes['tpu.dev'].generation == 'v5p'
+    device.attributes['tpu.dev'].coordX >= 1
+    device.attributes['tpu.dev'].productName.lowerAscii().matches('v5p')
+    a && b, a || b, !a, (a)
+
+Evaluation context is one published resourceapi.Device: the slice's
+driver name plus the device's typed attribute map
+({"string": v} | {"int": v} | {"bool": v} | {"version": v}).
+
+An unknown attribute, a driver-key mismatch in `device.attributes[...]`,
+or a type error raises CelError — callers treat that as "device does not
+match", which is the observable behavior of a CEL runtime error in the
+real scheduler.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Tuple
+
+_TOKEN_RE = re.compile(r"""
+    \s*(?:
+      (?P<op>&&|\|\||==|!=|>=|<=|>|<|!|\(|\)|\[|\]|\.)
+    | (?P<str>'(?:[^'\\]|\\.)*'|"(?:[^"\\]|\\.)*")
+    | (?P<int>-?\d+)
+    | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
+    )""", re.VERBOSE)
+
+
+class CelError(Exception):
+    pass
+
+
+def _tokenize(expr: str) -> List[Tuple[str, str]]:
+    tokens = []
+    pos = 0
+    while pos < len(expr):
+        m = _TOKEN_RE.match(expr, pos)
+        if m is None or m.end() == pos:
+            rest = expr[pos:].strip()
+            if not rest:
+                break
+            raise CelError(f"cannot tokenize at {rest[:20]!r}")
+        pos = m.end()
+        for kind in ("op", "str", "int", "ident"):
+            val = m.group(kind)
+            if val is not None:
+                tokens.append((kind, val))
+                break
+    return tokens
+
+
+class _Parser:
+    """Recursive descent over the token list; evaluates as it parses
+    (short-circuit for && / ||)."""
+
+    def __init__(self, tokens: List[Tuple[str, str]], driver: str,
+                 attributes: Dict[str, Dict]):
+        self._toks = tokens
+        self._i = 0
+        self._driver = driver
+        self._attrs = attributes
+
+    # -- token helpers --------------------------------------------------
+
+    def _peek(self):
+        return self._toks[self._i] if self._i < len(self._toks) else None
+
+    def _next(self):
+        tok = self._peek()
+        if tok is None:
+            raise CelError("unexpected end of expression")
+        self._i += 1
+        return tok
+
+    def _accept(self, kind: str, value: str = None) -> bool:
+        tok = self._peek()
+        if tok and tok[0] == kind and (value is None or tok[1] == value):
+            self._i += 1
+            return True
+        return False
+
+    def _expect(self, kind: str, value: str = None):
+        tok = self._next()
+        if tok[0] != kind or (value is not None and tok[1] != value):
+            raise CelError(f"expected {value or kind}, got {tok[1]!r}")
+        return tok
+
+    # -- grammar --------------------------------------------------------
+
+    def parse(self) -> Any:
+        v = self._or()
+        if self._peek() is not None:
+            raise CelError(f"trailing tokens at {self._peek()[1]!r}")
+        return v
+
+    def _or(self) -> Any:
+        v = self._and()
+        while self._accept("op", "||"):
+            rhs = self._and()
+            v = self._truthy(v) or self._truthy(rhs)
+        return v
+
+    def _and(self) -> Any:
+        v = self._cmp()
+        while self._accept("op", "&&"):
+            rhs = self._cmp()
+            v = self._truthy(v) and self._truthy(rhs)
+        return v
+
+    def _cmp(self) -> Any:
+        lhs = self._unary()
+        tok = self._peek()
+        if tok and tok[0] == "op" and tok[1] in ("==", "!=", ">=",
+                                                 "<=", ">", "<"):
+            op = self._next()[1]
+            rhs = self._unary()
+            if type(lhs) is not type(rhs):
+                raise CelError(
+                    f"type mismatch: {type(lhs).__name__} {op} "
+                    f"{type(rhs).__name__}")
+            if op == "==":
+                return lhs == rhs
+            if op == "!=":
+                return lhs != rhs
+            if isinstance(lhs, bool):
+                raise CelError(f"ordering comparison on bool ({op})")
+            if op == ">=":
+                return lhs >= rhs
+            if op == "<=":
+                return lhs <= rhs
+            if op == ">":
+                return lhs > rhs
+            return lhs < rhs
+        return lhs
+
+    def _unary(self) -> Any:
+        if self._accept("op", "!"):
+            return not self._truthy(self._unary())
+        return self._primary()
+
+    def _primary(self) -> Any:
+        if self._accept("op", "("):
+            v = self._or()
+            self._expect("op", ")")
+            return self._methods(v)
+        tok = self._next()
+        if tok[0] == "str":
+            return self._methods(_unquote(tok[1]))
+        if tok[0] == "int":
+            return int(tok[1])
+        if tok[0] == "ident":
+            if tok[1] in ("true", "false"):
+                return tok[1] == "true"
+            if tok[1] == "device":
+                return self._methods(self._device_chain())
+            raise CelError(f"unknown identifier {tok[1]!r}")
+        raise CelError(f"unexpected token {tok[1]!r}")
+
+    def _device_chain(self) -> Any:
+        self._expect("op", ".")
+        field = self._expect("ident")[1]
+        if field == "driver":
+            return self._driver
+        if field != "attributes":
+            raise CelError(f"unknown device field {field!r}")
+        self._expect("op", "[")
+        key = _unquote(self._expect("str")[1])
+        self._expect("op", "]")
+        if key != self._driver:
+            # The real API nests attribute names under the driver's
+            # domain; a wrong key must not match anything.
+            raise CelError(
+                f"attribute domain {key!r} does not match driver "
+                f"{self._driver!r}")
+        self._expect("op", ".")
+        name = self._expect("ident")[1]
+        if name not in self._attrs:
+            raise CelError(f"unknown attribute {name!r}")
+        typed = self._attrs[name]
+        for typ in ("string", "int", "bool", "version"):
+            if typ in typed:
+                val = typed[typ]
+                return int(val) if typ == "int" else val
+        raise CelError(f"attribute {name!r} has no supported type")
+
+    def _methods(self, value: Any) -> Any:
+        """Postfix method calls on a value: .lowerAscii(), .matches(re)."""
+        while True:
+            save = self._i
+            if not self._accept("op", "."):
+                return value
+            tok = self._peek()
+            if tok is None or tok[0] != "ident" or tok[1] not in (
+                    "lowerAscii", "matches"):
+                self._i = save
+                return value
+            method = self._next()[1]
+            self._expect("op", "(")
+            if method == "lowerAscii":
+                self._expect("op", ")")
+                if not isinstance(value, str):
+                    raise CelError("lowerAscii() on non-string")
+                value = value.lower()
+            else:
+                pattern = _unquote(self._expect("str")[1])
+                self._expect("op", ")")
+                if not isinstance(value, str):
+                    raise CelError("matches() on non-string")
+                # CEL matches() is an unanchored RE2 search.
+                value = re.search(pattern, value) is not None
+
+    @staticmethod
+    def _truthy(v: Any) -> bool:
+        if not isinstance(v, bool):
+            raise CelError(f"non-bool in boolean context: {v!r}")
+        return v
+
+
+def _unquote(raw: str) -> str:
+    body = raw[1:-1]
+    return re.sub(r"\\(.)", r"\1", body)
+
+
+def evaluate(expr: str, *, driver: str, attributes: Dict[str, Dict]) -> bool:
+    """True iff `expr` selects a device with the given driver/attributes.
+    Raises CelError on unsupported syntax, unknown attributes, or type
+    errors (callers treat that as no-match)."""
+    result = _Parser(_tokenize(expr), driver, attributes).parse()
+    if not isinstance(result, bool):
+        raise CelError(f"expression is not boolean: {result!r}")
+    return result
+
+
+def device_matches(expr: str, device: Dict, driver: str) -> bool:
+    """Evaluate against a published resourceapi.Device entry; a CEL error
+    means the device is not selectable by this expression (the real
+    scheduler's observable behavior for runtime errors)."""
+    try:
+        return evaluate(expr, driver=driver,
+                        attributes=device.get("attributes") or {})
+    except CelError:
+        return False

@@ -132,24 +132,29 @@ class NodeSim:
         rp = _RunningPod(uid)
         rp.logs_dir = os.path.join(self._dir, "pods", uid, "logs")
         os.makedirs(rp.logs_dir, exist_ok=True)
-        cdi_env: Dict[str, str] = {}
-        cdi_mounts: List[Tuple[str, str]] = []
+        # Per-pod-claim CDI edits, applied per CONTAINER below by each
+        # container's resources.claims — kubelet/containerd semantics: a
+        # container only receives the CDI devices of the claims it
+        # references, so two containers sharing a pod can see different
+        # subslice env from the same chip (the gpu-test6 shape).
+        edits: Dict[str, Tuple[Dict[str, str], List[Tuple[str, str]]]] = {}
         try:
-            for claim in claims:
+            for entry_name, claim in claims:
                 rp.claim_refs.append((claim["metadata"]["uid"],
                                       claim["metadata"]["name"], ns))
                 ids = self._prepare_claim(claim, rp)
                 env_part, mounts_part = self._cdi_edits(ids)
-                cdi_env.update(env_part)
+                linked: List[Tuple[str, str]] = []
                 # Short symlinks for mount targets: a rewritten AF_UNIX
                 # socket path (coordinator pipe) must stay <= 107 chars.
-                for i, (cpath, hpath) in enumerate(mounts_part):
-                    link = f"/tmp/simm-{uid[:8]}-{len(cdi_mounts) + i}"
+                for cpath, hpath in mounts_part:
+                    link = f"/tmp/simm-{uid[:8]}-{len(rp.links)}"
                     if os.path.islink(link):
                         os.unlink(link)
                     os.symlink(hpath, link)
                     rp.links.append(link)
-                    cdi_mounts.append((cpath, link))
+                    linked.append((cpath, link))
+                edits[entry_name] = (env_part, linked)
         except Exception as e:  # noqa: BLE001
             # kubelet semantics: a failed prepare is retried on the next
             # sync, NOT unprepared — prepare is idempotent, and the CD
@@ -162,8 +167,16 @@ class NodeSim:
             return
         try:
             for ctr in pod["spec"].get("containers") or []:
-                rp.procs.append(self._launch(pod, ctr, cdi_env, rp,
-                                             cdi_mounts=cdi_mounts))
+                names = [c.get("name") for c in
+                         (ctr.get("resources") or {}).get("claims") or []]
+                ctr_env: Dict[str, str] = {}
+                ctr_mounts: List[Tuple[str, str]] = []
+                for n in names:
+                    env_part, mounts_part = edits.get(n, ({}, []))
+                    ctr_env.update(env_part)
+                    ctr_mounts.extend(mounts_part)
+                rp.procs.append(self._launch(pod, ctr, ctr_env, rp,
+                                             cdi_mounts=ctr_mounts))
         except Exception as e:  # noqa: BLE001
             log.warning("pod %s/%s launch failed: %s", ns,
                         pod["metadata"]["name"], e)
@@ -230,7 +243,10 @@ class NodeSim:
             except ApiError:
                 pass
 
-    def _resolve_claims(self, pod: Dict, ns: str) -> Optional[List[Dict]]:
+    def _resolve_claims(self, pod: Dict,
+                        ns: str) -> Optional[List[Tuple[str, Dict]]]:
+        """(pod-claim-entry name, claim) pairs — the entry name is what a
+        container's resources.claims references."""
         statuses = {s["name"]: s["resourceClaimName"] for s in
                     ((pod.get("status") or {})
                      .get("resourceClaimStatuses") or [])}
@@ -246,7 +262,7 @@ class NodeSim:
                 return None
             if not (claim.get("status") or {}).get("allocation"):
                 return None
-            claims.append(claim)
+            claims.append((entry["name"], claim))
         return claims
 
     def _prepare_claim(self, claim: Dict, rp: _RunningPod) -> List[str]:

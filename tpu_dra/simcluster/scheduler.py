@@ -12,7 +12,6 @@ excluded, and the pod binds to a node that can satisfy every claim.
 from __future__ import annotations
 
 import logging
-import re
 import threading
 from typing import Dict, List, Optional, Set, Tuple
 
@@ -21,14 +20,9 @@ from tpu_dra.k8s.resources import (
     DEVICECLASSES, NODES, PODS, RESOURCECLAIMS, RESOURCECLAIMTEMPLATES,
     RESOURCESLICES,
 )
+from tpu_dra.simcluster.cel import device_matches
 
 log = logging.getLogger("simcluster.scheduler")
-
-# The CEL shape our DeviceClasses use (deviceclass-*.yaml):
-#   device.driver == "D" && device.attributes["D"].type == "T"
-_CEL_RE = re.compile(
-    r"device\.driver\s*==\s*\"([^\"]+)\"\s*&&\s*"
-    r"device\.attributes\[\"[^\"]+\"\]\.type\s*==\s*\"([^\"]+)\"")
 
 
 class Scheduler:
@@ -253,14 +247,19 @@ class Scheduler:
             exact = req.get("exactly") or req  # v1 wrapper or flat
             class_name = exact.get("deviceClassName", "")
             count = int(exact.get("count") or 1)
-            match = self._class_selector(class_name)
-            if match is None:
+            exprs = self._class_selectors(class_name)
+            if exprs is None:
                 return None
-            driver, dev_type = match
-            picked = self._pick_devices(node, driver, dev_type, count, taken)
+            # Per-request selectors AND with the class's (the real
+            # allocator's semantics: every selector must match;
+            # gpu-test6-style attribute selection rides here).
+            exprs = exprs + [
+                (sel.get("cel") or {}).get("expression", "")
+                for sel in exact.get("selectors") or []]
+            picked = self._pick_devices(node, exprs, count, taken)
             if picked is None:
                 return None
-            for dev in picked:
+            for driver, dev in picked:
                 taken.add((driver, node, dev))
                 parent = self._parent_of(dev)
                 taken.add((driver, node, parent) if parent != dev
@@ -276,33 +275,36 @@ class Scheduler:
                     {"key": "metadata.name", "operator": "In",
                      "values": [node]}]}]}}
 
-    def _class_selector(self, name: str) -> Optional[Tuple[str, str]]:
+    def _class_selectors(self, name: str) -> Optional[List[str]]:
+        """All CEL expressions of the DeviceClass (None if the class does
+        not exist — the claim is unallocatable, not unconstrained)."""
         try:
             dc = self._client.get(DEVICECLASSES, name)
         except NotFoundError:
             return None
-        for sel in (dc.get("spec") or {}).get("selectors") or []:
-            expr = (sel.get("cel") or {}).get("expression", "")
-            m = _CEL_RE.search(expr)
-            if m:
-                return m.group(1), m.group(2)
-        return None
+        return [(sel.get("cel") or {}).get("expression", "")
+                for sel in (dc.get("spec") or {}).get("selectors") or []]
 
-    def _pick_devices(self, node: str, driver: str, dev_type: str,
-                      count: int,
-                      taken: Set[Tuple[str, str, str]]) -> Optional[List[str]]:
+    def _pick_devices(self, node: str, exprs: List[str], count: int,
+                      taken: Set[Tuple[str, str, str]]
+                      ) -> Optional[List[Tuple[str, str]]]:
+        """Devices on `node` matching EVERY CEL expression, as
+        (driver, name) pairs. CEL is evaluated for real against the
+        published attributes (simcluster.cel): a wrong attribute name or
+        type mismatch selects nothing instead of everything."""
         available = []
         for sl in self._client.list(RESOURCESLICES):
             spec = sl.get("spec") or {}
-            if spec.get("nodeName") != node or spec.get("driver") != driver:
+            if spec.get("nodeName") != node:
                 continue
+            driver = spec.get("driver", "")
             for dev in spec.get("devices") or []:
-                attrs = dev.get("attributes") or {}
-                if (attrs.get("type") or {}).get("string") != dev_type:
+                if not all(device_matches(e, dev, driver)
+                           for e in exprs):
                     continue
                 if self._is_taken(taken, driver, node, dev["name"]):
                     continue
-                available.append(dev["name"])
+                available.append((driver, dev["name"]))
         if len(available) < count:
             return None
         return available[:count]

@@ -343,3 +343,125 @@ class FakeKernelPci:
         elif drv != "tpu-accel":
             return
         os.symlink(self._driver_dir(drv), link)
+
+
+def provision_two_node_cd(namespace: str = "cdtest",
+                          node_names=("node-a", "node-b"),
+                          retry_timeout: float = 30.0,
+                          join_timeout: float = 60.0) -> dict:
+    """Provision a 2-node ComputeDomain through the full CD stack —
+    controller + CD kubelet plugins + real C++ slice daemons converging
+    over the fake API server — and prepare one workload channel claim per
+    node (SURVEY §3.3). The single source of the harness for
+    bench.bench_cd_convergence (convergence timing) and
+    __graft_entry__._cd_psum_probe (claim-env -> mesh -> collective).
+
+    Returns {"ok", "error"/"skipped", "elapsed_s", "envs"} where
+    elapsed_s is CD-creation -> both claims prepared, and envs maps node
+    name -> the prepared claim's CDI env (the workload container's view:
+    TPU_WORKER_ID, TPU_WORKER_HOSTNAMES, coordinator/megascale vars).
+    """
+    import shutil
+    import tempfile
+    import threading
+    import time
+
+    from tpu_dra.cdcontroller import Controller
+    from tpu_dra.k8s import COMPUTEDOMAINS, FakeCluster, RESOURCECLAIMS
+    from tpu_dra.kubeletplugin.server import Claim
+
+    if not os.path.exists(DAEMON_BIN):
+        return {"ok": False, "skipped": "native daemon not built"}
+
+    # Fake chip inventory is deliberate: this harness benchmarks/validates
+    # the control plane with simulated nodes, and the hardened auto-detect
+    # would refuse fake-on-real-hardware.
+    saved = os.environ.get("TPU_DRA_TPUINFO_BACKEND")
+    os.environ["TPU_DRA_TPUINFO_BACKEND"] = "fake"
+    tmp = tempfile.mkdtemp(prefix="tpu-dra-cd2-")
+    controller = None
+    nodes = []
+    try:
+        cluster = FakeCluster()
+        controller = Controller(cluster, namespace="tpu-dra-driver",
+                                image="harness", gc_interval=3600.0)
+        controller.start()
+        nodes = [FakeNode(cluster, name, tmp, retry_timeout=retry_timeout)
+                 for name in node_names]
+
+        t0 = time.perf_counter()
+        cd = cluster.create(COMPUTEDOMAINS, {
+            "apiVersion": apitypes.API_VERSION, "kind": "ComputeDomain",
+            "metadata": {"name": "harness-cd", "namespace": namespace},
+            "spec": {"numNodes": len(nodes), "channel": {
+                "resourceClaimTemplate": {"name": "harness-rct"}}},
+        })
+        results: dict = {}
+        envs: dict = {}
+
+        def kubelet(node):
+            claim = cluster.create(RESOURCECLAIMS, {
+                "apiVersion": "resource.k8s.io/v1", "kind": "ResourceClaim",
+                "metadata": {"name": f"w-{node.name}",
+                             "namespace": namespace},
+                "spec": {"devices": {"requests": [{"name": "r0"}]}},
+                "status": {"allocation": {"devices": {
+                    "results": [{
+                        "request": "r0",
+                        "driver": apitypes.COMPUTE_DOMAIN_DRIVER_NAME,
+                        "pool": node.name, "device": "channel-0"}],
+                    "config": [{"requests": ["r0"], "opaque": {
+                        "driver": apitypes.COMPUTE_DOMAIN_DRIVER_NAME,
+                        "parameters": {
+                            "apiVersion": apitypes.API_VERSION,
+                            "kind": "ComputeDomainChannelConfig",
+                            "domainID": cd["metadata"]["uid"],
+                            "allocationMode": "Single"}}}]}}},
+            })
+            uid = claim["metadata"]["uid"]
+            c = Claim(uid=uid, name=claim["metadata"]["name"],
+                      namespace=namespace)
+            results[node.name] = node.driver.prepare_claims([c])[c.uid]
+            spec = node.cdi.read_spec(node.cdi.claim_spec_path(uid))
+            envs[node.name] = dict(
+                e.split("=", 1)
+                for e in spec["devices"][0]["containerEdits"]["env"])
+
+        threads = [threading.Thread(target=kubelet, args=(n,))
+                   for n in nodes]
+        for t in threads:
+            t.start()
+        failure = None
+        # Play the DaemonSet: start a daemon when its node gets labeled.
+        for node in nodes:
+            if not node.wait_labeled(cd["metadata"]["uid"]):
+                failure = f"{node.name} never labeled"
+                break
+            node.start_daemon(cd)
+        for t in threads:
+            t.join(timeout=join_timeout)
+        elapsed = time.perf_counter() - t0
+        if failure is None and any(t.is_alive() for t in threads):
+            failure = "kubelet prepare threads timed out"
+        if failure is None:
+            errors = [f"{n}: {r.error}"
+                      for n, r in results.items() if r.error]
+            if errors or len(envs) != len(nodes):
+                failure = "; ".join(errors) or "prepare incomplete"
+        if failure:
+            # Drain the prepare retry loops (bounded by retry_timeout)
+            # before teardown rips the state dirs out from under them.
+            for t in threads:
+                t.join(timeout=retry_timeout + 5)
+            return {"ok": False, "error": failure}
+        return {"ok": True, "elapsed_s": elapsed, "envs": envs}
+    finally:
+        for node in nodes:
+            node.stop()
+        if controller is not None:
+            controller.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+        if saved is None:
+            os.environ.pop("TPU_DRA_TPUINFO_BACKEND", None)
+        else:
+            os.environ["TPU_DRA_TPUINFO_BACKEND"] = saved
