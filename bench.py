@@ -510,13 +510,16 @@ def _flops_per_token(cfg, n_params: int):
             + 6 * cfg.n_layers * cfg.max_seq * cfg.d_model), matmul_params
 
 
-def bench_long_context(jax_probe, steps: int = 4):
+def bench_long_context(jax_probe, steps: int = 4, seq: int = 8192,
+                       prefix: str = "long_ctx"):
     """Single-chip long-context train step: the flagship model at
-    S=8192 (flash kernel + fused rope — the [S,S] score matrix would be
-    256MB/head here; the kernel keeps attention O(block)). Beyond one
-    chip's VMEM window the SP path takes over (ring attention,
-    __graft_entry__.dryrun_multichip); this phase pins the single-chip
-    end of that curve."""
+    S=`seq` (flash kernel + fused rope — the [S,S] score matrix would be
+    256MB/head at 8192; the kernel keeps attention O(block)). S=8192
+    rides the VMEM-resident kernels; S=16384 exercises the streaming
+    (XL) kernels, which lift the single-chip ceiling past the resident
+    path's VMEM budget. Beyond one chip the SP path takes over (ring
+    attention, __graft_entry__.dryrun_multichip); this phase pins the
+    single-chip end of that curve."""
     import math as _math
 
     from tpu_dra.native.tpuinfo import PEAK_BF16_TFLOPS
@@ -525,7 +528,7 @@ def bench_long_context(jax_probe, steps: int = 4):
     if jax_probe["platform"] != "tpu":
         return {}
     cfg = ModelConfig(vocab=32768, d_model=2048, n_heads=16, n_layers=8,
-                      d_ff=8192, max_seq=8192)
+                      d_ff=8192, max_seq=seq)
     step_s, loss_v, state = _train_step_rate(jax_probe, cfg, batch=1,
                                              steps=steps)
     assert _math.isfinite(loss_v), f"non-finite long-ctx loss: {loss_v}"
@@ -534,13 +537,13 @@ def bench_long_context(jax_probe, steps: int = 4):
     tokens_per_step = cfg.max_seq - 1
     flops_per_token, _ = _flops_per_token(cfg, n_params)
     out = {
-        "long_ctx_seq": cfg.max_seq,
-        "long_ctx_step_s": round(step_s, 4),
-        "long_ctx_tokens_per_s": round(tokens_per_step / step_s, 1),
+        f"{prefix}_seq": cfg.max_seq,
+        f"{prefix}_step_s": round(step_s, 4),
+        f"{prefix}_tokens_per_s": round(tokens_per_step / step_s, 1),
     }
     gen = jax_probe["generation"]
     if gen in PEAK_BF16_TFLOPS:
-        out["long_ctx_mfu"] = round(
+        out[f"{prefix}_mfu"] = round(
             flops_per_token * tokens_per_step / step_s / 1e12
             / PEAK_BF16_TFLOPS[gen], 4)
     return out
@@ -647,6 +650,14 @@ def main():
             out.update(bench_long_context(jax_probe))
         except Exception as e:  # noqa: BLE001 — best-effort
             out["long_ctx_error"] = str(e)
+        try:
+            # XL tier: S=16384 through the streaming kernels (the
+            # resident path cannot compile there — K/V + rope tables
+            # exceed scoped VMEM).
+            out.update(bench_long_context(jax_probe, steps=3, seq=16384,
+                                          prefix="long_ctx_xl"))
+        except Exception as e:  # noqa: BLE001 — best-effort
+            out["long_ctx_xl_error"] = str(e)
 
     result = {
         "metric": "claim_to_ready_p50_ms",

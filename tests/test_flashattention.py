@@ -308,3 +308,53 @@ class TestDefaultBlocks:
         # (causal) or a ValueError (non-causal) — must fall back.
         assert default_bwd_blocks(4608) == (256, 256)
         assert default_bwd_blocks(1024) == (256, 256)
+
+
+class TestStreamingKernels:
+    """The XL (streaming) kernels — K/V as a grid dimension with VMEM
+    scratch accumulators — must compute exactly the resident kernels'
+    function; they exist to lift the single-chip sequence ceiling past
+    the resident path's VMEM budget (S>=16384 at D=128 bf16 w/ rope)."""
+
+    def _qkv(self, dtype=jnp.float32):
+        B, S, H, D = 2, 384, 2, 16
+        keys = jax.random.split(jax.random.PRNGKey(0), 3)
+        return [jax.random.normal(k, (B, S, H, D), dtype) for k in keys]
+
+    @pytest.mark.parametrize("causal", [True, False])
+    @pytest.mark.parametrize("rope", [True, False])
+    def test_value_and_grad_parity(self, causal, rope):
+        from tpu_dra.workloads.flashattention import (
+            flash_attention_with_lse,
+        )
+        q, k, v = self._qkv()
+
+        def loss(mode):
+            def g(q, k, v):
+                out, lse = flash_attention_with_lse(
+                    q, k, v, causal=causal, rope=rope, interpret=True,
+                    block_q=128, block_k=128,
+                    streaming=(mode == "stream"))
+                # Consume BOTH outputs so the joint VJP (ring attention's
+                # contract) is exercised, not just the out-only path.
+                return ((out.astype(jnp.float32) * 1.7).sum()
+                        + (lse * 0.3).sum())
+            return g
+
+        ref_v, ref_g = jax.value_and_grad(loss("res"), argnums=(0, 1, 2))(
+            q, k, v)
+        st_v, st_g = jax.value_and_grad(loss("stream"), argnums=(0, 1, 2))(
+            q, k, v)
+        assert abs(float(ref_v - st_v)) <= 1e-4 * abs(float(ref_v))
+        for a, b in zip(ref_g, st_g):
+            scale = max(float(jnp.abs(a).max()), 1e-6)
+            assert float(jnp.abs(a - b).max()) / scale <= 1e-4
+
+    def test_needs_streaming_threshold(self):
+        from tpu_dra.workloads.flashattention import _needs_streaming
+        # S=8192 D=128 bf16 with rope: 8MB stationary — resident.
+        assert not _needs_streaming(8192, 128, jnp.bfloat16, True)
+        # S=16384: 16MB — must stream.
+        assert _needs_streaming(16384, 128, jnp.bfloat16, True)
+        # fp32 doubles the footprint: streams already at 8192.
+        assert _needs_streaming(8192, 128, jnp.float32, True)

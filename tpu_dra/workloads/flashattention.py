@@ -407,6 +407,359 @@ def _rope_operands(s, d, rope, dtype):
     return (cos_t, sinm_t), (spec, spec)
 
 
+# ---------------------------------------------------------------------------
+# Streaming (XL) kernels: K/V as a grid dimension
+# ---------------------------------------------------------------------------
+#
+# The resident kernels above hold the full-sequence K/V (+ rope tables)
+# in VMEM per grid row — the fastest layout while it fits, but a hard
+# ceiling near S=8192 (bf16 K+V 4MB + tables 4MB + blocks against the
+# 16MB scoped budget). The streaming variants below make the stationary
+# side a grid dimension instead: Mosaic pipelines each K/V (or Q/dO)
+# tile HBM->VMEM, online-softmax state lives in VMEM scratch across the
+# revisited output block, and the result is written on the final visit.
+# Cost vs resident at the same S: causal wastes the DMA of
+# above-diagonal tiles (they are skipped compute-side) and the mask
+# select runs on every tile — so the resident path stays the default
+# and streaming engages only when residency would OOM
+# (_needs_streaming), or explicitly for tests.
+
+# Conservative budget for the resident path's stationary VMEM
+# (K+V + rope tables), leaving headroom for blocks + double buffering
+# inside the 16MB scoped window.
+_RESIDENT_VMEM_BUDGET = 10 * 1024 * 1024
+# (block_q, block_k) for the streaming kernels. Swept on v5e at S=16384
+# (B1 H16 D128, rope, attention grad): (512,512) 64.3ms, (256,1024) 60.0,
+# (1024,512) 55.4, (512,1024) 47.9, (2048,512) 44.8, (2048,1024) 42.4,
+# (1024,1024) 42.8ms; (*,2048) OOMs scratch+blocks. Big square tiles win:
+# fewer revisit flushes and better MXU occupancy amortize the per-tile
+# mask/DMA tax.
+STREAM_BLOCKS = (1024, 1024)
+
+
+def _needs_streaming(s: int, d: int, dtype, rope: bool) -> bool:
+    itemsize = jnp.dtype(dtype).itemsize
+    resident = 2 * s * d * itemsize          # K + V (fwd/dq) or Q + dO
+    if rope:
+        resident += 2 * s * d * itemsize     # cos + sinm tables
+    return resident > _RESIDENT_VMEM_BUDGET
+
+
+def _stream_rope(x, cos_ref, sinm_ref, *, inverse: bool = False):
+    """_rope_apply against tile-sliced table refs: the BlockSpec index
+    map already positioned the (rows, d) slice at the tile's global
+    rows, so the in-tile start is 0. One rotation implementation for
+    both kernel families."""
+    return _rope_apply(x, 0, cos_ref, sinm_ref, inverse=inverse)
+
+
+def _stream_mask(scores, q_start, k_start, block_q, block_k):
+    """Causal mask for one streamed tile. Applied unconditionally on the
+    causal path (the tile-interior no-mask optimization of the resident
+    kernels needs static loop bounds the grid does not give us); for
+    fully-below-diagonal tiles the select is the identity."""
+    q_pos = q_start + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = k_start + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return jnp.where(q_pos >= k_pos, scores, NEG_INF)
+
+
+def _fwd_stream_kernel(q_ref, k_ref, v_ref, *rest, block_q: int,
+                       block_k: int, num_k_blocks: int, causal: bool,
+                       sm_scale: float, rope: bool):
+    """Grid (BH, q_blocks, k_blocks), k fastest. Scratch carries the
+    online-softmax state across the k dimension; o/lse are written on the
+    last k step (their index maps are constant in k, so Mosaic keeps the
+    blocks resident until then)."""
+    if rope:
+        (cos_q, sinm_q, cos_k, sinm_k,
+         o_ref, lse_ref, acc_ref, m_ref, den_ref) = rest
+    else:
+        o_ref, lse_ref, acc_ref, m_ref, den_ref = rest
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        den_ref[...] = jnp.zeros_like(den_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    def compute():
+        q = q_ref[...]
+        k_blk = k_ref[...]
+        if rope:
+            q = _stream_rope(q, cos_q, sinm_q)
+            k_blk = _stream_rope(k_blk, cos_k, sinm_k)
+        scores = _dot(q, k_blk, trans_b=True) * sm_scale
+        if causal:
+            scores = _stream_mask(scores, q_start, k_start,
+                                  block_q, block_k)
+        blk_max = jnp.max(scores, axis=1)
+        prev_max = m_ref[0, :]
+        new_max = jnp.maximum(prev_max, blk_max)
+        correction = jnp.exp(prev_max - new_max)
+        p = jnp.exp(scores - new_max[:, None])
+        acc_ref[...] = (acc_ref[...] * correction[:, None]
+                        + _dot(p.astype(v_ref.dtype), v_ref[...]))
+        den_ref[0, :] = den_ref[0, :] * correction + jnp.sum(p, axis=1)
+        m_ref[0, :] = new_max
+
+    if causal:
+        # Tiles strictly above the diagonal contribute nothing (their
+        # DMA still happens — the streaming tax).
+        @pl.when(k_start <= q_start + block_q - 1)
+        def _run():
+            compute()
+    else:
+        compute()
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _flush():
+        den = den_ref[0, :]
+        o_ref[...] = (acc_ref[...] / den[:, None]).astype(o_ref.dtype)
+        lse_ref[0, :] = m_ref[0, :] + jnp.log(den)
+
+
+def _bwd_dq_stream_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dlse_ref, *rest, block_q: int, block_k: int,
+                          num_k_blocks: int, causal: bool,
+                          sm_scale: float, rope: bool):
+    """dQ with K/V streamed by the grid (BH, q_blocks, k_blocks)."""
+    if rope:
+        cos_q, sinm_q, cos_k, sinm_k, dq_ref, acc_ref = rest
+    else:
+        dq_ref, acc_ref = rest
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    def compute():
+        q = q_ref[...]
+        k_blk = k_ref[...]
+        if rope:
+            q = _stream_rope(q, cos_q, sinm_q)
+            k_blk = _stream_rope(k_blk, cos_k, sinm_k)
+        lse = lse_ref[0, :].astype(jnp.float32)
+        corr = (dlse_ref[0, :].astype(jnp.float32)
+                - delta_ref[0, :].astype(jnp.float32))
+        scores = _dot(q, k_blk, trans_b=True) * sm_scale
+        if causal:
+            scores = _stream_mask(scores, q_start, k_start,
+                                  block_q, block_k)
+        p = jnp.exp(scores - lse[:, None])
+        dp = _dot(do_ref[...], v_ref[...], trans_b=True)
+        ds = p * (dp + corr[:, None])
+        acc_ref[...] = acc_ref[...] + _dot(ds.astype(k_blk.dtype), k_blk)
+
+    if causal:
+        @pl.when(k_start <= q_start + block_q - 1)
+        def _run():
+            compute()
+    else:
+        compute()
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _flush():
+        acc = acc_ref[...] * sm_scale
+        if rope:
+            acc = _stream_rope(acc, cos_q, sinm_q, inverse=True)
+        dq_ref[...] = acc.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_stream_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
+                           delta_ref, dlse_ref, *rest, block_q: int,
+                           block_k: int, num_q_blocks: int, causal: bool,
+                           sm_scale: float, rope: bool):
+    """dK/dV with Q/dO streamed by the grid (BH, k_blocks, q_blocks)."""
+    if rope:
+        (cos_q, sinm_q, cos_k, sinm_k,
+         dk_ref, dv_ref, dk_acc, dv_acc) = rest
+    else:
+        dk_ref, dv_ref, dk_acc, dv_acc = rest
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    def compute():
+        k_t = k_ref[...]
+        q_blk = q_ref[...]
+        if rope:
+            k_t = _stream_rope(k_t, cos_k, sinm_k)
+            q_blk = _stream_rope(q_blk, cos_q, sinm_q)
+        do_blk = do_ref[...]
+        lse_blk = lse_ref[0, :].astype(jnp.float32)
+        corr_blk = (dlse_ref[0, :].astype(jnp.float32)
+                    - delta_ref[0, :].astype(jnp.float32))
+        scores = _dot(q_blk, k_t, trans_b=True) * sm_scale
+        if causal:
+            scores = _stream_mask(scores, q_start, k_start,
+                                  block_q, block_k)
+        p = jnp.exp(scores - lse_blk[:, None])
+        dv_acc[...] = dv_acc[...] + _dot(p.astype(do_blk.dtype), do_blk,
+                                         trans_a=True)
+        dp = _dot(do_blk, v_ref[...], trans_b=True)
+        ds = p * (dp + corr_blk[:, None])
+        dk_acc[...] = dk_acc[...] + _dot(ds.astype(q_blk.dtype), q_blk,
+                                         trans_a=True)
+
+    if causal:
+        # Q tiles strictly left of this K tile's diagonal see none of it.
+        @pl.when(q_start + block_q - 1 >= k_start)
+        def _run():
+            compute()
+    else:
+        compute()
+
+    @pl.when(qi == num_q_blocks - 1)
+    def _flush():
+        dk = dk_acc[...] * sm_scale
+        if rope:
+            dk = _stream_rope(dk, cos_k, sinm_k, inverse=True)
+        dk_ref[...] = dk.astype(dk_ref.dtype)
+        dv_ref[...] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _stream_rope_operands(s, d, rope, dtype, block_q, block_k, qk_order):
+    """Rope table operands for the streaming kernels: the SAME [S, D]
+    tables passed twice, sliced per-tile by the grid — a (block_q, d)
+    view following the q axis and a (block_k, d) view following the k
+    axis. qk_order: 'qk' for grid (b, qi, ki) (fwd/dq), 'kq' for
+    (b, ki, qi) (dkv)."""
+    if not rope:
+        return (), ()
+    cos_t, sinm_t = _rope_tables(s, d)
+    if dtype == jnp.bfloat16:
+        cos_t, sinm_t = cos_t.astype(dtype), sinm_t.astype(dtype)
+    if qk_order == "qk":
+        q_spec = pl.BlockSpec((block_q, d), lambda b, qi, ki: (qi, 0))
+        k_spec = pl.BlockSpec((block_k, d), lambda b, qi, ki: (ki, 0))
+    else:
+        q_spec = pl.BlockSpec((block_q, d), lambda b, ki, qi: (qi, 0))
+        k_spec = pl.BlockSpec((block_k, d), lambda b, ki, qi: (ki, 0))
+    return ((cos_t, sinm_t, cos_t, sinm_t),
+            (q_spec, q_spec, k_spec, k_spec))
+
+
+def _fwd_call_stream(q, k, v, causal, block_q, block_k, interpret, rope):
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, s, d = q.shape
+    sm_scale = 1.0 / math.sqrt(d)
+    num_k = s // block_k
+    kernel = functools.partial(
+        _fwd_stream_kernel, block_q=block_q, block_k=block_k,
+        num_k_blocks=num_k, causal=causal, sm_scale=sm_scale, rope=rope)
+    rope_in, rope_specs = _stream_rope_operands(s, d, rope, q.dtype,
+                                                block_q, block_k, "qk")
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, s // block_q, num_k),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+            *rope_specs,
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((None, 1, block_q), lambda b, qi, ki: (b, 0, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, 1, s), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),   # acc
+            pltpu.VMEM((1, block_q), jnp.float32),   # running max
+            pltpu.VMEM((1, block_q), jnp.float32),   # denom
+        ],
+        interpret=interpret,
+    )(q, k, v, *rope_in)
+
+
+def _bwd_calls_stream(q, k, v, dout, lse, delta, dlse, causal, block_q,
+                      block_k, interpret, rope):
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, s, d = q.shape
+    sm_scale = 1.0 / math.sqrt(d)
+    num_q = s // block_q
+    num_k = s // block_k
+
+    dq_kernel = functools.partial(
+        _bwd_dq_stream_kernel, block_q=block_q, block_k=block_k,
+        num_k_blocks=num_k, causal=causal, sm_scale=sm_scale, rope=rope)
+    rope_in, rope_specs = _stream_rope_operands(s, d, rope, q.dtype,
+                                                block_q, block_k, "qk")
+    row_spec = pl.BlockSpec((None, 1, block_q), lambda b, qi, ki: (b, 0, qi))
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(bh, num_q, num_k),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((None, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+            row_spec, row_spec, row_spec,
+            *rope_specs,
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d),
+                               lambda b, qi, ki: (b, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, dout, lse, delta, dlse, *rope_in)
+
+    dkv_kernel = functools.partial(
+        _bwd_dkv_stream_kernel, block_q=block_q, block_k=block_k,
+        num_q_blocks=num_q, causal=causal, sm_scale=sm_scale, rope=rope)
+    rope_in, rope_specs = _stream_rope_operands(s, d, rope, q.dtype,
+                                                block_q, block_k, "kq")
+    row_spec_kq = pl.BlockSpec((None, 1, block_q),
+                               lambda b, ki, qi: (b, 0, qi))
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(bh, num_k, num_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda b, ki, qi: (b, qi, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((None, block_q, d), lambda b, ki, qi: (b, qi, 0)),
+            row_spec_kq, row_spec_kq, row_spec_kq,
+            *rope_specs,
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_k, d), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, ki, qi: (b, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, s, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),   # dk acc
+            pltpu.VMEM((block_k, d), jnp.float32),   # dv acc
+        ],
+        interpret=interpret,
+    )(q, k, v, dout, lse, delta, dlse, *rope_in)
+    return dq, dk, dv
+
+
 def _fwd_call(q, k, v, causal, block_q, block_k, interpret, rope):
     """q, k, v: [BH, S, D] -> (out [BH, S, D], lse [BH, S] fp32)."""
     bh, s, d = q.shape
@@ -435,9 +788,10 @@ def _fwd_call(q, k, v, causal, block_q, block_k, interpret, rope):
     )(q, k, v, *rope_in)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9,
+                                                    10))
 def _flash(q, k, v, causal, block_q, block_k, bwd_block_q, bwd_block_k,
-           interpret, rope):
+           interpret, rope, streaming):
     """[BH, S, D] primitive returning (out, lse [BH, 1, S] fp32).
 
     Both outputs are differentiable: an out-only consumer gets a zero
@@ -445,18 +799,22 @@ def _flash(q, k, v, causal, block_q, block_k, bwd_block_q, bwd_block_k,
     ring attention consumes BOTH (partials are merged by lse weights).
     bwd_block_{q,k} tile the two backward kernels independently of the
     forward (long sequences want a wider bwd K window; the forward OOMs
-    VMEM there)."""
-    return _fwd_call(q, k, v, causal, block_q, block_k, interpret, rope)
+    VMEM there). streaming=True selects the XL kernels (K/V as a grid
+    dimension) — the path for sequences whose K/V + rope tables exceed
+    the resident kernels' VMEM budget."""
+    fwd = _fwd_call_stream if streaming else _fwd_call
+    return fwd(q, k, v, causal, block_q, block_k, interpret, rope)
 
 
 def _flash_fwd_rule(q, k, v, causal, block_q, block_k, bwd_block_q,
-                    bwd_block_k, interpret, rope):
-    out, lse = _fwd_call(q, k, v, causal, block_q, block_k, interpret, rope)
+                    bwd_block_k, interpret, rope, streaming):
+    fwd = _fwd_call_stream if streaming else _fwd_call
+    out, lse = fwd(q, k, v, causal, block_q, block_k, interpret, rope)
     return (out, lse), (q, k, v, out, lse)
 
 
 def _flash_bwd_rule(causal, fwd_block_q, fwd_block_k, block_q, block_k,
-                    interpret, rope, res, cts):
+                    interpret, rope, streaming, res, cts):
     q, k, v, out, lse = res
     dout, dlse = cts
     dout = dout.astype(q.dtype)
@@ -468,6 +826,9 @@ def _flash_bwd_rule(causal, fwd_block_q, fwd_block_k, block_q, block_k,
     # [BH, 1, S] like lse (Mosaic block-shape constraint, see _fwd_kernel).
     delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)[:, None, :]
+    if streaming:
+        return _bwd_calls_stream(q, k, v, dout, lse, delta, dlse, causal,
+                                 block_q, block_k, interpret, rope)
 
     rope_in, rope_specs = _rope_operands(s, d, rope, q.dtype)
     dq_kernel = functools.partial(_bwd_dq_kernel, block_k=block_k,
@@ -529,7 +890,8 @@ def flash_attention_with_lse(q, k, v, *, causal: bool = True,
                              bwd_block_q: int = 0,
                              bwd_block_k: int = 0,
                              interpret: bool = False,
-                             rope: bool = False):
+                             rope: bool = False,
+                             streaming=None):
     """q, k, v: [B, S, H, D] -> (out [B, S, H, D], lse [B, H, S] fp32).
 
     Differentiable in BOTH outputs (joint custom VJP): lse is the per-row
@@ -554,17 +916,30 @@ def flash_attention_with_lse(q, k, v, *, causal: bool = True,
     attention — chose dividing blocks on purpose)."""
     b, s, h, d = q.shape
     explicit_fwd = bool(block_q or block_k)
+    # Lane-aligned length (causal pads up to it; non-causal cannot pad):
+    # block defaults are chosen against it so they never ADD padding
+    # beyond the forward's, nor break the non-causal divisibility rule.
+    s_eff = s + (-s) % LANES if causal else s
+    # streaming=None (default): engage the XL kernels exactly when the
+    # resident kernels' stationary K/V + rope tables would exceed the
+    # VMEM budget (e.g. S >= ~16384 at D=128 bf16 with rope).
+    if streaming is None:
+        streaming = _needs_streaming(s_eff, d, q.dtype, rope)
     if not block_q or not block_k:
-        dq_, dk_ = default_blocks(s)
+        if streaming:
+            sq, sk = STREAM_BLOCKS
+            dq_, dk_ = (sq, sk) if (s_eff % sq == 0 and s_eff % sk == 0) \
+                else (DEFAULT_BLOCK, DEFAULT_BLOCK)
+        else:
+            dq_, dk_ = default_blocks(s)
         block_q = block_q or dq_
         block_k = block_k or dk_
-    # Lane-aligned length (causal pads up to it; non-causal cannot pad):
-    # bwd defaults are chosen against it so they never ADD padding beyond
-    # the forward's, nor break the non-causal divisibility contract.
-    s_eff = s + (-s) % LANES if causal else s
     if not bwd_block_q or not bwd_block_k:
-        dq_, dk_ = (block_q, block_k) if explicit_fwd \
-            else default_bwd_blocks(s_eff)
+        if explicit_fwd or streaming:
+            # Streaming bwd kernels share the fwd's streamed tiling.
+            dq_, dk_ = (block_q, block_k)
+        else:
+            dq_, dk_ = default_bwd_blocks(s_eff)
         bwd_block_q = bwd_block_q or dq_
         bwd_block_k = bwd_block_k or dk_
     if causal:
@@ -599,7 +974,8 @@ def flash_attention_with_lse(q, k, v, *, causal: bool = True,
         return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, s, d)
 
     out, lse = _flash(to_bh(q), to_bh(k), to_bh(v), causal, block_q,
-                      block_k, bwd_block_q, bwd_block_k, interpret, rope)
+                      block_k, bwd_block_q, bwd_block_k, interpret, rope,
+                      streaming)
     out = jnp.transpose(out.reshape(b, h, s, d), (0, 2, 1, 3))
     lse = lse.reshape(b, h, s)
     if pad:
@@ -612,15 +988,16 @@ def flash_attention(q, k, v, *, causal: bool = True,
                     block_k: int = 0,
                     bwd_block_q: int = 0,
                     bwd_block_k: int = 0, interpret: bool = False,
-                    rope: bool = False):
+                    rope: bool = False, streaming=None):
     """q, k, v: [B, S, H, D] -> [B, S, H, D]. Differentiable (custom VJP
     with tiled backward kernels); see flash_attention_with_lse for the
-    padding/divisibility and fused-rope contracts."""
+    padding/divisibility, fused-rope, and streaming contracts."""
     out, _ = flash_attention_with_lse(q, k, v, causal=causal,
                                       block_q=block_q, block_k=block_k,
                                       bwd_block_q=bwd_block_q,
                                       bwd_block_k=bwd_block_k,
-                                      interpret=interpret, rope=rope)
+                                      interpret=interpret, rope=rope,
+                                      streaming=streaming)
     return out
 
 
