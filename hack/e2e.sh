@@ -9,7 +9,14 @@ ENV_FILE="$WORK/env.sh"
 "$REPO_ROOT/hack/e2e-up.sh" "$ENV_FILE" "$@" || exit 1
 # shellcheck disable=SC1090
 source "$ENV_FILE"
+# Side-metrics (stress churn p95 etc.) land next to the env file and are
+# surfaced at the end — the bench-adjacent numbers of the e2e tier.
+export E2E_STRESS_METRICS="$WORK/stress-metrics.jsonl"
 bash "$REPO_ROOT/tests/e2e/run.sh"
 rc=$?
+if [ -s "$E2E_STRESS_METRICS" ]; then
+  echo "=== e2e side-metrics ==="
+  cat "$E2E_STRESS_METRICS"
+fi
 "$REPO_ROOT/hack/e2e-down.sh" "$ENV_FILE"
 exit $rc

@@ -725,3 +725,91 @@ class TestHealthEvents:
         import time
         time.sleep(0.3)
         assert len(cluster.list(RESOURCESLICES)[0]["spec"]["devices"]) == n_before
+
+
+class TestTimesliceReconciliation:
+    """Time-slicing prepares skip the durable intent store; the safety
+    net is startup reconciliation — every chip not held by a
+    checkpointed time-slicing claim resets to the driver default."""
+
+    def _state(self, tmp_path, backend):
+        cdi = CDIHandler(str(tmp_path / "cdi"),
+                         driver_root=str(tmp_path / "drv"))
+        return DeviceState(
+            backend=backend, cdi=cdi,
+            checkpoints=CheckpointManager(str(tmp_path / "plugin")),
+            driver_name=TPU_DRIVER_NAME, node_name="node-a",
+            ts_manager=TimeSlicingManager(backend))
+
+    def test_ts_prepare_skips_intent_store(self, harness):
+        featuregates.Features.set_from_string("TimeSlicingSettings=true")
+        claim = make_claim(
+            harness["cluster"], ["chip-0"],
+            configs=[opaque({"apiVersion": API_VERSION, "kind": "TpuConfig",
+                             "sharing": {"strategy": "TimeSlicing",
+                                         "timeSlicingConfig": {
+                                             "interval": "Short"}}})])
+        assert grpc_prepare(harness, claim).error == ""
+        # No checkpoint_start phase: the intent store was skipped (the
+        # hot-path point of the reconciliation below).
+        assert "checkpoint_start" not in \
+            harness["state"].last_prepare_breakdown
+
+    def test_startup_resets_orphan_slice(self, tmp_path):
+        backend = FakeBackend(default_fake_chips(4, "v5p"))
+        state = self._state(tmp_path / "a", backend)
+        # Crash sim: a time slice applied with no checkpoint record.
+        backend.timeslices[2] = 20000
+        state.close()
+        self._state(tmp_path / "b", backend).close()  # fresh start
+        assert backend.timeslices[2] == 0
+
+    def test_startup_keeps_held_slice(self, tmp_path):
+        featuregates.Features.set_from_string("TimeSlicingSettings=true")
+        backend = FakeBackend(default_fake_chips(4, "v5p"))
+        state = self._state(tmp_path, backend)
+        claim = {
+            "metadata": {"uid": "ts-held", "name": "c", "namespace": "d"},
+            "status": {"allocation": {"devices": {
+                "results": [{"request": "tpu", "driver": TPU_DRIVER_NAME,
+                             "pool": "node-a", "device": "chip-1"}],
+                "config": [opaque({
+                    "apiVersion": API_VERSION, "kind": "TpuConfig",
+                    "sharing": {"strategy": "TimeSlicing",
+                                "timeSlicingConfig": {
+                                    "interval": "Long"}}})]}}},
+        }
+        assert state.prepare(claim).error == ""
+        assert backend.timeslices[1] > 0
+        held = backend.timeslices[1]
+        state.close()
+        # Restart over the SAME checkpoint dir: the held chip keeps its
+        # slice, everything else resets.
+        backend.timeslices[3] = 12345  # orphan on another chip
+        state2 = self._state(tmp_path, backend)
+        assert backend.timeslices[1] == held
+        assert backend.timeslices[3] == 0
+        state2.close()
+
+    def test_startup_spares_non_ts_claims(self, tmp_path):
+        """Reconciliation must not touch chips held by ANY claim:
+        reset() also clears exclusive mode, which passthrough and
+        multiprocess claims rely on (r5 advisor finding)."""
+        backend = FakeBackend(default_fake_chips(4, "v5p"))
+        state = self._state(tmp_path, backend)
+        # A completed non-time-slicing claim whose chip holds exclusive
+        # mode (the multiprocess/passthrough shape, minimally simulated).
+        claim = {
+            "metadata": {"uid": "excl-held", "name": "c", "namespace": "d"},
+            "status": {"allocation": {"devices": {
+                "results": [{"request": "tpu", "driver": TPU_DRIVER_NAME,
+                             "pool": "node-a", "device": "chip-0"}],
+                "config": []}}},
+        }
+        assert state.prepare(claim).error == ""
+        backend.exclusive[0] = True  # as a passthrough/mp prepare sets
+        state.close()
+        state2 = self._state(tmp_path, backend)
+        # chip-0 is held: its exclusive marker must survive the restart.
+        assert backend.exclusive[0] is True
+        state2.close()

@@ -70,7 +70,9 @@ def _dra_service(callbacks: DriverCallbacks) -> grpc.GenericRpcHandler:
         results = callbacks.prepare_claims(claims)
         resp = dra.NodePrepareResourcesResponse()
         for uid, res in results.items():
-            out = dra.NodePrepareResourceResponse()
+            # Built in place: the map entry materializes on first access,
+            # avoiding a per-claim message copy on the hot path.
+            out = resp.claims[uid]
             if res.error:
                 out.error = res.error
             else:
@@ -80,7 +82,6 @@ def _dra_service(callbacks: DriverCallbacks) -> grpc.GenericRpcHandler:
                     dev.device_name = d.device_name
                     dev.cdi_device_ids.extend(d.cdi_device_ids)
                     dev.request_names.extend(d.request_names)
-            resp.claims[uid].CopyFrom(out)
         return resp
 
     def node_unprepare(request: dra.NodeUnprepareResourcesRequest, context):
@@ -89,10 +90,11 @@ def _dra_service(callbacks: DriverCallbacks) -> grpc.GenericRpcHandler:
         errors = callbacks.unprepare_claims(claims)
         resp = dra.NodeUnprepareResourcesResponse()
         for uid, err in errors.items():
-            out = dra.NodeUnprepareResourceResponse()
             if err:
-                out.error = err
-            resp.claims[uid].CopyFrom(out)
+                resp.claims[uid].error = err
+            else:
+                # Success: materialize an empty entry for the uid.
+                resp.claims[uid].SetInParent()
         return resp
 
     handlers = {

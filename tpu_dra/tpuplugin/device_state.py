@@ -112,6 +112,24 @@ class DeviceState:
         for uid in self._cdi.list_claim_uids():
             if uid not in self._checkpoint.claims:
                 self._cdi.delete_claim_spec_file(uid)
+        # Orphan time-slice reconciliation: time-slicing prepares also
+        # skip the intent store (see _config_hazard) — a crash between
+        # set_timeslice and the terminal store leaves a chip-level
+        # setting with no claim. Reset every chip NOT held by ANY
+        # checkpointed claim to the driver default (one tpuctl exec per
+        # free chip, once per process start; idempotent for untouched
+        # chips). Chips of ANY live claim are excluded — not just
+        # time-slicing ones — because reset() also clears exclusive
+        # mode, which passthrough/multiprocess claims rely on (and a
+        # VFIO-rebound passthrough chip has no accel fd to set a slice
+        # on at all).
+        if self._ts_manager is not None:
+            held = {record.get("chip_index")
+                    for prepared in self._checkpoint.claims.values()
+                    for record in prepared.devices}
+            free = [c for c in backend.chips() if c.index not in held]
+            if free:
+                self._ts_manager.reset(free)
 
     def close(self) -> None:
         """Release cached checkpoint slot fds. The manager assumes a
@@ -230,11 +248,13 @@ class DeviceState:
             if sharing is None:
                 return False
             if sharing.is_time_slicing():
-                # Mirrors _apply_sharing_config: gated off or manager-less
-                # time slicing applies nothing.
-                return (featuregates.enabled(
-                    featuregates.TimeSlicingSettings)
-                    and self._ts_manager is not None)
+                # Non-hazardous even when it WILL set a time slice: the
+                # setting is chip-level and reconciled at startup (every
+                # chip not held by a checkpointed time-slicing claim is
+                # reset to default in __init__), so a crash between
+                # set_timeslice and the terminal store self-heals without
+                # a durable intent record.
+                return False
             return True  # multiprocess / future strategies: fail safe
         return True  # Passthrough and any unknown config kind
 
