@@ -813,3 +813,61 @@ class TestTimesliceReconciliation:
         # chip-0 is held: its exclusive marker must survive the restart.
         assert backend.exclusive[0] is True
         state2.close()
+
+    def test_intent_record_names_chips_before_side_effects(self, tmp_path):
+        """The PrepareStarted intent record must already name every chip
+        when side effects begin: rollback and the startup
+        reconciliation's `held` set both read it, so an empty-devices
+        intent record would let a restart reset a mid-prepare hazardous
+        claim's chips (r5 advisor finding)."""
+
+        class ExplodingMp:
+            def start(self, *a, **k):
+                raise RuntimeError("boom before any side effect applied")
+
+            def stop(self, *a, **k):
+                pass
+
+        featuregates.Features.set_from_string("MultiprocessSupport=true")
+        backend = FakeBackend(default_fake_chips(4, "v5p"))
+        cdi = CDIHandler(str(tmp_path / "cdi"),
+                         driver_root=str(tmp_path / "drv"))
+        ckpt_dir = str(tmp_path / "plugin")
+
+        intent_docs = []
+
+        class SpyCkpt(CheckpointManager):
+            def store(self, cp, version="v2", intent=False):
+                if intent:
+                    intent_docs.append(cp.to_v2_doc())
+                super().store(cp, version=version, intent=intent)
+
+        state = DeviceState(
+            backend=backend, cdi=cdi,
+            checkpoints=SpyCkpt(ckpt_dir),
+            driver_name=TPU_DRIVER_NAME, node_name="node-a",
+            ts_manager=TimeSlicingManager(backend),
+            mp_manager=ExplodingMp())
+        claim = {
+            "metadata": {"uid": "mp-crash", "name": "c", "namespace": "d"},
+            "status": {"allocation": {"devices": {
+                "results": [{"request": "tpu", "driver": TPU_DRIVER_NAME,
+                             "pool": "node-a", "device": "chip-1"}],
+                "config": [opaque({
+                    "apiVersion": API_VERSION, "kind": "TpuConfig",
+                    "sharing": {"strategy": "Multiprocess"}})]}}},
+        }
+        res = state.prepare(claim)
+        assert "boom" in res.error
+        state.close()
+        # The durable INTENT store (what a SIGKILL during apply would
+        # have left as the last durable state) already named the chip.
+        assert len(intent_docs) == 1
+        intent_devices = intent_docs[0]["preparedClaims"]["mp-crash"][
+            "devices"]
+        assert [r["chip_index"] for r in intent_devices] == [1]
+        # And the error-path terminal record agrees.
+        fresh = CheckpointManager(ckpt_dir).load()
+        prepared = fresh.claims["mp-crash"]
+        assert prepared.state == "PrepareStarted"
+        assert [r["chip_index"] for r in prepared.devices] == [1]

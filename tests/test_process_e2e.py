@@ -348,6 +348,19 @@ class TestCrashRecovery:
                 assert uresp.claims[uc.uid].error == ""
             channel.close()
 
+        # Orphan CDI reconciliation under real kill timing: after the
+        # final storm every claim except the anchor was unprepared, so
+        # the only claim spec left on disk must be the anchor's —
+        # anything else is a leaked spec from a crash window that the
+        # non-hazardous fast path (no intent store) failed to GC at
+        # startup or scrub on unprepare.
+        cdi_root = str(e2e["tmp"] / "cdi")
+        claim_specs = [f for f in os.listdir(cdi_root)
+                       if "-claim_" in f and f.endswith(".json")]
+        assert claim_specs == [
+            f"k8s.tpu.dev-claim_{anchor['metadata']['uid']}.json"], (
+            f"orphan claim specs survived the storm: {claim_specs}")
+
 
 def _exists(api, gvr, name, ns=None):
     try:

@@ -12,6 +12,7 @@ sharing (``applySharingConfig`` :567-615).
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from dataclasses import dataclass, field
@@ -30,6 +31,9 @@ from tpu_dra.tpuplugin.checkpoint import (
 )
 from tpu_dra.tpuplugin.passthrough import PassthroughManager
 from tpu_dra.tpuplugin.sharing import MultiprocessManager, TimeSlicingManager
+
+
+log = logging.getLogger("tpu_dra.tpuplugin")
 
 
 class PrepareError(Exception):
@@ -127,9 +131,17 @@ class DeviceState:
             held = {record.get("chip_index")
                     for prepared in self._checkpoint.claims.values()
                     for record in prepared.devices}
-            free = [c for c in backend.chips() if c.index not in held]
-            if free:
-                self._ts_manager.reset(free)
+            for c in backend.chips():
+                if c.index in held:
+                    continue
+                try:
+                    self._ts_manager.reset([c])
+                except Exception:  # noqa: BLE001 — one bad chip (still
+                    # VFIO-rebound, hardware-faulted) must not crash-loop
+                    # the plugin and take the whole node's chips with it.
+                    log.warning("startup time-slice reset failed for "
+                                "chip %d (continuing)", c.index,
+                                exc_info=True)
 
     def close(self) -> None:
         """Release cached checkpoint slot fds. The manager assumes a
@@ -181,11 +193,22 @@ class DeviceState:
                 return PrepareResult(error=f"prepare devices: {e}")
             timings["decode"] = time.perf_counter() - t0
 
-            # Record intent before touching hardware (crash consistency).
+            # Build the FULL device records up front (pure: names, chip
+            # indices, configs, deterministic CDI ids), so the intent
+            # record below already names every chip this claim will
+            # touch — a SIGKILL mid-apply must leave a record that
+            # rollback AND the startup time-slice reconciliation's
+            # `held` set can see (an empty-devices intent record would
+            # let reconciliation reset a mid-prepare claim's chips).
+            try:
+                records = self._build_records(uid, config_results)
+            except Exception as e:  # noqa: BLE001 — report as claim error
+                return PrepareResult(error=f"prepare devices: {e}")
             self._checkpoint.claims[uid] = PreparedClaim(
                 uid=uid, state=PREPARE_STARTED,
                 name=claim["metadata"].get("name", ""),
-                namespace=claim["metadata"].get("namespace", ""))
+                namespace=claim["metadata"].get("namespace", ""),
+                devices=records)
             if any(self._config_hazard(cr.config) for cr in config_results):
                 # Transient mid-prepare record: side slot (checkpoint.py —
                 # terminal states land on the primary for downgrade
@@ -198,20 +221,16 @@ class DeviceState:
                 self._ckpt_mgr.store(self._checkpoint, intent=True)
                 timings["checkpoint_start"] = time.perf_counter() - t0
 
-            records: List[Dict] = []
             try:
-                self._prepare_devices(claim, config_results, records,
-                                      timings)
+                self._apply_devices(claim, config_results, timings)
             except Exception as e:  # noqa: BLE001 — report as claim error
-                # Leave PrepareStarted with whatever was already applied
-                # recorded, so a later unprepare (or GC of an abandoned
-                # claim) can roll back the side effects — exclusive mode,
-                # multiprocess daemons, time slices.
-                self._checkpoint.claims[uid].devices = records
+                # Leave PrepareStarted with the records persisted, so a
+                # later unprepare (or GC of an abandoned claim) can roll
+                # back the side effects — exclusive mode, multiprocess
+                # daemons, time slices.
                 self._ckpt_mgr.store(self._checkpoint)
                 return PrepareResult(error=f"prepare devices: {e}")
 
-            self._checkpoint.claims[uid].devices = records
             self._checkpoint.claims[uid].state = PREPARE_COMPLETED
             t0 = time.perf_counter()
             self._ckpt_mgr.store(self._checkpoint)
@@ -258,30 +277,22 @@ class DeviceState:
             return True  # multiprocess / future strategies: fail safe
         return True  # Passthrough and any unknown config kind
 
-    def _prepare_devices(self, claim: Dict,
-                         config_results: List["_ConfigResult"],
-                         records: List[Dict],
-                         timings: Optional[Dict[str, float]] = None) -> None:
-        """Appends to `records` incrementally so the caller can persist
-        partial progress if a later step throws (crash/failure rollback)."""
-        if timings is None:
-            timings = {}
-        uid = claim["metadata"]["uid"]
-
-        chip_indices: set = set()
-        subslice_cores: Dict[int, set] = {}
-        subslice_hbm_total = 0
-        claim_env: Dict[str, str] = {}
-        claim_mounts: List[Dict] = []
-        claim_device_nodes: List[Dict] = []
-
+    def _build_records(self, uid: str,
+                       config_results: List["_ConfigResult"]) -> List[Dict]:
+        """The PURE half of prepare: checkpoint device records with
+        deterministic CDI ids for every allocation result. Runs before
+        the intent store so a mid-apply crash leaves a record naming
+        every chip the claim touches (rollback + the startup
+        reconciliation's `held` set both depend on that)."""
+        records: List[Dict] = []
         for cr in config_results:
-            group_chips = self._chips_for_results(cr.results)
-            # Record intent BEFORE applying side effects: if sharing setup
-            # fails halfway, unprepare can still reset from these records.
             is_passthrough = isinstance(cr.config, apitypes.PassthroughConfig)
             for result in cr.results:
-                dev = self.allocatable[result["device"]]
+                dev = self.allocatable.get(result["device"])
+                if dev is None:
+                    raise PrepareError(
+                        f"allocated device {result['device']!r} is not on "
+                        "this node")
                 # Passthrough claims get ONLY the claim device: the VFIO
                 # rebind removes /dev/accelN from the host, so the standard
                 # per-chip spec's deviceNodes would point at a dead path
@@ -300,7 +311,28 @@ class DeviceState:
                     "config": cr.config.to_dict(),
                     "cdi_ids": cdi_ids,
                 })
+        return records
 
+    def _apply_devices(self, claim: Dict,
+                       config_results: List["_ConfigResult"],
+                       timings: Optional[Dict[str, float]] = None) -> None:
+        """The side-effect half of prepare: sharing setup, passthrough
+        rebinds, exclusivity guards, and the claim CDI spec write. The
+        caller persisted the records for all of this before any of it
+        runs (crash/failure rollback)."""
+        if timings is None:
+            timings = {}
+        uid = claim["metadata"]["uid"]
+
+        chip_indices: set = set()
+        subslice_cores: Dict[int, set] = {}
+        subslice_hbm_total = 0
+        claim_env: Dict[str, str] = {}
+        claim_mounts: List[Dict] = []
+        claim_device_nodes: List[Dict] = []
+
+        for cr in config_results:
+            group_chips = self._chips_for_results(cr.results)
             t0 = time.perf_counter()
             sharing_env = self._apply_sharing_config(uid, cr, group_chips)
             timings["sharing"] = (timings.get("sharing", 0.0)

@@ -634,25 +634,68 @@ def _bwd_dkv_stream_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
         dv_ref[...] = dv_acc[...].astype(dv_ref.dtype)
 
 
-def _stream_rope_operands(s, d, rope, dtype, block_q, block_k, qk_order):
+def _stream_rope_operands(s, d, rope, dtype, block_q, block_k, qk_order,
+                          causal=False):
     """Rope table operands for the streaming kernels: the SAME [S, D]
     tables passed twice, sliced per-tile by the grid — a (block_q, d)
     view following the q axis and a (block_k, d) view following the k
     axis. qk_order: 'qk' for grid (b, qi, ki) (fwd/dq), 'kq' for
-    (b, ki, qi) (dkv)."""
+    (b, ki, qi) (dkv). The streamed axis's view is clamped like its
+    K/V (or Q/dO) companion so skipped tiles elide their table DMA too
+    (_clamp_ki/_clamp_qi)."""
     if not rope:
         return (), ()
     cos_t, sinm_t = _rope_tables(s, d)
     if dtype == jnp.bfloat16:
         cos_t, sinm_t = cos_t.astype(dtype), sinm_t.astype(dtype)
     if qk_order == "qk":
+        kidx = _clamp_ki(causal, block_q, block_k)
+
+        def k_tbl(b, qi, ki):
+            # Same clamp as the K/V stream (single source of truth —
+            # _clamp_ki); the table view just drops the batch element.
+            _, kk, _ = kidx(b, qi, ki)
+            return (kk, 0)
         q_spec = pl.BlockSpec((block_q, d), lambda b, qi, ki: (qi, 0))
-        k_spec = pl.BlockSpec((block_k, d), lambda b, qi, ki: (ki, 0))
+        k_spec = pl.BlockSpec((block_k, d), k_tbl)
     else:
-        q_spec = pl.BlockSpec((block_q, d), lambda b, ki, qi: (qi, 0))
+        qidx = _clamp_qi(causal, block_q, block_k)
+
+        def q_tbl(b, ki, qi):
+            _, qq, _ = qidx(b, ki, qi)
+            return (qq, 0)
+        q_spec = pl.BlockSpec((block_q, d), q_tbl)
         k_spec = pl.BlockSpec((block_k, d), lambda b, ki, qi: (ki, 0))
     return ((cos_t, sinm_t, cos_t, sinm_t),
             (q_spec, q_spec, k_spec, k_spec))
+
+
+def _clamp_ki(causal, block_q, block_k):
+    """K-tile index for grid (b, qi, ki). Causal: tiles strictly above
+    the diagonal are compute-skipped in the kernel; CLAMPING their index
+    to the last needed tile makes consecutive skipped iterations resolve
+    to the same block, so Mosaic elides their DMA entirely (the
+    streaming tax drops from 2x K-stream traffic to ~1x)."""
+    if not causal:
+        return lambda b, qi, ki: (b, ki, 0)
+
+    def idx(b, qi, ki):
+        last = (qi * block_q + block_q - 1) // block_k
+        return (b, jnp.minimum(ki, last), 0)
+    return idx
+
+
+def _clamp_qi(causal, block_q, block_k):
+    """Q-tile index for grid (b, ki, qi) (dkv): tiles strictly left of
+    this K tile's diagonal are skipped; clamp them UP to the first
+    needed tile for the same DMA elision."""
+    if not causal:
+        return lambda b, ki, qi: (b, qi, 0)
+
+    def idx(b, ki, qi):
+        first = (ki * block_k) // block_q
+        return (b, jnp.maximum(qi, first), 0)
+    return idx
 
 
 def _fwd_call_stream(q, k, v, causal, block_q, block_k, interpret, rope):
@@ -665,14 +708,16 @@ def _fwd_call_stream(q, k, v, causal, block_q, block_k, interpret, rope):
         _fwd_stream_kernel, block_q=block_q, block_k=block_k,
         num_k_blocks=num_k, causal=causal, sm_scale=sm_scale, rope=rope)
     rope_in, rope_specs = _stream_rope_operands(s, d, rope, q.dtype,
-                                                block_q, block_k, "qk")
+                                                block_q, block_k, "qk",
+                                                causal=causal)
+    k_idx = _clamp_ki(causal, block_q, block_k)
     return pl.pallas_call(
         kernel,
         grid=(bh, s // block_q, num_k),
         in_specs=[
             pl.BlockSpec((None, block_q, d), lambda b, qi, ki: (b, qi, 0)),
-            pl.BlockSpec((None, block_k, d), lambda b, qi, ki: (b, ki, 0)),
-            pl.BlockSpec((None, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((None, block_k, d), k_idx),
+            pl.BlockSpec((None, block_k, d), k_idx),
             *rope_specs,
         ],
         out_specs=[
@@ -705,15 +750,17 @@ def _bwd_calls_stream(q, k, v, dout, lse, delta, dlse, causal, block_q,
         _bwd_dq_stream_kernel, block_q=block_q, block_k=block_k,
         num_k_blocks=num_k, causal=causal, sm_scale=sm_scale, rope=rope)
     rope_in, rope_specs = _stream_rope_operands(s, d, rope, q.dtype,
-                                                block_q, block_k, "qk")
+                                                block_q, block_k, "qk",
+                                                causal=causal)
     row_spec = pl.BlockSpec((None, 1, block_q), lambda b, qi, ki: (b, 0, qi))
+    k_idx = _clamp_ki(causal, block_q, block_k)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(bh, num_q, num_k),
         in_specs=[
             pl.BlockSpec((None, block_q, d), lambda b, qi, ki: (b, qi, 0)),
-            pl.BlockSpec((None, block_k, d), lambda b, qi, ki: (b, ki, 0)),
-            pl.BlockSpec((None, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((None, block_k, d), k_idx),
+            pl.BlockSpec((None, block_k, d), k_idx),
             pl.BlockSpec((None, block_q, d), lambda b, qi, ki: (b, qi, 0)),
             row_spec, row_spec, row_spec,
             *rope_specs,
@@ -729,17 +776,23 @@ def _bwd_calls_stream(q, k, v, dout, lse, delta, dlse, causal, block_q,
         _bwd_dkv_stream_kernel, block_q=block_q, block_k=block_k,
         num_q_blocks=num_q, causal=causal, sm_scale=sm_scale, rope=rope)
     rope_in, rope_specs = _stream_rope_operands(s, d, rope, q.dtype,
-                                                block_q, block_k, "kq")
-    row_spec_kq = pl.BlockSpec((None, 1, block_q),
-                               lambda b, ki, qi: (b, 0, qi))
+                                                block_q, block_k, "kq",
+                                                causal=causal)
+    q_idx = _clamp_qi(causal, block_q, block_k)
+
+    def q_row_idx(b, ki, qi):
+        b_, clamped, _ = q_idx(b, ki, qi)
+        return (b_, 0, clamped)
+
+    row_spec_kq = pl.BlockSpec((None, 1, block_q), q_row_idx)
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(bh, num_k, num_q),
         in_specs=[
-            pl.BlockSpec((None, block_q, d), lambda b, ki, qi: (b, qi, 0)),
+            pl.BlockSpec((None, block_q, d), q_idx),
             pl.BlockSpec((None, block_k, d), lambda b, ki, qi: (b, ki, 0)),
             pl.BlockSpec((None, block_k, d), lambda b, ki, qi: (b, ki, 0)),
-            pl.BlockSpec((None, block_q, d), lambda b, ki, qi: (b, qi, 0)),
+            pl.BlockSpec((None, block_q, d), q_idx),
             row_spec_kq, row_spec_kq, row_spec_kq,
             *rope_specs,
         ],
