@@ -207,11 +207,18 @@ class _BenchDriver:
         return statistics.median(lats)
 
     def batch_cycle(self, tag, n_claims):
-        """One NodePrepareResources RPC carrying n_claims claims (kubelet
-        batches a pod's claims in one call); returns per-claim ms."""
+        """One NodePrepareResources RPC carrying n_claims single-chip
+        claims on DISTINCT chips (kubelet batches a pod's claims in one
+        call; the scheduler never co-allocates one exclusive device to
+        two claims, so n_claims must not exceed the chip count); returns
+        per-claim ms."""
         from tpu_dra.kubeletplugin.gen import dra_v1_pb2 as dra
+        if n_claims > len(self.chips):
+            raise ValueError(
+                f"batch of {n_claims} exclusive claims needs that many "
+                f"chips (have {len(self.chips)})")
         objs = [
-            _make_claim(self.cluster, [self.chips[i % len(self.chips)]],
+            _make_claim(self.cluster, [self.chips[i]],
                         f"bench-{tag}-{i}-{uuid.uuid4().hex[:6]}")
             for i in range(n_claims)]
         req = dra.NodePrepareResourcesRequest()
@@ -222,18 +229,23 @@ class _BenchDriver:
         t0 = time.perf_counter()
         resp = self._prepare(req)
         lat = (time.perf_counter() - t0) * 1e3
-        for obj in objs:
-            uid = obj["metadata"]["uid"]
-            if resp.claims[uid].error:
-                raise RuntimeError(
-                    f"batch prepare failed: {resp.claims[uid].error}")
-        ureq = dra.NodeUnprepareResourcesRequest()
-        for obj in objs:
-            uc = ureq.claims.add()
-            uc.uid = obj["metadata"]["uid"]
-            uc.name = obj["metadata"]["name"]
-            uc.namespace = "default"
-        self._unprepare(ureq)
+        try:
+            for obj in objs:
+                uid = obj["metadata"]["uid"]
+                if resp.claims[uid].error:
+                    raise RuntimeError(
+                        f"batch prepare failed: {resp.claims[uid].error}")
+        finally:
+            # Unprepare whatever DID prepare even when one claim errored:
+            # leaked prepared claims would dirty every later phase of
+            # this shared driver.
+            ureq = dra.NodeUnprepareResourcesRequest()
+            for obj in objs:
+                uc = ureq.claims.add()
+                uc.uid = obj["metadata"]["uid"]
+                uc.name = obj["metadata"]["name"]
+                uc.namespace = "default"
+            self._unprepare(ureq)
         return lat / n_claims
 
     def close(self):
@@ -299,14 +311,19 @@ def bench_claim_to_ready(backend, n_cycles: int = 100, warmup: int = 15):
         # per-claim cost amortizes the gRPC wire share. Compared against
         # a SINGLE-chip single-claim p50 measured the same way — the
         # main loop's cycles claim every chip, which is a different
-        # state-machine workload on multi-chip hosts.
-        batch_n = 4
+        # state-machine workload on multi-chip hosts. Exclusive claims
+        # need distinct chips, so the batch size is capped by the chip
+        # count and the phase reports null on single-chip hosts.
+        batch_n = min(4, len(chips))
         n_batch_cycles = max(5, n_cycles // 5)
         one_chip = [f"chip-{chips[0]}"]
         p50_one = bd.config_p50("one", n_batch_cycles, devices=one_chip)
-        batch_lats = sorted(bd.batch_cycle(f"b{i}", batch_n)
-                            for i in range(n_batch_cycles))
-        p50_batch = statistics.median(batch_lats)
+        if batch_n >= 2:
+            batch_lats = sorted(bd.batch_cycle(f"b{i}", batch_n)
+                                for i in range(n_batch_cycles))
+            p50_batch = statistics.median(batch_lats)
+        else:
+            p50_batch = None
 
         # One claim stays prepared so the psum phase runs on the devices the
         # driver actually allocated (its CDI env is the workload's view).
@@ -335,11 +352,15 @@ def bench_claim_to_ready(backend, n_cycles: int = 100, warmup: int = 15):
         # None = no subslice devices on this generation (single-core chips)
         "claim_to_ready_p50_subslice_ms": (round(p50_sub, 3)
                                            if p50_sub is not None else None),
-        # Per-claim cost when kubelet batches 4 single-chip claims in one
-        # RPC vs one single-chip claim per RPC: the difference is almost
-        # pure gRPC transport amortization (same state-machine work).
+        # Per-claim cost when kubelet batches batch_n single-chip claims
+        # (distinct chips) in one RPC vs one single-chip claim per RPC:
+        # the difference is almost pure gRPC transport amortization
+        # (same state-machine work). None = single-chip host (exclusive
+        # claims cannot share a chip, so no batch exists to measure).
         "claim_to_ready_p50_1chip_ms": round(p50_one, 3),
-        "claim_to_ready_p50_batch4_per_claim_ms": round(p50_batch, 3),
+        "claim_to_ready_batch_claims": batch_n if p50_batch else None,
+        "claim_to_ready_p50_batch_per_claim_ms": (
+            round(p50_batch, 3) if p50_batch is not None else None),
         "n_chips": len(chips),
         "visible_chips": env.get("TPU_VISIBLE_CHIPS", ""),
     }

@@ -1,0 +1,84 @@
+"""All-to-all (Ulysses-style) sequence parallelism.
+
+The second sequence-parallel strategy next to ring attention
+(ringattention.py): instead of rotating K/V blocks around a ring for
+`axis_size` partial-softmax steps, two `all_to_all` collectives re-shard
+the activations from sequence-sharded [B, S/N, H, D] to HEAD-sharded
+[B, S, H/N, D], run exact full-sequence attention per head subset (the
+pallas flash kernel on TPU — including its streaming XL path when S
+exceeds the resident VMEM budget), and shard back.
+
+Trade-offs vs ring (both are first-class; pick per topology):
+- collectives: 3 all_to_alls in + 1 out, each moving the full activation
+  once — vs ring's N ppermute steps. On all-to-all-friendly fabrics (ICI
+  torus) this is fewer, larger transfers with no per-step latency chain.
+- constraint: heads must divide by the mesh axis (H % N == 0); ring has
+  no head constraint and composes with any H.
+- attention math: exact full-S attention per device (positions are
+  global, so fused in-kernel RoPE applies directly); ring must merge
+  partials by logsumexp and apply RoPE outside the kernel.
+
+Reference frame: the reference repo has no SP of any kind (SURVEY §2.10
+— it provides the ComputeDomain substrate these strategies run on);
+this is TPU-first long-context machinery for the workloads the driver
+provisions.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def ulysses_attention(q, k, v, *, axis_name: str, causal: bool = True,
+                      impl: str = "auto", platform: str = "",
+                      rope: bool = False):
+    """Per-device body (inside shard_map): q, k, v are LOCAL sequence
+    blocks [B, S/N, H, D] with H divisible by the axis size. Returns the
+    local sequence block of the exact attention output."""
+    from tpu_dra.workloads.flashattention import attend
+
+    axis_size = jax.lax.psum(1, axis_name)
+    b, s_local, h, d = q.shape
+    if h % axis_size != 0:
+        raise ValueError(
+            f"ulysses needs heads % axis_size == 0 (H={h}, N={axis_size})")
+
+    def to_heads(x):
+        # [B, S/N, H, D] -> [B, S, H/N, D]: split the head axis across
+        # the mesh, gather the sequence axis.
+        return jax.lax.all_to_all(x, axis_name, split_axis=2,
+                                  concat_axis=1, tiled=True)
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    # Exact attention over the FULL sequence for this device's head
+    # subset; positions are global so in-kernel RoPE applies as-is.
+    out = attend(qh, kh, vh, causal=causal, impl=impl, platform=platform,
+                 rope=rope)
+    # [B, S, H/N, D] -> [B, S/N, H, D]: scatter sequence, gather heads.
+    return jax.lax.all_to_all(out, axis_name, split_axis=1,
+                              concat_axis=2, tiled=True)
+
+
+def make_ulysses_attention(mesh: Mesh, axis_name: str = "seq",
+                           causal: bool = True, impl: str = "auto",
+                           rope: bool = False):
+    """Jitted all-to-all sequence-parallel attention over `mesh`'s
+    `axis_name` axis. Inputs/outputs [B, S, H, D] sharded on S; H must
+    divide by the axis size (checked at trace time)."""
+    seq_sharding = NamedSharding(mesh, P(None, axis_name, None, None))
+    spec = P(None, axis_name, None, None)
+
+    # Resolve "auto" against the MESH's devices, not the default backend
+    # (same contract as make_ring_attention).
+    on_tpu = all(dev.platform == "tpu" for dev in mesh.devices.flat)
+    body = functools.partial(ulysses_attention, axis_name=axis_name,
+                             causal=causal, impl=impl, rope=rope,
+                             platform="tpu" if on_tpu else "cpu")
+    fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    return jax.jit(fn, in_shardings=(seq_sharding,) * 3,
+                   out_shardings=seq_sharding)
