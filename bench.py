@@ -358,7 +358,8 @@ def bench_claim_to_ready(backend, n_cycles: int = 100, warmup: int = 15):
         # (same state-machine work). None = single-chip host (exclusive
         # claims cannot share a chip, so no batch exists to measure).
         "claim_to_ready_p50_1chip_ms": round(p50_one, 3),
-        "claim_to_ready_batch_claims": batch_n if p50_batch else None,
+        "claim_to_ready_batch_claims": (batch_n if p50_batch is not None
+                                        else None),
         "claim_to_ready_p50_batch_per_claim_ms": (
             round(p50_batch, 3) if p50_batch is not None else None),
         "n_chips": len(chips),

@@ -92,6 +92,15 @@ def default_platform() -> str:
             else "cpu")
 
 
+def mesh_platform(mesh) -> str:
+    """The "auto"-dispatch platform of a Mesh: "tpu" only when EVERY
+    device is a TPU (a mixed mesh must not pick the Mosaic kernel).
+    Shared by the train-step/SP factories — the mesh-held counterpart of
+    default_platform()."""
+    return ("tpu" if all(dev.platform == "tpu"
+                         for dev in mesh.devices.flat) else "cpu")
+
+
 def rope_half(x, positions):
     """Half-split-pairing rotary embedding: plane j rotates dims
     (j, j+D/2) by positions * ROPE_BASE^(-2j/D). x: [B, S, H, D],

@@ -47,6 +47,11 @@ class ModelConfig:
     # traced forward cannot see what it runs on, and the default-backend
     # sniff is wrong for e.g. a CPU mesh on a TPU-equipped host.
     attn_platform: str = ""
+    # Context parallelism: when set, the forward runs INSIDE a shard_map
+    # whose activations are sequence-sharded on this mesh axis, and
+    # attention crosses shards via all-to-all (ulysses.ulysses_attention;
+    # sp_train.make_sp_train_step is the driver). Empty = no SP.
+    seq_axis: str = ""
     # Per-block rematerialization: "none" | "dots" | "full". Measured on
     # v5e at the flagship shape (d2048/L8/S1024/B8): none -> MFU 0.647,
     # dots_saveable -> 0.596, full -> 0.536. The flash kernel's backward
@@ -130,8 +135,18 @@ def attention_sublayer(params, x, cfg: ModelConfig):
     # attention: in-kernel on the flash path — roped q/k never touch HBM
     # (~9ms/step external at the flagship shape) — and applied externally
     # on the jnp path, so every impl computes the same function.
-    ctx = attend(q, k, v, causal=True, impl=cfg.attn_impl,
-                 platform=cfg.attn_platform, rope=True).reshape(B, S, D)
+    if cfg.seq_axis:
+        # Context parallelism: x is the LOCAL sequence block inside a
+        # shard_map; attention crosses shards via all-to-all (positions
+        # stay global through the re-shard, so fused RoPE is exact).
+        from tpu_dra.workloads.ulysses import ulysses_attention
+        ctx = ulysses_attention(
+            q, k, v, axis_name=cfg.seq_axis, causal=True,
+            impl=cfg.attn_impl, platform=cfg.attn_platform,
+            rope=True).reshape(B, S, D)
+    else:
+        ctx = attend(q, k, v, causal=True, impl=cfg.attn_impl,
+                     platform=cfg.attn_platform, rope=True).reshape(B, S, D)
     return x + ctx @ params["wo"].astype(cfg.dtype)
 
 
@@ -196,7 +211,8 @@ def build_train_step(model, mesh: Mesh, lr, loss, specs_fn, rebuild):
     cannot catch the misuse).
     """
     cfg = model.cfg
-    on_tpu = all(d.platform == "tpu" for d in mesh.devices.flat)
+    from tpu_dra.workloads.flashattention import mesh_platform
+    on_tpu = mesh_platform(mesh) == "tpu"
     if cfg.attn_impl == "auto" and not cfg.attn_platform:
         # Pin "auto" attention to the MESH's platform (see ModelConfig).
         cfg = dataclasses.replace(cfg,

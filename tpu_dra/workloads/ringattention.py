@@ -189,7 +189,8 @@ def make_ring_attention(mesh: Mesh, axis_name: str = "data",
 
     # Resolve "auto" against the MESH's devices, not the default backend:
     # a CPU mesh on a TPU-equipped host must not pick the Mosaic kernel.
-    on_tpu = all(d.platform == "tpu" for d in mesh.devices.flat)
+    from tpu_dra.workloads.flashattention import mesh_platform
+    on_tpu = mesh_platform(mesh) == "tpu"
     body = functools.partial(ring_attention, axis_name=axis_name,
                              causal=causal, impl=impl,
                              platform="tpu" if on_tpu else "cpu")

@@ -1,0 +1,97 @@
+"""Context-parallel (sequence-sharded) training step.
+
+The third sharding layout for the dense model, next to the DP x TP step
+(model.build_train_step) and the pipeline schedule: activations shard on
+the SEQUENCE axis — the layout for sequences too long for one device's
+HBM — parameters replicate, and only attention crosses shards (ulysses
+all-to-all inside the forward, ModelConfig.seq_axis). Gradient reduction
+over the axis happens in the shard_map transpose itself (replicated-
+param cotangents are summed across devices by the machinery) — the
+data-parallel pattern with tokens in place of batch rows.
+
+Objective: next-token prediction over the FULL sequence via a global
+roll — targets[i] = tokens[i+1], final position masked — computed
+identically by the parity reference in tests. (The DP step's shift-
+by-slicing would change the per-shard lengths, which must stay equal
+for the all-to-all.)
+
+Scale note: S grows with the mesh axis, so one chip's attention work per
+step grows linearly while its FFN work stays constant — the streaming XL
+kernels (flashattention) keep the attention compilable at any S the HBM
+can hold activations for.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_sp_train_step(model, mesh: Mesh, lr: float = 1e-3,
+                       axis_name: str = "seq"):
+    """Jitted SGD step over sequence-sharded tokens.
+
+    step(params, tokens) -> (new_params, loss); params replicated,
+    tokens [B, S] sharded on S (S divisible by the axis size, heads
+    divisible too — the ulysses constraint). Callers must chain
+    params through steps on TPU (donation, as in build_train_step).
+    """
+    cfg = model.cfg
+    from tpu_dra.workloads.flashattention import mesh_platform
+    on_tpu = mesh_platform(mesh) == "tpu"
+    cfg = dataclasses.replace(
+        cfg, seq_axis=axis_name,
+        attn_platform=cfg.attn_platform or ("tpu" if on_tpu else "cpu"))
+    sp_model = type(model)(cfg)
+
+    # The shard_map wraps ONLY the forward, returning per-shard partial
+    # sums reduced OUTSIDE; jax.grad then transposes the shard_map as a
+    # whole. Computing grads INSIDE the body (grad-of-psum'd-loss plus a
+    # grad psum) is the tempting formulation, but under check_vma=False
+    # the unchecked psum transpose silently produces wrong gradients —
+    # measured ~axis_size x off on this exact model. check_vma must stay
+    # off (flash partials carry no varying-axis typing), so the body
+    # stays collective-free on the loss path and correctness rests on
+    # the standard shard_map transpose (replicated-param cotangents are
+    # summed across devices by the machinery itself).
+    def body(params, tokens, targets, mask):
+        logits = sp_model.forward(params, tokens).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits)
+        nll = -jnp.take_along_axis(
+            logp, targets[..., None], axis=-1)[..., 0]
+        return (jnp.sum(nll * mask)[None], jnp.sum(mask)[None])
+
+    tok_spec = P(None, axis_name)
+    fwd = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(), tok_spec, tok_spec, tok_spec),
+        out_specs=(P(axis_name), P(axis_name)),
+        check_vma=False)  # flash partials carry no varying-axis typing
+
+    rep = NamedSharding(mesh, P())
+    tok_sharding = NamedSharding(mesh, tok_spec)
+
+    @functools.partial(jax.jit,
+                       in_shardings=(rep, tok_sharding),
+                       out_shardings=(rep, rep),
+                       donate_argnums=(0,) if on_tpu else ())
+    def step(params, tokens):
+        # Global next-token objective: roll the sequence left by one and
+        # mask the final position (its "target" wrapped around).
+        targets = jnp.roll(tokens, -1, axis=1)
+        mask = jnp.ones(tokens.shape, jnp.float32).at[:, -1].set(0.0)
+
+        def loss_fn(p):
+            sums, counts = fwd(p, tokens, targets, mask)
+            return sums.sum() / jnp.maximum(counts.sum(), 1.0)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        new_params = jax.tree.map(
+            lambda p, g: p - lr * g.astype(p.dtype), params, grads)
+        return new_params, loss
+
+    return step

@@ -74,7 +74,8 @@ def make_ulysses_attention(mesh: Mesh, axis_name: str = "seq",
 
     # Resolve "auto" against the MESH's devices, not the default backend
     # (same contract as make_ring_attention).
-    on_tpu = all(dev.platform == "tpu" for dev in mesh.devices.flat)
+    from tpu_dra.workloads.flashattention import mesh_platform
+    on_tpu = mesh_platform(mesh) == "tpu"
     body = functools.partial(ulysses_attention, axis_name=axis_name,
                              causal=causal, impl=impl, rope=rope,
                              platform="tpu" if on_tpu else "cpu")
