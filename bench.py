@@ -5,32 +5,47 @@ on the DRA-allocated devices, and single-chip train-step MFU.
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 
 Phases, mirroring BASELINE.json's north star ("JAX psum ICI bandwidth on
-DRA-allocated slice; claim-to-ready p50") plus a model-perf number:
+DRA-allocated slice; claim-to-ready p50") plus model-perf numbers:
 
-1. **claim-to-ready p50** — stands up the full node driver (gRPC DRA server
+1. **claim-to-ready** — stands up the full node driver (gRPC DRA server
    on a unix socket, CDI handler, checkpointing, ResourceSlice publishing),
-   then times N NodePrepareResources→NodeUnprepareResources cycles
-   end-to-end over the wire, exactly as kubelet drives them. The reference
-   never measured this (SURVEY §6); it is the driver's own hot path
-   (SURVEY §3.2). The chip inventory the claims are prepared against is
-   **derived from what JAX actually sees** when this host has real TPUs
-   (round-1 failure: 4 fake chips claimed, 1 real device measured).
+   then times 100 warmed NodePrepareResources→NodeUnprepareResources
+   cycles end-to-end over the wire, exactly as kubelet drives them:
+   p10/p50/p95 + IQR, a per-phase breakdown attributing ~100% of p50
+   (state machine + driver + rpc wire), per-allocation-config p50s
+   (exclusive / time-sliced / subslice / single-chip), and a batched-RPC
+   per-claim number isolating transport amortization. The reference never
+   measured this (SURVEY §6); it is the driver's own hot path (§3.2).
+   The chip inventory is **derived from what JAX actually sees** when
+   this host has real TPUs (round-1 failure: 4 fake chips claimed, 1
+   real device measured).
 
-2. **ComputeDomain convergence** — controller + 2 CD kubelet plugins +
-   2 real C++ slice daemons converging through the fake API server.
+2. **fake-v5p side phase** — the two configs the host generation cannot
+   measure: subslice (MIG analog; v5e chips are single-core) and
+   multiprocess (coordinator Deployment flipped ready at create, its
+   interaction share reported separately). All five BASELINE.md configs
+   report every round.
 
-3. **JAX psum on the allocated devices** — prepares a claim for every chip,
+3. **ComputeDomain convergence** — controller + 2 CD kubelet plugins +
+   2 real C++ slice daemons converging through the fake API server
+   (shared harness: tpu_dra.testing.provision_two_node_cd).
+
+4. **JAX psum on the allocated devices** — prepares a claim for every chip,
    reads TPU_VISIBLE_CHIPS back out of the claim's CDI spec (the same env a
    workload container would see), and runs the all-reduce bandwidth probe
-   over exactly those devices. Coverage is N/N by construction now; a
+   over exactly those devices. Coverage is N/N by construction; a
    mismatch is reported as a hard error field, not a silent subset.
 
-4. **Single-chip MFU** — times the flagship TransformerLM train step at a
+5. **Single-chip MFU** — times the flagship TransformerLM train step at a
    realistic config on one real chip; reports tokens/s, achieved model
    TFLOP/s, and MFU against the generation's public peak
    (tpu_dra.native.tpuinfo.PEAK_BF16_TFLOPS). The reference's only perf
    surface is collective-bandwidth assertions
    (tests/bats/test_cd_mnnvl_workload.bats:18-45) — this pins numbers.
+
+6. **Long-context tiers** — the same model at S=8192 (VMEM-resident flash
+   kernels) and S=16384 (streaming XL kernels; the shape does not compile
+   on the resident path).
 
 vs_baseline is 1.0: the reference publishes no numbers (BASELINE.json
 .published == {}), so there is nothing to normalize against yet; cross-round
