@@ -30,6 +30,10 @@ DRA-allocated slice; claim-to-ready p50") plus model-perf numbers:
    2 real C++ slice daemons converging through the fake API server
    (shared harness: tpu_dra.testing.provision_two_node_cd).
 
+3b. **Chaos recovery** — median ms from an injected plugin-daemon crash
+   to the affected claim prepared again (tpu_dra.simcluster.chaos):
+   the heal-speed counterpart to claim-to-ready.
+
 4. **JAX psum on the allocated devices** — prepares a claim for every chip,
    reads TPU_VISIBLE_CHIPS back out of the claim's CDI spec (the same env a
    workload container would see), and runs the all-reduce bandwidth probe
@@ -480,6 +484,19 @@ def bench_fake_v5p_configs(n_cycles: int = 30, warmup: int = 5):
             os.environ["TPU_DRA_TPUINFO_BACKEND"] = saved_backend
 
 
+def bench_chaos_recovery(n: int = 7):
+    """Chaos-recovery latency: median wall ms from an injected plugin
+    daemon crash (unclean teardown, nothing unprepared) to the affected
+    claim prepared again — checkpoint load + orphan GC + standard CDI
+    spec rewrite + DRA server up + idempotent re-prepare. The recovery
+    half of the robustness story: claim-to-ready measures the happy
+    path, this pins how fast a node heals (kubelet's 45s retry envelope
+    is the reference's only bound)."""
+    from tpu_dra.simcluster.chaos import measure_daemon_crash_recovery
+
+    return measure_daemon_crash_recovery(n)
+
+
 def bench_cd_convergence():
     """Full multi-node ComputeDomain claim-to-ready: controller + 2 CD
     kubelet plugins + 2 real C++ slice daemons converging through the fake
@@ -710,6 +727,10 @@ def main():
         out.update(bench_cd_convergence())
     except Exception as e:  # noqa: BLE001 — CD phase is best-effort
         out["cd_convergence_error"] = str(e)
+    try:
+        out.update(bench_chaos_recovery())
+    except Exception as e:  # noqa: BLE001 — chaos phase is best-effort
+        out["chaos_recovery_error"] = str(e)
     if jax_probe is None:
         out["psum_error"] = out["mfu_error"] = "jax unavailable"
     else:

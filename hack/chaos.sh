@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Chaos tier: seeded randomized fault schedules driven to quiesce, with
+# convergence invariants asserted after every schedule (ISSUE 1):
+#
+#   hack/chaos.sh [SEEDS] [EVENTS]
+#
+# 1. The fixed seed matrix (default seeds 0..24, 60 lifecycle events
+#    each) through tpu_dra.simcluster.chaos — claim convergence, no
+#    orphaned CDI specs, no leaked checkpoints, ResourceSlice vs
+#    healthy-chip consistency — plus the dropped-watch + API-flake
+#    informer recovery scenario. Violations exit non-zero.
+# 2. The @slow chaos soak tests (excluded from tier-1 by -m 'not slow').
+set -euo pipefail
+REPO_ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+SEEDS="${1:-${CHAOS_SEEDS:-25}}"
+EVENTS="${2:-${CHAOS_EVENTS:-60}}"
+
+echo ">> chaos matrix: ${SEEDS} seeded schedules x ${EVENTS} events"
+JAX_PLATFORMS=cpu TPU_DRA_TPUINFO_BACKEND=fake \
+  python -m tpu_dra.simcluster.chaos \
+    --seeds "$SEEDS" --seed-start "${CHAOS_SEED_START:-0}" \
+    --events "$EVENTS"
+
+echo ">> chaos soak (slow-marked pytest tier)"
+JAX_PLATFORMS=cpu python -m pytest "$REPO_ROOT/tests/test_chaos.py" \
+  -m slow -q -p no:cacheprovider
+echo ">> chaos tier green"

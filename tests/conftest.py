@@ -11,7 +11,24 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 # Select the in-process fake chip backend for tpu_dra.native (SURVEY §7.3).
 os.environ.setdefault("TPU_DRA_TPUINFO_BACKEND", "fake")
 
+import faulthandler  # noqa: E402
+
 import pytest  # noqa: E402
+
+# Hung chaos/stress tests must print every thread's stack instead of
+# timing out opaquely inside the tier timeout: re-armed per test below.
+# exit=False: the dump is diagnostic — the test (and the tier's own
+# timeout) still decide pass/fail. Override per-run via env.
+HANG_DUMP_TIMEOUT_S = float(os.environ.get(
+    "TPU_DRA_TEST_HANG_DUMP_S", "300"))
+
+
+def pytest_runtest_setup(item):
+    faulthandler.dump_traceback_later(HANG_DUMP_TIMEOUT_S, exit=False)
+
+
+def pytest_runtest_teardown(item, nextitem):
+    faulthandler.cancel_dump_traceback_later()
 
 # A sitecustomize in this image may pre-register a hardware TPU platform and
 # override jax_platforms before env vars are honored; pin the config back to
@@ -31,3 +48,13 @@ def _reset_feature_gates():
     featuregates.Features.reset()
     yield
     featuregates.Features.reset()
+
+
+@pytest.fixture(autouse=True)
+def _reset_fault_registry():
+    """The fault registry is process-global; a site left armed by one
+    test must never chaos-test its neighbors."""
+    from tpu_dra.infra.faults import FAULTS
+    FAULTS.reset()
+    yield
+    FAULTS.reset()

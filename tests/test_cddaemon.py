@@ -260,6 +260,77 @@ class TestProcessManager:
             pm.stop()
 
 
+class TestSupervisorBackoff:
+    def test_crash_loop_backs_off_instead_of_respawn_per_tick(self):
+        """A child that dies instantly must not be respawned at watchdog
+        frequency: consecutive crashes grow a capped backoff."""
+        pm = ProcessManager(["false"], watchdog_interval=0.02)
+        pm.RESTART_BACKOFF_BASE = 0.2
+        pm.ensure_started()
+        try:
+            time.sleep(0.5)
+            # Unsupervised respawn at 0.02s ticks would reach ~25 restarts;
+            # with 0.2s-base exponential backoff only a few fit in 0.5s.
+            assert 1 <= pm.restarts <= 4
+        finally:
+            pm.stop()
+
+    def test_ready_child_resets_crash_streak(self):
+        pm = ProcessManager(["sleep", "60"], watchdog_interval=0.02)
+        pm.ensure_started()
+        try:
+            pm._crashes = 5
+            pm._next_restart_at = time.monotonic() + 99
+            pm.mark_ready()
+            assert pm._crashes == 0
+            # Streak cleared: the next unexpected exit restarts promptly.
+            pm._proc.kill()
+            deadline = time.monotonic() + 2
+            while time.monotonic() < deadline and pm.restarts == 0:
+                time.sleep(0.02)
+            assert pm.restarts >= 1
+        finally:
+            pm.stop()
+
+    def test_on_restart_hook_fires_after_respawn(self):
+        import threading
+        fired = threading.Event()
+        pm = ProcessManager(["sleep", "60"], watchdog_interval=0.02,
+                            on_restart=fired.set)
+        pm.ensure_started()
+        try:
+            pm._proc.kill()
+            assert fired.wait(2), "on_restart hook never ran"
+        finally:
+            pm.stop()
+
+    def test_spawn_fault_keeps_watchdog_alive(self):
+        """An injected exec failure (cddaemon.spawn) must not kill the
+        watchdog thread; the respawn succeeds once the fault clears."""
+        from tpu_dra.infra.faults import FAULTS, OneShot
+
+        pm = ProcessManager(["sleep", "60"], watchdog_interval=0.02)
+        pm.RESTART_BACKOFF_BASE = 0.01
+        pm.ensure_started()
+        try:
+            FAULTS.arm("cddaemon.spawn", OneShot())
+            pm._proc.kill()
+            # Wait on restarts, not running(): right after kill() the
+            # unreaped child still reports poll() None, so running()
+            # can read True before the watchdog ever saw the death.
+            deadline = time.monotonic() + 3
+            while time.monotonic() < deadline and pm.restarts == 0:
+                time.sleep(0.02)
+            assert pm.restarts >= 1, "watchdog died with the injected fault"
+            assert pm.running()
+            # The successful respawn was necessarily preceded by the
+            # one-shot spawn failure.
+            assert FAULTS.fired("cddaemon.spawn") >= 1
+        finally:
+            FAULTS.reset()
+            pm.stop()
+
+
 @pytest.mark.skipif(not os.path.exists(DAEMON_BIN),
                     reason="native daemon not built")
 class TestNativeDaemon:

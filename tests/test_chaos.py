@@ -1,0 +1,157 @@
+"""Chaos convergence harness tier (ISSUE 1).
+
+Fast tier: a handful of seeded schedules, the dropped-watch + API-flake
+recovery scenario, and targeted single-fault convergence cases
+(transactional prepare rollback, torn checkpoint slots, crash recovery
+latency). The 25-schedule soak is @slow — hack/chaos.sh runs it with
+the fixed seed matrix; tier-1 (-m 'not slow') excludes it.
+"""
+
+import pytest
+
+from tpu_dra.infra.faults import FAULTS, EveryNth, OneShot
+from tpu_dra.simcluster.chaos import (
+    ChaosHarness, measure_daemon_crash_recovery, run_schedule,
+    run_watch_flake_scenario,
+)
+
+
+class TestChaosSchedules:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_schedule_converges_with_zero_violations(self, seed):
+        report = run_schedule(seed, n_events=25)
+        assert report.violations == []
+        assert report.events == 25
+
+    def test_faults_actually_fired(self):
+        """A chaos tier that injects nothing proves nothing: across a
+        few seeds, faults must both fire and fail real operations."""
+        fired = failed = 0
+        for seed in range(4):
+            r = run_schedule(seed, n_events=30)
+            assert r.violations == []
+            fired += sum(r.injected.values())
+            failed += r.failed_attempts
+        assert fired > 0
+        assert failed > 0
+
+
+class TestWatchFlakeRecovery:
+    @pytest.mark.parametrize("seed", [0, 3])
+    def test_dropped_watch_plus_api_flake_recovers(self, seed):
+        """The acceptance scenario: watch drops + API flakes, then the
+        informer cache converges to cluster truth with no manual relist."""
+        assert run_watch_flake_scenario(seed=seed) == []
+
+
+class TestPrepareRollback:
+    """Transactional prepare: a mid-claim failure unwinds CDI specs and
+    checkpoint entries so the retry is idempotent from a clean slate."""
+
+    def _harness(self):
+        h = ChaosHarness(seed=99)
+        return h
+
+    def test_cdi_write_failure_rolls_back_cleanly(self):
+        h = self._harness()
+        try:
+            obj = h.make_claim([0, 1])
+            with FAULTS.armed("cdi.claim_write", OneShot()):
+                err = h.attempt_prepare(obj)
+            assert err is not None
+            uid = obj["metadata"]["uid"]
+            # Clean unwind: no checkpoint entry, no CDI spec on disk.
+            assert uid not in h.state.prepared_claim_uids()
+            assert uid not in h.cdi.list_claim_uids()
+            # Retry from scratch succeeds.
+            assert h.attempt_prepare(obj) is None
+            assert uid in h.cdi.list_claim_uids()
+        finally:
+            FAULTS.reset()
+            h.close()
+
+    def test_terminal_store_failure_rolls_back(self):
+        """A failed PrepareCompleted store unwinds instead of leaving the
+        claim applied-but-not-durable."""
+        h = self._harness()
+        try:
+            obj = h.make_claim([0])
+            uid = obj["metadata"]["uid"]
+            # load_or_init already stored once; the claim's intent store
+            # is skipped for non-hazardous configs, so the next store IS
+            # the terminal one.
+            with FAULTS.armed("checkpoint.store", EveryNth(1)):
+                err = h.attempt_prepare(obj)
+            assert err is not None and "checkpoint store" in err
+            assert uid not in h.cdi.list_claim_uids()
+            assert h.attempt_prepare(obj) is None
+        finally:
+            FAULTS.reset()
+            h.close()
+
+    def test_rollback_failure_degrades_to_deferred_unwind(self):
+        """When the unwind itself cannot persist, the claim stays
+        PrepareStarted for a later unprepare — never silently dropped."""
+        from tpu_dra.tpuplugin.checkpoint import PREPARE_STARTED
+        h = self._harness()
+        try:
+            obj = h.make_claim([0])
+            uid = obj["metadata"]["uid"]
+            # Every store fails: the terminal store errors AND the
+            # rollback's store errors — deferred-unwind path.
+            with FAULTS.armed("checkpoint.store", EveryNth(1)), \
+                    FAULTS.armed("cdi.claim_write", EveryNth(1)):
+                err = h.attempt_prepare(obj)
+            assert err is not None and "rollback deferred" in err
+            snap = h.state.checkpoint_snapshot()
+            assert snap.claims[uid].state == PREPARE_STARTED
+            # Unprepare finishes the rollback once faults clear.
+            assert h.attempt_unprepare(obj) is None
+            assert uid not in h.state.prepared_claim_uids()
+        finally:
+            FAULTS.reset()
+            h.close()
+
+    def test_torn_checkpoint_slot_recovers_on_restart(self):
+        """checkpoint.corrupt tears one slot per store; load() must
+        recover the full claim state from the surviving slots."""
+        from tpu_dra.simcluster.chaos import _corrupt_one_slot
+        import random
+        h = self._harness()
+        try:
+            obj = h.make_claim([0, 1, 2])
+            with FAULTS.armed("checkpoint.corrupt", EveryNth(1),
+                              action=_corrupt_one_slot(random.Random(5))):
+                assert h.attempt_prepare(obj) is None
+            h.crash_restart()
+            uid = obj["metadata"]["uid"]
+            assert uid in h.state.prepared_claim_uids()
+            assert h.attempt_prepare(obj) is None  # idempotent re-prepare
+        finally:
+            FAULTS.reset()
+            h.close()
+
+
+class TestCrashRecoveryProbe:
+    def test_measures_sane_latency(self):
+        out = measure_daemon_crash_recovery(n=3)
+        assert out["chaos_recovery_crashes"] == 3
+        assert 0 < out["chaos_recovery_p50_ms"] < 60_000
+
+
+@pytest.mark.slow
+class TestChaosSoak:
+    def test_25_seeded_schedules_zero_violations(self):
+        """The acceptance bar: >= 25 seeded randomized fault schedules
+        run to quiesce with zero invariant violations. hack/chaos.sh
+        drives this with the fixed seed matrix."""
+        from tpu_dra.simcluster.chaos import run_matrix
+        summary = run_matrix(list(range(25)), n_events=60)
+        assert summary["violations"] == []
+        assert summary["schedules"] == 25
+        assert sum(summary["injected"].values()) > 0
+
+    def test_watch_flake_matrix(self):
+        for seed in range(10):
+            assert run_watch_flake_scenario(seed=seed) == [], \
+                f"seed {seed} failed to recover"

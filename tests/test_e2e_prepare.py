@@ -313,7 +313,10 @@ class TestPrepareFailureRollback:
                             requests=["tpu"])])
         res = grpc_prepare(harness, claim)
         assert res.error != ""
-        # Claim is left PrepareStarted; unprepare succeeds and is clean.
+        # The failure rolled back: no record remains, and unprepare of
+        # the never-prepared claim is a clean no-op.
+        assert claim["metadata"]["uid"] not in \
+            harness["state"].prepared_claim_uids()
         assert grpc_unprepare(harness, claim).error == ""
         assert claim["metadata"]["uid"] not in harness["state"].prepared_claim_uids()
 
@@ -652,6 +655,64 @@ class TestStartupPublishRetry:
             driver.shutdown()
 
 
+class TestHealthMonitorLifecycle:
+    def test_wedged_monitor_thread_surfaced_on_stop(self):
+        """A monitor thread stuck in a backend wait that never returns
+        must be reported (log + wedged flag), not silently abandoned —
+        a dead health pipeline looked exactly like a clean stop."""
+        import threading
+
+        from tpu_dra.tpuplugin.health import DeviceHealthMonitor
+
+        release = threading.Event()
+
+        class WedgedBackend:
+            def wait_health_event(self, timeout):
+                release.wait(30)  # ignores the timeout: wedged driver
+                return None
+
+        mon = DeviceHealthMonitor(WedgedBackend(), lambda e: None)
+        mon.start()
+        try:
+            mon.stop()
+            assert mon.wedged is True
+        finally:
+            release.set()
+            mon._thread.join(2)
+
+    def test_clean_stop_is_not_wedged(self):
+        from tpu_dra.tpuplugin.health import DeviceHealthMonitor
+
+        backend = FakeBackend(default_fake_chips(2, "v5e"))
+        mon = DeviceHealthMonitor(backend, lambda e: None)
+        mon.start()
+        mon.stop()
+        assert mon.wedged is False
+
+    def test_fault_site_injects_synthetic_event(self):
+        """health.chip_event payloads flow through the real monitor loop
+        (skip list included) without a backend that can produce them."""
+        import threading
+
+        from tpu_dra.infra.faults import FAULTS, OneShot
+        from tpu_dra.tpuplugin.health import DeviceHealthMonitor
+
+        backend = FakeBackend(default_fake_chips(2, "v5e"))
+        seen = []
+        got = threading.Event()
+        mon = DeviceHealthMonitor(
+            backend, lambda e: (seen.append(e), got.set()))
+        FAULTS.arm("health.chip_event", OneShot(),
+                   payload=HealthEvent(1, 200, "hbm_ecc", "injected"))
+        mon.start()
+        try:
+            assert got.wait(3)
+            assert seen[0].chip_index == 1
+        finally:
+            FAULTS.reset()
+            mon.stop()
+
+
 class TestHealthEvents:
     def test_unhealthy_chip_yanked_from_slice(self, harness):
         cluster, backend = harness["cluster"], harness["backend"]
@@ -866,8 +927,8 @@ class TestTimesliceReconciliation:
         intent_devices = intent_docs[0]["preparedClaims"]["mp-crash"][
             "devices"]
         assert [r["chip_index"] for r in intent_devices] == [1]
-        # And the error-path terminal record agrees.
+        # And the failed prepare rolled back transactionally: the record
+        # is gone from the terminal state (retry starts from scratch),
+        # not parked as PrepareStarted.
         fresh = CheckpointManager(ckpt_dir).load()
-        prepared = fresh.claims["mp-crash"]
-        assert prepared.state == "PrepareStarted"
-        assert [r["chip_index"] for r in prepared.devices] == [1]
+        assert "mp-crash" not in fresh.claims

@@ -1,0 +1,265 @@
+"""Fault-injection substrate + retrying k8s client.
+
+The registry semantics (schedules, arm/disarm, guard styles) and the
+reliability layer built on its sites: verb retry with backoff, watch
+reconnect resuming from the last seen resourceVersion, ERROR/410
+passthrough feeding the informer's relist path.
+"""
+
+import random
+import threading
+
+import pytest
+
+from tpu_dra.infra.faults import (
+    FAULTS, Always, EveryNth, FaultInjected, FaultRegistry, OneShot,
+    Probabilistic,
+)
+from tpu_dra.k8s import (
+    ApiError, FakeCluster, Informer, NotFoundError, PODS,
+    RetryingApiClient,
+)
+
+
+def pod(name, ns="default"):
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": ns}}
+
+
+class TestSchedules:
+    def test_every_nth(self):
+        s = EveryNth(3)
+        assert [s() for _ in range(7)] == [False, False, True, False,
+                                           False, True, False]
+
+    def test_every_nth_of_one_always_fires(self):
+        s = EveryNth(1)
+        assert all(s() for _ in range(5))
+
+    def test_one_shot(self):
+        s = OneShot()
+        assert [s() for _ in range(3)] == [True, False, False]
+
+    def test_one_shot_after(self):
+        s = OneShot(after=2)
+        assert [s() for _ in range(4)] == [False, False, True, False]
+
+    def test_probabilistic_seeded_replay(self):
+        a = Probabilistic(0.5, random.Random(7))
+        b = Probabilistic(0.5, random.Random(7))
+        assert [a() for _ in range(20)] == [b() for _ in range(20)]
+
+    def test_always(self):
+        s = Always()
+        assert all(s() for _ in range(3))
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            EveryNth(0)
+        with pytest.raises(ValueError):
+            Probabilistic(1.5)
+
+
+class TestFaultRegistry:
+    def test_disarmed_guards_are_noops(self):
+        r = FaultRegistry()
+        r.check("k8s.api.request")  # no raise
+        assert r.fires("k8s.watch.drop") is False
+        assert r.pull("health.chip_event") is None
+
+    def test_check_raises_when_fired(self):
+        r = FaultRegistry()
+        r.arm("k8s.api.request", EveryNth(2))
+        r.check("k8s.api.request")  # 1st call: no fire
+        with pytest.raises(FaultInjected) as ei:
+            r.check("k8s.api.request")
+        assert ei.value.site == "k8s.api.request"
+        assert r.fired("k8s.api.request") == 1
+
+    def test_custom_action_receives_ctx(self):
+        r = FaultRegistry()
+        seen = []
+        r.arm("cdi.claim_write", Always(),
+              action=lambda claim_uid: seen.append(claim_uid))
+        r.check("cdi.claim_write", claim_uid="u-1")
+        assert seen == ["u-1"]
+
+    def test_pull_returns_payload_and_callable_payload(self):
+        r = FaultRegistry()
+        r.arm("health.chip_event", OneShot(), payload="evt")
+        assert r.pull("health.chip_event") == "evt"
+        assert r.pull("health.chip_event") is None  # one-shot spent
+        r.arm("health.chip_event", Always(), payload=lambda: "minted")
+        assert r.pull("health.chip_event") == "minted"
+
+    def test_unknown_site_rejected(self):
+        r = FaultRegistry()
+        with pytest.raises(KeyError):
+            r.arm("no.such.site", Always())
+
+    def test_register_site_extends_catalog(self):
+        r = FaultRegistry()
+        r.register_site("custom.site", "test-only")
+        r.arm("custom.site", Always())
+        assert r.fires("custom.site")
+
+    def test_armed_context_manager_disarms(self):
+        r = FaultRegistry()
+        with r.armed("k8s.api.request", Always()):
+            assert r.fires("k8s.api.request")
+        assert not r.fires("k8s.api.request")
+
+    def test_take_counts_zeroes(self):
+        r = FaultRegistry()
+        r.arm("k8s.api.request", Always())
+        r.fires("k8s.api.request")
+        r.fires("k8s.api.request")
+        assert r.take_counts() == {"k8s.api.request": 2}
+        assert r.take_counts() == {"k8s.api.request": 0}
+
+    def test_thread_safety_smoke(self):
+        r = FaultRegistry()
+        r.arm("k8s.api.request", EveryNth(2))
+        hits = []
+
+        def worker():
+            for _ in range(200):
+                if r.fires("k8s.api.request"):
+                    hits.append(1)
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(hits) == 400  # every 2nd of 800 calls, no lost updates
+
+
+class FastRetrying(RetryingApiClient):
+    def __init__(self, inner, **kw):
+        kw.setdefault("base_delay", 0.001)
+        kw.setdefault("max_delay", 0.005)
+        kw.setdefault("sleep", lambda s: None)
+        super().__init__(inner, **kw)
+
+
+class TestRetryingVerbs:
+    def test_transient_error_retried_to_success(self):
+        cluster = FakeCluster()
+        cluster.create(PODS, pod("p"))
+        client = FastRetrying(cluster)
+        with FAULTS.armed("k8s.api.request", EveryNth(1)):
+            # Always-fire exhausts every attempt and surfaces the fault.
+            with pytest.raises(FaultInjected):
+                client.get(PODS, "p", "default")
+        with FAULTS.armed("k8s.api.request", OneShot()):
+            got = client.get(PODS, "p", "default")  # 1 fault, then ok
+        assert got["metadata"]["name"] == "p"
+
+    def test_real_api_error_retried(self):
+        """A 503 from the server itself (not the fault site) is retried."""
+        cluster = FakeCluster()
+        cluster.create(PODS, pod("p"))
+        client = FastRetrying(cluster)
+        orig, calls = client.inner.get, []
+
+        def flaky_get(*a, **kw):
+            calls.append(1)
+            if len(calls) < 3:
+                raise ApiError(503, "apiserver rolling")
+            return orig(*a, **kw)
+
+        client.inner.get = flaky_get
+        assert client.get(PODS, "p", "default")["metadata"]["name"] == "p"
+        assert len(calls) == 3
+
+    def test_non_transient_not_retried(self):
+        client = FastRetrying(FakeCluster())
+        calls = []
+        orig = client.inner.get
+
+        def counting_get(*a, **kw):
+            calls.append(1)
+            return orig(*a, **kw)
+
+        client.inner.get = counting_get
+        with pytest.raises(NotFoundError):
+            client.get(PODS, "missing", "default")
+        assert len(calls) == 1
+
+    def test_exhausted_retries_raise_last_error(self):
+        client = FastRetrying(FakeCluster(), max_attempts=3)
+        with FAULTS.armed("k8s.api.request", Always()):
+            with pytest.raises(FaultInjected):
+                client.list(PODS)
+
+
+class TestResilientWatch:
+    def test_drop_resumes_from_last_rv_without_event_loss(self):
+        """Events landing while the stream is down must be replayed on
+        reconnect (RV resume against the server's event log), not lost."""
+        cluster = FakeCluster()
+        client = FastRetrying(cluster)
+        stop = threading.Event()
+        events = []
+        started = threading.Event()
+
+        def consume():
+            _, rv = cluster.list_with_rv(PODS)
+            started.set()
+            for evt in client.watch(PODS, namespace="default",
+                                    resource_version=rv, stop=stop):
+                events.append(evt)
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        started.wait(2)
+        cluster.create(PODS, pod("before-drop"))
+        assert cluster.wait_for(lambda: len(events) == 1)
+        # Drop the stream on the NEXT delivery; the event that triggers
+        # the drop must be re-delivered after reconnect, not swallowed.
+        FAULTS.arm("k8s.watch.drop", OneShot())
+        cluster.create(PODS, pod("dropped-delivery"))
+        cluster.create(PODS, pod("while-down"))
+        assert cluster.wait_for(lambda: len(events) >= 3, timeout=5)
+        stop.set()
+        t.join(2)
+        names = [o["metadata"]["name"] for _, o in events]
+        assert names[:3] == ["before-drop", "dropped-delivery",
+                             "while-down"]
+
+    def test_error_410_passes_through_and_ends_stream(self):
+        cluster = FakeCluster()
+        cluster.EVENT_LOG_CAP = 4
+        first = cluster.create(PODS, pod("old"))
+        for i in range(12):
+            cluster.create(PODS, pod(f"churn-{i}"))
+        client = FastRetrying(cluster)
+        stop = threading.Event()
+        gen = client.watch(PODS, namespace="default",
+                           resource_version=first["metadata"]
+                           ["resourceVersion"], stop=stop)
+        event_type, obj = next(gen)
+        stop.set()
+        assert event_type == "ERROR"
+        assert obj["code"] == 410
+        with pytest.raises(StopIteration):
+            next(gen)
+
+    def test_informer_backoff_resets_after_successful_list(self):
+        """Consecutive relist failures grow the backoff; a successful
+        list resets it (no tight relist loop against a down apiserver,
+        no stuck slow loop after it recovers)."""
+        cluster = FakeCluster()
+        client = FastRetrying(cluster, max_attempts=2)
+        inf = Informer(client, PODS, namespace="default")
+        inf.RELIST_BACKOFF_BASE = 0.01
+        with FAULTS.armed("k8s.api.request", Always()):
+            inf.start()
+            assert not inf.wait_for_sync(0.3)  # outage: cannot sync
+        # Fault cleared: the informer must recover on its own.
+        assert cluster.wait_for(lambda: inf.wait_for_sync(0.1), timeout=5)
+        cluster.create(PODS, pod("after-outage"))
+        assert cluster.wait_for(
+            lambda: inf.lister.get("after-outage", "default") is not None)
+        inf.stop()

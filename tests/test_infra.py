@@ -121,6 +121,66 @@ class TestWorkQueue:
         assert calls.count("second") == 1
         assert calls.count("first") <= 2  # at most one retry already in flight
 
+    def test_supersede_when_newer_completed_before_older_ran(self):
+        """The race the None-current case hid: the NEWER item under a key
+        completes (deleting the active-op entry) before the delayed OLDER
+        item ever runs; the older item's failure must be forgotten, not
+        retried forever against state the newer item already reconciled."""
+        q = WorkQueue(FastRL())
+        calls = []
+        newer_done = threading.Event()
+        q.enqueue("old", lambda o: (calls.append("old"),
+                                    (_ for _ in ()).throw(
+                                        RuntimeError("stale"))),
+                  key="k", after=0.08)
+        q.enqueue("new", lambda o: (calls.append("new"),
+                                    newer_done.set()), key="k", after=0.0)
+        t = q.run_in_thread()
+        assert newer_done.wait(2)
+        time.sleep(0.3)  # any (wrong) retries of the stale item land here
+        q.shutdown()
+        t.join(2)
+        assert calls.count("new") == 1
+        assert calls.count("old") == 1  # ran once, forgotten, no retries
+
+    def test_supersede_under_threaded_producers(self):
+        """Concurrent producers hammer one key with failing items, then a
+        final item succeeds: every stale failure must be forgotten and
+        the queue must drain (the pre-fix behavior kept retrying stale
+        items forever once the final success emptied the active-op map)."""
+        q = WorkQueue(FastRL())
+        t = q.run_in_thread()
+        fail_calls = []
+        done = threading.Event()
+
+        def failing(obj):
+            fail_calls.append(obj)
+            raise RuntimeError(f"fail {obj}")
+
+        def produce(tid):
+            for i in range(20):
+                q.enqueue(f"{tid}-{i}", failing, key="k")
+
+        producers = [threading.Thread(target=produce, args=(tid,))
+                     for tid in range(4)]
+        for p in producers:
+            p.start()
+        for p in producers:
+            p.join()
+        q.enqueue("final", lambda o: done.set(), key="k")
+        assert done.wait(5)
+        # Quiesce: stale items each fail at most once more, get
+        # forgotten, and the heap empties for good.
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and len(q):
+            time.sleep(0.02)
+        assert len(q) == 0, "stale failures kept retrying"
+        settled = len(fail_calls)
+        time.sleep(0.2)
+        assert len(fail_calls) == settled, "retries continued after drain"
+        q.shutdown()
+        t.join(2)
+
     def test_keyless_items_always_retry(self):
         q = WorkQueue(FastRL())
         done = threading.Event()

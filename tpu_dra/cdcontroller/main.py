@@ -20,7 +20,7 @@ from tpu_dra.infra.flags import (
 )
 from tpu_dra.infra.featuregates import Features
 from tpu_dra.infra.metrics import MetricsServer
-from tpu_dra.k8s.client import HttpApiClient
+from tpu_dra.k8s.client import HttpApiClient, RetryingApiClient
 
 
 def flags() -> FlagSet:
@@ -55,7 +55,9 @@ def main(argv=None) -> int:
     fs.dump_config(ns, logger)
     debug.start_debug_signal_handlers()
 
-    client = HttpApiClient(base_url=ns.kube_api_url)
+    # Transient API-server failures (rolling upgrade, LB blips)
+    # retry with jittered backoff instead of crash-looping the pod.
+    client = RetryingApiClient(HttpApiClient(base_url=ns.kube_api_url))
     controller = Controller(
         client, namespace=ns.namespace, image=ns.image,
         log_verbosity=ns.v, feature_gates=Features.as_string(),

@@ -36,7 +36,7 @@ from tpu_dra.infra.flags import (
     Flag, FlagSet, apply_feature_gates, feature_gate_flag, logging_flags,
     setup_logging,
 )
-from tpu_dra.k8s.client import HttpApiClient
+from tpu_dra.k8s.client import HttpApiClient, RetryingApiClient
 from tpu_dra.native.tpuinfo import get_backend
 
 log = logging.getLogger("tpu_dra.cddaemon")
@@ -138,7 +138,8 @@ class DaemonRunner:
         self.config_path = os.path.join(ns.work_dir, "slice-daemon.cfg")
         self.nodes_path = os.path.join(ns.work_dir, "nodes.cfg")
         self.process = ProcessManager(
-            [ns.daemon_binary, "--config", self.config_path])
+            [ns.daemon_binary, "--config", self.config_path],
+            on_restart=self._on_daemon_restart)
         self._stop = threading.Event()
         self._threads = []
         self._last_ready = None
@@ -182,6 +183,27 @@ class DaemonRunner:
             log.exception("deregistration failed; stale entry will be "
                           "cleaned by the controller's pod-delete handler")
         self.cd.stop()
+
+    def _on_daemon_restart(self) -> None:
+        """Supervisor hook: a crashed slice daemon was respawned. Force
+        the readiness mirror pessimistic NOW — workloads gating on the CD
+        channel must not ride a Ready status backed by a daemon that just
+        died — and drop the loop back to its fast startup cadence so the
+        recovered daemon republishes Ready at probe latency.
+
+        Publish BEFORE updating _last_ready: clearing the marker first
+        opens a race where the (now fast-cadence) readiness loop probes
+        the new child ready, publishes True and records it, and this
+        hook's delayed False write lands last — wedging the mirror at
+        False with nothing left to notice the mismatch. With the write
+        first, whatever order the two publishes land in, the next loop
+        tick sees marker != probe and reconverges."""
+        try:
+            self.cd.set_node_status(False)
+            self._last_ready = False
+        except Exception:  # noqa: BLE001 — the readiness loop retries
+            log.exception("post-restart readiness republish failed")
+            self._last_ready = None  # force a republish on the next tick
 
     # -- loops --------------------------------------------------------------
 
@@ -266,7 +288,9 @@ def run(argv=None) -> int:
     fs.dump_config(ns, logger)
     debug.start_debug_signal_handlers()
 
-    client = HttpApiClient(base_url=ns.kube_api_url)
+    # Transient API-server failures (rolling upgrade, LB blips)
+    # retry with jittered backoff instead of crash-looping the pod.
+    client = RetryingApiClient(HttpApiClient(base_url=ns.kube_api_url))
     runner = DaemonRunner(client, ns)
 
     stop = threading.Event()

@@ -3,6 +3,13 @@
 Reference: cmd/compute-domain-daemon/process.go:38-247 — start/stop/restart
 with buffered wait-reaping, a 1s watchdog that restarts the child on
 unexpected exit (:170-203), and signal forwarding.
+
+Beyond the reference: the watchdog is a real supervisor — consecutive
+crashes back off exponentially (capped) instead of respawning a
+crash-looping child every tick, and an ``on_restart`` hook lets the
+owner republish readiness the moment a replacement child is spawned
+(the readiness mirror otherwise waits a full steady-state probe period
+to notice the daemon it reported Ready is gone).
 """
 
 from __future__ import annotations
@@ -12,21 +19,33 @@ import signal
 import subprocess
 import threading
 import time
-from typing import List, Optional
+from typing import Callable, List, Optional
+
+from tpu_dra.infra.faults import FAULTS
 
 log = logging.getLogger("tpu_dra.cddaemon.process")
 
 
 class ProcessManager:
-    def __init__(self, argv: List[str], watchdog_interval: float = 1.0):
+    # Consecutive-crash restart backoff: first respawn is immediate (the
+    # common one-off crash), then 0.5s * 2^n capped at 15s — a corrupt
+    # config must not fork-bomb the node at watchdog frequency.
+    RESTART_BACKOFF_BASE = 0.5
+    RESTART_BACKOFF_MAX = 15.0
+
+    def __init__(self, argv: List[str], watchdog_interval: float = 1.0,
+                 on_restart: Optional[Callable[[], None]] = None):
         self._argv = argv
         self._interval = watchdog_interval
+        self._on_restart = on_restart
         self._proc: Optional[subprocess.Popen] = None
         self._lock = threading.RLock()
         self._want_running = False
         self._stop = threading.Event()
         self._watchdog: Optional[threading.Thread] = None
         self.restarts = 0
+        self._crashes = 0           # consecutive, reset on confirmed-ready
+        self._next_restart_at = 0.0
         # Non-fatal signals are held until the child is confirmed alive
         # (mark_ready(), driven by the wrapper's first successful READY
         # probe): a SIGUSR1 delivered in the exec->handler-install window
@@ -51,6 +70,10 @@ class ProcessManager:
             self._watchdog.start()
 
     def _spawn_locked(self) -> None:
+        # Injection site: exec failure (binary missing after an image
+        # upgrade, ENOMEM) — the supervisor must back off and keep
+        # trying, not die with the watchdog thread.
+        FAULTS.check("cddaemon.spawn", argv=self._argv)
         log.info("starting: %s", " ".join(self._argv))
         self._confirmed_ready = False
         self._proc = subprocess.Popen(self._argv)
@@ -124,6 +147,10 @@ class ProcessManager:
             if pid is not None and self._proc.pid != pid:
                 return
             self._confirmed_ready = True
+            # A child that reached ready ends the crash streak: the next
+            # unexpected exit restarts immediately again.
+            self._crashes = 0
+            self._next_restart_at = 0.0
             pending, self._pending_signals = self._pending_signals, []
             for sig in pending:
                 if self._proc.poll() is None:
@@ -137,11 +164,44 @@ class ProcessManager:
 
     def _watch(self) -> None:
         while not self._stop.wait(self._interval):
+            restarted = False
             with self._lock:
                 if not self._want_running:
                     continue
-                if self._proc is not None and self._proc.poll() is not None:
-                    log.warning("child exited unexpectedly (rc=%s); restarting",
-                                self._proc.returncode)
+                if self._proc is None or self._proc.poll() is None:
+                    continue
+                now = time.monotonic()
+                if now < self._next_restart_at:
+                    continue  # crash-looping: hold the backoff
+                log.warning("child exited unexpectedly (rc=%s); restarting"
+                            " (crash streak %d)", self._proc.returncode,
+                            self._crashes + 1)
+                self._crashes += 1
+                self._next_restart_at = now + min(
+                    self.RESTART_BACKOFF_BASE * (2 ** (self._crashes - 1)),
+                    self.RESTART_BACKOFF_MAX)
+                try:
                     self._spawn_locked()
-                    self.restarts += 1
+                except Exception:  # noqa: BLE001 — spawn failed: the
+                    # backoff above already schedules the next attempt;
+                    # the watchdog thread must survive to make it.
+                    log.exception("respawn failed; retrying after backoff")
+                    continue
+                self.restarts += 1
+                restarted = True
+            if restarted and self._on_restart is not None:
+                # On its own thread: the hook touches the API server
+                # (readiness republish, with retries that can run long
+                # during an outage) and must stall neither supervision —
+                # a child dying mid-hook still gets its backed-off
+                # respawn — nor stop()'s watchdog join.
+                threading.Thread(target=self._run_restart_hook,
+                                 daemon=True,
+                                 name="process-on-restart").start()
+
+    def _run_restart_hook(self) -> None:
+        try:
+            self._on_restart()
+        except Exception:  # noqa: BLE001 — a broken hook must not kill
+            # the supervisor
+            log.exception("on_restart hook failed")

@@ -27,6 +27,7 @@ import json
 import os
 from typing import Dict, List, Optional
 
+from tpu_dra.infra.faults import FAULTS
 from tpu_dra.native.tpuinfo import Chip
 
 CDI_VERSION = "0.5.0"
@@ -127,6 +128,10 @@ class CDIHandler:
         """Transient per-claim spec carrying claim-scoped edits — sharing
         env, ComputeDomain coordination env, multiprocess mounts
         (CreateClaimSpecFile analog)."""
+        # Injection site: a failed claim-spec write is the canonical
+        # mid-prepare failure (full disk, ENOSPC on /var/run/cdi) —
+        # the prepare rollback path must unwind cleanly from here.
+        FAULTS.check("cdi.claim_write", claim_uid=claim_uid)
         edits: Dict = {"env": [f"{k}={v}" for k, v in sorted(env.items())]}
         if mounts:
             edits["mounts"] = mounts

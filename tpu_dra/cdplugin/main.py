@@ -25,7 +25,7 @@ from tpu_dra.infra.flags import (
     setup_logging,
 )
 from tpu_dra.infra.metrics import MetricsServer
-from tpu_dra.k8s.client import HttpApiClient
+from tpu_dra.k8s.client import HttpApiClient, RetryingApiClient
 from tpu_dra.native.tpuinfo import get_backend
 from tpu_dra.tpuplugin.checkpoint import CheckpointManager
 
@@ -65,7 +65,9 @@ def main(argv=None) -> int:
 
     backend = get_backend()
     slice_id = discover_slice_id(backend)
-    client = HttpApiClient(base_url=ns.kube_api_url)
+    # Transient API-server failures (rolling upgrade, LB blips)
+    # retry with jittered backoff instead of crash-looping the pod.
+    client = RetryingApiClient(HttpApiClient(base_url=ns.kube_api_url))
     cd_manager = ComputeDomainManager(
         client, node_name=ns.node_name, driver_plugin_dir=ns.plugin_dir)
     cd_manager.start()

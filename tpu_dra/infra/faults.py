@@ -1,0 +1,274 @@
+"""Fault-injection substrate: named sites, armed with schedules.
+
+The reference driver's operational value is surviving partial failure
+(NVML event storms, kubelet restarts, API-server flakes), but none of
+that is drivable deterministically from tests. This registry gives
+production code cheap guard calls at the places failure actually enters
+the system — a *site* — and gives chaos tests a way to arm each site
+with a *schedule* (every-Nth, probabilistic, one-shot) deciding which
+guard invocations fire.
+
+Guard styles, by what the site needs on failure:
+
+- ``check(site, **ctx)``  — raise ``FaultInjected`` (or run the armed
+  action with ``ctx``) when the schedule fires; no-op otherwise. For
+  sites whose failure mode is an exception (API request, CDI write,
+  checkpoint store).
+- ``fires(site)``         — plain bool, for sites that model failure as
+  control flow (dropping a watch stream) rather than an exception.
+- ``pull(site)``          — return the armed payload when the schedule
+  fires, else None. For sites that *inject data* (a synthetic chip
+  health event) rather than an error.
+
+The disarmed fast path is a single dict emptiness test — cheap enough
+to leave on hot paths permanently. All state transitions take a lock;
+guards may be hit from many threads (watch loops, workqueues, gRPC
+handlers).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+
+class FaultInjected(Exception):
+    """Raised by a fired ``check`` guard with no custom action armed."""
+
+    def __init__(self, site: str, detail: str = ""):
+        super().__init__(f"injected fault at {site}"
+                         + (f": {detail}" if detail else ""))
+        self.site = site
+
+
+# ---------------------------------------------------------------------------
+# Schedules: when does an armed site fire?
+# ---------------------------------------------------------------------------
+
+class Schedule:
+    """Decides, per guard invocation, whether the armed fault fires.
+    ``__call__`` runs under the registry lock — keep it cheap."""
+
+    def __call__(self) -> bool:
+        raise NotImplementedError
+
+
+class EveryNth(Schedule):
+    """Fire on every Nth invocation (the deterministic flake)."""
+
+    def __init__(self, n: int):
+        if n < 1:
+            raise ValueError("n must be >= 1")
+        self._n = n
+        self._count = 0
+
+    def __call__(self) -> bool:
+        self._count += 1
+        return self._count % self._n == 0
+
+
+class Probabilistic(Schedule):
+    """Fire with probability p per invocation; seeded rng for replay."""
+
+    def __init__(self, p: float, rng: Optional[random.Random] = None):
+        if not 0.0 <= p <= 1.0:
+            raise ValueError("p must be in [0, 1]")
+        self._p = p
+        self._rng = rng or random.Random()
+
+    def __call__(self) -> bool:
+        return self._rng.random() < self._p
+
+
+class OneShot(Schedule):
+    """Fire exactly once, optionally skipping the first `after` calls."""
+
+    def __init__(self, after: int = 0):
+        self._skip = after
+        self._fired = False
+
+    def __call__(self) -> bool:
+        if self._fired:
+            return False
+        if self._skip > 0:
+            self._skip -= 1
+            return False
+        self._fired = True
+        return True
+
+
+class Always(Schedule):
+    """Fire on every invocation (hard outage until disarmed)."""
+
+    def __call__(self) -> bool:
+        return True
+
+
+# ---------------------------------------------------------------------------
+# Site catalog
+# ---------------------------------------------------------------------------
+
+# Every injection site production code consults, with the invariant its
+# failure threatens (mirrored in SURVEY.md "Failure model & fault sites").
+SITES: Dict[str, str] = {
+    "k8s.api.request":
+        "API request fails transiently (429/500/503, socket error); "
+        "threatens: reconcile convergence, ResourceSlice freshness",
+    "k8s.watch.drop":
+        "watch stream dies mid-flight; threatens: informer cache "
+        "staleness if resume loses events",
+    "cdi.claim_write":
+        "per-claim CDI spec write fails; threatens: orphaned spec files, "
+        "claims stuck half-prepared",
+    "checkpoint.store":
+        "checkpoint store fails; threatens: claim state-machine "
+        "durability, prepare idempotency",
+    "checkpoint.corrupt":
+        "slot file torn/corrupted after a store (action scribbles on the "
+        "written paths); threatens: recovery after crash",
+    "cddaemon.spawn":
+        "slice-daemon child fails to spawn; threatens: readiness "
+        "mirroring, CD convergence",
+    "health.chip_event":
+        "synthetic chip health event (payload-injecting site); "
+        "threatens: ResourceSlice vs healthy-chip consistency",
+}
+
+
+@dataclass
+class _Armed:
+    schedule: Schedule
+    action: Optional[Callable[..., Any]] = None
+    payload: Any = None
+    fired: int = 0
+    calls: int = 0
+    detail: str = ""
+
+
+class FaultRegistry:
+    """Registry of injection sites; one global instance (``FAULTS``) is
+    consulted by production guards, tests arm/disarm on it."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._armed: Dict[str, _Armed] = {}
+        self._sites = dict(SITES)
+
+    # -- site catalog -------------------------------------------------------
+
+    def register_site(self, site: str, description: str) -> None:
+        """Extension point for out-of-tree sites (tests, plugins)."""
+        with self._lock:
+            self._sites.setdefault(site, description)
+
+    def sites(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._sites)
+
+    # -- arming -------------------------------------------------------------
+
+    def arm(self, site: str, schedule: Schedule, *,
+            action: Optional[Callable[..., Any]] = None,
+            payload: Any = None, detail: str = "") -> None:
+        """Arm `site` with `schedule`. When a ``check`` guard fires:
+        `action(**ctx)` runs if given (it decides whether/what to raise),
+        else ``FaultInjected`` is raised. `payload` is what ``pull``
+        returns on fire. Unknown site names are rejected — a typo here
+        would silently chaos-test nothing."""
+        with self._lock:
+            if site not in self._sites:
+                raise KeyError(f"unknown fault site {site!r} "
+                               f"(known: {sorted(self._sites)})")
+            self._armed[site] = _Armed(schedule=schedule, action=action,
+                                       payload=payload, detail=detail)
+
+    def disarm(self, site: str) -> None:
+        with self._lock:
+            self._armed.pop(site, None)
+
+    def reset(self) -> None:
+        """Disarm everything (chaos quiesce / test teardown)."""
+        with self._lock:
+            self._armed.clear()
+
+    @contextmanager
+    def armed(self, site: str, schedule: Schedule, *,
+              action: Optional[Callable[..., Any]] = None,
+              payload: Any = None, detail: str = ""):
+        """Scoped arm for tests: disarms on exit no matter what."""
+        self.arm(site, schedule, action=action, payload=payload,
+                 detail=detail)
+        try:
+            yield self
+        finally:
+            self.disarm(site)
+
+    # -- guards (production call sites) -------------------------------------
+
+    def _fire(self, site: str) -> Optional[_Armed]:
+        # Disarmed fast path: a plain dict emptiness/membership test,
+        # no lock (dict reads are atomic under the GIL; a racing arm()
+        # is observed on the next guard hit, which is all chaos needs).
+        if site not in self._armed:
+            return None
+        with self._lock:
+            armed = self._armed.get(site)
+            if armed is None:
+                return None
+            armed.calls += 1
+            if not armed.schedule():
+                return None
+            armed.fired += 1
+            return armed
+
+    def fires(self, site: str) -> bool:
+        """Control-flow guard: True when the armed schedule fires."""
+        return self._fire(site) is not None
+
+    def check(self, site: str, **ctx) -> None:
+        """Exception guard: raise FaultInjected (or run the armed action
+        with `ctx`) when the schedule fires; no-op otherwise."""
+        armed = self._fire(site)
+        if armed is None:
+            return
+        if armed.action is not None:
+            armed.action(**ctx)
+            return
+        raise FaultInjected(site, armed.detail)
+
+    def pull(self, site: str) -> Any:
+        """Payload guard: the armed payload when the schedule fires
+        (a callable payload is invoked to mint the value), else None."""
+        armed = self._fire(site)
+        if armed is None:
+            return None
+        payload = armed.payload
+        return payload() if callable(payload) else payload
+
+    # -- introspection ------------------------------------------------------
+
+    def fired(self, site: str) -> int:
+        with self._lock:
+            armed = self._armed.get(site)
+            return armed.fired if armed else 0
+
+    def counts(self) -> Dict[str, int]:
+        """site -> times fired, for armed sites (chaos reports)."""
+        with self._lock:
+            return {s: a.fired for s, a in self._armed.items()}
+
+    def take_counts(self) -> Dict[str, int]:
+        """counts(), zeroing the fired counters — so a chaos run that
+        re-arms sites mid-walk can accumulate without double counting."""
+        with self._lock:
+            out = {s: a.fired for s, a in self._armed.items()}
+            for a in self._armed.values():
+                a.fired = 0
+            return out
+
+
+# The process-global registry every production guard consults.
+FAULTS = FaultRegistry()

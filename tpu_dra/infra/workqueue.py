@@ -241,7 +241,14 @@ class WorkQueue:
             self._log(f"reconcile: {e} (attempt {attempts})")
             with self._cond:
                 current = self._active_ops.get(item.key)
-                if item.key and current is not None and current is not item:
+                if item.key and current is not item:
+                    # Superseded — a newer item under this key is either
+                    # still pending (current is that item) or already
+                    # COMPLETED (current is None: success deletes the
+                    # entry). Both mean this failure is obsolete; the
+                    # None case previously re-enqueued the stale item,
+                    # which then retried forever against state the newer
+                    # item had already reconciled.
                     self._log(f"not re-enqueueing '{item.key}': superseded")
                     self._rl.forget(item.item_id)
                 else:

@@ -9,7 +9,7 @@ in-memory fake API server with real watch/finalizer semantics for tests
 
 from tpu_dra.k8s.client import (  # noqa: F401
     ApiClient, ApiError, ConflictError, NotFoundError, GVR, HttpApiClient,
-    label_selector_matches,
+    RetryingApiClient, label_selector_matches,
 )
 from tpu_dra.k8s.resources import (  # noqa: F401
     PODS, NODES, DAEMONSETS, DEPLOYMENTS, RESOURCECLAIMS,

@@ -7,20 +7,27 @@ either kubeconfig or in-cluster config). Objects are plain dicts
 
 Supports: CRUD + status subresource, JSON merge-patch, list with
 label/field selectors, and streaming watch (chunked JSON lines), with
-in-cluster service-account config discovery.
+in-cluster service-account config discovery. ``RetryingApiClient`` wraps
+any ApiClient (HTTP or fake) with jittered-backoff retry on transient
+errors and a watch that reconnects resuming from the last seen
+resourceVersion.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import random
 import socket
 import ssl
 import threading
+import time
 import urllib.parse
 import urllib.request
 from dataclasses import dataclass
 from typing import Any, Dict, Generator, List, Optional, Tuple
+
+from tpu_dra.infra.faults import FAULTS, FaultInjected
 
 
 @dataclass(frozen=True)
@@ -375,3 +382,172 @@ class HttpApiClient(ApiClient):
                 buf += data
         finally:
             sock.close()
+
+
+# ---------------------------------------------------------------------------
+# Resilient client wrapper
+# ---------------------------------------------------------------------------
+
+# HTTP statuses a well-behaved client retries (client-go's
+# IsRetryableError set: throttling + server-side transient failures).
+# Status 0 is our own "connection-level failure" marker.
+TRANSIENT_STATUSES = frozenset({0, 429, 500, 502, 503, 504})
+
+
+def is_transient(err: Exception) -> bool:
+    """Would a retry plausibly succeed? Conflict/NotFound/AlreadyExists
+    and other 4xx are caller-level outcomes, not network weather."""
+    if isinstance(err, (NotFoundError, ConflictError, AlreadyExistsError)):
+        return False
+    if isinstance(err, FaultInjected):
+        return True  # injected faults model transient infrastructure
+    if isinstance(err, ApiError):
+        return err.status in TRANSIENT_STATUSES
+    return isinstance(err, (OSError, TimeoutError))
+
+
+class _WatchDropped(Exception):
+    """Internal: the watch stream died mid-flight; reconnect from the
+    last seen resourceVersion."""
+
+
+class RetryingApiClient(ApiClient):
+    """Decorates any ApiClient with the reliability layer every reconcile
+    loop needs (the client-go rest retry + reflector resume analog):
+
+    - every verb retries transient errors (TRANSIENT_STATUSES, socket
+      errors) with jittered exponential backoff, up to `max_attempts`;
+    - ``watch`` reconnects on stream death, resuming from the last seen
+      object resourceVersion so no events are lost across the gap. A
+      server-side ERROR event (410 Gone above all) is passed through and
+      ends the stream: resuming past it would hide a history hole, so
+      the informer must relist (informer.py treats ERROR as fatal).
+      Resume requires an RV to resume FROM: if the stream dies before
+      any RV is known (none passed, none delivered), the wrapper ends
+      the stream instead of silently reconnecting from "now" — a
+      from-now reconnect would swallow whatever happened during the
+      outage with no signal to the consumer.
+
+    Mutating verbs are retried too: an ambiguous first attempt (request
+    landed, response lost) then surfaces as AlreadyExists/Conflict on
+    the retry — exactly what reconcile callers already tolerate.
+
+    Consults fault sites ``k8s.api.request`` (per attempt, inside the
+    retry loop) and ``k8s.watch.drop`` (per delivered event), so chaos
+    schedules exercise this exact code path rather than a test double.
+    """
+
+    def __init__(self, inner: ApiClient, *, max_attempts: int = 5,
+                 base_delay: float = 0.05, max_delay: float = 2.0,
+                 jitter: float = 0.5, rng: Optional[random.Random] = None,
+                 sleep=time.sleep):
+        self._inner = inner
+        self._max_attempts = max_attempts
+        self._base = base_delay
+        self._max_delay = max_delay
+        self._jitter = jitter
+        self._rng = rng or random.Random()
+        self._sleep = sleep
+
+    @property
+    def inner(self) -> ApiClient:
+        return self._inner
+
+    def _backoff(self, attempt: int) -> float:
+        d = min(self._base * (2 ** attempt), self._max_delay)
+        return max(0.0, d * (1.0 + self._jitter * (self._rng.random() - 0.5)))
+
+    def _call(self, verb: str, fn, *args, **kwargs):
+        last: Optional[Exception] = None
+        for attempt in range(self._max_attempts):
+            try:
+                FAULTS.check("k8s.api.request", verb=verb)
+                return fn(*args, **kwargs)
+            except Exception as e:  # noqa: BLE001 — classified below
+                if not is_transient(e):
+                    raise
+                last = e
+            if attempt < self._max_attempts - 1:
+                # No sleep after the final attempt: the outcome is
+                # decided, don't tax the error path with a dead wait.
+                self._sleep(self._backoff(attempt))
+        assert last is not None
+        raise last
+
+    # -- verbs --------------------------------------------------------------
+
+    def get(self, gvr, name, namespace=None):
+        return self._call("get", self._inner.get, gvr, name, namespace)
+
+    def list(self, gvr, namespace=None, label_selector=None):
+        return self._call("list", self._inner.list, gvr, namespace,
+                          label_selector)
+
+    def list_with_rv(self, gvr, namespace=None, label_selector=None):
+        return self._call("list", self._inner.list_with_rv, gvr, namespace,
+                          label_selector)
+
+    def create(self, gvr, obj, namespace=None):
+        return self._call("create", self._inner.create, gvr, obj, namespace)
+
+    def update(self, gvr, obj, namespace=None):
+        return self._call("update", self._inner.update, gvr, obj, namespace)
+
+    def update_status(self, gvr, obj, namespace=None):
+        return self._call("update", self._inner.update_status, gvr, obj,
+                          namespace)
+
+    def patch(self, gvr, name, patch, namespace=None):
+        return self._call("patch", self._inner.patch, gvr, name, patch,
+                          namespace)
+
+    def delete(self, gvr, name, namespace=None):
+        return self._call("delete", self._inner.delete, gvr, name, namespace)
+
+    # -- watch --------------------------------------------------------------
+
+    def watch(self, gvr, namespace=None, label_selector=None,
+              resource_version=None, stop=None):
+        rv = resource_version
+        failures = 0
+        while stop is None or not stop.is_set():
+            gen = None
+            try:
+                FAULTS.check("k8s.api.request", verb="watch")
+                gen = self._inner.watch(
+                    gvr, namespace=namespace, label_selector=label_selector,
+                    resource_version=rv, stop=stop)
+                for event_type, obj in gen:
+                    if FAULTS.fires("k8s.watch.drop"):
+                        raise _WatchDropped()
+                    if event_type == "ERROR":
+                        # 410 Gone (or any server stream error): resuming
+                        # from rv would skip the trimmed gap. Surface it;
+                        # the informer relists.
+                        yield event_type, obj
+                        return
+                    failures = 0
+                    new_rv = (obj.get("metadata") or {}).get(
+                        "resourceVersion")
+                    if new_rv:
+                        rv = new_rv
+                    yield event_type, obj
+                # Clean server close (idle timeout): reconnect from the
+                # last seen RV — the entire point of this wrapper.
+            except Exception as e:  # noqa: BLE001 — classified below
+                if not isinstance(e, _WatchDropped) and not is_transient(e):
+                    raise
+            finally:
+                if gen is not None:
+                    gen.close()
+            if rv is None:
+                # Nothing to resume from: reconnecting would start at
+                # "now" and hide the gap. End the stream; the consumer's
+                # relist path (the pre-wrapper contract) takes over.
+                return
+            failures += 1
+            delay = self._backoff(min(failures - 1, self._max_attempts - 1))
+            if stop is not None:
+                stop.wait(delay)  # shutdown must not ride out the backoff
+            else:
+                self._sleep(delay)

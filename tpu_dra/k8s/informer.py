@@ -10,6 +10,7 @@ cache the DaemonSet manager uses to see its own writes
 from __future__ import annotations
 
 import copy
+import random
 import threading
 from typing import Callable, Dict, List, Optional
 
@@ -73,6 +74,7 @@ class Informer:
         self._update_handlers: List[Callable[[Dict, Dict], None]] = []
         self._delete_handlers: List[Callable[[Dict], None]] = []
         self._synced = threading.Event()
+        self._listed_ok = False
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.lister = Lister(self._store, self._lock)
@@ -162,14 +164,29 @@ class Informer:
                 import traceback
                 traceback.print_exc()
 
+    # Relist backoff bounds: quick first retry (a single 410 relist should
+    # not stall handlers), capped so a down apiserver is not hammered.
+    RELIST_BACKOFF_BASE = 0.2
+    RELIST_BACKOFF_MAX = 30.0
+
     def _run(self) -> None:
+        backoff = self.RELIST_BACKOFF_BASE
         while not self._stop.is_set():
+            self._listed_ok = False
             try:
                 self._list_and_watch()
             except Exception:  # noqa: BLE001 — relist on any stream failure
                 if self._stop.is_set():
                     return
-                self._stop.wait(1.0)
+                # A successful LIST (even if the watch later died, e.g.
+                # 410 relist) resets the backoff; consecutive list
+                # failures grow it — an apiserver outage must not turn
+                # every informer into a tight relist loop.
+                if self._listed_ok:
+                    backoff = self.RELIST_BACKOFF_BASE
+                else:
+                    backoff = min(backoff * 2, self.RELIST_BACKOFF_MAX)
+                self._stop.wait(backoff * (0.75 + 0.5 * random.random()))
 
     def _list_and_watch(self) -> None:
         # list_with_rv + resourceVersion-resumed watch closes the gap in
@@ -178,6 +195,7 @@ class Informer:
         objs, list_rv = self._client.list_with_rv(
             self._gvr, namespace=self._namespace,
             label_selector=self._selector)
+        self._listed_ok = True
         with self._lock:
             seen = set()
             for obj in objs:

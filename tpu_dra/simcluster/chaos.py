@@ -1,0 +1,592 @@
+"""Chaos convergence harness: seeded randomized fault schedules against
+full claim lifecycles, with invariants asserted after quiesce.
+
+The production stack under test is real — ``TpuDriver`` + ``DeviceState``
++ ``CheckpointManager`` + ``CDIHandler`` over a ``RetryingApiClient``-
+wrapped ``FakeCluster`` — only the kubelet gRPC hop is skipped (covered
+by tests/test_e2e_prepare.py; this tier turns the crank thousands of
+times and the wire adds nothing to the failure model). Faults enter
+through the ``tpu_dra.infra.faults`` sites the production code itself
+consults: API request errors, watch drops, CDI write failures,
+checkpoint store failures and torn slots, plugin crashes (rebuild from
+disk), and chip health events.
+
+Each schedule is a seeded random walk over lifecycle operations
+(prepare, retry, unprepare, crash-restart, health event, re-arm faults).
+After the walk, faults are disarmed (quiesce) and the harness drives
+every in-flight claim to its terminal state, then asserts the
+invariants the ISSUE names:
+
+1. every claim converged — prepared-and-ready or cleanly unallocated;
+2. no orphaned CDI spec files (specs on disk == completed claims);
+3. no leaked checkpoint entries (checkpoint == completed claims);
+4. the published ResourceSlice matches the healthy-chip device set;
+5. a final crash-restart recovers the same state (crash consistency);
+6. full teardown leaves zero residue.
+
+``python -m tpu_dra.simcluster.chaos --seeds 25`` runs the fixed seed
+matrix (hack/chaos.sh); violations exit non-zero.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import shutil
+import statistics
+import tempfile
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from tpu_dra.api.types import API_VERSION, TPU_DRIVER_NAME
+from tpu_dra.cdi.handler import CDIHandler
+from tpu_dra.infra import featuregates
+from tpu_dra.infra.faults import (
+    FAULTS, EveryNth, OneShot, Probabilistic, Schedule,
+)
+from tpu_dra.k8s import (
+    FakeCluster, PODS, RESOURCECLAIMS, RESOURCESLICES, RetryingApiClient,
+)
+from tpu_dra.k8s.informer import Informer
+from tpu_dra.kubeletplugin.server import Claim
+from tpu_dra.native.tpuinfo import FakeBackend, HealthEvent, default_fake_chips
+from tpu_dra.tpuplugin.checkpoint import PREPARE_COMPLETED, CheckpointManager
+from tpu_dra.tpuplugin.device_state import DeviceState
+from tpu_dra.tpuplugin.driver import TpuDriver
+from tpu_dra.tpuplugin.health import RECOVERED_KIND
+from tpu_dra.tpuplugin.sharing import TimeSlicingManager
+
+# Sites the random walk may arm. health.chip_event is injected directly
+# (driver callback) for determinism; cddaemon.spawn belongs to the CD
+# daemon stack, exercised by its own tests.
+CHAOS_SITES = ("k8s.api.request", "cdi.claim_write", "checkpoint.store",
+               "checkpoint.corrupt")
+
+TS_CONFIG = [{"source": "FromClaim", "requests": [], "opaque": {
+    "driver": TPU_DRIVER_NAME, "parameters": {
+        "apiVersion": API_VERSION, "kind": "TpuConfig",
+        "sharing": {"strategy": "TimeSlicing",
+                    "timeSlicingConfig": {"interval": "Short"}}}}}]
+
+
+@dataclass
+class ChaosReport:
+    seed: int
+    events: int = 0
+    prepares: int = 0
+    unprepares: int = 0
+    crashes: int = 0
+    health_events: int = 0
+    failed_attempts: int = 0          # operations a fault made fail
+    injected: Dict[str, int] = field(default_factory=dict)
+    violations: List[str] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def to_dict(self) -> Dict:
+        return {"seed": self.seed, "events": self.events,
+                "prepares": self.prepares, "unprepares": self.unprepares,
+                "crashes": self.crashes, "health_events": self.health_events,
+                "failed_attempts": self.failed_attempts,
+                "injected": dict(self.injected),
+                "violations": list(self.violations)}
+
+
+def _corrupt_one_slot(rng: random.Random):
+    """Armed action for checkpoint.corrupt: tear ONE of the slots the
+    store just wrote (a real torn write hits the slot in flight)."""
+    def action(paths=()):
+        if not paths:
+            return
+        path = rng.choice(list(paths))
+        try:
+            with open(path, "r+b") as f:
+                f.seek(0)
+                f.write(b'{"torn":')  # valid JSON prefix, broken envelope
+        except OSError:
+            pass
+    return action
+
+
+class ChaosHarness:
+    """One seeded schedule: a real node-driver stack + the random walk."""
+
+    MAX_QUIESCE_RETRIES = 30
+
+    def __init__(self, seed: int, *, chips: int = 4,
+                 generation: str = "v5p"):
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self.report = ChaosReport(seed=seed)
+        # Gates for the whole harness lifetime: time-slicing configs are
+        # part of the random claim mix; the health monitor THREAD is off
+        # because the walk injects events synchronously at the driver
+        # callback (deterministic, and no 0.5s monitor join per crash —
+        # the monitor's own pipeline has dedicated tests).
+        self._gates = featuregates.Features.overrides_snapshot()
+        featuregates.Features.set_from_string(
+            "TimeSlicingSettings=true,TPUDeviceHealthCheck=false")
+        self.tmp = tempfile.mkdtemp(prefix=f"tpu-dra-chaos-{seed}-")
+        self.cluster = FakeCluster()
+        # Fast backoff: chaos turns the crank; wall-clock realism is the
+        # schedule's job, not the sleep's.
+        self.client = RetryingApiClient(
+            self.cluster, max_attempts=4, base_delay=0.001,
+            max_delay=0.01, rng=random.Random(seed ^ 0x5EED))
+        self.backend = FakeBackend(
+            default_fake_chips(chips, generation, slice_id="chaos"))
+        self.n_chips = chips
+        self.driver: Optional[TpuDriver] = None
+        self.state: Optional[DeviceState] = None
+        self.cdi: Optional[CDIHandler] = None
+        # uid -> claim object, by expected terminal state
+        self.prepared: Dict[str, Dict] = {}   # last prepare succeeded
+        self.pending: Dict[str, Dict] = {}    # attempted, not yet ready
+        self._build_stack()
+
+    # -- stack lifecycle ----------------------------------------------------
+
+    def _build_stack(self) -> None:
+        self.cdi = CDIHandler(os.path.join(self.tmp, "cdi"),
+                              driver_root=os.path.join(self.tmp, "drv"))
+        self.state = DeviceState(
+            backend=self.backend, cdi=self.cdi,
+            checkpoints=CheckpointManager(os.path.join(self.tmp, "plugin")),
+            driver_name=TPU_DRIVER_NAME, node_name="chaos-node",
+            ts_manager=TimeSlicingManager(self.backend))
+        self.driver = TpuDriver(
+            state=self.state, client=self.client,
+            driver_name=TPU_DRIVER_NAME, node_name="chaos-node",
+            plugin_dir=os.path.join(self.tmp, "plugin"),
+            registry_dir=os.path.join(self.tmp, "reg"))
+        # publish_wait=0: under an armed API fault the initial publish
+        # retries in the background; the walk must not block on it.
+        self.driver.start(publish_wait=0)
+
+    def _teardown_stack(self) -> None:
+        """SIGKILL analog: stop threads/sockets and release fds, but do
+        NOT unprepare or write any terminal state — recovery must come
+        from what is on disk."""
+        if self.driver is not None:
+            self.driver.shutdown()
+            self.driver = None
+            self.state = None
+
+    def crash_restart(self, max_attempts: int = 25) -> None:
+        """Crash the plugin and bring it back up. Startup itself can hit
+        armed faults (checkpoint load/store, CDI write) — a crash-looping
+        pod retries until the fault clears, so does this. A schedule that
+        fires on EVERY attempt (a hard outage) would crash-loop forever;
+        after max_attempts the outage is declared over (faults disarmed,
+        harvesting their counts) and the plugin comes up — what an
+        operator fixing the node achieves."""
+        self._teardown_stack()
+        self.report.crashes += 1
+        for _ in range(max_attempts):
+            try:
+                self._build_stack()
+                return
+            except Exception:  # noqa: BLE001 — crash loop, retry
+                time.sleep(0.002)
+        self._harvest_faults()
+        FAULTS.reset()
+        self._build_stack()
+
+    def close(self) -> None:
+        self._teardown_stack()
+        featuregates.Features.restore_overrides(self._gates)
+        shutil.rmtree(self.tmp, ignore_errors=True)
+
+    # -- claim plumbing -----------------------------------------------------
+
+    def _used_chips(self) -> set:
+        used = set()
+        for obj in list(self.prepared.values()) + list(self.pending.values()):
+            used.update(obj["_chaos_chips"])
+        return used
+
+    def make_claim(self, chip_indices: List[int],
+                   devices: Optional[List[str]] = None,
+                   configs: Optional[List[Dict]] = None) -> Dict:
+        devices = devices or [f"chip-{i}" for i in chip_indices]
+        obj = self.cluster.create(RESOURCECLAIMS, {
+            "apiVersion": "resource.k8s.io/v1", "kind": "ResourceClaim",
+            "metadata": {"name": f"chaos-{self.seed}-"
+                                 f"{self.rng.randrange(16**8):08x}",
+                         "namespace": "default"},
+            "spec": {"devices": {"requests": [{"name": "tpu"}]}},
+            "status": {"allocation": {"devices": {"results": [
+                {"request": "tpu", "driver": TPU_DRIVER_NAME,
+                 "pool": "chaos-node", "device": d} for d in devices],
+                "config": configs or []}}},
+        })
+        obj["_chaos_chips"] = set(chip_indices)
+        return obj
+
+    def attempt_prepare(self, obj: Dict) -> Optional[str]:
+        """One kubelet-style NodePrepareResources attempt; returns the
+        error string (fault surfaced) or None (ready)."""
+        claim = Claim(uid=obj["metadata"]["uid"],
+                      name=obj["metadata"]["name"],
+                      namespace=obj["metadata"]["namespace"])
+        self.report.prepares += 1
+        try:
+            res = self.driver.prepare_claims([claim])[claim.uid]
+        except Exception as e:  # noqa: BLE001 — fault escaped as exception
+            return str(e)
+        return res.error or None
+
+    def attempt_unprepare(self, obj: Dict) -> Optional[str]:
+        claim = Claim(uid=obj["metadata"]["uid"],
+                      name=obj["metadata"]["name"],
+                      namespace=obj["metadata"]["namespace"])
+        self.report.unprepares += 1
+        try:
+            err = self.driver.unprepare_claims([claim])[claim.uid]
+        except Exception as e:  # noqa: BLE001
+            return str(e)
+        return err or None
+
+    # -- the random walk ----------------------------------------------------
+
+    def _random_schedule(self) -> Schedule:
+        kind = self.rng.choice(("nth", "prob", "oneshot"))
+        if kind == "nth":
+            return EveryNth(self.rng.randint(1, 4))
+        if kind == "prob":
+            return Probabilistic(self.rng.uniform(0.2, 0.7),
+                                 random.Random(self.rng.randrange(1 << 30)))
+        return OneShot(after=self.rng.randint(0, 3))
+
+    def _harvest_faults(self) -> None:
+        """Fold fired counters into the report (and zero them) before
+        anything disarms or re-arms sites."""
+        for site, fired in FAULTS.take_counts().items():
+            self.report.injected[site] = (
+                self.report.injected.get(site, 0) + fired)
+
+    def _op_rearm(self) -> None:
+        self._harvest_faults()
+        site = self.rng.choice(CHAOS_SITES)
+        if self.rng.random() < 0.3:
+            FAULTS.disarm(site)
+            return
+        action = (_corrupt_one_slot(self.rng)
+                  if site == "checkpoint.corrupt" else None)
+        FAULTS.arm(site, self._random_schedule(), action=action)
+
+    def _op_prepare_new(self) -> None:
+        free = sorted(set(range(self.n_chips)) - self._used_chips())
+        if not free:
+            return
+        n = self.rng.randint(1, min(2, len(free)))
+        picked = self.rng.sample(free, n)
+        devices = configs = None
+        roll = self.rng.random()
+        if roll < 0.2 and n == 1:
+            # Subslice claim: any allocatable device backed by the chip.
+            names = [name for name, d in self.state.allocatable.items()
+                     if d.chip.index == picked[0]]
+            devices = [self.rng.choice(names)]
+        elif roll < 0.4:
+            configs = TS_CONFIG
+        obj = self.make_claim(picked, devices=devices, configs=configs)
+        err = self.attempt_prepare(obj)
+        uid = obj["metadata"]["uid"]
+        if err is None:
+            self.prepared[uid] = obj
+        else:
+            self.report.failed_attempts += 1
+            self.pending[uid] = obj
+
+    def _op_retry_pending(self) -> None:
+        if not self.pending:
+            return
+        uid = self.rng.choice(sorted(self.pending))
+        obj = self.pending[uid]
+        if obj.get("_chaos_unprepare"):
+            # Mid-unprepare claim: kubelet never re-prepares a claim it
+            # decided to release; keep driving it toward unallocated.
+            if self.attempt_unprepare(obj) is None:
+                self.pending.pop(uid)
+            else:
+                self.report.failed_attempts += 1
+            return
+        err = self.attempt_prepare(obj)
+        if err is None:
+            self.prepared[uid] = self.pending.pop(uid)
+        else:
+            self.report.failed_attempts += 1
+
+    def _op_unprepare(self) -> None:
+        pool = sorted(self.prepared) + sorted(self.pending)
+        if not pool:
+            return
+        uid = self.rng.choice(pool)
+        obj = self.prepared.get(uid) or self.pending.get(uid)
+        err = self.attempt_unprepare(obj)
+        if err is None:
+            self.prepared.pop(uid, None)
+            self.pending.pop(uid, None)
+        else:
+            self.report.failed_attempts += 1
+            # Not cleanly unallocated yet: it must converge at quiesce.
+            self.pending.setdefault(uid, self.prepared.pop(uid, obj))
+            obj["_chaos_unprepare"] = True
+
+    def _op_health(self) -> None:
+        self.report.health_events += 1
+        chip = self.rng.randrange(self.n_chips)
+        if self.rng.random() < 0.4:
+            event = HealthEvent(chip_index=chip, code=0,
+                                kind=RECOVERED_KIND)
+        else:
+            event = HealthEvent(chip_index=chip,
+                                code=self.rng.randint(100, 120),
+                                kind="hbm_fault")
+        self.driver._on_unhealthy_event(event)
+
+    def run(self, n_events: int = 40) -> ChaosReport:
+        ops = [(self._op_prepare_new, 4), (self._op_retry_pending, 3),
+               (self._op_unprepare, 2), (self._op_rearm, 2),
+               (self.crash_restart, 1), (self._op_health, 1)]
+        weighted = [op for op, w in ops for _ in range(w)]
+        try:
+            for _ in range(n_events):
+                self.report.events += 1
+                self.rng.choice(weighted)()
+            self.quiesce_and_verify()
+        finally:
+            self._harvest_faults()
+            FAULTS.reset()
+            self.close()
+        return self.report
+
+    # -- quiesce + invariants -----------------------------------------------
+
+    def quiesce_and_verify(self) -> None:
+        self._harvest_faults()
+        FAULTS.reset()
+        v = self.report.violations
+
+        # 1. Convergence: drive every in-flight claim to its terminal
+        # state — the retry loop kubelet would run, minus the waiting.
+        for uid in sorted(self.pending):
+            obj = self.pending.pop(uid)
+            to_unallocated = obj.get("_chaos_unprepare", False)
+            err = last = None
+            for _ in range(self.MAX_QUIESCE_RETRIES):
+                last = (self.attempt_unprepare(obj) if to_unallocated
+                        else self.attempt_prepare(obj))
+                if last is None:
+                    break
+            else:
+                err = last
+            if err is not None:
+                v.append(f"claim {uid} did not converge to "
+                         f"{'unallocated' if to_unallocated else 'ready'} "
+                         f"after faults cleared: {err}")
+            elif not to_unallocated:
+                self.prepared[uid] = obj
+
+        # 2. Crash consistency: the terminal state must survive an
+        # unclean restart (load_or_init + orphan GC path).
+        self.crash_restart()
+
+        snap = self.state.checkpoint_snapshot()
+        want = set(self.prepared)
+
+        # 3. No leaked checkpoint entries / lost claims.
+        got = set(snap.claims)
+        if got != want:
+            v.append(f"checkpoint claims {sorted(got)} != expected "
+                     f"prepared {sorted(want)}")
+        for uid, pc in snap.claims.items():
+            if pc.state != PREPARE_COMPLETED:
+                v.append(f"claim {uid} left in state {pc.state} "
+                         "after quiesce")
+
+        # 4. No orphaned CDI spec files.
+        specs = set(self.cdi.list_claim_uids())
+        if specs != want:
+            v.append(f"CDI claim specs {sorted(specs)} != expected "
+                     f"{sorted(want)}")
+
+        # 5. Idempotent re-prepare returns the same devices.
+        for uid, obj in sorted(self.prepared.items()):
+            err = self.attempt_prepare(obj)
+            if err is not None:
+                v.append(f"re-prepare of converged claim {uid} "
+                         f"errored: {err}")
+
+        # 6. ResourceSlice matches the healthy-chip device set.
+        try:
+            self.driver.publish_resources()
+            slices = self.cluster.list(RESOURCESLICES)
+            published = {d["name"] for s in slices
+                         for d in s["spec"].get("devices", [])}
+            healthy = {d["name"] for d in self.state.healthy_devices()}
+            if published != healthy:
+                v.append(f"ResourceSlice devices {sorted(published)} != "
+                         f"healthy set {sorted(healthy)}")
+        except Exception as e:  # noqa: BLE001
+            v.append(f"publish after quiesce failed: {e}")
+
+        # 7. Full teardown: everything unprepares, zero residue.
+        for uid, obj in sorted(self.prepared.items()):
+            err = self.attempt_unprepare(obj)
+            if err is not None:
+                v.append(f"final unprepare of {uid} failed: {err}")
+        self.prepared.clear()
+        if self.cdi.list_claim_uids():
+            v.append("CDI specs left after full teardown: "
+                     f"{self.cdi.list_claim_uids()}")
+        if self.state.prepared_claim_uids():
+            v.append("checkpoint entries left after full teardown: "
+                     f"{self.state.prepared_claim_uids()}")
+
+
+def run_schedule(seed: int, n_events: int = 40, chips: int = 4) -> ChaosReport:
+    """One seeded fault schedule to quiesce; the chaos tier's unit."""
+    return ChaosHarness(seed, chips=chips).run(n_events)
+
+
+def run_matrix(seeds: List[int], n_events: int = 40) -> Dict:
+    reports = [run_schedule(seed, n_events) for seed in seeds]
+    injected: Dict[str, int] = {}
+    for r in reports:
+        for site, n in r.injected.items():
+            injected[site] = injected.get(site, 0) + n
+    return {
+        "schedules": len(reports),
+        "events": sum(r.events for r in reports),
+        "prepares": sum(r.prepares for r in reports),
+        "failed_attempts": sum(r.failed_attempts for r in reports),
+        "crashes": sum(r.crashes for r in reports),
+        "injected": injected,
+        "violations": [f"seed {r.seed}: {msg}"
+                       for r in reports for msg in r.violations],
+    }
+
+
+# ---------------------------------------------------------------------------
+# Dropped-watch + API-flake scenario
+# ---------------------------------------------------------------------------
+
+def run_watch_flake_scenario(seed: int = 0, n_objects: int = 30,
+                             timeout: float = 10.0) -> List[str]:
+    """An informer over the retrying client while the watch stream keeps
+    dying and API requests flake: after faults clear, the cache must
+    match cluster truth with NO manual relist — the resilient watch's
+    RV-resume and the informer's 410-relist path do all the recovery.
+    Returns violations (empty = recovered)."""
+    violations: List[str] = []
+    rng = random.Random(seed)
+    cluster = FakeCluster()
+    cluster.EVENT_LOG_CAP = 16  # tight history: dropped resumes hit 410s
+    client = RetryingApiClient(cluster, max_attempts=4, base_delay=0.001,
+                               max_delay=0.01,
+                               rng=random.Random(seed ^ 0xF1A3))
+    inf = Informer(client, PODS, namespace="default")
+    inf.RELIST_BACKOFF_BASE = 0.01  # keep the chaos tier fast
+    live: set = set()
+    with FAULTS.armed("k8s.watch.drop", Probabilistic(0.2, rng)), \
+         FAULTS.armed("k8s.api.request",
+                      Probabilistic(0.25, random.Random(seed + 7))):
+        inf.start()
+        inf.wait_for_sync(timeout)
+        for i in range(n_objects):
+            name = f"p-{i}"
+            cluster.create(PODS, {"apiVersion": "v1", "kind": "Pod",
+                                  "metadata": {"name": name,
+                                               "namespace": "default"}})
+            live.add(name)
+            if live and rng.random() < 0.3:
+                victim = rng.choice(sorted(live))
+                cluster.delete(PODS, victim, "default")
+                live.discard(victim)
+    # Quiesce (context managers disarmed the sites): cache must converge.
+    try:
+        deadline = time.monotonic() + timeout
+        truth = {o["metadata"]["name"]
+                 for o in cluster.list(PODS, namespace="default")}
+        assert truth == live
+        while time.monotonic() < deadline:
+            cached = {o["metadata"]["name"] for o in inf.lister.list()}
+            if cached == truth:
+                break
+            time.sleep(0.02)
+        else:
+            cached = {o["metadata"]["name"] for o in inf.lister.list()}
+            violations.append(
+                f"informer cache did not converge: cached-truth="
+                f"{sorted(cached - truth)} truth-cached="
+                f"{sorted(truth - cached)}")
+    finally:
+        inf.stop()
+    return violations
+
+
+# ---------------------------------------------------------------------------
+# Crash-recovery latency probe (bench.py chaos_recovery_p50_ms)
+# ---------------------------------------------------------------------------
+
+def measure_daemon_crash_recovery(n: int = 7, seed: int = 1234) -> Dict:
+    """Median wall ms from an injected plugin-daemon crash to the
+    affected claim prepared (ready) again: unclean teardown, full stack
+    rebuild from disk (checkpoint load + orphan GC + standard CDI spec +
+    DRA server + initial publish), then the idempotent re-prepare that
+    hands kubelet the claim's devices back."""
+    h = ChaosHarness(seed)
+    samples: List[float] = []
+    try:
+        obj = h.make_claim(list(range(h.n_chips)))
+        err = h.attempt_prepare(obj)
+        if err is not None:
+            raise RuntimeError(f"baseline prepare failed: {err}")
+        for _ in range(n):
+            t0 = time.perf_counter()
+            h.crash_restart()
+            err = h.attempt_prepare(obj)
+            if err is not None:
+                raise RuntimeError(f"post-crash prepare failed: {err}")
+            samples.append((time.perf_counter() - t0) * 1e3)
+    finally:
+        h.close()
+    samples.sort()
+    return {
+        "chaos_recovery_p50_ms": round(statistics.median(samples), 3),
+        "chaos_recovery_p95_ms": round(
+            samples[int(0.95 * (len(samples) - 1))], 3),
+        "chaos_recovery_crashes": len(samples),
+    }
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="seeded chaos schedule matrix (hack/chaos.sh)")
+    ap.add_argument("--seeds", type=int, default=25,
+                    help="number of schedules")
+    ap.add_argument("--seed-start", type=int, default=0)
+    ap.add_argument("--events", type=int, default=40,
+                    help="lifecycle events per schedule")
+    args = ap.parse_args(argv)
+
+    summary = run_matrix(
+        list(range(args.seed_start, args.seed_start + args.seeds)),
+        n_events=args.events)
+    summary["watch_flake_violations"] = run_watch_flake_scenario(
+        seed=args.seed_start)
+    print(json.dumps(summary, indent=2))
+    return 1 if (summary["violations"]
+                 or summary["watch_flake_violations"]) else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

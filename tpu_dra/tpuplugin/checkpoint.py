@@ -22,6 +22,8 @@ import zlib
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from tpu_dra.infra.faults import FAULTS
+
 PREPARE_STARTED = "PrepareStarted"
 PREPARE_COMPLETED = "PrepareCompleted"
 
@@ -210,6 +212,10 @@ class CheckpointManager:
         """Persist the full state. ``intent=True`` marks a transient
         mid-operation record (side slot only, one write); terminal stores
         write side-then-primary (see class doc for the crash analysis)."""
+        # Injection site: store failure (ENOSPC, fsync EIO) — prepare and
+        # unprepare must stay retryable/idempotent when the state machine
+        # cannot persist.
+        FAULTS.check("checkpoint.store", intent=intent)
         doc = cp.to_v1_doc() if version == "v1" else cp.to_v2_doc()
         payload = json.dumps(doc, sort_keys=True, separators=(",", ":"))
         self._seq += 1
@@ -244,6 +250,11 @@ class CheckpointManager:
             # full state for recovery.
             self._write_slot(self._path, envelope)
             self._slot_seqs[self._path] = self._seq
+        # Injection site for torn writes: the armed action scribbles on
+        # the just-written slot files; the next load must recover from
+        # the surviving slots (crash-consistency chaos).
+        FAULTS.check("checkpoint.corrupt",
+                     paths=(side,) if intent else (side, self._path))
 
     def _load_slot(self, path: str):
         """-> (seq | None-for-legacy, doc) or None (absent/empty) or

@@ -218,28 +218,75 @@ class DeviceState:
                 # delete reconcile without a record — one device sync
                 # instead of two on the claim-to-ready hot path.
                 t0 = time.perf_counter()
-                self._ckpt_mgr.store(self._checkpoint, intent=True)
+                try:
+                    self._ckpt_mgr.store(self._checkpoint, intent=True)
+                except Exception as e:  # noqa: BLE001 — no side effects
+                    # applied yet; unwind the record instead of leaking
+                    # a raw exception through the DRA server.
+                    return self._fail_prepare(uid, f"intent store: {e}")
                 timings["checkpoint_start"] = time.perf_counter() - t0
 
             try:
                 self._apply_devices(claim, config_results, timings)
             except Exception as e:  # noqa: BLE001 — report as claim error
-                # Leave PrepareStarted with the records persisted, so a
-                # later unprepare (or GC of an abandoned claim) can roll
-                # back the side effects — exclusive mode, multiprocess
-                # daemons, time slices.
-                self._ckpt_mgr.store(self._checkpoint)
-                return PrepareResult(error=f"prepare devices: {e}")
+                return self._fail_prepare(uid, f"prepare devices: {e}")
 
             self._checkpoint.claims[uid].state = PREPARE_COMPLETED
             t0 = time.perf_counter()
-            self._ckpt_mgr.store(self._checkpoint)
+            try:
+                self._ckpt_mgr.store(self._checkpoint)
+            except Exception as e:  # noqa: BLE001 — terminal store failed:
+                # the claim is fully applied but not durably completed; a
+                # crash now would replay as PrepareStarted. Unwind so the
+                # kubelet retry starts from a clean slate instead of
+                # half-committed state.
+                return self._fail_prepare(uid, f"checkpoint store: {e}")
             timings["checkpoint_final"] = time.perf_counter() - t0
             timings["total"] = time.perf_counter() - t_total
             self.last_prepare_breakdown = {
                 k: v * 1e3 for k, v in timings.items()}
             return PrepareResult(devices=[
                 _prepared_device_from_record(r) for r in records])
+
+    def _fail_prepare(self, uid: str, err: str) -> PrepareResult:
+        """Transactional unwind of a failed prepare (caller holds _lock):
+        reverse the side effects the persisted records name (exclusive
+        mode, multiprocess daemons, time slices, VFIO rebinds), delete
+        the claim CDI spec, and erase the checkpoint entry — so the
+        kubelet's retry re-runs prepare from scratch (idempotent) and an
+        abandoned claim is *cleanly unallocated*, not half-held.
+
+        If the unwind itself fails (a chip wedged mid-rebind, checkpoint
+        store refused), fall back to the pre-transactional behavior:
+        keep the PrepareStarted record so a later unprepare — or the
+        next driver start — can finish the rollback. Never raises."""
+        prepared = self._checkpoint.claims.get(uid)
+        try:
+            if prepared is not None:
+                self._unprepare_devices(uid, prepared)
+            self._cdi.delete_claim_spec_file(uid)
+            del self._checkpoint.claims[uid]
+            self._ckpt_mgr.store(self._checkpoint)
+        except Exception as rollback_err:  # noqa: BLE001 — degrade to
+            # deferred rollback (unprepare/startup GC both handle
+            # PrepareStarted records); re-insert in case deletion
+            # happened before the store failed.
+            if prepared is not None:
+                prepared.state = PREPARE_STARTED
+                self._checkpoint.claims[uid] = prepared
+            try:
+                self._ckpt_mgr.store(self._checkpoint)
+            except Exception:  # noqa: BLE001 — the durable intent record
+                # (if this prepare was hazardous) still names the claim's
+                # chips for the next start's recovery.
+                log.warning("failed-prepare record store failed for %s",
+                            uid, exc_info=True)
+            log.warning("prepare rollback for %s incomplete (%s); claim "
+                        "left PrepareStarted for deferred unwind", uid,
+                        rollback_err)
+            return PrepareResult(
+                error=f"{err}; rollback deferred: {rollback_err}")
+        return PrepareResult(error=err)
 
     def _resolve_claim_configs(self, claim: Dict) -> List["_ConfigResult"]:
         """The pure phase of prepare: parse allocation results and resolve
@@ -566,7 +613,15 @@ class DeviceState:
                 return f"unprepare devices: {e}"
             self._cdi.delete_claim_spec_file(claim_uid)
             del self._checkpoint.claims[claim_uid]
-            self._ckpt_mgr.store(self._checkpoint)
+            try:
+                self._ckpt_mgr.store(self._checkpoint)
+            except Exception as e:  # noqa: BLE001 — reinsert: memory
+                # must not run ahead of disk. Without this, the retry
+                # takes the unknown-claim no-op path and reports success
+                # while the on-disk entry survives to resurrect at the
+                # next restart (found by the chaos harness, seed 5).
+                self._checkpoint.claims[claim_uid] = prepared
+                return f"unprepare checkpoint store: {e}"
             return None
 
     def _unprepare_devices(self, claim_uid: str, prepared: PreparedClaim) -> None:

@@ -13,10 +13,14 @@ Benign event codes are skipped by the same mechanism
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Callable, Iterable, Optional, Set
 
+from tpu_dra.infra.faults import FAULTS
 from tpu_dra.native.tpuinfo import HealthEvent, TpuInfoBackend
+
+log = logging.getLogger("tpu_dra.tpuplugin.health")
 
 # Benign/app-level event codes that must not yank a chip (the Xid skip-list
 # analog, device_health.go:320-342). Codes model: <100 = app/driver-level
@@ -45,6 +49,12 @@ class DeviceHealthMonitor:
         self._skip.update(additional_codes_to_ignore or [])
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # True when stop() timed out joining the monitor thread: the
+        # thread is wedged (a backend wait that never returns) and health
+        # events are no longer flowing. Owners (shutdown paths, tests)
+        # can assert on it; a silent return here previously made a dead
+        # health pipeline indistinguishable from a clean stop.
+        self.wedged = False
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self._run, daemon=True,
@@ -55,11 +65,22 @@ class DeviceHealthMonitor:
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=WAIT_TIMEOUT_S + 1)
+            if self._thread.is_alive():
+                self.wedged = True
+                log.error(
+                    "health monitor thread did not stop within %.1fs — "
+                    "wedged in the backend event wait; health events are "
+                    "NOT flowing (chips can die unnoticed until restart)",
+                    WAIT_TIMEOUT_S + 1)
 
     def _run(self) -> None:
         """The eventSet.Wait loop (device_health.go:146-204)."""
         while not self._stop.is_set():
-            event = self._backend.wait_health_event(WAIT_TIMEOUT_S)
+            # Injection site: chaos schedules mint synthetic events
+            # (arm with payload=HealthEvent(...)) without a backend that
+            # can produce them on demand.
+            event = (FAULTS.pull("health.chip_event")
+                     or self._backend.wait_health_event(WAIT_TIMEOUT_S))
             if event is None:
                 continue
             # The skip list exists to stop benign codes from YANKING

@@ -20,7 +20,7 @@ from tpu_dra.infra.flags import (
     setup_logging,
 )
 from tpu_dra.infra.metrics import MetricsServer
-from tpu_dra.k8s.client import HttpApiClient
+from tpu_dra.k8s.client import HttpApiClient, RetryingApiClient
 from tpu_dra.native.tpuinfo import get_backend
 from tpu_dra.tpuplugin.checkpoint import CheckpointManager
 from tpu_dra.tpuplugin.device_state import DeviceState
@@ -70,7 +70,9 @@ def main(argv=None) -> int:
     debug.start_debug_signal_handlers()
 
     backend = get_backend()
-    client = HttpApiClient(base_url=ns.kube_api_url)
+    # Transient API-server failures (rolling upgrade, LB blips)
+    # retry with jittered backoff instead of crash-looping the pod.
+    client = RetryingApiClient(HttpApiClient(base_url=ns.kube_api_url))
     cdi = CDIHandler(ns.cdi_root, driver_root=ns.driver_root)
     checkpoints = CheckpointManager(ns.plugin_dir)
 
