@@ -225,12 +225,13 @@ class _BenchDriver:
                       for i in range(n))
         return statistics.median(lats)
 
-    def batch_cycle(self, tag, n_claims):
+    def batch_cycle(self, tag, n_claims, breakdown=None):
         """One NodePrepareResources RPC carrying n_claims single-chip
         claims on DISTINCT chips (kubelet batches a pod's claims in one
         call; the scheduler never co-allocates one exclusive device to
         two claims, so n_claims must not exceed the chip count); returns
-        per-claim ms."""
+        per-claim ms. `breakdown` collects the batch pipeline's
+        per-phase ms (decode / apply / checkpoint_final / total)."""
         from tpu_dra.kubeletplugin.gen import dra_v1_pb2 as dra
         if n_claims > len(self.chips):
             raise ValueError(
@@ -248,6 +249,9 @@ class _BenchDriver:
         t0 = time.perf_counter()
         resp = self._prepare(req)
         lat = (time.perf_counter() - t0) * 1e3
+        if breakdown is not None:
+            for k, v in self.state.last_batch_breakdown.items():
+                breakdown.setdefault(k, []).append(v)
         try:
             for obj in objs:
                 uid = obj["metadata"]["uid"]
@@ -337,9 +341,11 @@ def bench_claim_to_ready(backend, n_cycles: int = 100, warmup: int = 15):
         n_batch_cycles = max(5, n_cycles // 5)
         one_chip = [f"chip-{chips[0]}"]
         p50_one = bd.config_p50("one", n_batch_cycles, devices=one_chip)
+        batch_breakdown: dict = {}
         if batch_n >= 2:
-            batch_lats = sorted(bd.batch_cycle(f"b{i}", batch_n)
-                                for i in range(n_batch_cycles))
+            batch_lats = sorted(
+                bd.batch_cycle(f"b{i}", batch_n, breakdown=batch_breakdown)
+                for i in range(n_batch_cycles))
             p50_batch = statistics.median(batch_lats)
         else:
             p50_batch = None
@@ -363,7 +369,7 @@ def bench_claim_to_ready(backend, n_cycles: int = 100, warmup: int = 15):
     out = {
         "claim_to_ready_p50_ms": p50,
         "claim_to_ready_p10_ms": round(_pctl(lat_ms, 0.10), 4),
-        "claim_to_ready_p95_ms": _pctl(lat_ms, 0.95),
+        "claim_to_ready_p95_ms": round(_pctl(lat_ms, 0.95), 4),
         "claim_to_ready_iqr_ms": round(
             _pctl(lat_ms, 0.75) - _pctl(lat_ms, 0.25), 4),
         "claim_to_ready_cycles": len(lat_ms),
@@ -381,6 +387,13 @@ def bench_claim_to_ready(backend, n_cycles: int = 100, warmup: int = 15):
                                         else None),
         "claim_to_ready_p50_batch_per_claim_ms": (
             round(p50_batch, 3) if p50_batch is not None else None),
+        # Same-backend amortization ratio (1chip / batch-per-claim, both
+        # measured on THIS driver): the honest gain number — when the
+        # batch key is later filled from the fake-v5p side phase, main()
+        # recomputes this against that phase's own 1chip baseline rather
+        # than comparing across backends.
+        "claim_to_ready_batch_amortization_x": (
+            round(p50_one / p50_batch, 2) if p50_batch else None),
         "n_chips": len(chips),
         "visible_chips": env.get("TPU_VISIBLE_CHIPS", ""),
     }
@@ -393,6 +406,14 @@ def bench_claim_to_ready(backend, n_cycles: int = 100, warmup: int = 15):
     # + (de)serialization. Together the breakdown sums to ~p50.
     for k, vals in sorted(phase_ms.items()):
         out[f"prepare_breakdown_{k}_ms"] = round(statistics.median(vals), 4)
+    # Batch-path attribution (the group-commit pipeline's own phases):
+    # decode / apply (parallel side effects) / checkpoint_final (the ONE
+    # terminal fdatasync for the whole batch) / total, batch-level ms.
+    for k, vals in sorted(batch_breakdown.items()):
+        if k == "n_claims":
+            continue  # reported as claim_to_ready_batch_claims
+        out[f"prepare_batch_breakdown_{k}_ms"] = round(
+            statistics.median(vals), 4)
     state_total = statistics.median(phase_ms.get("total", [0.0]))
     out["prepare_breakdown_driver_ms"] = round(
         max(srv_p50 - state_total, 0.0), 4)
@@ -466,14 +487,40 @@ def bench_fake_v5p_configs(n_cycles: int = 30, warmup: int = 5):
         p50_mp = bd.config_p50("mp", n_cycles, configs=mp_cfg,
                                breakdown=mp_breakdown)
         sharing_ms = statistics.median(mp_breakdown.get("sharing", [0.0]))
-        return {
+
+        # Batched prepare on the 4-chip fake inventory: exclusive claims
+        # need distinct chips, so single-chip hosts cannot form a batch
+        # and the main phase's batch metrics reported null all
+        # trajectory. Measured here every round (same disk, same CDI
+        # tmpfs as the main phase's fake driver), alongside a 1-claim
+        # p50 on the SAME driver so the amortization is an
+        # apples-to-apples delta. main() promotes these to the headline
+        # batch keys when the host inventory could not produce them.
+        p50_one = bd.config_p50("one", n_cycles,
+                                devices=[f"chip-{bd.chips[0]}"])
+        batch_breakdown: dict = {}
+        bd.batch_cycle("bwarm", 4)
+        batch_lats = sorted(
+            bd.batch_cycle(f"b{i}", 4, breakdown=batch_breakdown)
+            for i in range(n_cycles))
+        out = {
             "claim_to_ready_p50_subslice_fake_v5p_ms": round(p50_sub, 3),
             "claim_to_ready_p50_multiprocess_ms": round(p50_mp, 3),
             # The coordinator-Deployment interaction share of the mp p50
             # (create + AssertReady against the instant-ready fake): the
             # driver-only mp number is p50 minus this.
             "multiprocess_sharing_phase_ms": round(sharing_ms, 3),
+            "claim_to_ready_p50_1chip_fake_v5p_ms": round(p50_one, 3),
+            "claim_to_ready_p50_batch_per_claim_fake_v5p_ms": round(
+                statistics.median(batch_lats), 3),
+            "claim_to_ready_batch_claims_fake_v5p": 4,
         }
+        for k, vals in sorted(batch_breakdown.items()):
+            if k == "n_claims":
+                continue  # claim_to_ready_batch_claims_fake_v5p above
+            out[f"prepare_batch_breakdown_{k}_fake_v5p_ms"] = round(
+                statistics.median(vals), 4)
+        return out
     finally:
         featuregates.Features.restore_overrides(gates_before)
         if bd is not None:
@@ -721,6 +768,24 @@ def main():
             out["claim_to_ready_p50_subslice_ms"] = v5p[
                 "claim_to_ready_p50_subslice_fake_v5p_ms"]
             out["claim_to_ready_subslice_backend"] = "fake-v5p"
+        if out.get("claim_to_ready_p50_batch_per_claim_ms") is None and \
+                "claim_to_ready_p50_batch_per_claim_fake_v5p_ms" in v5p:
+            # Single-chip host: the batch number comes from the fake-v5p
+            # side phase so the group-commit amortization reports every
+            # round instead of null (it had been null all trajectory).
+            # The amortization ratio is recomputed against the SAME
+            # phase's 1chip baseline — the headline
+            # claim_to_ready_p50_1chip_ms stays a host-backend number,
+            # so dividing the two would compare different backends.
+            out["claim_to_ready_p50_batch_per_claim_ms"] = v5p[
+                "claim_to_ready_p50_batch_per_claim_fake_v5p_ms"]
+            out["claim_to_ready_batch_claims"] = v5p[
+                "claim_to_ready_batch_claims_fake_v5p"]
+            out["claim_to_ready_batch_backend"] = "fake-v5p"
+            out["claim_to_ready_batch_amortization_x"] = round(
+                v5p["claim_to_ready_p50_1chip_fake_v5p_ms"]
+                / v5p["claim_to_ready_p50_batch_per_claim_fake_v5p_ms"],
+                2)
     except Exception as e:  # noqa: BLE001 — side phase is best-effort
         out["fake_v5p_error"] = str(e)
     try:

@@ -1,0 +1,61 @@
+#!/usr/bin/env bash
+# Perf tier: the claim-to-ready hot path's regression tripwires (ISSUE 2):
+#
+#   hack/perf.sh [CYCLES]
+#
+# 1. The group-commit tripwire tests (tests/test_batch_prepare.py): a
+#    batched prepare/unprepare of N claims must land exactly ONE
+#    terminal checkpoint store / device sync (asserted against the
+#    CheckpointManager store counters) — N syncs means the group commit
+#    silently degraded back to per-claim commits.
+# 2. A quick claim-to-ready probe through the real gRPC path (single
+#    claim p50 + batched per-claim p50 on a fake 4-chip v5p inventory),
+#    printed as one JSON line for eyeballing against BENCH_r*.json.
+set -euo pipefail
+REPO_ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+CYCLES="${1:-${PERF_CYCLES:-30}}"
+
+echo ">> group-commit tripwire (one terminal sync per batch)"
+JAX_PLATFORMS=cpu python -m pytest "$REPO_ROOT/tests/test_batch_prepare.py" \
+  -q -p no:cacheprovider
+
+echo ">> claim-to-ready probe (${CYCLES} cycles, fake v5p 4-chip)"
+cd "$REPO_ROOT"
+JAX_PLATFORMS=cpu TPU_DRA_TPUINFO_BACKEND=fake python - "$CYCLES" <<'EOF'
+import json
+import statistics
+import sys
+
+from tpu_dra.native.tpuinfo import FakeBackend, default_fake_chips
+
+import bench
+
+n = int(sys.argv[1])
+bd = bench._BenchDriver(FakeBackend(default_fake_chips(4, "v5p")),
+                        prefix="tpu-dra-perf-")
+try:
+    for i in range(5):
+        bd.cycle(f"warm-{i}")
+    p50_one = bd.config_p50("one", n, devices=[f"chip-{bd.chips[0]}"])
+    breakdown = {}
+    bd.batch_cycle("bwarm", 4)
+    p50_batch = statistics.median(sorted(
+        bd.batch_cycle(f"b{i}", 4, breakdown=breakdown)
+        for i in range(n)))
+    out = {
+        "claim_to_ready_p50_1chip_ms": round(p50_one, 3),
+        "claim_to_ready_p50_batch_per_claim_ms": round(p50_batch, 3),
+        "batch_amortization_x": round(p50_one / p50_batch, 2),
+        "terminal_stores": bd.state._ckpt_mgr.terminal_stores,
+        "slot_syncs": bd.state._ckpt_mgr.slot_syncs,
+    }
+    for k, vals in sorted(breakdown.items()):
+        if k != "n_claims":
+            out[f"batch_{k}_ms"] = round(statistics.median(vals), 4)
+finally:
+    bd.close()
+print(json.dumps(out))
+if p50_batch >= p50_one:
+    sys.exit("REGRESSION: batched per-claim p50 not below single-claim p50")
+EOF
+echo ">> perf tier green"

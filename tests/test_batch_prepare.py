@@ -1,0 +1,292 @@
+"""Batched prepare/unprepare pipeline (ISSUE 2): one flock + concurrent
+claim fetch per NodePrepareResources RPC, group-commit checkpointing
+(N claims, ONE terminal fdatasync), disjoint-chip parallel apply, and
+per-claim error isolation (a mid-batch loser rolls back while its batch
+siblings commit durably).
+"""
+
+import uuid
+
+import pytest
+
+from tpu_dra.api.types import API_VERSION, TPU_DRIVER_NAME
+from tpu_dra.infra import featuregates
+from tpu_dra.infra.faults import FAULTS, Always, EveryNth
+from tpu_dra.k8s import DEPLOYMENTS, RESOURCECLAIMS
+from tpu_dra.kubeletplugin.gen import dra_v1_pb2 as dra
+from tpu_dra.tpuplugin.checkpoint import (
+    PREPARE_COMPLETED, CheckpointManager,
+)
+from tpu_dra.tpuplugin.device_state import DeviceState
+from tpu_dra.tpuplugin.driver import prepare_batch_size
+
+from test_e2e_prepare import harness, make_claim, opaque  # noqa: F401
+
+
+def batch_prepare(h, claim_objs):
+    """One NodePrepareResources RPC carrying every claim; returns the
+    per-claim response map."""
+    req = dra.NodePrepareResourcesRequest()
+    for obj in claim_objs:
+        c = req.claims.add()
+        c.uid = obj["metadata"]["uid"]
+        c.name = obj["metadata"]["name"]
+        c.namespace = obj["metadata"]["namespace"]
+    return h["prepare"](req).claims
+
+
+def batch_unprepare(h, claim_objs):
+    req = dra.NodeUnprepareResourcesRequest()
+    for obj in claim_objs:
+        c = req.claims.add()
+        c.uid = obj["metadata"]["uid"]
+        c.name = obj["metadata"]["name"]
+        c.namespace = obj["metadata"]["namespace"]
+    return h["unprepare"](req).claims
+
+
+def make_batch(h, n=4):
+    """n single-chip claims on distinct chips (the kubelet pod shape)."""
+    return [make_claim(h["cluster"], [f"chip-{i}"]) for i in range(n)]
+
+
+class TestBatchPrepare:
+    def test_batch_all_succeed(self, harness):  # noqa: F811
+        objs = make_batch(harness)
+        resp = batch_prepare(harness, objs)
+        for obj in objs:
+            uid = obj["metadata"]["uid"]
+            assert resp[uid].error == ""
+            assert len(resp[uid].devices) == 1
+        snap = harness["state"].checkpoint_snapshot()
+        for obj in objs:
+            assert snap.claims[obj["metadata"]["uid"]].state \
+                == PREPARE_COMPLETED
+        assert set(harness["cdi"].list_claim_uids()) \
+            == {o["metadata"]["uid"] for o in objs}
+
+    def test_batch_idempotent_replay(self, harness):  # noqa: F811
+        objs = make_batch(harness, 3)
+        first = batch_prepare(harness, objs)
+        second = batch_prepare(harness, objs)
+        for obj in objs:
+            uid = obj["metadata"]["uid"]
+            assert second[uid].error == ""
+            assert (first[uid].devices[0].cdi_device_ids
+                    == second[uid].devices[0].cdi_device_ids)
+
+    def test_duplicate_uid_in_one_rpc(self, harness):  # noqa: F811
+        obj = make_claim(harness["cluster"], ["chip-0"])
+        resp = batch_prepare(harness, [obj, obj])
+        assert resp[obj["metadata"]["uid"]].error == ""
+        # Exactly one prepared claim, one device in the response entry.
+        assert len(resp[obj["metadata"]["uid"]].devices) == 1
+        assert harness["state"].prepared_claim_uids() \
+            == [obj["metadata"]["uid"]]
+
+    def test_batch_size_histogram_observed(self, harness):  # noqa: F811
+        before = prepare_batch_size.count
+        batch_prepare(harness, make_batch(harness, 4))
+        assert prepare_batch_size.count == before + 1
+        assert prepare_batch_size.total >= 4
+
+
+class TestGroupCommit:
+    """The regression tripwire (hack/perf.sh): a batch of N claims lands
+    exactly ONE terminal checkpoint store / device sync — N syncs means
+    the group commit silently degraded to per-claim commits."""
+
+    def test_batch_prepare_one_terminal_sync(self, harness):  # noqa: F811
+        ckpt = harness["ckpt"]
+        objs = make_batch(harness, 4)
+        t0, s0 = ckpt.terminal_stores, ckpt.slot_syncs
+        resp = batch_prepare(harness, objs)
+        assert all(resp[o["metadata"]["uid"]].error == "" for o in objs)
+        # Default configs are non-hazardous: no intent store, so the
+        # whole 4-claim batch costs exactly 1 terminal store = 1 sync.
+        assert ckpt.terminal_stores - t0 == 1
+        assert ckpt.slot_syncs - s0 == 1
+
+    def test_batch_unprepare_one_terminal_sync(self, harness):  # noqa: F811
+        ckpt = harness["ckpt"]
+        objs = make_batch(harness, 4)
+        batch_prepare(harness, objs)
+        t0, s0 = ckpt.terminal_stores, ckpt.slot_syncs
+        resp = batch_unprepare(harness, objs)
+        for obj in objs:
+            assert resp[obj["metadata"]["uid"]].error == ""
+        assert ckpt.terminal_stores - t0 == 1
+        assert ckpt.slot_syncs - s0 == 1
+        assert harness["state"].prepared_claim_uids() == []
+
+    def test_hazardous_batch_one_intent_one_terminal(self, harness):  # noqa: F811
+        """Hazardous members share ONE durable intent store covering all
+        of them, then the batch's one terminal store: 2 syncs total for
+        the whole batch, not 2 per claim."""
+        featuregates.Features.set_from_string("MultiprocessSupport=true")
+        cluster = harness["cluster"]
+
+        def make_ready(verb, gvr, obj):
+            if verb == "create" and gvr is DEPLOYMENTS and obj:
+                obj.setdefault("status", {})["readyReplicas"] = 1
+            return obj
+
+        cluster.reactors.append(make_ready)
+        mp = opaque({"apiVersion": API_VERSION, "kind": "TpuConfig",
+                     "sharing": {"strategy": "Multiprocess",
+                                 "multiprocessConfig": {
+                                     "defaultHbmLimit": "8Gi",
+                                     "defaultActiveCoresPercentage": 50}}})
+        objs = [make_claim(cluster, [f"chip-{i}"], configs=[mp])
+                for i in range(3)]
+        ckpt = harness["ckpt"]
+        n0, s0 = ckpt.stores, ckpt.slot_syncs
+        resp = batch_prepare(harness, objs)
+        assert all(resp[o["metadata"]["uid"]].error == "" for o in objs)
+        assert ckpt.stores - n0 == 2      # one intent + one terminal
+        assert ckpt.slot_syncs - s0 == 2
+
+    def test_store_batch_refuses_inconsistent_commit(self, tmp_path):
+        """The group-commit seam's postcondition check: memory running
+        ahead of (or behind) disk is refused before anything durable."""
+        from tpu_dra.tpuplugin.checkpoint import Checkpoint, CheckpointError
+        mgr = CheckpointManager(str(tmp_path / "cp"))
+        cp = Checkpoint()
+        with pytest.raises(CheckpointError, match="missing"):
+            mgr.store_batch(cp, present=["ghost"])
+        from tpu_dra.tpuplugin.checkpoint import PreparedClaim
+        cp.claims["lingerer"] = PreparedClaim(uid="lingerer")
+        with pytest.raises(CheckpointError, match="lingering"):
+            mgr.store_batch(cp, absent=["lingerer"])
+        mgr.close()
+
+
+class TestMixedOutcomeBatch:
+    """ISSUE satellite: one claim in a 4-claim batch fails mid-apply →
+    the other three are prepared AND durable after a simulated
+    crash-restart; the loser is cleanly rolled back (no CDI spec, no
+    checkpoint entry); the per-claim gRPC error map names only the
+    loser. The failure enters through the batch path's own
+    fault-injection site (prepare.batch_apply)."""
+
+    def test_apply_loser_rolls_back_survivors_commit(self, harness):  # noqa: F811
+        objs = make_batch(harness, 4)
+        loser = objs[2]["metadata"]["uid"]
+        survivors = [o for o in objs if o["metadata"]["uid"] != loser]
+
+        def fail_loser(claim_uid=None, **_ctx):
+            if claim_uid == loser:
+                raise RuntimeError("injected mid-apply failure")
+
+        with FAULTS.armed("prepare.batch_apply", Always(),
+                          action=fail_loser):
+            resp = batch_prepare(harness, objs)
+        # The error map names only the loser.
+        assert "injected mid-apply failure" in resp[loser].error
+        for obj in survivors:
+            assert resp[obj["metadata"]["uid"]].error == ""
+            assert len(resp[obj["metadata"]["uid"]].devices) == 1
+        # Loser cleanly unallocated: no CDI spec, no checkpoint entry.
+        assert loser not in harness["cdi"].list_claim_uids()
+        assert loser not in harness["state"].prepared_claim_uids()
+        # Simulated crash-restart: rebuild DeviceState over the same
+        # checkpoint dir — the survivors' group commit must be durable.
+        state2 = DeviceState(
+            backend=harness["backend"], cdi=harness["cdi"],
+            checkpoints=harness["ckpt"], driver_name=TPU_DRIVER_NAME,
+            node_name="node-a")
+        try:
+            recovered = state2.checkpoint_snapshot()
+            assert set(recovered.claims) \
+                == {o["metadata"]["uid"] for o in survivors}
+            for obj in survivors:
+                assert recovered.claims[obj["metadata"]["uid"]].state \
+                    == PREPARE_COMPLETED
+        finally:
+            state2.close()
+        # With the fault gone, the loser's retry prepares from scratch.
+        resp2 = batch_prepare(harness, [objs[2]])
+        assert resp2[loser].error == ""
+
+    def test_fetch_404_isolates_to_claim(self, harness):  # noqa: F811
+        objs = make_batch(harness, 3)
+        ghost = objs[1]
+        harness["cluster"].delete(RESOURCECLAIMS,
+                                  ghost["metadata"]["name"], "default")
+        resp = batch_prepare(harness, objs)
+        assert "not found" in resp[ghost["metadata"]["uid"]].error
+        for obj in (objs[0], objs[2]):
+            assert resp[obj["metadata"]["uid"]].error == ""
+
+    def test_fetch_fault_site_isolates_to_claim(self, harness):  # noqa: F811
+        objs = make_batch(harness, 3)
+        loser = objs[0]["metadata"]["uid"]
+
+        def fail_loser(claim_uid=None, **_ctx):
+            if claim_uid == loser:
+                raise ConnectionError("injected fetch flake")
+
+        with FAULTS.armed("prepare.batch_fetch", Always(),
+                          action=fail_loser):
+            resp = batch_prepare(harness, objs)
+        assert "injected fetch flake" in resp[loser].error
+        for obj in objs[1:]:
+            assert resp[obj["metadata"]["uid"]].error == ""
+
+    def test_uid_mismatch_isolates_to_claim(self, harness):  # noqa: F811
+        objs = make_batch(harness, 2)
+        req = dra.NodePrepareResourcesRequest()
+        c = req.claims.add()
+        c.uid = "stale-uid"
+        c.name = objs[0]["metadata"]["name"]
+        c.namespace = "default"
+        c = req.claims.add()
+        c.uid = objs[1]["metadata"]["uid"]
+        c.name = objs[1]["metadata"]["name"]
+        c.namespace = "default"
+        resp = harness["prepare"](req).claims
+        assert "UID mismatch" in resp["stale-uid"].error
+        assert resp[objs[1]["metadata"]["uid"]].error == ""
+
+
+class TestBatchUnprepareStoreFailure:
+    def test_store_failure_reinserts_every_member(self, harness):  # noqa: F811
+        """A failed group-committed unprepare store must leave every
+        removed entry reinserted (memory never ahead of disk) and every
+        member's error reported; the retry converges once the fault
+        clears."""
+        objs = make_batch(harness, 3)
+        batch_prepare(harness, objs)
+        uids = {o["metadata"]["uid"] for o in objs}
+        with FAULTS.armed("checkpoint.store", EveryNth(1)):
+            resp = batch_unprepare(harness, objs)
+        for uid in uids:
+            assert "checkpoint store" in resp[uid].error
+        assert set(harness["state"].prepared_claim_uids()) == uids
+        resp2 = batch_unprepare(harness, objs)
+        for uid in uids:
+            assert resp2[uid].error == ""
+        assert harness["state"].prepared_claim_uids() == []
+
+
+class TestBatchBreakdown:
+    def test_batch_breakdown_recorded(self, harness):  # noqa: F811
+        """A fully-successful batch records the pipeline's phase ms
+        (the bench's prepare_batch_breakdown_* source)."""
+        objs = make_batch(harness, 4)
+        resp = batch_prepare(harness, objs)
+        assert all(resp[o["metadata"]["uid"]].error == "" for o in objs)
+        bd = harness["state"].last_batch_breakdown
+        assert bd["n_claims"] == 4.0
+        for phase in ("decode", "apply", "checkpoint_final", "total"):
+            assert 0 <= bd[phase] <= bd["total"] + 1e-6, (phase, bd)
+
+    def test_single_claim_breakdown_preserved(self, harness):  # noqa: F811
+        """The historical single-prepare breakdown keys survive the
+        batch refactor (bench prepare_breakdown_* compatibility)."""
+        obj = make_claim(harness["cluster"], ["chip-1"])
+        assert batch_prepare(harness, [obj])[
+            obj["metadata"]["uid"]].error == ""
+        assert set(harness["state"].last_prepare_breakdown) == {
+            "decode", "sharing", "guards", "cdi_write",
+            "checkpoint_final", "total"}

@@ -112,6 +112,30 @@ class TestPrepareRollback:
             FAULTS.reset()
             h.close()
 
+    def test_batch_apply_fault_mixed_outcome_converges(self):
+        """The batch path's own injection site: every other member of a
+        multi-claim prepare RPC fails mid-apply. Survivors must be
+        prepared and durable, losers cleanly rolled back, and the whole
+        set must converge once the fault clears — the group-commit
+        analog of the single-claim rollback contract."""
+        h = self._harness()
+        try:
+            with FAULTS.armed("prepare.batch_apply", EveryNth(2)):
+                for _ in range(4):
+                    h._op_prepare_batch()
+            assert h.report.batches > 0
+            # Losers landed in pending; drive them to ready.
+            for uid in sorted(h.pending):
+                obj = h.pending.pop(uid)
+                assert h.attempt_prepare(obj) is None
+                h.prepared[uid] = obj
+            # Every claim's spec + checkpoint entry present exactly once.
+            assert set(h.cdi.list_claim_uids()) == set(h.prepared)
+            assert set(h.state.prepared_claim_uids()) == set(h.prepared)
+        finally:
+            FAULTS.reset()
+            h.close()
+
     def test_torn_checkpoint_slot_recovers_on_restart(self):
         """checkpoint.corrupt tears one slot per store; load() must
         recover the full claim state from the surviving slots."""

@@ -123,6 +123,14 @@ SITES: Dict[str, str] = {
     "cdi.claim_write":
         "per-claim CDI spec write fails; threatens: orphaned spec files, "
         "claims stuck half-prepared",
+    "prepare.batch_fetch":
+        "per-claim ResourceClaim fetch in the batch fan-out fails; "
+        "threatens: per-claim error isolation (one 404/flake must not "
+        "fail the rest of the NodePrepareResources batch)",
+    "prepare.batch_apply":
+        "per-claim side-effect application in the batch path fails "
+        "mid-batch; threatens: group-commit atomicity (survivors must "
+        "commit durably, the loser must roll back cleanly)",
     "checkpoint.store":
         "checkpoint store fails; threatens: claim state-machine "
         "durability, prepare idempotency",

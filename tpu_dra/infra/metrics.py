@@ -29,6 +29,13 @@ class _Metric:
     def _key(self, labels: Optional[Dict[str, str]]):
         return tuple(sorted((labels or {}).items()))
 
+    def value(self, labels: Optional[Dict[str, str]] = None) -> float:
+        """Current scalar for one label set (0.0 when never touched) —
+        the programmatic read seam tests and the bench use instead of
+        scraping the text exposition."""
+        with self._lock:
+            return self._values.get(self._key(labels), 0.0)
+
     def expose(self) -> List[str]:
         with self._lock:
             lines = [f"# HELP {self.name} {self.help}",
@@ -83,6 +90,18 @@ class Histogram(_Metric):
                     self._counts[i] += 1
                     return
             self._counts[-1] += 1
+
+    @property
+    def count(self) -> int:
+        """Observations so far (the _count series, programmatically)."""
+        with self._lock:
+            return self._n
+
+    @property
+    def total(self) -> float:
+        """Sum of observed values (the _sum series, programmatically)."""
+        with self._lock:
+            return self._sum
 
     def expose(self) -> List[str]:
         with self._lock:

@@ -447,6 +447,10 @@ class RetryingApiClient(ApiClient):
         self._max_delay = max_delay
         self._jitter = jitter
         self._rng = rng or random.Random()
+        # The batched prepare path fans GETs out from pool threads, so
+        # verbs (and their backoff jitter) run concurrently; Random's
+        # Mersenne state is not thread-safe, so draws are serialized.
+        self._rng_lock = threading.Lock()
         self._sleep = sleep
 
     @property
@@ -455,7 +459,9 @@ class RetryingApiClient(ApiClient):
 
     def _backoff(self, attempt: int) -> float:
         d = min(self._base * (2 ** attempt), self._max_delay)
-        return max(0.0, d * (1.0 + self._jitter * (self._rng.random() - 0.5)))
+        with self._rng_lock:
+            u = self._rng.random()
+        return max(0.0, d * (1.0 + self._jitter * (u - 0.5)))
 
     def _call(self, verb: str, fn, *args, **kwargs):
         last: Optional[Exception] = None

@@ -53,7 +53,13 @@ class Claim:
 
 
 class DriverCallbacks:
-    """Implemented by each driver (gpu/cd kubelet plugin device states)."""
+    """Implemented by each driver (gpu/cd kubelet plugin device states).
+
+    The claims list is the RPC's batch — kubelet sends a pod's claims in
+    ONE NodePrepareResources call. Implementations must return one entry
+    per claim uid with per-claim error isolation (one bad claim must not
+    fail its batch siblings); they may treat the batch as a single unit
+    of work (one lock acquisition, group-committed durable state)."""
 
     def prepare_claims(self, claims: List[Claim]) -> Dict[str, PrepareResult]:
         raise NotImplementedError
@@ -67,7 +73,14 @@ def _dra_service(callbacks: DriverCallbacks) -> grpc.GenericRpcHandler:
     def node_prepare(request: dra.NodePrepareResourcesRequest, context):
         claims = [Claim(uid=c.uid, name=c.name, namespace=c.namespace)
                   for c in request.claims]
-        results = callbacks.prepare_claims(claims)
+        results = dict(callbacks.prepare_claims(claims))
+        for claim in claims:
+            # A driver bug that dropped a claim from the result map must
+            # surface as that claim's error, not a missing response entry
+            # kubelet could misread as success-shaped.
+            results.setdefault(
+                claim.uid,
+                PrepareResult(error="driver returned no result for claim"))
         resp = dra.NodePrepareResourcesResponse()
         for uid, res in results.items():
             # Built in place: the map entry materializes on first access,
@@ -87,7 +100,10 @@ def _dra_service(callbacks: DriverCallbacks) -> grpc.GenericRpcHandler:
     def node_unprepare(request: dra.NodeUnprepareResourcesRequest, context):
         claims = [Claim(uid=c.uid, name=c.name, namespace=c.namespace)
                   for c in request.claims]
-        errors = callbacks.unprepare_claims(claims)
+        errors = dict(callbacks.unprepare_claims(claims))
+        for claim in claims:
+            errors.setdefault(claim.uid,
+                              "driver returned no result for claim")
         resp = dra.NodeUnprepareResourcesResponse()
         for uid, err in errors.items():
             if err:

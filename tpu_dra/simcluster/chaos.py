@@ -50,7 +50,7 @@ from tpu_dra.k8s import (
     FakeCluster, PODS, RESOURCECLAIMS, RESOURCESLICES, RetryingApiClient,
 )
 from tpu_dra.k8s.informer import Informer
-from tpu_dra.kubeletplugin.server import Claim
+from tpu_dra.kubeletplugin.server import Claim, PrepareResult
 from tpu_dra.native.tpuinfo import FakeBackend, HealthEvent, default_fake_chips
 from tpu_dra.tpuplugin.checkpoint import PREPARE_COMPLETED, CheckpointManager
 from tpu_dra.tpuplugin.device_state import DeviceState
@@ -60,9 +60,13 @@ from tpu_dra.tpuplugin.sharing import TimeSlicingManager
 
 # Sites the random walk may arm. health.chip_event is injected directly
 # (driver callback) for determinism; cddaemon.spawn belongs to the CD
-# daemon stack, exercised by its own tests.
+# daemon stack, exercised by its own tests. The prepare.batch_* sites
+# fire inside the batched prepare pipeline (driver fetch fan-out and
+# DeviceState parallel apply), so the group-commit rollback machinery is
+# chaos-tested on the exact production path.
 CHAOS_SITES = ("k8s.api.request", "cdi.claim_write", "checkpoint.store",
-               "checkpoint.corrupt")
+               "checkpoint.corrupt", "prepare.batch_fetch",
+               "prepare.batch_apply")
 
 TS_CONFIG = [{"source": "FromClaim", "requests": [], "opaque": {
     "driver": TPU_DRIVER_NAME, "parameters": {
@@ -77,6 +81,7 @@ class ChaosReport:
     events: int = 0
     prepares: int = 0
     unprepares: int = 0
+    batches: int = 0                  # multi-claim prepare RPCs driven
     crashes: int = 0
     health_events: int = 0
     failed_attempts: int = 0          # operations a fault made fail
@@ -90,6 +95,7 @@ class ChaosReport:
     def to_dict(self) -> Dict:
         return {"seed": self.seed, "events": self.events,
                 "prepares": self.prepares, "unprepares": self.unprepares,
+                "batches": self.batches,
                 "crashes": self.crashes, "health_events": self.health_events,
                 "failed_attempts": self.failed_attempts,
                 "injected": dict(self.injected),
@@ -303,6 +309,35 @@ class ChaosHarness:
             self.report.failed_attempts += 1
             self.pending[uid] = obj
 
+    def _op_prepare_batch(self) -> None:
+        """Kubelet-style multi-claim RPC: several single-chip claims
+        through ONE driver.prepare_claims call — the group-commit path —
+        with per-claim outcome tracking (a faulted member lands in
+        pending while its batch siblings land in prepared)."""
+        free = sorted(set(range(self.n_chips)) - self._used_chips())
+        if len(free) < 2:
+            return
+        n = self.rng.randint(2, min(3, len(free)))
+        objs = [self.make_claim([c]) for c in self.rng.sample(free, n)]
+        claims = [Claim(uid=o["metadata"]["uid"],
+                        name=o["metadata"]["name"],
+                        namespace=o["metadata"]["namespace"])
+                  for o in objs]
+        self.report.prepares += len(objs)
+        self.report.batches += 1
+        try:
+            res = self.driver.prepare_claims(claims)
+        except Exception as e:  # noqa: BLE001 — fault escaped as exception
+            res = {c.uid: PrepareResult(error=str(e)) for c in claims}
+        for obj in objs:
+            uid = obj["metadata"]["uid"]
+            r = res.get(uid)
+            if r is not None and not r.error:
+                self.prepared[uid] = obj
+            else:
+                self.report.failed_attempts += 1
+                self.pending[uid] = obj
+
     def _op_retry_pending(self) -> None:
         if not self.pending:
             return
@@ -351,7 +386,8 @@ class ChaosHarness:
         self.driver._on_unhealthy_event(event)
 
     def run(self, n_events: int = 40) -> ChaosReport:
-        ops = [(self._op_prepare_new, 4), (self._op_retry_pending, 3),
+        ops = [(self._op_prepare_new, 4), (self._op_prepare_batch, 2),
+               (self._op_retry_pending, 3),
                (self._op_unprepare, 2), (self._op_rearm, 2),
                (self.crash_restart, 1), (self._op_health, 1)]
         weighted = [op for op, w in ops for _ in range(w)]
@@ -465,6 +501,7 @@ def run_matrix(seeds: List[int], n_events: int = 40) -> Dict:
         "schedules": len(reports),
         "events": sum(r.events for r in reports),
         "prepares": sum(r.prepares for r in reports),
+        "batches": sum(r.batches for r in reports),
         "failed_attempts": sum(r.failed_attempts for r in reports),
         "crashes": sum(r.crashes for r in reports),
         "injected": injected,

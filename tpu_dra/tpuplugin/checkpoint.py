@@ -147,6 +147,15 @@ class CheckpointManager:
         self._side_paths = (self._path + ".b", self._path + ".c")
         self._fds: Dict[str, int] = {}
         self._sizes: Dict[str, int] = {}
+        # Observability counters (the group-commit regression tripwire,
+        # hack/perf.sh): total store() calls, terminal (non-intent)
+        # stores, and actual device syncs issued on slot data. A batch
+        # of N claims must land exactly 1 terminal store = 1 slot sync;
+        # N syncs here means the group commit silently degraded to
+        # per-claim commits.
+        self.stores: int = 0
+        self.terminal_stores: int = 0
+        self.slot_syncs: int = 0
         # Seed per-slot seqs from whatever is on disk so a manager that
         # stores before loading (e.g. a tool force-writing a downgrade
         # image) still supersedes stale slots from an earlier process,
@@ -206,6 +215,7 @@ class CheckpointManager:
         # store's side-slot copy) get durability from a later synced slot.
         if sync:
             getattr(os, "fdatasync", os.fsync)(fd)
+            self.slot_syncs += 1
 
     def store(self, cp: Checkpoint, version: str = "v2",
               intent: bool = False) -> None:
@@ -216,6 +226,9 @@ class CheckpointManager:
         # unprepare must stay retryable/idempotent when the state machine
         # cannot persist.
         FAULTS.check("checkpoint.store", intent=intent)
+        self.stores += 1
+        if not intent:
+            self.terminal_stores += 1
         doc = cp.to_v1_doc() if version == "v1" else cp.to_v2_doc()
         payload = json.dumps(doc, sort_keys=True, separators=(",", ":"))
         self._seq += 1
@@ -255,6 +268,31 @@ class CheckpointManager:
         # the surviving slots (crash-consistency chaos).
         FAULTS.check("checkpoint.corrupt",
                      paths=(side,) if intent else (side, self._path))
+
+    def store_batch(self, cp: Checkpoint, *, present=(), absent=(),
+                    version: str = "v2", intent: bool = False) -> None:
+        """Multi-claim group commit: ONE slot write + ONE durable sync
+        covering every claim the batch touched — N claims, 1 fdatasync,
+        instead of the N the per-claim loop paid (SURVEY §9). The
+        crash-consistency story is unchanged: the durable image is still
+        the FULL state written through store(), so a crash before this
+        call replays every member from its previous durable state and a
+        crash after it finds every member settled together.
+
+        `present`/`absent` are the commit's claim-level postconditions
+        (uids the batch prepared / removed): a group commit whose
+        in-memory state silently dropped a member — memory running ahead
+        of or behind disk, the exact bug class chaos seed 5 found on the
+        unprepare path — is refused here, before anything durable
+        happens, instead of surfacing as a resurrected or lost claim at
+        the next restart."""
+        missing = [u for u in present if u not in cp.claims]
+        lingering = [u for u in absent if u in cp.claims]
+        if missing or lingering:
+            raise CheckpointError(
+                f"group commit inconsistent: missing={missing} "
+                f"lingering={lingering}")
+        self.store(cp, version=version, intent=intent)
 
     def _load_slot(self, path: str):
         """-> (seq | None-for-legacy, doc) or None (absent/empty) or

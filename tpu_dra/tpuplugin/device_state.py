@@ -15,6 +15,8 @@ from __future__ import annotations
 import logging
 import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
+from contextlib import ExitStack
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -22,6 +24,7 @@ from tpu_dra.api import scheme as apischeme
 from tpu_dra.api import types as apitypes
 from tpu_dra.cdi.handler import CDIHandler, visible_chips_env
 from tpu_dra.infra import featuregates
+from tpu_dra.infra.faults import FAULTS
 from tpu_dra.kubeletplugin.server import PreparedDevice, PrepareResult
 from tpu_dra.native.tpuinfo import Chip, TpuInfoBackend
 from tpu_dra.tpuplugin import deviceinfo
@@ -83,6 +86,21 @@ class _ConfigResult:
     results: List[Dict] = field(default_factory=list)
 
 
+@dataclass
+class _BatchClaim:
+    """One non-idempotent member of a prepare batch, carried from the
+    pure phase through parallel apply to the group commit."""
+    uid: str
+    claim: Dict
+    config_results: List[_ConfigResult]
+    records: List[Dict]
+    hazardous: bool = False    # needs the durable intent store
+    serialize: bool = False    # side effects span beyond own chips
+    slow_apply: bool = False   # apply blocks (exec / API round trips)
+    timings: Dict[str, float] = field(default_factory=dict)
+    error: Optional[str] = None
+
+
 class DeviceState:
     def __init__(self, *, backend: TpuInfoBackend, cdi: CDIHandler,
                  checkpoints: CheckpointManager, driver_name: str,
@@ -105,6 +123,21 @@ class DeviceState:
         self._unhealthy_uuids: set = set()
         # Per-phase ms of the last non-idempotent prepare (see prepare()).
         self.last_prepare_breakdown: Dict[str, float] = {}
+        # Batch-level phase ms of the last fully-successful prepare_batch
+        # (decode, checkpoint_start, apply, checkpoint_final, total,
+        # n_claims) — the bench's batch-path attribution source.
+        self.last_batch_breakdown: Dict[str, float] = {}
+        # Disjoint-chip parallel apply: side effects are chip-scoped
+        # (time slices, exclusive mode, per-claim CDI files and
+        # coordinator Deployments), so batch members touching disjoint
+        # chip sets apply concurrently; members sharing a chip
+        # serialize on its lock. Passthrough and unknown config kinds
+        # additionally serialize on _hazard_lock: their side effects
+        # (IOMMU-group rebinds) span beyond the claim's own chips.
+        self._chip_locks: Dict[int, threading.Lock] = {
+            c.index: threading.Lock() for c in backend.chips()}
+        self._hazard_lock = threading.Lock()
+        self._apply_pool: Optional[ThreadPoolExecutor] = None
         # Standard per-node CDI spec is written once at startup
         # (NewDeviceState analog, device_state.go:59-145).
         self._cdi.create_standard_device_spec_file(backend.chips())
@@ -147,6 +180,9 @@ class DeviceState:
         """Release cached checkpoint slot fds. The manager assumes a
         single writer per process; call this at driver shutdown (and from
         test fixtures that create many states)."""
+        if self._apply_pool is not None:
+            self._apply_pool.shutdown(wait=True)
+            self._apply_pool = None
         self._ckpt_mgr.close()
 
     @property
@@ -168,125 +204,294 @@ class DeviceState:
     def prepare(self, claim: Dict) -> PrepareResult:
         """claim: a resource.k8s.io/v1 ResourceClaim object (dict).
 
-        Per-phase wall times of the last non-idempotent prepare land in
-        `last_prepare_breakdown` (ms) so the bench can attribute
-        claim-to-ready regressions to a phase instead of guessing
-        (VERDICT r3: the r2->r3 regression was never attributed).
-        """
-        uid = claim["metadata"]["uid"]
+        Single-claim surface kept for recovery paths and tests; kubelet
+        RPCs go through prepare_batch — this is a batch of one."""
+        return self.prepare_batch([claim])[claim["metadata"]["uid"]]
+
+    def prepare_batch(self, claims: List[Dict]) -> Dict[str, PrepareResult]:
+        """Prepare every claim of one NodePrepareResources RPC as ONE
+        unit of work (SURVEY §9): the pure phase and checkpoint mutation
+        run under the global lock, side effects apply concurrently for
+        disjoint-chip members, and durable state lands in group commits
+        — one intent store covering all hazardous members, one terminal
+        store for the whole batch (N claims, 1 fdatasync, instead of the
+        N the per-claim loop paid).
+
+        Per-claim transactional semantics are unchanged: a member that
+        fails mid-apply unwinds itself (side effects reversed, CDI spec
+        deleted, no checkpoint entry) while the survivors commit; errors
+        isolate to the failing claim's result.
+
+        Per-phase wall times of the last fully-successful batch land in
+        `last_batch_breakdown`; single-claim batches additionally keep
+        the historical `last_prepare_breakdown` (VERDICT r3: the r2->r3
+        regression was never attributed)."""
+        results: Dict[str, PrepareResult] = {}
+        batch_timings: Dict[str, float] = {}
+        t_total = time.perf_counter()
+        todo: List[_BatchClaim] = []
         with self._lock:
-            existing = self._checkpoint.claims.get(uid)
-            if existing is not None and existing.state == PREPARE_COMPLETED:
-                return PrepareResult(devices=[
-                    _prepared_device_from_record(r) for r in existing.devices])
-
-            timings: Dict[str, float] = {}
-            t_total = time.perf_counter()
-            # Pure phase first (no side effects): parse allocation results
-            # and resolve opaque configs, so config errors return before
-            # any state is recorded and the hazard of this prepare is
-            # known before deciding whether an intent store is needed.
+            # Pure phase first (no side effects): idempotency check,
+            # allocation parsing, opaque-config resolution and the FULL
+            # device records up front (names, chip indices, configs,
+            # deterministic CDI ids), so config errors return before any
+            # state is recorded and the intent record below already
+            # names every chip each member will touch — a SIGKILL
+            # mid-apply must leave a record that rollback AND the
+            # startup time-slice reconciliation's `held` set can see.
             t0 = time.perf_counter()
-            try:
-                config_results = self._resolve_claim_configs(claim)
-            except Exception as e:  # noqa: BLE001 — report as claim error
-                return PrepareResult(error=f"prepare devices: {e}")
-            timings["decode"] = time.perf_counter() - t0
-
-            # Build the FULL device records up front (pure: names, chip
-            # indices, configs, deterministic CDI ids), so the intent
-            # record below already names every chip this claim will
-            # touch — a SIGKILL mid-apply must leave a record that
-            # rollback AND the startup time-slice reconciliation's
-            # `held` set can see (an empty-devices intent record would
-            # let reconciliation reset a mid-prepare claim's chips).
-            try:
-                records = self._build_records(uid, config_results)
-            except Exception as e:  # noqa: BLE001 — report as claim error
-                return PrepareResult(error=f"prepare devices: {e}")
-            self._checkpoint.claims[uid] = PreparedClaim(
-                uid=uid, state=PREPARE_STARTED,
-                name=claim["metadata"].get("name", ""),
-                namespace=claim["metadata"].get("namespace", ""),
-                devices=records)
-            if any(self._config_hazard(cr.config) for cr in config_results):
-                # Transient mid-prepare record: side slot (checkpoint.py —
-                # terminal states land on the primary for downgrade
-                # safety). Non-hazardous prepares skip this durable intent
-                # entirely: their only side effect is the claim CDI spec,
-                # which startup orphan GC and the unconditional unprepare
-                # delete reconcile without a record — one device sync
-                # instead of two on the claim-to-ready hot path.
+            for claim in claims:
+                uid = claim["metadata"]["uid"]
+                if uid in results or any(b.uid == uid for b in todo):
+                    continue  # duplicate uid in one RPC: one result
+                existing = self._checkpoint.claims.get(uid)
+                if existing is not None and \
+                        existing.state == PREPARE_COMPLETED:
+                    results[uid] = PrepareResult(devices=[
+                        _prepared_device_from_record(r)
+                        for r in existing.devices])
+                    continue
+                try:
+                    config_results = self._resolve_claim_configs(claim)
+                    records = self._build_records(uid, config_results)
+                except Exception as e:  # noqa: BLE001 — claim error
+                    results[uid] = PrepareResult(
+                        error=f"prepare devices: {e}")
+                    continue
+                configs = [cr.config for cr in config_results]
+                todo.append(_BatchClaim(
+                    uid=uid, claim=claim, config_results=config_results,
+                    records=records,
+                    hazardous=any(self._config_hazard(c)
+                                  for c in configs),
+                    # Passthrough (IOMMU-group rebinds yank sibling
+                    # chips) and unknown config kinds serialize on the
+                    # hazard lock; everything else — including
+                    # multiprocess, whose Deployment and daemon dirs
+                    # are per-claim — is covered by its chip locks.
+                    serialize=any(
+                        not isinstance(c, (apitypes.TpuConfig,
+                                           apitypes.SubsliceConfig))
+                        for c in configs),
+                    # Only sharing strategies block (tpuctl execs,
+                    # coordinator-Deployment round trips); env-only
+                    # applies are too cheap for pool dispatch to win.
+                    slow_apply=any(
+                        not isinstance(c, apitypes.SubsliceConfig)
+                        and (not isinstance(c, apitypes.TpuConfig)
+                             or c.sharing is not None)
+                        for c in configs)))
+            batch_timings["decode"] = time.perf_counter() - t0
+            if not todo:
+                return results
+            for b in todo:
+                self._checkpoint.claims[b.uid] = PreparedClaim(
+                    uid=b.uid, state=PREPARE_STARTED,
+                    name=b.claim["metadata"].get("name", ""),
+                    namespace=b.claim["metadata"].get("namespace", ""),
+                    devices=b.records)
+            hazardous = [b for b in todo if b.hazardous]
+            if hazardous:
+                # ONE transient mid-prepare record covering every
+                # hazardous member: side slot (checkpoint.py — terminal
+                # states land on the primary for downgrade safety).
+                # Non-hazardous members skip the durable intent
+                # entirely: their only side effect is the claim CDI
+                # spec, which startup orphan GC and the unconditional
+                # unprepare delete reconcile without a record.
                 t0 = time.perf_counter()
                 try:
-                    self._ckpt_mgr.store(self._checkpoint, intent=True)
+                    self._ckpt_mgr.store_batch(
+                        self._checkpoint,
+                        present=[b.uid for b in hazardous], intent=True)
                 except Exception as e:  # noqa: BLE001 — no side effects
-                    # applied yet; unwind the record instead of leaking
-                    # a raw exception through the DRA server.
-                    return self._fail_prepare(uid, f"intent store: {e}")
-                timings["checkpoint_start"] = time.perf_counter() - t0
+                    # applied for ANY member yet and disk never saw the
+                    # records: unwind them in memory and fail the batch;
+                    # kubelet retries each claim from scratch.
+                    for b in todo:
+                        self._checkpoint.claims.pop(b.uid, None)
+                        results[b.uid] = PrepareResult(
+                            error=f"intent store: {e}")
+                    return results
+                batch_timings["checkpoint_start"] = time.perf_counter() - t0
 
-            try:
-                self._apply_devices(claim, config_results, timings)
-            except Exception as e:  # noqa: BLE001 — report as claim error
-                return self._fail_prepare(uid, f"prepare devices: {e}")
+        # Side-effect application OUTSIDE the global lock: members on
+        # disjoint chip sets run concurrently, chip locks serialize
+        # overlaps (two subslice/time-slicing claims of one chip), the
+        # hazard lock serializes configs whose effects span beyond the
+        # claim's own chips. Checkpoint reads (exclusivity guards) stay
+        # stable because every mutation waits for the terminal phase.
+        t0 = time.perf_counter()
+        self._apply_batch(todo)
+        batch_timings["apply"] = time.perf_counter() - t0
 
-            self._checkpoint.claims[uid].state = PREPARE_COMPLETED
+        with self._lock:
+            failed = [b for b in todo if b.error is not None]
+            survivors = [b for b in todo if b.error is None]
+            # uid -> rollback error for members whose unwind could not
+            # complete (degraded to a deferred PrepareStarted record).
+            deferred: Dict[str, str] = {}
+            for b in failed:
+                err = self._unwind_claim(b.uid)
+                if err is not None:
+                    deferred[b.uid] = err
+            for b in survivors:
+                self._checkpoint.claims[b.uid].state = PREPARE_COMPLETED
             t0 = time.perf_counter()
             try:
-                self._ckpt_mgr.store(self._checkpoint)
-            except Exception as e:  # noqa: BLE001 — terminal store failed:
-                # the claim is fully applied but not durably completed; a
-                # crash now would replay as PrepareStarted. Unwind so the
-                # kubelet retry starts from a clean slate instead of
-                # half-committed state.
-                return self._fail_prepare(uid, f"checkpoint store: {e}")
-            timings["checkpoint_final"] = time.perf_counter() - t0
-            timings["total"] = time.perf_counter() - t_total
-            self.last_prepare_breakdown = {
-                k: v * 1e3 for k, v in timings.items()}
-            return PrepareResult(devices=[
-                _prepared_device_from_record(r) for r in records])
+                # The group commit: every member's terminal outcome —
+                # survivors completed, failures erased, deferred unwinds
+                # parked PrepareStarted — in ONE durable store.
+                self._ckpt_mgr.store_batch(
+                    self._checkpoint,
+                    present=[b.uid for b in survivors]
+                    + sorted(deferred),
+                    absent=[b.uid for b in failed
+                            if b.uid not in deferred])
+            except Exception as e:  # noqa: BLE001 — terminal store
+                # failed: survivors are fully applied but not durably
+                # completed; a crash now would replay them as
+                # PrepareStarted. Unwind them too and persist the
+                # rollback, so the kubelet retry starts from a clean
+                # slate instead of half-committed state.
+                for b in survivors:
+                    b.error = f"checkpoint store: {e}"
+                    err = self._unwind_claim(b.uid)
+                    if err is not None:
+                        deferred[b.uid] = err
+                try:
+                    self._ckpt_mgr.store(self._checkpoint)
+                except Exception as e2:  # noqa: BLE001 — rollback store
+                    # failed as well: degrade every not-yet-deferred
+                    # member to a deferred PrepareStarted record so a
+                    # later unprepare — or the next driver start — can
+                    # finish the unwind. Never silently dropped.
+                    for b in todo:
+                        if b.uid in deferred:
+                            continue
+                        self._checkpoint.claims[b.uid] = PreparedClaim(
+                            uid=b.uid, state=PREPARE_STARTED,
+                            name=b.claim["metadata"].get("name", ""),
+                            namespace=b.claim["metadata"].get(
+                                "namespace", ""),
+                            devices=b.records)
+                        deferred[b.uid] = str(e2)
+                    try:
+                        self._ckpt_mgr.store(self._checkpoint)
+                    except Exception:  # noqa: BLE001 — the durable
+                        # intent record (if hazardous) still names the
+                        # members' chips for the next start's recovery.
+                        log.warning("failed-batch record store failed",
+                                    exc_info=True)
+            batch_timings["checkpoint_final"] = time.perf_counter() - t0
+            batch_timings["total"] = time.perf_counter() - t_total
 
-    def _fail_prepare(self, uid: str, err: str) -> PrepareResult:
-        """Transactional unwind of a failed prepare (caller holds _lock):
-        reverse the side effects the persisted records name (exclusive
+            for b in todo:
+                if b.uid in deferred:
+                    log.warning(
+                        "prepare rollback for %s incomplete (%s); claim "
+                        "left PrepareStarted for deferred unwind", b.uid,
+                        deferred[b.uid])
+                    results[b.uid] = PrepareResult(
+                        error=f"{b.error}; rollback deferred: "
+                              f"{deferred[b.uid]}")
+                elif b.error is not None:
+                    results[b.uid] = PrepareResult(error=b.error)
+                else:
+                    results[b.uid] = PrepareResult(devices=[
+                        _prepared_device_from_record(r)
+                        for r in b.records])
+
+            if survivors and not failed:
+                self.last_batch_breakdown = {
+                    **{k: v * 1e3 for k, v in batch_timings.items()},
+                    "n_claims": float(len(todo)),
+                }
+            if len(todo) == 1 and todo[0].error is None \
+                    and not deferred:
+                b = todo[0]
+                timings = dict(b.timings)
+                timings["decode"] = batch_timings["decode"]
+                if "checkpoint_start" in batch_timings:
+                    timings["checkpoint_start"] = \
+                        batch_timings["checkpoint_start"]
+                timings["checkpoint_final"] = \
+                    batch_timings["checkpoint_final"]
+                timings["total"] = batch_timings["total"]
+                self.last_prepare_breakdown = {
+                    k: v * 1e3 for k, v in timings.items()}
+        return results
+
+    def _apply_batch(self, todo: List[_BatchClaim]) -> None:
+        """Run every member's side-effect application; failures land in
+        the member's `error` (never raises). Pool dispatch pays off only
+        when at least two members genuinely block (tpuctl execs,
+        coordinator-Deployment round trips) AND can actually overlap
+        (serialize-flagged members queue on the hazard lock anyway);
+        otherwise the batch stays on the calling thread — measured: the
+        pool costs ~0.07 ms/claim on env-only applies, a pure loss."""
+        parallelizable = sum(1 for b in todo
+                             if b.slow_apply and not b.serialize)
+        if len(todo) == 1 or parallelizable < 2:
+            for b in todo:
+                self._apply_member(b)
+            return
+        if self._apply_pool is None:
+            self._apply_pool = ThreadPoolExecutor(
+                max_workers=min(8, max(2, len(self._chip_locks))),
+                thread_name_prefix="tpu-dra-apply")
+        futures = [self._apply_pool.submit(self._apply_member, b)
+                   for b in todo]
+        for f in futures:
+            f.result()
+
+    def _apply_member(self, b: _BatchClaim) -> None:
+        """One member's side effects under its locks. Never raises —
+        the terminal phase reads `b.error` for transactional rollback."""
+        try:
+            # Injection site: mid-batch apply failure — the loser must
+            # roll back while its batch siblings commit durably.
+            FAULTS.check("prepare.batch_apply", claim_uid=b.uid)
+            with ExitStack() as stack:
+                # Lock order is global (hazard first, then ascending
+                # chip index), so overlapping members cannot deadlock.
+                if b.serialize:
+                    stack.enter_context(self._hazard_lock)
+                for idx in sorted({r["chip_index"] for r in b.records}):
+                    stack.enter_context(self._chip_locks[idx])
+                self._apply_devices(b.claim, b.config_results, b.timings)
+        except Exception as e:  # noqa: BLE001 — report as claim error
+            b.error = f"prepare devices: {e}"
+
+    def _unwind_claim(self, uid: str) -> Optional[str]:
+        """Transactional unwind of one failed batch member (caller holds
+        _lock): reverse the side effects the records name (exclusive
         mode, multiprocess daemons, time slices, VFIO rebinds), delete
         the claim CDI spec, and erase the checkpoint entry — so the
         kubelet's retry re-runs prepare from scratch (idempotent) and an
-        abandoned claim is *cleanly unallocated*, not half-held.
+        abandoned claim is *cleanly unallocated*, not half-held. The
+        batch's single terminal store persists the erasure; no store
+        happens here.
 
-        If the unwind itself fails (a chip wedged mid-rebind, checkpoint
-        store refused), fall back to the pre-transactional behavior:
-        keep the PrepareStarted record so a later unprepare — or the
-        next driver start — can finish the rollback. Never raises."""
+        If the unwind itself fails (a chip wedged mid-rebind), keep the
+        PrepareStarted record so a later unprepare — or the next driver
+        start — can finish the rollback, and return the error. Never
+        raises."""
         prepared = self._checkpoint.claims.get(uid)
         try:
             if prepared is not None:
                 self._unprepare_devices(uid, prepared)
             self._cdi.delete_claim_spec_file(uid)
-            del self._checkpoint.claims[uid]
-            self._ckpt_mgr.store(self._checkpoint)
+            self._checkpoint.claims.pop(uid, None)
+            return None
         except Exception as rollback_err:  # noqa: BLE001 — degrade to
             # deferred rollback (unprepare/startup GC both handle
             # PrepareStarted records); re-insert in case deletion
-            # happened before the store failed.
+            # happened before the failure.
             if prepared is not None:
                 prepared.state = PREPARE_STARTED
                 self._checkpoint.claims[uid] = prepared
-            try:
-                self._ckpt_mgr.store(self._checkpoint)
-            except Exception:  # noqa: BLE001 — the durable intent record
-                # (if this prepare was hazardous) still names the claim's
-                # chips for the next start's recovery.
-                log.warning("failed-prepare record store failed for %s",
-                            uid, exc_info=True)
-            log.warning("prepare rollback for %s incomplete (%s); claim "
-                        "left PrepareStarted for deferred unwind", uid,
-                        rollback_err)
-            return PrepareResult(
-                error=f"{err}; rollback deferred: {rollback_err}")
-        return PrepareResult(error=err)
+            return str(rollback_err)
 
     def _resolve_claim_configs(self, claim: Dict) -> List["_ConfigResult"]:
         """The pure phase of prepare: parse allocation results and resolve
@@ -468,10 +673,16 @@ class DeviceState:
         group, so (a) a passthrough prepare conflicts with ANY other claim
         holding a group chip, and (b) a normal prepare conflicts with a
         PASSTHROUGH claim holding a group chip (the rebind destroyed its
-        /dev/accelN). Callers hold self._lock, so checkpoint reads are
-        stable. (Sibling handling analog: device_state.go:526-552.)"""
+        /dev/accelN). Runs during a batch's apply phase, when checkpoint
+        mutation is quiescent (mutations happen only in the pure and
+        terminal phases, under self._lock); concurrent prepare/unprepare
+        CALLERS must be serialized externally — in production the
+        driver's node-global flock does this. The iteration snapshot
+        below keeps a misbehaving concurrent caller from crashing the
+        guard mid-iteration, though its answer could then be stale.
+        (Sibling handling analog: device_state.go:526-552.)"""
         group_indices = set(self._group_chip_indices(chip))
-        for uid, prepared in self._checkpoint.claims.items():
+        for uid, prepared in list(self._checkpoint.claims.items()):
             if uid == claim_uid:
                 continue
             for record in prepared.devices:
@@ -598,31 +809,55 @@ class DeviceState:
 
     def unprepare(self, claim_uid: str) -> Optional[str]:
         """Returns error string or None (idempotent: unknown claim is a
-        no-op success, device_state.go:218-273)."""
+        no-op success, device_state.go:218-273). A batch of one."""
+        return self.unprepare_batch([claim_uid])[claim_uid]
+
+    def unprepare_batch(self, claim_uids: List[str]
+                        ) -> Dict[str, Optional[str]]:
+        """Unprepare every claim of one NodeUnprepareResources RPC with
+        a single group-committed terminal store (N claims, 1 fdatasync).
+        Per-claim semantics are the single-claim contract: unknown claims
+        are no-op successes (orphan CDI specs still scrubbed), a failed
+        device unwind isolates to its claim, and a failed store reinserts
+        every removed entry — memory must not run ahead of disk (chaos
+        seed 5), or the retry would no-op while the on-disk entries
+        survive to resurrect at the next restart."""
+        results: Dict[str, Optional[str]] = {}
         with self._lock:
-            prepared = self._checkpoint.claims.get(claim_uid)
-            if prepared is None:
-                # Unknown claim: still scrub any orphan CDI spec — a crash
-                # after a non-hazardous prepare's CDI write but before its
-                # terminal checkpoint store can leave one behind.
+            removed: List[Tuple[str, PreparedClaim]] = []
+            for claim_uid in claim_uids:
+                if claim_uid in results:
+                    continue  # duplicate uid in one RPC
+                prepared = self._checkpoint.claims.get(claim_uid)
+                if prepared is None:
+                    # Unknown claim: still scrub any orphan CDI spec — a
+                    # crash after a non-hazardous prepare's CDI write but
+                    # before its terminal checkpoint store can leave one.
+                    self._cdi.delete_claim_spec_file(claim_uid)
+                    results[claim_uid] = None
+                    continue
+                try:
+                    self._unprepare_devices(claim_uid, prepared)
+                except Exception as e:  # noqa: BLE001
+                    results[claim_uid] = f"unprepare devices: {e}"
+                    continue
                 self._cdi.delete_claim_spec_file(claim_uid)
-                return None
-            try:
-                self._unprepare_devices(claim_uid, prepared)
-            except Exception as e:  # noqa: BLE001
-                return f"unprepare devices: {e}"
-            self._cdi.delete_claim_spec_file(claim_uid)
-            del self._checkpoint.claims[claim_uid]
-            try:
-                self._ckpt_mgr.store(self._checkpoint)
-            except Exception as e:  # noqa: BLE001 — reinsert: memory
-                # must not run ahead of disk. Without this, the retry
-                # takes the unknown-claim no-op path and reports success
-                # while the on-disk entry survives to resurrect at the
-                # next restart (found by the chaos harness, seed 5).
-                self._checkpoint.claims[claim_uid] = prepared
-                return f"unprepare checkpoint store: {e}"
-            return None
+                del self._checkpoint.claims[claim_uid]
+                removed.append((claim_uid, prepared))
+                results[claim_uid] = None
+            if removed:
+                try:
+                    self._ckpt_mgr.store_batch(
+                        self._checkpoint,
+                        absent=[uid for uid, _ in removed])
+                except Exception as e:  # noqa: BLE001 — reinsert ALL
+                    # removed entries; their device unwinds are
+                    # idempotent, so the retry re-runs them safely.
+                    for claim_uid, prepared in removed:
+                        self._checkpoint.claims[claim_uid] = prepared
+                        results[claim_uid] = \
+                            f"unprepare checkpoint store: {e}"
+        return results
 
     def _unprepare_devices(self, claim_uid: str, prepared: PreparedClaim) -> None:
         chips: Dict[int, Chip] = {}
@@ -660,13 +895,16 @@ class DeviceState:
     def mark_unhealthy(self, chip_index: int) -> List[str]:
         """Mark all devices backed by the chip unhealthy; returns affected
         device names (UpdateDeviceHealthStatus analog,
-        device_state.go:701-715)."""
-        affected = []
-        for name, dev in self.allocatable.items():
-            if dev.chip.index == chip_index:
-                self._unhealthy_uuids.add(dev.chip.uuid)
-                affected.append(name)
-        return affected
+        device_state.go:701-715). Takes _lock: the health-monitor thread
+        mutates the set while republish reads it — unguarded, a republish
+        mid-event could observe a torn inventory."""
+        with self._lock:
+            affected = []
+            for name, dev in self.allocatable.items():
+                if dev.chip.index == chip_index:
+                    self._unhealthy_uuids.add(dev.chip.uuid)
+                    affected.append(name)
+            return affected
 
     def mark_healthy(self, chip_index: int) -> List[str]:
         """Reverse of mark_unhealthy: a recovery event re-admits the chip's
@@ -676,19 +914,24 @@ class DeviceState:
         # Collect first, discard after: the chip's devices (chip +
         # subslices) share one uuid, and discarding inside the loop would
         # report only the first match.
-        affected = [name for name, dev in self.allocatable.items()
-                    if dev.chip.index == chip_index
-                    and dev.chip.uuid in self._unhealthy_uuids]
-        for name in affected:
-            self._unhealthy_uuids.discard(self.allocatable[name].chip.uuid)
-        return affected
+        with self._lock:
+            affected = [name for name, dev in self.allocatable.items()
+                        if dev.chip.index == chip_index
+                        and dev.chip.uuid in self._unhealthy_uuids]
+            for name in affected:
+                self._unhealthy_uuids.discard(
+                    self.allocatable[name].chip.uuid)
+            return affected
 
     def healthy_devices(self) -> List[Dict]:
         """resourceapi device list excluding unhealthy chips (the republish
-        path drops yanked devices, driver.go:283-293)."""
-        return [dev.to_resource_api()
-                for name, dev in sorted(self.allocatable.items())
-                if dev.chip.uuid not in self._unhealthy_uuids]
+        path drops yanked devices, driver.go:283-293). Takes _lock so a
+        health event landing mid-republish cannot yield a half-updated
+        device set."""
+        with self._lock:
+            return [dev.to_resource_api()
+                    for name, dev in sorted(self.allocatable.items())
+                    if dev.chip.uuid not in self._unhealthy_uuids]
 
     def prepared_claim_uids(self) -> List[str]:
         with self._lock:

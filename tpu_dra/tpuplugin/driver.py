@@ -10,9 +10,12 @@ from __future__ import annotations
 
 import logging
 import threading
-from typing import Dict, List, Optional
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Tuple
 
 from tpu_dra.infra import featuregates
+from tpu_dra.infra.faults import FAULTS
 from tpu_dra.infra.flock import Flock
 from tpu_dra.infra.metrics import DefaultRegistry
 from tpu_dra.infra.workqueue import WorkQueue, default_prep_unprep_rate_limiter
@@ -29,7 +32,15 @@ log = logging.getLogger("tpu_dra.tpuplugin")
 
 claim_prepare_seconds = DefaultRegistry.histogram(
     "tpu_dra_claim_prepare_seconds",
-    "NodePrepareResources per-claim latency (claim-to-ready component)")
+    "NodePrepareResources batch-amortized per-claim latency (batch wall / "
+    "claims, observed once per claim; claims in a batch complete together, "
+    "so individual tails live in the batch wall, not here)")
+
+prepare_batch_size = DefaultRegistry.histogram(
+    "tpu_dra_prepare_batch_size",
+    "Claims per NodePrepareResources RPC (kubelet batches a pod's claims; "
+    "the batch is the group-commit unit)",
+    buckets=(1, 2, 4, 8, 16, 32, 64))
 
 
 class TpuDriver(DriverCallbacks):
@@ -43,9 +54,15 @@ class TpuDriver(DriverCallbacks):
         self._driver_name = driver_name
         self._node_name = node_name
         self._pu_lock = Flock(flock_path or f"{plugin_dir}/pu.lock")
-        # Wall ms of the last _node_prepare_resource (flock + claim fetch
-        # + DeviceState.prepare): with the client-observed latency this
-        # attributes the gRPC wire share of claim-to-ready (bench).
+        # Claim-fetch fan-out pool: a batch's ResourceClaims are fetched
+        # concurrently so the API-server round-trip is paid once per RPC
+        # wall-clock, not once per claim. Sized past any realistic
+        # per-pod claim count; larger batches just wave through in turns.
+        self._fetch_pool = ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="tpu-dra-claim-fetch")
+        # Wall ms of the last prepare_claims batch (flock + claim fetch
+        # + DeviceState.prepare_batch): with the client-observed latency
+        # this attributes the gRPC wire share of claim-to-ready (bench).
         self.last_prepare_ms: float = 0.0
         self._pool_generation = 1
         self._gen_lock = threading.Lock()
@@ -92,59 +109,93 @@ class TpuDriver(DriverCallbacks):
             self._health.stop()
         self._publish_queue.shutdown()
         self.server.stop()
+        self._fetch_pool.shutdown(wait=True)
         self._state.close()
 
     # -- DRA callbacks ------------------------------------------------------
 
     def prepare_claims(self, claims: List[Claim]) -> Dict[str, PrepareResult]:
+        """nodePrepareResource analog (driver.go:166-193), batched: the
+        RPC is the unit of work. ONE flock acquisition covers the whole
+        batch (the per-claim loop re-acquired it N times), the
+        ResourceClaim fetches fan out concurrently, and DeviceState
+        group-commits the batch. Per-claim errors (404, UID mismatch,
+        prepare failure) isolate to that claim's result."""
+        t0 = time.monotonic()
+        prepare_batch_size.observe(len(claims))
         results: Dict[str, PrepareResult] = {}
-        for claim in claims:
-            results[claim.uid] = self._node_prepare_resource(claim)
-        return results
+        try:
+            self._pu_lock.acquire(timeout=10.0)
+        except TimeoutError as e:
+            return {c.uid: PrepareResult(error=str(e)) for c in claims}
+        try:
+            objs = []
+            for claim, (obj, err) in self._fetch_claims(claims):
+                if err is not None:
+                    results[claim.uid] = PrepareResult(error=err)
+                else:
+                    objs.append(obj)
+            if objs:
+                results.update(self._state.prepare_batch(objs))
+            elapsed = time.monotonic() - t0
+            # Batch members complete together, so the honest per-claim
+            # number is the amortized share (see the metric help text).
+            per_claim = elapsed / max(len(claims), 1)
+            for _ in claims:
+                claim_prepare_seconds.observe(per_claim)
+            self.last_prepare_ms = elapsed * 1e3
+            return results
+        finally:
+            self._pu_lock.release()
 
     def unprepare_claims(self, claims: List[Claim]) -> Dict[str, str]:
-        errors: Dict[str, str] = {}
+        """One flock + one group-committed unprepare per RPC."""
+        try:
+            self._pu_lock.acquire(timeout=10.0)
+        except TimeoutError as e:
+            return {c.uid: str(e) for c in claims}
+        try:
+            errors = self._state.unprepare_batch([c.uid for c in claims])
+            return {c.uid: errors.get(c.uid) or "" for c in claims}
+        finally:
+            self._pu_lock.release()
+
+    def _fetch_claims(self, claims: List[Claim]
+                      ) -> List[Tuple[Claim, Tuple[Optional[Dict],
+                                                   Optional[str]]]]:
+        """Concurrent ResourceClaim fan-out: [(claim, (obj|None,
+        err|None))], duplicates collapsed to their first occurrence.
+        Single-claim batches fetch inline — pool dispatch buys nothing."""
+        unique: List[Claim] = []
+        seen = set()
         for claim in claims:
-            errors[claim.uid] = self._node_unprepare_resource(claim)
-        return errors
+            if claim.uid not in seen:
+                seen.add(claim.uid)
+                unique.append(claim)
+        if len(unique) == 1:
+            return [(unique[0], self._fetch_one(unique[0]))]
+        futures = [(c, self._fetch_pool.submit(self._fetch_one, c))
+                   for c in unique]
+        return [(c, f.result()) for c, f in futures]
 
-    def _node_prepare_resource(self, claim: Claim) -> PrepareResult:
-        """nodePrepareResource analog (driver.go:166-193): flock + fetch the
-        ResourceClaim from the API server + DeviceState.Prepare."""
-        import time
-        t0 = time.monotonic()
+    def _fetch_one(self, claim: Claim
+                   ) -> Tuple[Optional[Dict], Optional[str]]:
+        """(ResourceClaim, None) or (None, error). Never raises: one
+        failed fetch must not take down its batch siblings."""
         try:
-            self._pu_lock.acquire(timeout=10.0)
-        except TimeoutError as e:
-            return PrepareResult(error=str(e))
-        try:
-            try:
-                obj = self._client.get(RESOURCECLAIMS, claim.name,
-                                       claim.namespace)
-            except NotFoundError:
-                return PrepareResult(
-                    error=f"resourceclaim {claim.namespace}/{claim.name} not found")
-            if obj["metadata"].get("uid") != claim.uid:
-                return PrepareResult(
-                    error=f"claim UID mismatch for {claim.namespace}/{claim.name}")
-            result = self._state.prepare(obj)
-            elapsed = time.monotonic() - t0
-            claim_prepare_seconds.observe(elapsed)
-            self.last_prepare_ms = elapsed * 1e3
-            return result
-        finally:
-            self._pu_lock.release()
-
-    def _node_unprepare_resource(self, claim: Claim) -> str:
-        try:
-            self._pu_lock.acquire(timeout=10.0)
-        except TimeoutError as e:
-            return str(e)
-        try:
-            err = self._state.unprepare(claim.uid)
-            return err or ""
-        finally:
-            self._pu_lock.release()
+            # Injection site: a single claim's fetch fails while the
+            # rest of the batch proceeds (error-isolation chaos).
+            FAULTS.check("prepare.batch_fetch", claim_uid=claim.uid)
+            obj = self._client.get(RESOURCECLAIMS, claim.name,
+                                   claim.namespace)
+        except NotFoundError:
+            return None, (f"resourceclaim {claim.namespace}/{claim.name} "
+                          "not found")
+        except Exception as e:  # noqa: BLE001 — isolate to this claim
+            return None, f"fetch resourceclaim: {e}"
+        if obj["metadata"].get("uid") != claim.uid:
+            return None, f"claim UID mismatch for {claim.namespace}/{claim.name}"
+        return obj, None
 
     # -- publishing ---------------------------------------------------------
 
