@@ -544,6 +544,137 @@ def bench_chaos_recovery(n: int = 7):
     return measure_daemon_crash_recovery(n)
 
 
+def bench_sched_churn(n_nodes: int = 100, n_pods: int = 500,
+                      chips_per_node: int = 4, window: int = None):
+    """Control-plane churn at scale (ISSUE 3): N fake nodes publishing
+    ResourceSlices, M pod lifecycles (create -> template claim ->
+    allocate -> bind -> delete -> claim GC) through the EVENT-DRIVEN
+    scheduler (informer/workqueue + incremental allocation index +
+    compile-cached CEL). Reports:
+
+    - sched_pod_to_allocated_p50_ms: pod create -> bound+allocated wall
+      (measured from the pod watch stream, `window` lifecycles in
+      flight, so the number includes realistic queue depth);
+    - sched_throughput_pods_per_s: completed lifecycles / wall;
+    - sched_full_relists: scheduler-level full rescans during the churn
+      — steady state MUST be 0 (the poll-era scheduler full-listed Pods
+      AND ResourceClaims every 150 ms);
+    - sched_cel_compiles vs sched_cel_distinct_exprs: the compile cache
+      gate (compiles <= distinct source strings seen).
+    """
+    import queue as queue_mod
+    import threading
+
+    from tpu_dra.infra.metrics import (
+        CEL_CACHE_HITS, CEL_CACHE_MISSES, CEL_COMPILES, SCHED_FULL_RELISTS,
+    )
+    from tpu_dra.k8s import FakeCluster, PODS, RESOURCECLAIMS
+    from tpu_dra.simcluster.scheduler import Scheduler
+    from tpu_dra.testing import DEFAULT_SCHED_SELECTOR, seed_sched_inventory
+
+    cluster = FakeCluster()
+    # Two selector expressions so the CEL cache sees a conjunction per
+    # allocation; both must compile exactly once across the whole churn.
+    exprs = [
+        DEFAULT_SCHED_SELECTOR,
+        'device.attributes["tpu.dev"].generation == "v5p"',
+    ]
+    seed_sched_inventory(cluster, nodes=n_nodes,
+                         chips_per_node=chips_per_node,
+                         node_fmt="n{i:03d}", selector_exprs=exprs)
+
+    capacity = n_nodes * chips_per_node
+    window = min(window or 64, max(1, capacity // 2), n_pods)
+
+    relists0 = SCHED_FULL_RELISTS.value()
+    compiles0 = CEL_COMPILES.value()
+    hits0, misses0 = CEL_CACHE_HITS.value(), CEL_CACHE_MISSES.value()
+
+    # Sweep pushed far beyond the bench horizon: the claim-GC drain
+    # check below must prove the EVENT path works, not be masked by the
+    # periodic safety net firing inside the wait window.
+    sched = Scheduler(cluster, resync_interval=2.0, gc_sweep_interval=3600.0)
+    sched.start()
+    stop = threading.Event()
+    bound_q: "queue_mod.Queue" = queue_mod.Queue()
+    seen = set()
+
+    def watch_bindings():
+        for ev, obj in cluster.watch(PODS, namespace="default", stop=stop):
+            if ev in ("ADDED", "MODIFIED") and obj["spec"].get("nodeName"):
+                name = obj["metadata"]["name"]
+                if name not in seen:
+                    seen.add(name)
+                    bound_q.put((name, time.perf_counter()))
+
+    watcher = threading.Thread(target=watch_bindings, daemon=True)
+    watcher.start()
+
+    def make_pod(i):
+        name = f"churn-{i:05d}"
+        t_created[name] = time.perf_counter()
+        cluster.create(PODS, {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": {"containers": [{"name": "c", "image": "x"}],
+                     "resourceClaims": [
+                         {"name": "t", "resourceClaimTemplateName": "tmpl"}]},
+        }, namespace="default")
+
+    t_created: dict = {}
+    lat_ms = []
+    try:
+        t0 = time.perf_counter()
+        created = 0
+        for _ in range(window):
+            make_pod(created)
+            created += 1
+        done = 0
+        while done < n_pods:
+            name, t_bound = bound_q.get(timeout=60)
+            lat_ms.append((t_bound - t_created.pop(name)) * 1e3)
+            done += 1
+            cluster.delete(PODS, name, "default")  # churn: free the devices
+            if created < n_pods:
+                make_pod(created)
+                created += 1
+        wall_s = time.perf_counter() - t0
+        # Drain: every template claim must be GCed once its pod is gone
+        # (event-driven GC — the sweep interval is set far beyond the
+        # bench horizon so a leak here would be a real event-path bug).
+        gc_ok = cluster.wait_for(
+            lambda: not cluster.list(RESOURCECLAIMS, namespace="default"),
+            timeout=15)
+    finally:
+        stop.set()
+        sched.stop()
+
+    lat_ms.sort()
+    distinct = len(set(exprs))
+    compiles = int(CEL_COMPILES.value() - compiles0)
+    hits = CEL_CACHE_HITS.value() - hits0
+    misses = CEL_CACHE_MISSES.value() - misses0
+    out = {
+        "sched_pod_to_allocated_p50_ms": round(
+            statistics.median(lat_ms), 3),
+        "sched_pod_to_allocated_p95_ms": round(_pctl(lat_ms, 0.95), 3),
+        "sched_throughput_pods_per_s": round(n_pods / wall_s, 1),
+        "sched_full_relists": int(SCHED_FULL_RELISTS.value() - relists0),
+        "sched_churn_nodes": n_nodes,
+        "sched_churn_pods": n_pods,
+        "sched_churn_chips_per_node": chips_per_node,
+        "sched_churn_window": window,
+        "sched_cel_compiles": compiles,
+        "sched_cel_distinct_exprs": distinct,
+        "sched_cel_cache_hit_pct": round(
+            100.0 * hits / (hits + misses), 2) if (hits + misses) else None,
+    }
+    if not gc_ok:
+        out["sched_churn_gc_leak"] = len(
+            cluster.list(RESOURCECLAIMS, namespace="default"))
+    return out
+
+
 def bench_cd_convergence():
     """Full multi-node ComputeDomain claim-to-ready: controller + 2 CD
     kubelet plugins + 2 real C++ slice daemons converging through the fake
@@ -788,6 +919,10 @@ def main():
                 2)
     except Exception as e:  # noqa: BLE001 — side phase is best-effort
         out["fake_v5p_error"] = str(e)
+    try:
+        out.update(bench_sched_churn())
+    except Exception as e:  # noqa: BLE001 — churn phase is best-effort
+        out["sched_churn_error"] = str(e)
     try:
         out.update(bench_cd_convergence())
     except Exception as e:  # noqa: BLE001 — CD phase is best-effort

@@ -1,5 +1,6 @@
 #!/usr/bin/env bash
-# Perf tier: the claim-to-ready hot path's regression tripwires (ISSUE 2):
+# Perf tier: the claim-to-ready hot path's regression tripwires (ISSUE 2)
+# plus the event-driven control plane's gates (ISSUE 3):
 #
 #   hack/perf.sh [CYCLES]
 #
@@ -11,6 +12,12 @@
 # 2. A quick claim-to-ready probe through the real gRPC path (single
 #    claim p50 + batched per-claim p50 on a fake 4-chip v5p inventory),
 #    printed as one JSON line for eyeballing against BENCH_r*.json.
+# 3. Scheduler churn gates on the fake backend (SCHED_NODES x
+#    SCHED_PODS, defaults 100x500): steady-state full relists MUST be 0
+#    (event-driven, not poll-and-scan), CEL compiles MUST not exceed
+#    distinct selector sources (compile cache), claim GC must drain, and
+#    the pod-to-allocated p50 must not regress >50% against the newest
+#    BENCH_r*.json round that recorded it.
 set -euo pipefail
 REPO_ROOT="$(cd "$(dirname "$0")/.." && pwd)"
 CYCLES="${1:-${PERF_CYCLES:-30}}"
@@ -57,5 +64,52 @@ finally:
 print(json.dumps(out))
 if p50_batch >= p50_one:
     sys.exit("REGRESSION: batched per-claim p50 not below single-claim p50")
+EOF
+
+echo ">> CEL compile-cache tripwire tests"
+JAX_PLATFORMS=cpu python -m pytest "$REPO_ROOT/tests/test_cel_cache.py" \
+  -q -p no:cacheprovider
+
+echo ">> scheduler churn gates (${SCHED_NODES:-100} nodes x ${SCHED_PODS:-500} pods, fake backend)"
+JAX_PLATFORMS=cpu TPU_DRA_TPUINFO_BACKEND=fake \
+  SCHED_NODES="${SCHED_NODES:-100}" SCHED_PODS="${SCHED_PODS:-500}" \
+  python - <<'EOF'
+import glob
+import json
+import os
+import re
+import sys
+
+import bench
+
+out = bench.bench_sched_churn(n_nodes=int(os.environ["SCHED_NODES"]),
+                              n_pods=int(os.environ["SCHED_PODS"]))
+print(json.dumps(out))
+if out["sched_full_relists"] != 0:
+    sys.exit(f"REGRESSION: {out['sched_full_relists']} steady-state full "
+             "relists (event-driven scheduler must not poll-and-scan)")
+if out["sched_cel_compiles"] > out["sched_cel_distinct_exprs"]:
+    sys.exit("REGRESSION: CEL compiles "
+             f"({out['sched_cel_compiles']}) exceed distinct expressions "
+             f"({out['sched_cel_distinct_exprs']}) — compile cache broken")
+if out.get("sched_churn_gc_leak"):
+    sys.exit(f"REGRESSION: {out['sched_churn_gc_leak']} claims leaked "
+             "after pod deletion (event-driven GC broken)")
+
+# p50 tripwire vs the newest BENCH round that recorded the metric
+# (pre-ISSUE-3 rounds did not; the first recording round sets the bar).
+prev = None
+for path in sorted(glob.glob("BENCH_r*.json"),
+                   key=lambda p: int(re.search(r"r(\d+)", p).group(1)),
+                   reverse=True):
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("sched_pod_to_allocated_p50_ms") is not None:
+        prev = (path, doc["sched_pod_to_allocated_p50_ms"])
+        break
+if prev is not None and out["sched_pod_to_allocated_p50_ms"] > prev[1] * 1.5:
+    sys.exit(f"REGRESSION: sched_pod_to_allocated_p50_ms "
+             f"{out['sched_pod_to_allocated_p50_ms']} > 1.5x {prev[1]} "
+             f"({prev[0]})")
 EOF
 echo ">> perf tier green"

@@ -1,0 +1,152 @@
+"""CEL compile-once cache tier (ISSUE 3): one parse per distinct source
+string, correct evaluation of the cached AST across devices, and
+fail-closed semantics preserved through the cache."""
+
+import threading
+
+import pytest
+
+from tpu_dra.infra.metrics import (
+    CEL_CACHE_HITS, CEL_CACHE_MISSES, CEL_COMPILES,
+)
+from tpu_dra.simcluster import cel
+from tpu_dra.simcluster.cel import (
+    CelError, compile_expr, compile_many, device_matches, evaluate,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    """Each test starts with an empty compile cache (counters are
+    process-global and monotonic; tests assert deltas)."""
+    cel.clear_cache()
+    yield
+    cel.clear_cache()
+
+
+def _deltas():
+    return (CEL_COMPILES.value(), CEL_CACHE_HITS.value(),
+            CEL_CACHE_MISSES.value())
+
+
+def dev(gen="v5p", typ="chip", coord=0):
+    return {"attributes": {"generation": {"string": gen},
+                           "type": {"string": typ},
+                           "coordX": {"int": coord}}}
+
+
+class TestCompileCache:
+    EXPR = ('device.driver == "tpu.dev" && '
+            'device.attributes["tpu.dev"].generation == "v5p"')
+
+    def test_one_compile_many_devices(self):
+        """The tentpole property: same expression, different
+        devices/attribute maps -> correct per-device results, exactly ONE
+        compile."""
+        c0, h0, m0 = _deltas()
+        results = [device_matches(self.EXPR, d, "tpu.dev") for d in
+                   (dev("v5p"), dev("v5e"), dev("v5p", coord=3),
+                    {"attributes": {}}, dev("v5p"))]
+        assert results == [True, False, True, False, True]
+        c1, h1, m1 = _deltas()
+        assert c1 - c0 == 1, "expression must compile exactly once"
+        assert m1 - m0 == 1
+        assert h1 - h0 == 4  # every evaluation after the first is a hit
+
+    def test_cache_keyed_by_full_source_string(self):
+        """'v5p' vs 'v5e' differ only in the literal: the cache must key
+        on the FULL source so they never collide."""
+        e_v5p = "device.attributes['tpu.dev'].generation == 'v5p'"
+        e_v5e = "device.attributes['tpu.dev'].generation == 'v5e'"
+        c0 = CEL_COMPILES.value()
+        assert evaluate(e_v5p, driver="tpu.dev",
+                        attributes=dev("v5p")["attributes"])
+        assert not evaluate(e_v5e, driver="tpu.dev",
+                            attributes=dev("v5p")["attributes"])
+        assert evaluate(e_v5e, driver="tpu.dev",
+                        attributes=dev("v5e")["attributes"])
+        assert not evaluate(e_v5p, driver="tpu.dev",
+                            attributes=dev("v5e")["attributes"])
+        assert CEL_COMPILES.value() - c0 == 2  # one per distinct source
+
+    def test_program_reuse_across_drivers(self):
+        """One cached program serves every (driver, attributes) pair —
+        the driver mismatch stays an eval-time no-match."""
+        prog = compile_expr(self.EXPR)
+        assert prog is compile_expr(self.EXPR)  # identical object: cached
+        assert prog.matches(dev("v5p"), "tpu.dev")
+        assert not prog.matches(dev("v5p"), "gpu.nvidia.com")
+
+    def test_syntax_errors_negatively_cached(self):
+        """A broken selector costs one parse, not one per device."""
+        bad = "device.attributes['tpu.dev'].generation =="
+        c0 = CEL_COMPILES.value()
+        for _ in range(3):
+            with pytest.raises(CelError):
+                compile_expr(bad)
+            assert not device_matches(bad, dev(), "tpu.dev")
+        assert CEL_COMPILES.value() - c0 == 1
+
+    def test_bad_regex_is_cel_error_not_crash(self):
+        bad = "device.attributes['tpu.dev'].generation.matches('[')"
+        with pytest.raises(CelError):
+            compile_expr(bad)
+        assert not device_matches(bad, dev(), "tpu.dev")
+
+    def test_compile_many_conjunction(self):
+        progs = compile_many([self.EXPR,
+                              "device.attributes['tpu.dev'].coordX >= 1"])
+        assert progs is not None and len(progs) == 2
+        assert all(p.matches(dev("v5p", coord=2), "tpu.dev") for p in progs)
+        assert not all(p.matches(dev("v5p", coord=0), "tpu.dev")
+                       for p in progs)
+        # Any broken member voids the conjunction (selects nothing).
+        assert compile_many([self.EXPR, "not (valid"]) is None
+
+    def test_short_circuit_preserved_in_ast(self):
+        """`a || b` must not evaluate b when a decides — an unknown
+        attribute on the rhs would otherwise fail the match."""
+        expr = ("device.attributes['tpu.dev'].generation == 'v5p' || "
+                "device.attributes['tpu.dev'].noSuchAttr == 1")
+        assert evaluate(expr, driver="tpu.dev",
+                        attributes=dev("v5p")["attributes"])
+        with pytest.raises(CelError):
+            evaluate(expr, driver="tpu.dev",
+                     attributes=dev("v5e")["attributes"])
+
+    def test_concurrent_compiles_stay_bounded(self):
+        """Racing first-evaluations of one expression never compile more
+        than once per distinct source (double-checked under the lock)."""
+        exprs = [f"device.attributes['tpu.dev'].coordX == {i}"
+                 for i in range(8)]
+        c0 = CEL_COMPILES.value()
+        errs = []
+
+        def worker():
+            try:
+                for e in exprs * 5:
+                    device_matches(e, dev(coord=3), "tpu.dev")
+            except Exception as exc:  # noqa: BLE001
+                errs.append(exc)
+
+        threads = [threading.Thread(target=worker) for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        assert CEL_COMPILES.value() - c0 <= len(exprs)
+
+    def test_cache_overflow_clears_and_recovers(self):
+        old_max = cel._CACHE_MAX
+        cel._CACHE_MAX = 8
+        try:
+            for i in range(20):
+                evaluate(f"device.attributes['tpu.dev'].coordX == {i}",
+                         driver="tpu.dev", attributes=dev()["attributes"])
+            assert cel.cache_info()["entries"] <= 8
+            assert evaluate("device.attributes['tpu.dev'].coordX == 0",
+                            driver="tpu.dev",
+                            attributes=dev(coord=0)["attributes"])
+        finally:
+            cel._CACHE_MAX = old_max

@@ -137,6 +137,15 @@ SITES: Dict[str, str] = {
     "checkpoint.corrupt":
         "slot file torn/corrupted after a store (action scribbles on the "
         "written paths); threatens: recovery after crash",
+    "sched.watch_event":
+        "scheduler-side watch event mishandled before the allocation "
+        "index/pending set applies it (the handler drops it and marks "
+        "the index dirty); threatens: allocated-device index staleness "
+        "— the guarded full-resync fallback must converge",
+    "sched.index_apply":
+        "incremental allocated-device index apply/remove fails; "
+        "threatens: index vs cluster-truth divergence, device "
+        "double-allocation if an allocation proceeded on a dirty index",
     "cddaemon.spawn":
         "slice-daemon child fails to spawn; threatens: readiness "
         "mirroring, CD convergence",

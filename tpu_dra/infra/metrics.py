@@ -221,6 +221,40 @@ class MetricsServer:
         self._server.server_close()
 
 
+# ---------------------------------------------------------------------------
+# Control-plane instruments (sim scheduler + CEL compile cache)
+# ---------------------------------------------------------------------------
+# Defined here rather than in their consumer modules because two layers
+# share them (simcluster.cel compiles, simcluster.scheduler evaluates and
+# resyncs) and the bench/perf tier asserts on them cross-process — one
+# canonical home keeps the gate names stable (SURVEY §10).
+
+CEL_CACHE_HITS = DefaultRegistry.counter(
+    "tpu_dra_cel_cache_hits",
+    "CEL compile-cache lookups that found a cached program")
+CEL_CACHE_MISSES = DefaultRegistry.counter(
+    "tpu_dra_cel_cache_misses",
+    "CEL compile-cache lookups that found nothing (a compile follows)")
+CEL_COMPILES = DefaultRegistry.counter(
+    "tpu_dra_cel_compiles",
+    "CEL expressions actually tokenized+parsed; steady state this equals "
+    "the number of DISTINCT selector sources seen (perf.sh gate)")
+SCHED_FULL_RELISTS = DefaultRegistry.counter(
+    "tpu_dra_sched_full_relists",
+    "scheduler-level full rescans: poll-mode reconcile_once calls plus "
+    "dirty-index resync fallbacks; steady-state event-driven target is 0")
+SCHED_WATCH_EVENTS = DefaultRegistry.counter(
+    "tpu_dra_sched_watch_events",
+    "watch events applied by the scheduler, labeled by resource")
+SCHED_PODS_BOUND = DefaultRegistry.counter(
+    "tpu_dra_sched_pods_bound",
+    "pods bound to a node by the sim scheduler")
+SCHED_CLAIMS_GCED = DefaultRegistry.counter(
+    "tpu_dra_sched_claims_gced",
+    "template-owned ResourceClaims garbage-collected after pod death, "
+    "labeled by path (event|sweep)")
+
+
 class Timer:
     """Context manager observing elapsed seconds into a Histogram."""
 

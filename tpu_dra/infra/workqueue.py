@@ -157,6 +157,11 @@ class WorkItem:
     obj: Any
     callback: Callable[[Any], None]
     item_id: int = field(default_factory=itertools.count().__next__)
+    # Registered in _queued_keys (dedupe bookkeeping)? Failure-backoff
+    # retries are NOT: a retry parked seconds out must never absorb a
+    # fresh immediate enqueue — the new item runs now, the retry later
+    # no-ops (state-based reconcile).
+    counted: bool = False
 
 
 class WorkQueue:
@@ -176,20 +181,39 @@ class WorkQueue:
         self._seq = itertools.count()
         self._cond = threading.Condition()
         self._active_ops: Dict[str, WorkItem] = {}
+        # key -> number of items still queued (in the heap, not yet
+        # popped); backs dedupe=True below.
+        self._queued_keys: Dict[str, int] = {}
         self._shutdown = False
         self._log = log or (lambda msg: None)
 
     # -- producers ----------------------------------------------------------
 
     def enqueue(self, obj: Any, callback: Callable[[Any], None],
-                key: str = "", after: Optional[float] = None) -> None:
+                key: str = "", after: Optional[float] = None,
+                dedupe: bool = False) -> None:
         """after: explicit delay in seconds, overriding the rate limiter —
         for time-based re-evaluation (settle windows) rather than
-        failure backoff."""
-        item = WorkItem(key=key, obj=obj, callback=callback)
+        failure backoff.
+
+        dedupe=True gives client-go Add() semantics for keyed items: a
+        key already sitting in the queue absorbs the enqueue (the queued
+        item will observe the latest state when it runs — callbacks are
+        state-based reconciles by contract), while a key currently
+        PROCESSING enqueues normally so a change racing the reconcile is
+        never lost. Failure-backoff retries never absorb (WorkItem
+        .counted): a retry parked behind exponential backoff must not
+        delay reaction to a fresh event. Event-storm fan-in (N
+        capacity-freed events all nudging the same pending pods)
+        collapses to one queued item per key instead of N."""
         with self._cond:
+            if dedupe and key and self._queued_keys.get(key, 0) > 0:
+                return
+            item = WorkItem(key=key, obj=obj, callback=callback)
             if key:
                 self._active_ops[key] = item
+                item.counted = True
+                self._queued_keys[key] = self._queued_keys.get(key, 0) + 1
             self._push_locked(item, after=after)
             self._cond.notify()
 
@@ -228,6 +252,14 @@ class WorkQueue:
                     now = time.monotonic()
                     if ready_at <= now:
                         heapq.heappop(self._heap)
+                        if item.key and item.counted:
+                            item.counted = False  # a retry re-push stays
+                            #   uncounted: dedupe must not absorb into it
+                            n = self._queued_keys.get(item.key, 0) - 1
+                            if n > 0:
+                                self._queued_keys[item.key] = n
+                            else:
+                                self._queued_keys.pop(item.key, None)
                         return item
                     self._cond.wait(timeout=min(ready_at - now, 0.5))
                 else:

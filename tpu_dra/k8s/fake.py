@@ -71,8 +71,15 @@ class FakeCluster(ApiClient):
         obj.setdefault("metadata", {})["resourceVersion"] = str(self._last_rv)
 
     def _emit(self, gvr: GVR, ns: str, event_type: str, obj: Dict) -> None:
+        # ONE frozen snapshot per event, shared by the replay log and
+        # every watcher queue (events are read-only by contract — the
+        # informer layer copies before handing objects to mutating
+        # consumers). The previous per-watcher deepcopy made every emit
+        # O(watchers) full copies, which dominated the fake apiserver at
+        # churn scale (5 informers x thousands of lifecycle events).
+        snapshot = copy.deepcopy(obj)
         rv = int(obj.get("metadata", {}).get("resourceVersion", "0") or 0)
-        self._events.append((rv, gvr.key, ns, event_type, copy.deepcopy(obj)))
+        self._events.append((rv, gvr.key, ns, event_type, snapshot))
         if len(self._events) > self.EVENT_LOG_CAP:
             cut = len(self._events) - self.EVENT_LOG_CAP
             self._trimmed_rv = max(self._trimmed_rv, self._events[cut - 1][0])
@@ -85,7 +92,7 @@ class FakeCluster(ApiClient):
                 continue
             if not label_selector_matches(w.selector, labels):
                 continue
-            w.events.put((event_type, copy.deepcopy(obj)))
+            w.events.put((event_type, snapshot))
 
     def _run_reactors(self, verb: str, gvr: GVR, obj: Optional[Dict]):
         for r in self.reactors:
@@ -178,6 +185,12 @@ class FakeCluster(ApiClient):
             if (merged["metadata"].get("deletionTimestamp")
                     and not merged["metadata"].get("finalizers")):
                 del bucket[name]
+                # Fresh RV for the DELETED event: reusing the MODIFIED
+                # event's RV would let a watch resuming from it skip the
+                # deletion entirely (`rv <= since` in the replay path) —
+                # an event-loss hole an incremental cache index never
+                # recovers from without a full resync.
+                self._bump(merged)
                 self._emit(gvr, key[1], "DELETED", merged)
             return copy.deepcopy(merged)
 

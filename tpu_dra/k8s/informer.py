@@ -17,6 +17,11 @@ from typing import Callable, Dict, List, Optional
 from tpu_dra.k8s.client import ApiClient, GVR
 
 
+# Sentinel returned by Informer._set for writes that lost an RV race
+# (see _set); watch loops skip dispatch for them.
+STALE = object()
+
+
 def meta_namespace_key(obj: Dict) -> str:
     meta = obj.get("metadata", {})
     ns = meta.get("namespace", "")
@@ -36,21 +41,35 @@ def label_index(label: str) -> Callable[[Dict], List[str]]:
 
 
 class Lister:
-    """Read access to an informer's cache (the lister analog)."""
+    """Read access to an informer's cache (the lister analog).
 
-    def __init__(self, store: Dict[str, Dict], lock: threading.RLock):
+    ``deep_copy=True`` (the default) hands every caller a private copy —
+    safe to mutate, paid per read. Hot read-only consumers (the sim
+    scheduler scans pods/claims/slices on every scheduling attempt) pass
+    ``deep_copy=False`` and receive VIEWS of the live cache objects:
+    the ownership rule (SURVEY §10) is that zero-copy reads are
+    read-only — a caller that wants to mutate must ``copy.deepcopy`` the
+    one object it writes, never the whole listing."""
+
+    def __init__(self, store: Dict[str, Dict], lock: threading.RLock,
+                 deep_copy: bool = True):
         self._store = store
         self._lock = lock
+        self._deep_copy = deep_copy
 
     def get(self, name: str, namespace: str = "") -> Optional[Dict]:
         key = f"{namespace}/{name}" if namespace else name
         with self._lock:
             obj = self._store.get(key)
-            return copy.deepcopy(obj) if obj else None
+            if obj is None:
+                return None
+            return copy.deepcopy(obj) if self._deep_copy else obj
 
     def list(self) -> List[Dict]:
         with self._lock:
-            return [copy.deepcopy(o) for o in self._store.values()]
+            if self._deep_copy:
+                return [copy.deepcopy(o) for o in self._store.values()]
+            return list(self._store.values())
 
 
 class Informer:
@@ -60,12 +79,21 @@ class Informer:
     def __init__(self, client: ApiClient, gvr: GVR,
                  namespace: Optional[str] = None,
                  label_selector: Optional[str] = None,
-                 field_filter: Optional[Callable[[Dict], bool]] = None):
+                 field_filter: Optional[Callable[[Dict], bool]] = None,
+                 copy_on_read: bool = True,
+                 copy_events: bool = True):
+        """copy_on_read=False makes the lister (and get_by_index) return
+        views of the cache instead of deepcopies — for hot read-only
+        consumers; see Lister. copy_events=False skips the per-dispatch
+        deepcopy of handler arguments — handlers then share the cached
+        object and MUST treat it as read-only."""
         self._client = client
         self._gvr = gvr
         self._namespace = namespace
         self._selector = label_selector
         self._field_filter = field_filter
+        self._copy_on_read = copy_on_read
+        self._copy_events = copy_events
         self._store: Dict[str, Dict] = {}
         self._lock = threading.RLock()
         self._indexers: Dict[str, Callable[[Dict], List[str]]] = {}
@@ -77,7 +105,8 @@ class Informer:
         self._listed_ok = False
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self.lister = Lister(self._store, self._lock)
+        self.lister = Lister(self._store, self._lock,
+                             deep_copy=copy_on_read)
 
     # -- configuration (before start) ---------------------------------------
 
@@ -116,8 +145,10 @@ class Informer:
 
     def get_by_index(self, index: str, value: str) -> List[Dict]:
         with self._lock:
-            return [copy.deepcopy(o)
-                    for o in self._indices.get(index, {}).get(value, {}).values()]
+            objs = self._indices.get(index, {}).get(value, {}).values()
+            if self._copy_on_read:
+                return [copy.deepcopy(o) for o in objs]
+            return list(objs)
 
     def update_cache(self, obj: Dict) -> None:
         """Mutation cache: record our own write so the next read sees it
@@ -131,9 +162,28 @@ class Informer:
     def _accepts(self, obj: Dict) -> bool:
         return self._field_filter is None or self._field_filter(obj)
 
-    def _set(self, obj: Dict) -> Optional[Dict]:
+    @staticmethod
+    def _rv_int(obj: Dict) -> Optional[int]:
+        try:
+            return int(obj.get("metadata", {}).get("resourceVersion") or 0)
+        except (TypeError, ValueError):
+            return None  # opaque RV: ordering unknown, accept the write
+
+    def _set(self, obj: Dict):
+        """Store obj; returns the previous object, None (new key), or
+        the STALE sentinel when obj carries an OLDER resourceVersion
+        than the cache — which happens when a consumer's update_cache
+        (mutation-cache write) raced an already-queued watch event for
+        an earlier state. Accepting that event would roll the cache (and
+        any event-driven index built on it) back in time; per-object RV
+        monotonicity is exactly what a real watch stream guarantees."""
         key = meta_namespace_key(obj)
         old = self._store.get(key)
+        if old is not None:
+            new_rv, old_rv = self._rv_int(obj), self._rv_int(old)
+            if (new_rv is not None and old_rv is not None
+                    and new_rv < old_rv):
+                return STALE
         self._store[key] = obj
         self._reindex(key, old, obj)
         return old
@@ -159,7 +209,10 @@ class Informer:
     def _dispatch(self, handlers, *args) -> None:
         for h in handlers:
             try:
-                h(*copy.deepcopy(args))
+                # copy_events=False: handlers share the cached object and
+                # must treat it as read-only (the scheduler's handlers
+                # only derive keys / index entries from it).
+                h(*(copy.deepcopy(args) if self._copy_events else args))
             except Exception:  # noqa: BLE001 — a broken handler must not kill the watch
                 import traceback
                 traceback.print_exc()
@@ -198,17 +251,20 @@ class Informer:
         self._listed_ok = True
         with self._lock:
             seen = set()
+            stale = set()
             for obj in objs:
                 if not self._accepts(obj):
                     continue
-                seen.add(meta_namespace_key(obj))
-                self._set(obj)
+                key = meta_namespace_key(obj)
+                seen.add(key)
+                if self._set(obj) is STALE:
+                    stale.add(key)  # mutation-cache write outran the LIST
             for key in [k for k in self._store if k not in seen]:
                 gone = self._store[key]
                 self._remove(gone)
                 self._dispatch(self._delete_handlers, gone)
         for obj in objs:
-            if self._accepts(obj):
+            if self._accepts(obj) and meta_namespace_key(obj) not in stale:
                 self._dispatch(self._add_handlers, obj)
         self._synced.set()
 
@@ -226,16 +282,14 @@ class Informer:
                 raise RuntimeError(f"watch stream error: {obj}")
             if not self._accepts(obj):
                 continue
-            if event_type == "ADDED":
+            if event_type in ("ADDED", "MODIFIED"):
                 with self._lock:
                     old = self._set(obj)
-                if old is None:
-                    self._dispatch(self._add_handlers, obj)
-                else:
-                    self._dispatch(self._update_handlers, old, obj)
-            elif event_type == "MODIFIED":
-                with self._lock:
-                    old = self._set(obj)
+                if old is STALE:
+                    # An update_cache write already advanced this key
+                    # past the event's RV; dispatching the older state
+                    # would roll event-driven consumers back in time.
+                    continue
                 if old is None:
                     self._dispatch(self._add_handlers, obj)
                 else:

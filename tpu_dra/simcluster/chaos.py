@@ -511,6 +511,248 @@ def run_matrix(seeds: List[int], n_events: int = 40) -> Dict:
 
 
 # ---------------------------------------------------------------------------
+# Scheduler-churn walk (event-driven control plane under faults)
+# ---------------------------------------------------------------------------
+
+# Sites the scheduler walk may arm: API flakes and watch-stream drops hit
+# the informer plane; sched.watch_event / sched.index_apply hit the
+# scheduler's own event handling and incremental allocation index, so the
+# guarded full-resync fallback is chaos-tested on the production path.
+SCHED_CHAOS_SITES = ("k8s.api.request", "k8s.watch.drop",
+                     "sched.watch_event", "sched.index_apply")
+
+
+def _chip_conflicts(claims: List[Dict]) -> List[str]:
+    """Device double-allocations across allocated claims, with partition
+    semantics: the same device twice, or a whole chip plus any of its
+    subslices, in DIFFERENT claims."""
+    from tpu_dra.simcluster.scheduler import (
+        _parent_of, claim_entries, claim_key,
+    )
+
+    holders: Dict[tuple, List[str]] = {}     # (driver,pool,device) -> keys
+    chip_holders: Dict[tuple, List[tuple]] = {}  # (driver,pool,chip) ->
+    #                                              [(key, is_whole)]
+    out = []
+    for claim in claims:
+        key = claim_key(claim)
+        for driver, pool, dev in claim_entries(claim):
+            holders.setdefault((driver, pool, dev), []).append(key)
+            chip = _parent_of(dev)  # the scheduler's own partition rule
+            chip_holders.setdefault((driver, pool, chip), []).append(
+                (key, chip == dev))
+    for ent, keys in sorted(holders.items()):
+        if len(set(keys)) > 1:
+            out.append(f"device {ent} allocated to {sorted(set(keys))}")
+    for ent, users in sorted(chip_holders.items()):
+        whole = {k for k, is_whole in users if is_whole}
+        subs = {k for k, is_whole in users if not is_whole}
+        if whole and subs - whole:
+            out.append(f"chip {ent} wholly allocated to {sorted(whole)} "
+                       f"while subslices go to {sorted(subs)}")
+    return out
+
+
+class SchedulerChaosHarness:
+    """One seeded schedule against the EVENT-DRIVEN scheduler: a random
+    walk of pod churn (create / delete), fault re-arming across
+    SCHED_CHAOS_SITES, and forced resyncs, against a real Scheduler over
+    a RetryingApiClient-wrapped FakeCluster with a deliberately tiny
+    watch-event log (dropped streams hit real 410 relists). After the
+    walk, faults are disarmed and the harness waits for convergence,
+    then asserts the ISSUE's invariants:
+
+    1. every live pod is bound, its claims allocated on its node;
+    2. no device double-allocation (partition semantics included);
+    3. no claim left behind by a dead pod (no leak after pod death);
+    4. the incremental allocation index matches cluster truth.
+    """
+
+    QUIESCE_TIMEOUT = 30.0
+
+    def __init__(self, seed: int, *, nodes: int = 4, chips_per_node: int = 2):
+        from tpu_dra.simcluster.scheduler import Scheduler
+
+        self.seed = seed
+        self.rng = random.Random(seed ^ 0x5C4ED)
+        self.report = ChaosReport(seed=seed)
+        self.nodes = nodes
+        self.chips = chips_per_node
+        self.capacity = nodes * chips_per_node
+        self.cluster = FakeCluster()
+        self.cluster.EVENT_LOG_CAP = 48  # tight history: drops hit 410s
+        self.client = RetryingApiClient(
+            self.cluster, max_attempts=4, base_delay=0.001,
+            max_delay=0.01, rng=random.Random(seed ^ 0xD15C))
+        self._seed_inventory()
+        self.sched = Scheduler(self.client, resync_interval=0.05,
+                               gc_sweep_interval=0.2)
+        self.sched.start()
+        for inf in self.sched._informers.values():
+            inf.RELIST_BACKOFF_BASE = 0.01  # keep the chaos tier fast
+        self.live: Dict[str, None] = {}
+        self._pod_seq = 0
+
+    def _seed_inventory(self) -> None:
+        from tpu_dra.testing import seed_sched_inventory
+        seed_sched_inventory(self.cluster, nodes=self.nodes,
+                             chips_per_node=self.chips)
+
+    # -- walk ops -----------------------------------------------------------
+
+    def _random_schedule(self) -> Schedule:
+        kind = self.rng.choice(("nth", "prob", "oneshot"))
+        if kind == "nth":
+            return EveryNth(self.rng.randint(1, 4))
+        if kind == "prob":
+            return Probabilistic(self.rng.uniform(0.1, 0.5),
+                                 random.Random(self.rng.randrange(1 << 30)))
+        return OneShot(after=self.rng.randint(0, 3))
+
+    def _harvest_faults(self) -> None:
+        for site, fired in FAULTS.take_counts().items():
+            self.report.injected[site] = (
+                self.report.injected.get(site, 0) + fired)
+
+    def _op_rearm(self) -> None:
+        self._harvest_faults()
+        site = self.rng.choice(SCHED_CHAOS_SITES)
+        if self.rng.random() < 0.3:
+            FAULTS.disarm(site)
+            return
+        FAULTS.arm(site, self._random_schedule())
+
+    def _op_create_pod(self) -> None:
+        if len(self.live) >= self.capacity:
+            return  # keep the cluster satisfiable: quiesce expects binds
+        from tpu_dra.testing import make_sched_pod
+        name = f"cp-{self.seed}-{self._pod_seq}"
+        self._pod_seq += 1
+        make_sched_pod(self.cluster, name)
+        self.live[name] = None
+        self.report.prepares += 1  # pod lifecycles driven
+
+    def _op_delete_pod(self) -> None:
+        if not self.live:
+            return
+        name = self.rng.choice(sorted(self.live))
+        self.cluster.delete(PODS, name, "default")
+        self.live.pop(name, None)
+        self.report.unprepares += 1
+
+    def _op_force_resync(self) -> None:
+        self.sched.request_resync("chaos op")
+
+    # -- run + invariants ---------------------------------------------------
+
+    def run(self, n_events: int = 60) -> ChaosReport:
+        ops = [(self._op_create_pod, 4), (self._op_delete_pod, 2),
+               (self._op_rearm, 2), (self._op_force_resync, 1)]
+        weighted = [op for op, w in ops for _ in range(w)]
+        try:
+            for _ in range(n_events):
+                self.report.events += 1
+                self.rng.choice(weighted)()
+                # Let the control plane breathe between ops; the walk is
+                # about interleaving, not about starving the scheduler.
+                time.sleep(self.rng.uniform(0.0, 0.004))
+            self.quiesce_and_verify()
+        finally:
+            self._harvest_faults()
+            FAULTS.reset()
+            self.close()
+        return self.report
+
+    def _converged(self) -> List[str]:
+        """Empty when the control plane reached the expected steady
+        state; otherwise what is still wrong (the quiesce loop polls
+        this until the deadline, then records it as violations)."""
+        problems = []
+        pods = {p["metadata"]["name"]: p
+                for p in self.cluster.list(PODS, namespace="default")}
+        claims = self.cluster.list(RESOURCECLAIMS, namespace="default")
+        for name in sorted(self.live):
+            pod = pods.get(name)
+            if pod is None:
+                problems.append(f"live pod {name} missing from cluster")
+                continue
+            node = pod["spec"].get("nodeName")
+            if not node:
+                problems.append(f"live pod {name} not bound")
+                continue
+            claim = next((c for c in claims
+                          if (c["metadata"].get("annotations") or {}).get(
+                              "sim/owner-pod") == name), None)
+            if claim is None:
+                problems.append(f"live pod {name} has no claim")
+                continue
+            entries = [r.get("pool") for r in
+                       ((claim.get("status") or {}).get("allocation") or {})
+                       .get("devices", {}).get("results", [])]
+            if not entries:
+                problems.append(f"claim of live pod {name} unallocated")
+            elif set(entries) != {node}:
+                problems.append(f"pod {name} bound to {node} but claim "
+                                f"allocated on {sorted(set(entries))}")
+        alive = set(self.live)
+        for claim in claims:
+            owner = (claim["metadata"].get("annotations") or {}).get(
+                "sim/owner-pod")
+            if owner and owner not in alive:
+                problems.append(f"claim {claim['metadata']['name']} leaked "
+                                f"after pod {owner} death")
+        # Index health is part of convergence: a resync enqueued by the
+        # walk's final ops may still be queued — asserting one-shot
+        # after cluster-truth convergence would flag that transient as
+        # a violation.
+        if self.sched._index.dirty:
+            problems.append("index dirty (resync pending)")
+        else:
+            problems.extend(self.sched.verify_index())
+        return problems
+
+    def quiesce_and_verify(self) -> None:
+        self._harvest_faults()
+        FAULTS.reset()
+        v = self.report.violations
+        deadline = time.monotonic() + self.QUIESCE_TIMEOUT
+        problems = self._converged()
+        while problems and time.monotonic() < deadline:
+            time.sleep(0.02)
+            problems = self._converged()
+        v.extend(problems)
+        # Hard invariants, on cluster truth after convergence:
+        claims = self.cluster.list(RESOURCECLAIMS, namespace="default")
+        v.extend(_chip_conflicts(claims))
+        v.extend(self.sched.verify_index())
+
+    def close(self) -> None:
+        self.sched.stop()
+
+
+def run_sched_schedule(seed: int, n_events: int = 60) -> ChaosReport:
+    """One seeded scheduler-churn walk to quiesce."""
+    return SchedulerChaosHarness(seed).run(n_events)
+
+
+def run_sched_matrix(seeds: List[int], n_events: int = 60) -> Dict:
+    reports = [run_sched_schedule(seed, n_events) for seed in seeds]
+    injected: Dict[str, int] = {}
+    for r in reports:
+        for site, n in r.injected.items():
+            injected[site] = injected.get(site, 0) + n
+    return {
+        "schedules": len(reports),
+        "events": sum(r.events for r in reports),
+        "pod_creates": sum(r.prepares for r in reports),
+        "pod_deletes": sum(r.unprepares for r in reports),
+        "injected": injected,
+        "violations": [f"seed {r.seed}: {msg}"
+                       for r in reports for msg in r.violations],
+    }
+
+
+# ---------------------------------------------------------------------------
 # Dropped-watch + API-flake scenario
 # ---------------------------------------------------------------------------
 
@@ -615,14 +857,18 @@ def main(argv=None) -> int:
                     help="lifecycle events per schedule")
     args = ap.parse_args(argv)
 
-    summary = run_matrix(
-        list(range(args.seed_start, args.seed_start + args.seeds)),
-        n_events=args.events)
+    seeds = list(range(args.seed_start, args.seed_start + args.seeds))
+    summary = run_matrix(seeds, n_events=args.events)
     summary["watch_flake_violations"] = run_watch_flake_scenario(
         seed=args.seed_start)
+    # Scheduler-churn walk over the same seed matrix: the event-driven
+    # control plane (informers + incremental allocation index + guarded
+    # resync) under the sched.* fault sites.
+    summary["scheduler"] = run_sched_matrix(seeds, n_events=args.events)
     print(json.dumps(summary, indent=2))
     return 1 if (summary["violations"]
-                 or summary["watch_flake_violations"]) else 0
+                 or summary["watch_flake_violations"]
+                 or summary["scheduler"]["violations"]) else 0
 
 
 if __name__ == "__main__":

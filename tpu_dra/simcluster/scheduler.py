@@ -7,53 +7,695 @@ follows the real algorithm's observable behavior: DeviceClass CEL
 selectors are matched against device attributes published in
 ResourceSlices, devices already referenced by any allocated claim are
 excluded, and the pod binds to a node that can satisfy every claim.
+
+Two drive modes (SURVEY §10):
+
+- **event mode** (``start()``) — the production shape, mirroring the
+  reference's informer/workqueue controllers: informers watch Pods /
+  ResourceClaims / ResourceSlices / DeviceClasses / Nodes, only dirty
+  pods are enqueued, and the allocated-device set lives in an
+  **incremental AllocationIndex** maintained from claim watch events
+  (plus the scheduler's own writes, mutation-cache style) instead of
+  being recomputed from a full claim list per attempt. Claim GC runs
+  from pod-delete events with a low-frequency sweep as the safety net.
+  Steady state performs ZERO full relists (metrics:
+  ``tpu_dra_sched_full_relists``); the index falls back to a guarded
+  full resync only when an event is known-dropped or an index apply
+  fails (fault sites ``sched.watch_event`` / ``sched.index_apply``).
+
+- **sync mode** (``reconcile_once()`` on an unstarted scheduler, or
+  ``start(mode="poll")``) — the poll-and-scan path kept for unit tests
+  and as the ultimate fallback: full-lists Pods and ResourceClaims and
+  rebuilds a transient index per pass. Every pass counts as a full
+  relist.
+
+CEL selector evaluation is compile-cached (simcluster.cel): expressions
+parse once per distinct source string; allocation evaluates the cached
+AST per candidate device. Per-DeviceClass selector sources are
+additionally cached keyed by the class's resourceVersion.
 """
 
 from __future__ import annotations
 
+import copy
 import logging
 import threading
-from typing import Dict, List, Optional, Set, Tuple
+import time
+from collections import deque
+from typing import Dict, Iterable, List, Optional, Set, Tuple
 
-from tpu_dra.k8s.client import ApiClient, ConflictError, NotFoundError
+from tpu_dra.infra.faults import FAULTS, FaultInjected
+from tpu_dra.infra.metrics import (
+    SCHED_CLAIMS_GCED, SCHED_FULL_RELISTS, SCHED_PODS_BOUND,
+    SCHED_WATCH_EVENTS,
+)
+from tpu_dra.infra.workqueue import (
+    ExponentialFailureRateLimiter, WorkQueue,
+)
+from tpu_dra.k8s.client import (
+    AlreadyExistsError, ApiClient, ConflictError, NotFoundError,
+)
+from tpu_dra.k8s.informer import Informer
 from tpu_dra.k8s.resources import (
     DEVICECLASSES, NODES, PODS, RESOURCECLAIMS, RESOURCECLAIMTEMPLATES,
     RESOURCESLICES,
 )
-from tpu_dra.simcluster.cel import device_matches
+from tpu_dra.simcluster import cel
 
 log = logging.getLogger("simcluster.scheduler")
 
+_Entry = Tuple[str, str, str]  # (driver, pool, device)
+
+
+def _parent_of(device: str) -> str:
+    """Subslice devices ('chip-N-ss...') partition their parent chip
+    ('chip-N'); everything else is its own parent."""
+    return device.split("-ss")[0] if "-ss" in device else device
+
+
+def _expand(entries: Iterable[_Entry]) -> List[_Entry]:
+    """Allocation entries plus their partition-semantics block markers
+    (the DRA partitionable-device counter analog): a whole-chip
+    allocation blocks its subslices (marker '<chip>-ss*') and a subslice
+    blocks the whole chip (marker = parent name), while two different
+    subslices of one chip can coexist (MIG-style)."""
+    out: List[_Entry] = []
+    for driver, pool, name in entries:
+        out.append((driver, pool, name))
+        parent = _parent_of(name)
+        out.append((driver, pool, parent) if parent != name
+                   else (driver, pool, f"{name}-ss*"))
+    return out
+
+
+def claim_key(obj: Dict) -> str:
+    meta = obj.get("metadata", {})
+    return f"{meta.get('namespace', 'default')}/{meta['name']}"
+
+
+def claim_entries(claim: Dict) -> Tuple[_Entry, ...]:
+    """The (driver, pool, device) results of a claim's allocation
+    (empty when unallocated)."""
+    alloc = (claim.get("status") or {}).get("allocation") or {}
+    return tuple(
+        (r.get("driver", ""), r.get("pool", ""), r.get("device", ""))
+        for r in (alloc.get("devices") or {}).get("results") or [])
+
+
+class AllocationIndex:
+    """Incremental allocated-device index, maintained from ResourceClaim
+    add/update/delete events instead of re-listing all claims per
+    scheduling attempt.
+
+    Holds only extracted string tuples (never references to cache
+    objects), refcounted so that two subslice claims on one chip keep
+    the parent-chip block marker alive until BOTH release. ``apply`` is
+    idempotent per claim key (replace semantics), which makes informer
+    relists — which re-dispatch adds for every object — safe to feed
+    straight in.
+
+    ``dirty`` flags a known divergence (a dropped watch event, a failed
+    apply): allocation must not proceed until ``resync`` rebuilds from a
+    full claim listing (the guarded fallback).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._by_claim: Dict[str, Tuple[_Entry, ...]] = {}
+        self._taken: Dict[_Entry, int] = {}
+        # Per-claim resourceVersion high-water mark: the scheduler
+        # applies its OWN writes synchronously (mutation-cache style),
+        # so the watch event for an EARLIER state of the same claim can
+        # arrive afterwards on the informer thread — applying it would
+        # roll the allocation back and let another pod double-allocate
+        # the device. Numeric-RV monotonicity guards every apply/remove.
+        self._rv: Dict[str, int] = {}
+        # FIFO of keys whose allocation is gone but whose watermark is
+        # retained (anti-resurrection for in-flight stale events). The
+        # steady state is designed to NEVER resync, so without eviction
+        # one watermark per claim-ever-seen would leak; beyond the
+        # horizon a stale event for the claim can no longer be in
+        # flight, so the oldest watermarks are safe to drop.
+        self._removed: "deque[str]" = deque()
+        # Bumped on every EFFECTIVE mutation: lets a resync detect that
+        # an informer-thread apply/remove landed between its lister
+        # snapshot and its swap (which would otherwise be silently
+        # resurrected by the wholesale replace).
+        self._mutations = 0
+        self.dirty = False
+        self.dirty_reason = ""
+
+    RV_RETENTION = 4096  # evicted-claim watermarks kept (FIFO)
+
+    # -- mutation -----------------------------------------------------------
+
+    def _add(self, expanded: List[_Entry]) -> None:
+        for e in expanded:
+            self._taken[e] = self._taken.get(e, 0) + 1
+
+    def _sub(self, expanded: List[_Entry]) -> None:
+        for e in expanded:
+            n = self._taken.get(e, 0) - 1
+            if n > 0:
+                self._taken[e] = n
+            else:
+                self._taken.pop(e, None)
+
+    def _note_removed_locked(self, key: str) -> None:
+        self._removed.append(key)
+        while len(self._removed) > self.RV_RETENTION:
+            old = self._removed.popleft()
+            if old not in self._by_claim:  # not re-created since
+                self._rv.pop(old, None)
+
+    # ONE resourceVersion parse for both halves of the mutation-cache
+    # discipline: the informer's STALE guard and this index's watermark
+    # must agree on ordering or one layer accepts what the other rejects.
+    _rv_int = staticmethod(Informer._rv_int)
+
+    def _stale_locked(self, key: str, claim: Dict) -> bool:
+        rv = self._rv_int(claim)
+        if rv is None:
+            return False
+        if rv < self._rv.get(key, 0):
+            return True
+        self._rv[key] = rv
+        return False
+
+    def apply(self, claim: Dict) -> None:
+        """Add/replace one claim's allocation. Consults the
+        ``sched.index_apply`` fault site — a raised fault leaves the
+        index UNCHANGED (the caller marks it dirty and resyncs).
+        Applies carrying an older resourceVersion than already indexed
+        are ignored (see _rv above)."""
+        key = claim_key(claim)
+        FAULTS.check("sched.index_apply", claim=key)
+        entries = claim_entries(claim)
+        with self._lock:
+            if self._stale_locked(key, claim):
+                return
+            old = self._by_claim.get(key)
+            if old == entries:
+                return
+            self._mutations += 1
+            if old:
+                self._sub(_expand(old))
+            if entries:
+                self._add(_expand(entries))
+                self._by_claim[key] = entries
+            elif old is not None:
+                self._by_claim.pop(key, None)
+                self._note_removed_locked(key)
+
+    def remove(self, claim: Dict, force: bool = False) -> None:
+        """Drop a claim's allocation. ``force=True`` is for the
+        scheduler mirroring its OWN client.delete (the delete's RV is
+        unknowable — the verb returns nothing), so the staleness guard
+        is bypassed and the high-water mark advanced to at least the
+        deleted object's RV; single-writer discipline makes that safe."""
+        key = claim_key(claim)
+        FAULTS.check("sched.index_apply", claim=key)
+        with self._lock:
+            if force:
+                rv = self._rv_int(claim)
+                if rv:
+                    self._rv[key] = max(self._rv.get(key, 0), rv)
+            elif self._stale_locked(key, claim):
+                return
+            self._mutations += 1  # watermark advance alone must also
+            #   invalidate an in-flight resync snapshot
+            old = self._by_claim.pop(key, None)
+            if old:
+                self._sub(_expand(old))
+            self._note_removed_locked(key)
+
+    def begin_resync(self) -> None:
+        """Clear the dirty flag BEFORE the caller takes its claim
+        snapshot: a concurrent _mark_dirty whose dropped event postdates
+        the snapshot then re-dirties the index and its queued resync
+        re-runs — clearing after the swap would clobber that mark and
+        leave the index divergent forever."""
+        with self._lock:
+            self.dirty = False
+            self.dirty_reason = ""
+
+    def mutation_count(self) -> int:
+        with self._lock:
+            return self._mutations
+
+    def resync(self, claims: Iterable[Dict],
+               only_if_mutations: Optional[int] = None) -> bool:
+        """Rebuild from a full claim listing (call begin_resync first).
+        Deliberately does NOT consult the fault site: this IS the
+        recovery path — an armed apply fault must not be able to starve
+        it. Does NOT touch the dirty flag (see begin_resync).
+
+        only_if_mutations: the mutation_count() the caller read BEFORE
+        taking its claim snapshot; the swap is refused (returns False)
+        when a concurrent apply/remove landed in between — wholesale
+        replacement would silently resurrect what that mutation
+        changed (e.g. an out-of-band claim delete)."""
+        by_claim: Dict[str, Tuple[_Entry, ...]] = {}
+        taken: Dict[_Entry, int] = {}
+        rvs: Dict[str, int] = {}
+        for claim in claims:
+            key = claim_key(claim)
+            rv = self._rv_int(claim)
+            if rv:
+                rvs[key] = rv
+            entries = claim_entries(claim)
+            if not entries:
+                continue
+            by_claim[key] = entries
+            for e in _expand(entries):
+                taken[e] = taken.get(e, 0) + 1
+        with self._lock:
+            if (only_if_mutations is not None
+                    and self._mutations != only_if_mutations):
+                return False
+            self._by_claim = by_claim
+            self._taken = taken
+            self._rv = rvs
+            self._removed.clear()
+        return True
+
+    # -- queries ------------------------------------------------------------
+
+    def is_taken(self, driver: str, pool: str, name: str,
+                 overlay: Optional[Set[_Entry]] = None) -> bool:
+        key = (driver, pool, name)
+        parent = _parent_of(name)
+        marker = (driver, pool, f"{parent}-ss*") if parent != name else None
+        with self._lock:
+            if key in self._taken:
+                return True
+            if marker and marker in self._taken:
+                return True  # parent chip wholly claimed
+        if overlay:
+            if key in overlay:
+                return True
+            if marker and marker in overlay:
+                return True
+        return False
+
+    def entries_for(self, key: str) -> Tuple[_Entry, ...]:
+        with self._lock:
+            return self._by_claim.get(key, ())
+
+    def owners_of_pool(self, pool: str) -> Set[str]:
+        """Claim keys holding any device on `pool` (diagnostics)."""
+        with self._lock:
+            return {k for k, entries in self._by_claim.items()
+                    if any(e[1] == pool for e in entries)}
+
+    def diff_against(self, claims: Iterable[Dict]) -> List[str]:
+        """Divergences between the live index and a ground-truth claim
+        listing (chaos invariant: after quiesce, empty)."""
+        want: Dict[str, Tuple[_Entry, ...]] = {}
+        for claim in claims:
+            entries = claim_entries(claim)
+            if entries:
+                want[claim_key(claim)] = entries
+        with self._lock:
+            have = dict(self._by_claim)
+        out = []
+        for key in sorted(set(want) | set(have)):
+            if want.get(key) != have.get(key):
+                out.append(f"index[{key}]={have.get(key)} != "
+                           f"truth {want.get(key)}")
+        return out
+
+
+class _Unscheduled(Exception):
+    """Internal: transient condition (conflict, missing object) — let the
+    workqueue retry with backoff."""
+
 
 class Scheduler:
-    def __init__(self, client: ApiClient, interval: float = 0.15):
+    """See module docstring. ``interval`` is the poll-mode cadence (and
+    the legacy constructor signature); ``resync_interval`` is the
+    event-mode safety-net cadence at which still-pending pods are
+    re-nudged; ``gc_sweep_interval`` paces the low-frequency orphan-claim
+    sweep backing the event-driven GC."""
+
+    SYNC_TIMEOUT = 10.0
+
+    def __init__(self, client: ApiClient, interval: float = 0.15, *,
+                 resync_interval: float = 2.0,
+                 gc_sweep_interval: float = 10.0):
         self._client = client
         self._interval = interval
+        self._resync_interval = resync_interval
+        self._gc_sweep_interval = gc_sweep_interval
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._queue: Optional[WorkQueue] = None
+        self._worker: Optional[threading.Thread] = None
+        self._sweeper: Optional[threading.Thread] = None
+        self._informers: Dict[str, Informer] = {}
+        self._index = AllocationIndex()
+        self._pending: Set[str] = set()
+        # Pods fully placed by us: their own bind-event echo must not
+        # re-enqueue a full reconcile pass (entries leave on pod delete,
+        # so the set is bounded by live placed pods).
+        self._done: Set[str] = set()
+        self._plock = threading.Lock()
+        # DeviceClass name -> (resourceVersion, selector sources): spares
+        # re-extracting selector lists per allocation; the compiled
+        # programs themselves are cached process-wide in simcluster.cel.
+        self._class_cache: Dict[str, Tuple[str, List[str]]] = {}
+        self._started = False
 
-    def start(self) -> None:
-        self._thread = threading.Thread(target=self._run, daemon=True,
-                                        name="sim-scheduler")
-        self._thread.start()
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self, mode: str = "events") -> None:
+        self._stop.clear()  # both modes: a restart after stop() must run
+        if mode == "poll":
+            self._thread = threading.Thread(target=self._poll_run,
+                                            daemon=True,
+                                            name="sim-scheduler")
+            self._thread.start()
+            return
+        # Fresh state for (re)start: informers begin with empty stores,
+        # so nothing would ever dispatch deletes for claims that died
+        # while the scheduler was stopped — a retained index would keep
+        # their devices phantom-allocated forever.
+        self._index = AllocationIndex()
+        with self._plock:
+            self._pending.clear()
+            self._done.clear()
+        self._class_cache.clear()
+        self._queue = WorkQueue(
+            # No global token bucket: event enqueues are explicit-delay
+            # (after=0) and failures back off per item; a bucket would
+            # throttle churn-scale nudge fan-in for no protection (the
+            # "apiserver" here is in-process or the fake).
+            rate_limiter=ExponentialFailureRateLimiter(0.005, 2.0),
+            log=lambda msg: log.debug("workqueue: %s", msg))
+
+        inf = {}
+        for name, gvr in (("pods", PODS), ("claims", RESOURCECLAIMS),
+                          ("slices", RESOURCESLICES),
+                          ("classes", DEVICECLASSES), ("nodes", NODES)):
+            inf[name] = Informer(self._client, gvr,
+                                 copy_on_read=False, copy_events=False)
+        inf["claims"].add_indexer("owner", self._owner_index)
+        inf["slices"].add_indexer("node", self._slice_node_index)
+
+        inf["pods"].on_add(self._on_pod)
+        inf["pods"].on_update(lambda old, new: self._on_pod(new))
+        inf["pods"].on_delete(self._on_pod_deleted)
+        inf["claims"].on_add(lambda obj: self._on_claim(None, obj))
+        inf["claims"].on_update(self._on_claim)
+        inf["claims"].on_delete(self._on_claim_deleted)
+        for src in ("slices", "nodes"):
+            inf[src].on_add(lambda obj, s=src: self._on_capacity(s))
+            inf[src].on_update(lambda o, n, s=src: self._on_capacity(s))
+            inf[src].on_delete(lambda obj, s=src: self._on_capacity(s))
+        inf["classes"].on_add(lambda obj: self._on_class(obj))
+        inf["classes"].on_update(lambda o, n: self._on_class(n))
+        inf["classes"].on_delete(lambda obj: self._on_class(obj))
+
+        self._informers = inf
+        self._started = True
+        self._worker = threading.Thread(
+            target=self._queue.run, args=(self._stop,), daemon=True,
+            name="sim-scheduler-worker")
+        self._worker.start()
+        for i in inf.values():
+            i.start()
+        for i in inf.values():
+            i.wait_for_sync(self.SYNC_TIMEOUT)
+        # The initial claim listing flowed through _on_claim adds during
+        # informer sync, so the index is already built; the nudge below
+        # only covers pods whose add events raced the pending-set wiring.
+        self._nudge_pending_pods()
+        self._sweeper = threading.Thread(target=self._sweep_loop,
+                                         daemon=True,
+                                         name="sim-scheduler-sweep")
+        self._sweeper.start()
 
     def stop(self) -> None:
         self._stop.set()
-        if self._thread:
-            self._thread.join(timeout=5)
+        for i in self._informers.values():
+            i.stop()
+        if self._queue is not None:
+            self._queue.shutdown()
+        for t in (self._worker, self._sweeper, self._thread):
+            if t is not None:
+                t.join(timeout=5)
+        self._started = False
 
-    def _run(self) -> None:
+    def _poll_run(self) -> None:
         while not self._stop.wait(self._interval):
             try:
                 self.reconcile_once()
             except Exception:  # noqa: BLE001 — the loop must survive
                 log.exception("scheduler reconcile failed")
 
-    # ------------------------------------------------------------------
+    # -- event handlers (watch threads: derive keys, enqueue, return) -------
+
+    @staticmethod
+    def _owner_index(obj: Dict) -> List[str]:
+        owner = (obj.get("metadata", {}).get("annotations") or {}).get(
+            "sim/owner-pod")
+        if not owner:
+            return []
+        ns = obj["metadata"].get("namespace", "default")
+        return [f"{ns}/{owner}"]
+
+    @staticmethod
+    def _slice_node_index(obj: Dict) -> List[str]:
+        node = (obj.get("spec") or {}).get("nodeName")
+        return [node] if node else []
+
+    def _drop_event(self, resource: str) -> bool:
+        """The sched.watch_event chaos seam: a fired site models the
+        scheduler mishandling this event. The event is dropped BUT the
+        index is marked dirty — the guard knows it dropped something, so
+        the full-resync fallback takes over before the next allocation
+        (that is what makes the fallback 'guarded')."""
+        if FAULTS.fires("sched.watch_event"):
+            self._mark_dirty(f"watch event dropped ({resource})")
+            return True
+        SCHED_WATCH_EVENTS.inc(labels={"resource": resource})
+        return False
+
+    def _on_pod(self, pod: Dict) -> None:
+        if self._drop_event("pods"):
+            return
+        if pod["metadata"].get("deletionTimestamp"):
+            return
+        key = self._pod_key(pod)
+        phase = (pod.get("status") or {}).get("phase", "Pending")
+        if phase not in ("", "Pending"):
+            self._forget_pod(key)
+            return
+        if pod["spec"].get("nodeName"):
+            with self._plock:
+                if key in self._done:
+                    return  # our own bind/status echo: already placed
+        self._enqueue_pod(key)
+
+    def _on_pod_deleted(self, pod: Dict) -> None:
+        if self._drop_event("pods"):
+            return
+        key = self._pod_key(pod)
+        self._forget_pod(key)
+        # Event-driven claim GC: the resourceclaim controller's ownerRef
+        # analog, fired from the delete event instead of a 150ms
+        # full-list poll; the periodic sweep stays as the safety net.
+        self._queue.enqueue(key, self._gc_pod_claims, key=f"gc/{key}",
+                            after=0, dedupe=True)
+
+    def _on_claim(self, old: Optional[Dict], new: Dict) -> None:
+        if self._drop_event("resourceclaims"):
+            return
+        try:
+            self._index.apply(new)
+        except FaultInjected:
+            self._mark_dirty("index apply failed")
+            return
+        if old is not None and claim_entries(old) and not claim_entries(new):
+            self._nudge_pending_pods()  # deallocation freed devices
+
+    def _on_claim_deleted(self, claim: Dict) -> None:
+        if self._drop_event("resourceclaims"):
+            return
+        try:
+            self._index.remove(claim)
+        except FaultInjected:
+            self._mark_dirty("index remove failed")
+            return
+        # A deleted claim may free devices — and if its owner pod is
+        # still alive (out-of-band deletion), that pod needs re-driving
+        # so its template claim is recreated.
+        owner = (claim.get("metadata", {}).get("annotations") or {}).get(
+            "sim/owner-pod")
+        if owner:
+            ns = claim["metadata"].get("namespace", "default")
+            self._enqueue_pod(f"{ns}/{owner}")
+        self._nudge_pending_pods()
+
+    def _on_capacity(self, resource: str) -> None:
+        if self._drop_event(resource):
+            return
+        self._nudge_pending_pods()
+
+    def _on_class(self, dc: Dict) -> None:
+        if self._drop_event("deviceclasses"):
+            return
+        self._class_cache.pop(dc["metadata"]["name"], None)
+        self._nudge_pending_pods()
+
+    # -- queue plumbing ------------------------------------------------------
+
+    @staticmethod
+    def _pod_key(pod: Dict) -> str:
+        return (f"{pod['metadata'].get('namespace', 'default')}/"
+                f"{pod['metadata']['name']}")
+
+    def _enqueue_pod(self, key: str) -> None:
+        with self._plock:
+            self._pending.add(key)
+            self._done.discard(key)
+        self._queue.enqueue(key, self._process_pod, key=f"pod/{key}",
+                            after=0, dedupe=True)
+
+    def _forget_pod(self, key: str, done: bool = False) -> None:
+        with self._plock:
+            self._pending.discard(key)
+            if done:
+                self._done.add(key)
+            else:
+                self._done.discard(key)
+
+    def _nudge_pending_pods(self) -> None:
+        """Re-drive every still-pending pod (capacity may have freed).
+        dedupe=True collapses event-storm fan-in to one queued item per
+        pod."""
+        with self._plock:
+            keys = sorted(self._pending)
+        for key in keys:
+            self._queue.enqueue(key, self._process_pod, key=f"pod/{key}",
+                                after=0, dedupe=True)
+
+    def _mark_dirty(self, reason: str) -> None:
+        self._index.dirty = True
+        self._index.dirty_reason = reason
+        if self._queue is not None:
+            self._queue.enqueue(reason, lambda _: self._full_resync(),
+                                key="resync", after=0, dedupe=True)
+
+    def request_resync(self, reason: str = "requested") -> None:
+        """Public seam (chaos op): force the guarded full-resync path."""
+        self._mark_dirty(reason)
+
+    def _full_resync(self) -> None:
+        """The guarded fallback: rebuild the allocation index and the
+        pending-pod set from the informer caches (which self-heal via
+        relist even when the SCHEDULER mishandled events) and re-drive
+        everything pending. Counted — the bench asserts steady state
+        never comes here."""
+        if not self._index.dirty:
+            return
+        SCHED_FULL_RELISTS.inc()
+        reason = self._index.dirty_reason
+        # Clear-dirty BEFORE the snapshot: a drop landing after the
+        # listing re-dirties the index and its own queued resync re-runs.
+        self._index.begin_resync()
+        for _ in range(8):
+            gen = self._index.mutation_count()
+            if self._index.resync(self._list_claims(),
+                                  only_if_mutations=gen):
+                break
+        else:
+            # Concurrent mutations kept invalidating the snapshot
+            # (effective handler-side changes are rare, so this is an
+            # extreme tail): retry through the queue rather than spin.
+            self._mark_dirty("resync raced concurrent index mutations")
+            return
+        with self._plock:
+            self._pending.clear()
+            self._done.clear()  # conservatively re-verify placed pods
+        for pod in self._list_pods():
+            if pod["metadata"].get("deletionTimestamp"):
+                continue
+            phase = (pod.get("status") or {}).get("phase", "Pending")
+            if phase in ("", "Pending"):
+                self._enqueue_pod(self._pod_key(pod))
+        log.info("full resync completed (%s)", reason)
+
+    def _sweep_loop(self) -> None:
+        next_gc = time.monotonic() + self._gc_sweep_interval
+        while not self._stop.wait(self._resync_interval):
+            self._nudge_pending_pods()
+            if time.monotonic() >= next_gc:
+                next_gc = time.monotonic() + self._gc_sweep_interval
+                self._queue.enqueue(
+                    "sweep", lambda _: self._gc_sweep(),
+                    key="gc-sweep", after=0, dedupe=True)
+
+    # -- data access (lister-backed when started, client-backed sync) --------
+
+    def _list_pods(self) -> List[Dict]:
+        if self._started:
+            return self._informers["pods"].lister.list()
+        return self._client.list(PODS)
+
+    def _list_claims(self) -> List[Dict]:
+        if self._started:
+            return self._informers["claims"].lister.list()
+        return self._client.list(RESOURCECLAIMS)
+
+    def _get_pod(self, ns: str, name: str) -> Optional[Dict]:
+        if self._started:
+            return self._informers["pods"].lister.get(name, ns)
+        try:
+            return self._client.get(PODS, name, ns)
+        except NotFoundError:
+            return None
+
+    def _get_claim(self, ns: str, name: str) -> Optional[Dict]:
+        if self._started:
+            return self._informers["claims"].lister.get(name, ns)
+        try:
+            return self._client.get(RESOURCECLAIMS, name, ns)
+        except NotFoundError:
+            return None
+
+    def _iter_nodes(self) -> List[Dict]:
+        nodes = (self._informers["nodes"].lister.list() if self._started
+                 else self._client.list(NODES))
+        return sorted(nodes, key=lambda n: n["metadata"]["name"])
+
+    def _slices_for_node(self, node: str) -> List[Dict]:
+        if self._started:
+            return self._informers["slices"].get_by_index("node", node)
+        return [sl for sl in self._client.list(RESOURCESLICES)
+                if (sl.get("spec") or {}).get("nodeName") == node]
+
+    def _get_class(self, name: str) -> Optional[Dict]:
+        if self._started:
+            return self._informers["classes"].lister.get(name)
+        try:
+            return self._client.get(DEVICECLASSES, name)
+        except NotFoundError:
+            return None
+
+    # -- sync mode -----------------------------------------------------------
 
     def reconcile_once(self) -> None:
+        """One poll-and-scan pass (sync/poll mode): full-list Pods and
+        ResourceClaims, rebuild a transient allocation index, GC orphans,
+        drive every pending pod. Event mode makes this the exception —
+        each call counts on tpu_dra_sched_full_relists."""
+        SCHED_FULL_RELISTS.inc()
         pods = self._client.list(PODS)
-        self._gc_orphan_claims(pods)
+        claims = self._client.list(RESOURCECLAIMS)
+        gced = self._gc_orphan_claims(pods, claims, path="sweep")
+        self._index.begin_resync()
+        self._index.resync(c for c in claims if claim_key(c) not in gced)
         for pod in pods:
             if pod["metadata"].get("deletionTimestamp"):
                 continue
@@ -61,52 +703,123 @@ class Scheduler:
             if phase not in ("", "Pending"):
                 continue
             try:
-                self._ensure_claims_from_templates(pod)
+                pod = self._ensure_claims_from_templates(pod)
                 self._schedule(pod)
-            except ConflictError:
-                continue  # racing another write: next tick retries
+            except (ConflictError, _Unscheduled):
+                continue  # racing another write: next pass retries
 
-    def _gc_orphan_claims(self, pods: List[Dict]) -> None:
+    # -- claim GC -------------------------------------------------------------
+
+    def _gc_pod_claims(self, key: str) -> None:
+        """Event path: the pod named by `key` is gone; delete the claims
+        it owns (owner index lookup, no listing)."""
+        for claim in self._informers["claims"].get_by_index("owner", key):
+            self._delete_claim(claim, path="event")
+
+    def _gc_sweep(self) -> None:
+        """Safety-net sweep over the informer caches (NOT an apiserver
+        list): catches claims whose pod-delete event was missed."""
+        self._gc_orphan_claims(self._list_pods(), self._list_claims(),
+                               path="sweep")
+
+    def _gc_orphan_claims(self, pods: List[Dict], claims: List[Dict],
+                          path: str = "sweep") -> Set[str]:
         """The resourceclaim controller's ownerRef GC analog: a claim
         generated from a template dies with its pod — otherwise exclusive
         devices (channel-0, the daemon device) stay allocated forever and
-        the next workload can never schedule."""
+        the next workload can never schedule. Returns the keys of the
+        claims deleted (so a sync pass excludes them from its index)."""
         alive = {(p["metadata"].get("namespace", "default"),
-                  p["metadata"]["name"]) for p in pods}
-        for claim in self._client.list(RESOURCECLAIMS):
+                  p["metadata"]["name"]) for p in pods
+                 if not p["metadata"].get("deletionTimestamp")}
+        gced: Set[str] = set()
+        for claim in claims:
             owner = (claim["metadata"].get("annotations") or {}).get(
                 "sim/owner-pod")
             if not owner:
                 continue
             ns = claim["metadata"].get("namespace", "default")
             if (ns, owner) not in alive:
-                try:
-                    self._client.delete(RESOURCECLAIMS,
-                                        claim["metadata"]["name"], ns)
-                    log.info("GC claim %s/%s (pod %s gone)", ns,
-                             claim["metadata"]["name"], owner)
-                except NotFoundError:
-                    pass
+                self._delete_claim(claim, path=path)
+                gced.add(claim_key(claim))
+        return gced
 
-    # -- resourceclaim controller analog --------------------------------
+    def _delete_claim(self, claim: Dict, path: str) -> None:
+        ns = claim["metadata"].get("namespace", "default")
+        name = claim["metadata"]["name"]
+        try:
+            self._client.delete(RESOURCECLAIMS, name, ns)
+        except NotFoundError:
+            return
+        # Mirror our own delete into the index synchronously (the write
+        # half of the mutation-cache discipline): with creates, status
+        # writes AND deletes all applied on the worker thread, the
+        # informer-thread handlers only ever replay states the index has
+        # already seen — so a full resync can never race a real mutation.
+        try:
+            self._index.remove(claim, force=True)
+        except FaultInjected:
+            self._mark_dirty("index remove failed (own delete)")
+        SCHED_CLAIMS_GCED.inc(labels={"path": path})
+        log.info("GC claim %s/%s via %s (owner pod gone)", ns, name, path)
 
-    def _ensure_claims_from_templates(self, pod: Dict) -> None:
+    # -- per-pod reconcile (worker thread) ------------------------------------
+
+    def _process_pod(self, key: str) -> None:
+        # Never allocate over a known-divergent index: resync first
+        # (same worker thread, so this is naturally serialized with all
+        # other allocation).
+        if self._index.dirty:
+            self._full_resync()
+            if self._index.dirty:  # resync raced mutations; retry later
+                raise _Unscheduled("index dirty, resync pending")
+        ns, name = key.split("/", 1)
+        pod = self._get_pod(ns, name)
+        if pod is None or pod["metadata"].get("deletionTimestamp"):
+            self._forget_pod(key)
+            return
+        phase = (pod.get("status") or {}).get("phase", "Pending")
+        if phase not in ("", "Pending"):
+            self._forget_pod(key)
+            return
+        try:
+            pod = self._ensure_claims_from_templates(pod)
+            done = self._schedule(pod)
+        except (ConflictError, _Unscheduled) as e:
+            raise _Unscheduled(str(e)) from e  # workqueue retries w/ backoff
+        if done:
+            self._forget_pod(key, done=True)
+        # else: stays pending; capacity events / the periodic nudge
+        # re-drive it — no busy retry for genuinely unschedulable pods.
+
+    # -- resourceclaim controller analog --------------------------------------
+
+    def _ensure_claims_from_templates(self, pod: Dict) -> Dict:
+        """Create template-backed claims the pod is missing; returns the
+        (possibly refreshed) pod object. Zero-copy discipline: `pod` may
+        be a lister view — it is deepcopied before any mutation."""
         ns = pod["metadata"].get("namespace", "default")
         statuses = ((pod.get("status") or {})
                     .get("resourceClaimStatuses") or [])
         known = {s["name"]: s["resourceClaimName"] for s in statuses}
         changed = False
         for entry in (pod["spec"].get("resourceClaims") or []):
-            if entry.get("resourceClaimName") or entry["name"] in known:
+            if entry.get("resourceClaimName"):
                 continue
             tmpl_name = entry.get("resourceClaimTemplateName")
             if not tmpl_name:
                 continue
+            if entry["name"] in known:
+                # Status says the claim exists; recreate it if it was
+                # deleted out-of-band while the pod lives on.
+                if self._get_claim(ns, known[entry["name"]]) is not None:
+                    continue
             try:
                 rct = self._client.get(RESOURCECLAIMTEMPLATES, tmpl_name, ns)
             except NotFoundError:
-                continue  # template not stamped yet; retry next tick
-            claim_name = f"{pod['metadata']['name']}-{entry['name']}"
+                continue  # template not stamped yet; retried by nudge
+            claim_name = known.get(entry["name"]) or (
+                f"{pod['metadata']['name']}-{entry['name']}")
             claim = {
                 "apiVersion": "resource.k8s.io/v1", "kind": "ResourceClaim",
                 "metadata": {
@@ -120,33 +833,46 @@ class Scheduler:
                 "spec": (rct.get("spec") or {}).get("spec") or {},
             }
             try:
-                self._client.create(RESOURCECLAIMS, claim, namespace=ns)
-            except ConflictError:
-                pass
+                created = self._client.create(RESOURCECLAIMS, claim,
+                                              namespace=ns)
+                self._after_claim_write(created)
+            except (ConflictError, AlreadyExistsError):
+                pass  # racing create (retry, superseded worker): converged
             known[entry["name"]] = claim_name
             changed = True
         if changed:
-            pod.setdefault("status", {})["resourceClaimStatuses"] = [
+            upd = copy.deepcopy(pod)
+            upd.setdefault("status", {})["resourceClaimStatuses"] = [
                 {"name": k, "resourceClaimName": v}
                 for k, v in sorted(known.items())]
-            self._client.update_status(PODS, pod, ns)
+            pod = self._client.update_status(PODS, upd, ns)
+            if self._started:
+                self._informers["pods"].update_cache(pod)
+        return pod
 
-    # -- allocation + binding -------------------------------------------
+    # -- allocation + binding -------------------------------------------------
 
-    def _schedule(self, pod: Dict) -> None:
+    def _schedule(self, pod: Dict) -> bool:
+        """Returns True when the pod is fully placed (bound, claims
+        allocated); False when it must wait for capacity."""
         ns = pod["metadata"].get("namespace", "default")
         claims = self._pod_claims(pod, ns)
         if claims is None:
-            return  # some claim object missing; retry next tick
+            raise _Unscheduled("claim object missing")  # retried
         node_name = pod["spec"].get("nodeName")
         candidates = ([node_name] if node_name
                       else self._candidate_nodes(pod))
         for node in candidates:
             if self._try_allocate_all(claims, node):
                 if not node_name:
-                    pod["spec"]["nodeName"] = node
-                    self._client.update(PODS, pod, ns)
-                return
+                    upd = copy.deepcopy(pod)
+                    upd["spec"]["nodeName"] = node
+                    updated = self._client.update(PODS, upd, ns)
+                    if self._started:
+                        self._informers["pods"].update_cache(updated)
+                    SCHED_PODS_BOUND.inc()
+                return True
+        return False
 
     def _pod_claims(self, pod: Dict, ns: str) -> Optional[List[Dict]]:
         statuses = {s["name"]: s["resourceClaimName"] for s in
@@ -161,16 +887,16 @@ class Scheduler:
                 if entry.get("resourceClaimTemplateName"):
                     return None
                 continue
-            try:
-                out.append(self._client.get(RESOURCECLAIMS, name, ns))
-            except NotFoundError:
+            claim = self._get_claim(ns, name)
+            if claim is None:
                 return None
+            out.append(claim)
         return out
 
     def _candidate_nodes(self, pod: Dict) -> List[str]:
         selector = pod["spec"].get("nodeSelector") or {}
         names = []
-        for node in self._client.list(NODES):
+        for node in self._iter_nodes():
             labels = node["metadata"].get("labels") or {}
             if all(labels.get(k) == v for k, v in selector.items()):
                 names.append(node["metadata"]["name"])
@@ -179,8 +905,10 @@ class Scheduler:
     def _try_allocate_all(self, claims: List[Dict], node: str) -> bool:
         """Allocate every unallocated claim on `node`; all-or-nothing per
         pod (claims already allocated elsewhere pin the pod implicitly:
-        a shared pre-allocated claim simply must exist on this node)."""
-        taken = self._allocated_devices()
+        a shared pre-allocated claim simply must exist on this node).
+        Device availability comes from the incremental index plus a
+        staging overlay for this pod's own picks."""
+        overlay: Set[_Entry] = set()
         staged: List[Tuple[Dict, Dict]] = []
         for claim in claims:
             alloc = (claim.get("status") or {}).get("allocation")
@@ -192,78 +920,57 @@ class Scheduler:
                 if pools and node not in pools:
                     return False
                 continue
-            allocation = self._allocate(claim, node, taken)
+            allocation = self._allocate(claim, node, overlay)
             if allocation is None:
                 return False
             staged.append((claim, allocation))
         for claim, allocation in staged:
-            claim.setdefault("status", {})["allocation"] = allocation
-            self._client.update_status(RESOURCECLAIMS, claim,
-                                       claim["metadata"].get("namespace"))
+            upd = copy.deepcopy(claim)
+            upd.setdefault("status", {})["allocation"] = allocation
+            updated = self._client.update_status(
+                RESOURCECLAIMS, upd, upd["metadata"].get("namespace"))
+            self._after_claim_write(updated)
         return True
 
-    @staticmethod
-    def _parent_of(device: str) -> str:
-        """Subslice devices ('chip-N-ss...') partition their parent chip
-        ('chip-N'); everything else is its own parent."""
-        return device.split("-ss")[0] if "-ss" in device else device
-
-    def _allocated_devices(self) -> Set[Tuple[str, str, str]]:
-        """Names in use, expanded with partition semantics (the DRA
-        partitionable-device counter analog): a whole-chip allocation
-        blocks its subslices and vice versa, while two different
-        subslices of one chip can coexist (MIG-style)."""
-        taken = set()
-        for claim in self._client.list(RESOURCECLAIMS):
-            alloc = (claim.get("status") or {}).get("allocation") or {}
-            for r in (alloc.get("devices") or {}).get("results") or []:
-                key = (r.get("driver", ""), r.get("pool", ""))
-                name = r.get("device", "")
-                taken.add((*key, name))
-                parent = self._parent_of(name)
-                if parent != name:
-                    # Subslice in use: the WHOLE chip is unavailable, but
-                    # sibling subslices stay allocatable.
-                    taken.add((*key, parent))
-                else:
-                    # Whole chip in use: all of its subslices are too.
-                    taken.add((*key, f"{name}-ss*"))
-        return taken
-
-    def _is_taken(self, taken: Set[Tuple[str, str, str]], driver: str,
-                  pool: str, name: str) -> bool:
-        if (driver, pool, name) in taken:
-            return True
-        parent = self._parent_of(name)
-        if parent != name and (driver, pool, f"{parent}-ss*") in taken:
-            return True  # parent chip wholly claimed
-        return False
+    def _after_claim_write(self, obj: Dict) -> None:
+        """Mutation-cache discipline for the scheduler's own writes: the
+        informer cache AND the allocation index see the write before the
+        watch event lands — the index never lags the scheduler's own
+        allocations, which is what makes single-writer allocation safe
+        on an event-driven cache. (In sync mode the index update keeps
+        later pods in the SAME pass from re-picking the devices.)"""
+        if self._started:
+            self._informers["claims"].update_cache(obj)
+        try:
+            self._index.apply(obj)
+        except FaultInjected:
+            self._mark_dirty("index apply failed (own write)")
 
     def _allocate(self, claim: Dict, node: str,
-                  taken: Set[Tuple[str, str, str]]) -> Optional[Dict]:
+                  overlay: Set[_Entry]) -> Optional[Dict]:
         devices = (claim.get("spec") or {}).get("devices") or {}
         results = []
         for req in devices.get("requests") or []:
             exact = req.get("exactly") or req  # v1 wrapper or flat
             class_name = exact.get("deviceClassName", "")
             count = int(exact.get("count") or 1)
-            exprs = self._class_selectors(class_name)
-            if exprs is None:
+            sources = self._class_selector_sources(class_name)
+            if sources is None:
                 return None
             # Per-request selectors AND with the class's (the real
             # allocator's semantics: every selector must match;
             # gpu-test6-style attribute selection rides here).
-            exprs = exprs + [
+            sources = sources + [
                 (sel.get("cel") or {}).get("expression", "")
                 for sel in exact.get("selectors") or []]
-            picked = self._pick_devices(node, exprs, count, taken)
+            progs = cel.compile_many(sources)
+            if progs is None:
+                return None  # a broken selector selects nothing
+            picked = self._pick_devices(node, progs, count, overlay)
             if picked is None:
                 return None
             for driver, dev in picked:
-                taken.add((driver, node, dev))
-                parent = self._parent_of(dev)
-                taken.add((driver, node, parent) if parent != dev
-                          else (driver, node, f"{dev}-ss*"))
+                overlay.update(_expand([(driver, node, dev)]))
                 results.append({"request": req["name"], "driver": driver,
                                 "pool": node, "device": dev})
         if not results:
@@ -275,36 +982,57 @@ class Scheduler:
                     {"key": "metadata.name", "operator": "In",
                      "values": [node]}]}]}}
 
-    def _class_selectors(self, name: str) -> Optional[List[str]]:
+    def _class_selector_sources(self, name: str) -> Optional[List[str]]:
         """All CEL expressions of the DeviceClass (None if the class does
-        not exist — the claim is unallocatable, not unconstrained)."""
-        try:
-            dc = self._client.get(DEVICECLASSES, name)
-        except NotFoundError:
+        not exist — the claim is unallocatable, not unconstrained),
+        cached per (name, resourceVersion)."""
+        dc = self._get_class(name)
+        if dc is None:
+            self._class_cache.pop(name, None)
             return None
-        return [(sel.get("cel") or {}).get("expression", "")
-                for sel in (dc.get("spec") or {}).get("selectors") or []]
+        rv = dc["metadata"].get("resourceVersion", "")
+        cached = self._class_cache.get(name)
+        if cached is not None and cached[0] == rv:
+            return cached[1]
+        sources = [(sel.get("cel") or {}).get("expression", "")
+                   for sel in (dc.get("spec") or {}).get("selectors") or []]
+        self._class_cache[name] = (rv, sources)
+        return sources
 
-    def _pick_devices(self, node: str, exprs: List[str], count: int,
-                      taken: Set[Tuple[str, str, str]]
+    def _pick_devices(self, node: str, progs: List["cel.Program"],
+                      count: int, overlay: Set[_Entry]
                       ) -> Optional[List[Tuple[str, str]]]:
-        """Devices on `node` matching EVERY CEL expression, as
+        """Devices on `node` matching EVERY compiled CEL program, as
         (driver, name) pairs. CEL is evaluated for real against the
         published attributes (simcluster.cel): a wrong attribute name or
         type mismatch selects nothing instead of everything."""
         available = []
-        for sl in self._client.list(RESOURCESLICES):
+        for sl in self._slices_for_node(node):
             spec = sl.get("spec") or {}
-            if spec.get("nodeName") != node:
-                continue
             driver = spec.get("driver", "")
             for dev in spec.get("devices") or []:
-                if not all(device_matches(e, dev, driver)
-                           for e in exprs):
+                if not all(p.matches(dev, driver) for p in progs):
                     continue
-                if self._is_taken(taken, driver, node, dev["name"]):
+                if self._index.is_taken(driver, node, dev["name"],
+                                        overlay=overlay):
                     continue
                 available.append((driver, dev["name"]))
+                if len(available) == count:
+                    break
+            if len(available) == count:
+                break
         if len(available) < count:
             return None
         return available[:count]
+
+    # -- introspection --------------------------------------------------------
+
+    def verify_index(self) -> List[str]:
+        """Divergences between the incremental index and cluster truth
+        (a fresh apiserver claim listing); empty = consistent. Chaos
+        invariant after quiesce."""
+        return self._index.diff_against(self._client.list(RESOURCECLAIMS))
+
+    def pending_pods(self) -> Set[str]:
+        with self._plock:
+            return set(self._pending)

@@ -465,3 +465,71 @@ def provision_two_node_cd(namespace: str = "cdtest",
             os.environ.pop("TPU_DRA_TPUINFO_BACKEND", None)
         else:
             os.environ["TPU_DRA_TPUINFO_BACKEND"] = saved
+
+
+# ---------------------------------------------------------------------------
+# Scheduler-churn inventory (shared by bench.bench_sched_churn, the
+# chaos SchedulerChaosHarness, and tests/test_scheduler_scale.py)
+# ---------------------------------------------------------------------------
+
+DEFAULT_SCHED_SELECTOR = ('device.driver == "tpu.dev" && '
+                          'device.attributes["tpu.dev"].type == "chip"')
+
+
+def seed_sched_inventory(client, *, nodes: int, chips_per_node: int,
+                         node_fmt: str = "n{i}",
+                         selector_exprs=None,
+                         generation: str = "v5p",
+                         namespace: str = "default"):
+    """Seed the control-plane churn fixture in ONE place: DeviceClass
+    ``tpu.dev`` (CEL selectors), ResourceClaimTemplate ``tmpl``, and
+    `nodes` Nodes each publishing a ResourceSlice of `chips_per_node`
+    whole-chip devices (attributes: type=chip, generation). Returns the
+    node names. A schema change here changes bench, chaos, and tests
+    together instead of drifting across three hand-copied fixtures."""
+    from tpu_dra.k8s.resources import (
+        DEVICECLASSES, NODES, RESOURCECLAIMTEMPLATES, RESOURCESLICES,
+    )
+
+    exprs = (list(selector_exprs) if selector_exprs
+             else [DEFAULT_SCHED_SELECTOR])
+    client.create(DEVICECLASSES, {
+        "apiVersion": "resource.k8s.io/v1", "kind": "DeviceClass",
+        "metadata": {"name": "tpu.dev"},
+        "spec": {"selectors": [{"cel": {"expression": e}} for e in exprs]}})
+    client.create(RESOURCECLAIMTEMPLATES, {
+        "apiVersion": "resource.k8s.io/v1", "kind": "ResourceClaimTemplate",
+        "metadata": {"name": "tmpl", "namespace": namespace},
+        "spec": {"spec": {"devices": {"requests": [
+            {"name": "tpu", "exactly": {"deviceClassName": "tpu.dev"}}]}}},
+    }, namespace=namespace)
+    names = []
+    for i in range(nodes):
+        name = node_fmt.format(i=i)
+        names.append(name)
+        client.create(NODES, {"apiVersion": "v1", "kind": "Node",
+                              "metadata": {"name": name, "labels": {}}})
+        client.create(RESOURCESLICES, {
+            "apiVersion": "resource.k8s.io/v1", "kind": "ResourceSlice",
+            "metadata": {"name": f"{name}-tpu.dev"},
+            "spec": {"driver": "tpu.dev", "nodeName": name,
+                     "pool": {"name": name, "generation": 1},
+                     "devices": [{"name": f"chip-{j}", "attributes": {
+                         "type": {"string": "chip"},
+                         "generation": {"string": generation}}}
+                         for j in range(chips_per_node)]}})
+    return names
+
+
+def make_sched_pod(client, name: str, namespace: str = "default"):
+    """A pod claiming one device via the ``tmpl`` template (the churn
+    fixture's pod shape)."""
+    from tpu_dra.k8s.resources import PODS
+
+    return client.create(PODS, {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {"containers": [{"name": "c", "image": "x"}],
+                 "resourceClaims": [
+                     {"name": "t", "resourceClaimTemplateName": "tmpl"}]},
+    }, namespace=namespace)
