@@ -544,6 +544,34 @@ def bench_chaos_recovery(n: int = 7):
     return measure_daemon_crash_recovery(n)
 
 
+def _start_bind_watcher(cluster, stop):
+    """Background watcher pushing (pod_name, t_bound) for every pod
+    observed gaining spec.nodeName (shared by bench_sched_churn and
+    bench_topology so the binding-detection rule cannot drift).
+    Registration races the first bind (the fake's watch registers on the
+    thread's first next()), so callers that hard-fail on a missed event
+    must fall back to cluster truth on queue timeout."""
+    import queue as queue_mod
+    import threading
+
+    from tpu_dra.k8s import PODS
+
+    bound_q: "queue_mod.Queue" = queue_mod.Queue()
+    seen = set()
+
+    def watch_bindings():
+        for ev, obj in cluster.watch(PODS, namespace="default", stop=stop):
+            if ev in ("ADDED", "MODIFIED") and obj["spec"].get("nodeName"):
+                name = obj["metadata"]["name"]
+                if name not in seen:
+                    seen.add(name)
+                    bound_q.put((name, time.perf_counter()))
+
+    watcher = threading.Thread(target=watch_bindings, daemon=True)
+    watcher.start()
+    return bound_q, watcher
+
+
 def bench_sched_churn(n_nodes: int = 100, n_pods: int = 500,
                       chips_per_node: int = 4, window: int = None):
     """Control-plane churn at scale (ISSUE 3): N fake nodes publishing
@@ -596,19 +624,7 @@ def bench_sched_churn(n_nodes: int = 100, n_pods: int = 500,
     sched = Scheduler(cluster, resync_interval=2.0, gc_sweep_interval=3600.0)
     sched.start()
     stop = threading.Event()
-    bound_q: "queue_mod.Queue" = queue_mod.Queue()
-    seen = set()
-
-    def watch_bindings():
-        for ev, obj in cluster.watch(PODS, namespace="default", stop=stop):
-            if ev in ("ADDED", "MODIFIED") and obj["spec"].get("nodeName"):
-                name = obj["metadata"]["name"]
-                if name not in seen:
-                    seen.add(name)
-                    bound_q.put((name, time.perf_counter()))
-
-    watcher = threading.Thread(target=watch_bindings, daemon=True)
-    watcher.start()
+    bound_q, _watcher = _start_bind_watcher(cluster, stop)
 
     def make_pod(i):
         name = f"churn-{i:05d}"
@@ -673,6 +689,136 @@ def bench_sched_churn(n_nodes: int = 100, n_pods: int = 500,
         out["sched_churn_gc_leak"] = len(
             cluster.list(RESOURCECLAIMS, namespace="default"))
     return out
+
+
+def bench_topology(n_pods: int = 120, seed: int = 7):
+    """ICI fragmentation bench (ISSUE 4): churned alloc/free of mixed
+    1/2/4/8-chip pods on a 4x4x4 fake v5p torus (64 chips, one node)
+    through the EVENT-DRIVEN scheduler with the TopologyAwareScheduling
+    gate on. Reports:
+
+    - topo_contiguity_ratio: topology-scored cuboid picks over all
+      multi-chip picks (contiguous / (contiguous + first-fit fallback))
+      — MUST be 1.0 with the gate on over a coordinate-publishing
+      inventory (hack/perf.sh gate);
+    - topo_place_p50_ms / p95: pod create -> bound+allocated wall
+      (the placement latency the topology scan adds rides in here);
+    - topo_score_p50_ms: the scan+score share alone (histogram);
+    - topo_free_cuboid_p50_chips: the fragmentation observable across
+      the churn (largest free cuboid after each placement).
+    """
+    import random
+    import threading
+    import queue as queue_mod
+
+    from tpu_dra.infra import featuregates
+    from tpu_dra.infra.metrics import (
+        TOPO_ALLOCS, TOPO_FREE_CUBOID, TOPO_SCORE_SECONDS,
+    )
+    from tpu_dra.k8s import FakeCluster, PODS, RESOURCECLAIMS
+    from tpu_dra.simcluster.scheduler import Scheduler
+    from tpu_dra.testing import make_sched_pod, seed_sched_inventory
+
+    gates_before = featuregates.Features.overrides_snapshot()
+    featuregates.Features.set_from_string("TopologyAwareScheduling=true")
+    sched = None
+    stop = threading.Event()
+    rng = random.Random(seed)
+    sizes = (1, 1, 2, 2, 4, 4, 8)
+    lat_ms = []
+    live: dict = {}   # name -> chips
+    unplaced = 0
+    # Everything from here inside the try: a setup failure must still
+    # restore the gate override (main() treats this phase as
+    # best-effort, and a leaked override would silently flip every
+    # later phase in this process onto the topology path).
+    try:
+        cluster = FakeCluster()
+        seed_sched_inventory(cluster, nodes=1, chips_per_node=64,
+                             generation="v5p", node_fmt="torus{i}",
+                             claim_counts=(2, 4, 8))
+        contig0 = TOPO_ALLOCS.value(labels={"outcome": "contiguous"})
+        fallback0 = TOPO_ALLOCS.value(labels={"outcome": "fallback"})
+        unplace0 = TOPO_ALLOCS.value(labels={"outcome": "unplaceable"})
+        score_n0 = TOPO_SCORE_SECONDS.count
+        score_sum0 = TOPO_SCORE_SECONDS.total
+
+        sched = Scheduler(cluster, resync_interval=0.05,
+                          gc_sweep_interval=3600.0)
+        sched.start()
+        bound_q, _watcher = _start_bind_watcher(cluster, stop)
+
+        for i in range(n_pods):
+            n = rng.choice(sizes)
+            # Budgeted churn: free enough before each create that a
+            # contiguous window for `n` chips plausibly exists (48/64 =
+            # 75% cap keeps the walk fragmenting without deadlocking).
+            while sum(live.values()) + n > 48:
+                victim = rng.choice(sorted(live))
+                cluster.delete(PODS, victim, "default")
+                live.pop(victim)
+            name = f"topo-{i:04d}"
+            t0 = time.perf_counter()
+            make_sched_pod(cluster, name,
+                           template="tmpl" if n == 1 else f"tmpl{n}")
+            live[name] = n
+            try:
+                while True:
+                    bound, t1 = bound_q.get(timeout=15)
+                    if bound == name:
+                        break
+                lat_ms.append((t1 - t0) * 1e3)
+            except queue_mod.Empty:
+                # The watch registers on the watcher thread's first
+                # next(), so the very first bind can slip past it —
+                # consult cluster truth before declaring a wedge (a
+                # falsely-counted unplaced pod would hard-fail the
+                # perf.sh gate with a misleading message).
+                if cluster.get(PODS, name,
+                               "default")["spec"].get("nodeName"):
+                    lat_ms.append((time.perf_counter() - t0) * 1e3)
+                    continue
+                # Fragmentation wedge (rare, seeded): count it, free the
+                # pod, keep churning — the contiguity gate is unaffected
+                # (nothing was allocated).
+                unplaced += 1
+                cluster.delete(PODS, name, "default")
+                live.pop(name)
+        for name in sorted(live):
+            cluster.delete(PODS, name, "default")
+        cluster.wait_for(
+            lambda: not cluster.list(RESOURCECLAIMS, namespace="default"),
+            timeout=15)
+    finally:
+        stop.set()
+        if sched is not None:
+            sched.stop()
+        featuregates.Features.restore_overrides(gates_before)
+
+    contig = TOPO_ALLOCS.value(labels={"outcome": "contiguous"}) - contig0
+    fallback = TOPO_ALLOCS.value(labels={"outcome": "fallback"}) - fallback0
+    unplaceable = (TOPO_ALLOCS.value(labels={"outcome": "unplaceable"})
+                   - unplace0)
+    score_n = TOPO_SCORE_SECONDS.count - score_n0
+    score_ms = ((TOPO_SCORE_SECONDS.total - score_sum0) / score_n * 1e3
+                if score_n else None)
+    lat_ms.sort()
+    return {
+        "topo_contiguity_ratio": (
+            round(contig / (contig + fallback), 4)
+            if contig + fallback else None),
+        "topo_place_p50_ms": round(statistics.median(lat_ms), 3),
+        "topo_place_p95_ms": round(_pctl(lat_ms, 0.95), 3),
+        "topo_alloc_contiguous": int(contig),
+        "topo_alloc_fallback": int(fallback),
+        "topo_alloc_unplaceable_attempts": int(unplaceable),
+        "topo_unplaced_pods": unplaced,
+        "topo_score_mean_ms": (round(score_ms, 4)
+                               if score_ms is not None else None),
+        "topo_free_cuboid_p50_chips": TOPO_FREE_CUBOID.percentile(0.5),
+        "topo_churn_pods": len(lat_ms),
+        "topo_mesh": "4x4x4",
+    }
 
 
 def bench_cd_convergence():
@@ -923,6 +1069,10 @@ def main():
         out.update(bench_sched_churn())
     except Exception as e:  # noqa: BLE001 — churn phase is best-effort
         out["sched_churn_error"] = str(e)
+    try:
+        out.update(bench_topology())
+    except Exception as e:  # noqa: BLE001 — topology phase is best-effort
+        out["topology_error"] = str(e)
     try:
         out.update(bench_cd_convergence())
     except Exception as e:  # noqa: BLE001 — CD phase is best-effort

@@ -112,4 +112,39 @@ if prev is not None and out["sched_pod_to_allocated_p50_ms"] > prev[1] * 1.5:
              f"{out['sched_pod_to_allocated_p50_ms']} > 1.5x {prev[1]} "
              f"({prev[0]})")
 EOF
+
+echo ">> topology gates (4x4x4 torus churn, TopologyAwareScheduling on)"
+JAX_PLATFORMS=cpu TPU_DRA_TPUINFO_BACKEND=fake python - <<'EOF'
+import glob
+import json
+import re
+import sys
+
+import bench
+
+out = bench.bench_topology()
+print(json.dumps(out))
+if out["topo_contiguity_ratio"] != 1.0:
+    sys.exit(f"REGRESSION: topo_contiguity_ratio "
+             f"{out['topo_contiguity_ratio']} != 1.0 — multi-chip picks "
+             "degraded to first-fit on a coordinate-publishing inventory")
+if out["topo_unplaced_pods"]:
+    sys.exit(f"REGRESSION: {out['topo_unplaced_pods']} pods never placed "
+             "— fragmentation scoring stopped preserving free cuboids")
+
+# p50 tripwire vs the newest BENCH round that recorded the metric
+# (pre-ISSUE-4 rounds did not; the first recording round sets the bar).
+prev = None
+for path in sorted(glob.glob("BENCH_r*.json"),
+                   key=lambda p: int(re.search(r"r(\d+)", p).group(1)),
+                   reverse=True):
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("topo_place_p50_ms") is not None:
+        prev = (path, doc["topo_place_p50_ms"])
+        break
+if prev is not None and out["topo_place_p50_ms"] > prev[1] * 1.5:
+    sys.exit(f"REGRESSION: topo_place_p50_ms "
+             f"{out['topo_place_p50_ms']} > 1.5x {prev[1]} ({prev[0]})")
+EOF
 echo ">> perf tier green"

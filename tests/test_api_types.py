@@ -246,3 +246,43 @@ class TestChannelConfig:
     def test_missing_domain(self):
         with pytest.raises(ValidationError, match="domainID"):
             ComputeDomainChannelConfig().validate()
+
+
+class TestPublishedDeviceAttributes:
+    """The resourceapi.Device rendering CEL selectors constrain on —
+    in particular the ICI topology attributes (ISSUE 4)."""
+
+    def test_slice_topology_attribute_published(self):
+        from tpu_dra.native.tpuinfo import default_fake_chips
+        from tpu_dra.tpuplugin.deviceinfo import AllocatableDevice
+
+        chip = default_fake_chips(4, "v5p", slice_id="s0")[0]
+        dev = AllocatableDevice(type="chip", chip=chip).to_resource_api()
+        attrs = dev["attributes"]
+        assert attrs["sliceTopology"] == {"string": "2x2x1"}
+        assert attrs["coordX"] == {"int": chip.coords[0]}
+        assert attrs["sliceID"] == {"string": "s0"}
+        assert attrs["workerIndex"] == {"int": 0}
+
+    def test_slice_topology_selectable_by_cel(self):
+        from tpu_dra.native.tpuinfo import default_fake_chips
+        from tpu_dra.simcluster import cel
+        from tpu_dra.tpuplugin.deviceinfo import AllocatableDevice
+
+        chip = default_fake_chips(4, "v5p")[0]
+        dev = AllocatableDevice(type="chip", chip=chip).to_resource_api()
+        prog = cel.compile_expr(
+            'device.attributes["tpu.dev"].sliceTopology == "2x2x1"')
+        assert prog.matches(dev, "tpu.dev")
+        prog = cel.compile_expr(
+            'device.attributes["tpu.dev"].sliceTopology == "4x4x4"')
+        assert not prog.matches(dev, "tpu.dev")
+
+    def test_unknown_topology_publishes_empty_string(self):
+        from tpu_dra.native.tpuinfo import Chip
+        from tpu_dra.tpuplugin.deviceinfo import AllocatableDevice
+
+        chip = Chip(index=0, uuid="u", generation="v5e",
+                    tensorcore_count=1, hbm_bytes=1)
+        dev = AllocatableDevice(type="chip", chip=chip).to_resource_api()
+        assert dev["attributes"]["sliceTopology"] == {"string": ""}

@@ -88,6 +88,18 @@ def compute_domain_crd() -> Dict:
                                     "items": {"type": "object",
                                               "properties": node_props},
                                 },
+                                # ICI placement summary the controller
+                                # stamps on multi-node domains under the
+                                # TopologyAwareScheduling gate (without
+                                # it a structural schema would prune the
+                                # field).
+                                "topology": {
+                                    "type": "object",
+                                    "properties": {
+                                        "slices": {"type": "integer"},
+                                        "sliceAligned": {"type": "boolean"},
+                                    },
+                                },
                             },
                         },
                     },

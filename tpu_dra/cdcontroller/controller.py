@@ -27,7 +27,9 @@ from typing import Dict, List, Optional
 from tpu_dra.api import types as apitypes
 from tpu_dra.cdcontroller import templates
 from tpu_dra.cdcontroller.cleanup import CleanupManager
+from tpu_dra.infra import featuregates
 from tpu_dra.infra.metrics import DefaultRegistry
+from tpu_dra.topology import domain_topology
 from tpu_dra.infra.workqueue import WorkQueue, default_controller_rate_limiter
 from tpu_dra.k8s import (
     ApiClient, COMPUTEDOMAINS, DAEMONSETS, NODES, PODS, RESOURCECLAIMTEMPLATES,
@@ -360,16 +362,43 @@ class Controller:
                     want = apitypes.COMPUTE_DOMAIN_STATUS_NOT_READY
                     self._queue.enqueue(uid, self._reconcile,
                                         key=f"cd/{uid}", after=remaining)
-        self._set_cd_status(uid, want)
+        # ICI placement observability (gated): how many physical slices
+        # the registered member set spans and whether it is slice-aligned
+        # (one sliceID, contiguous worker indices). The daemons register
+        # sliceID/index per node, so this is the controller's view of the
+        # scheduler's topology-ranked node selection — a Ready domain
+        # spanning slices means collectives will cross DCN.
+        topo = None
+        if (len(nodes) > 1
+                and featuregates.enabled(
+                    featuregates.TopologyAwareScheduling)):
+            topo = domain_topology(nodes)
+            if (want == apitypes.COMPUTE_DOMAIN_STATUS_READY
+                    and not topo["sliceAligned"]):
+                log.warning(
+                    "computedomain %s is Ready but spans %d ICI slices "
+                    "(members not slice-aligned): inter-node collectives "
+                    "will traverse DCN", uid, topo["slices"])
+        self._set_cd_status(uid, want, topo=topo)
 
-    def _set_cd_status(self, uid: str, want: str) -> None:
+    def _set_cd_status(self, uid: str, want: str,
+                       topo: Optional[Dict] = None) -> None:
+        """topo=None means "no topology summary applies" (single-node
+        membership, or the gate is off): a previously stamped
+        status.topology is REMOVED rather than left stale — the field
+        must describe the current member set or not exist."""
         cd = self._fresh_cd(uid)
         if cd is None:
             return
         status = cd.setdefault("status", {})
-        if status.get("status") == want:
+        if (status.get("status") == want
+                and status.get("topology") == topo):
             return
         status["status"] = want
+        if topo is not None:
+            status["topology"] = topo
+        else:
+            status.pop("topology", None)
         status.setdefault("nodes", [])
         try:
             updated = self._client.update_status(COMPUTEDOMAINS, cd)

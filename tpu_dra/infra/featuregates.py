@@ -50,6 +50,9 @@ MultiprocessSupport = "MultiprocessSupport"
 SliceDaemonsWithDNSNames = "SliceDaemonsWithDNSNames"
 PassthroughSupport = "PassthroughSupport"
 TPUDeviceHealthCheck = "TPUDeviceHealthCheck"
+# TPU-native (no reference analog): ICI-topology-scored device picks +
+# slice-aligned ComputeDomain placement (tpu_dra.topology).
+TopologyAwareScheduling = "TopologyAwareScheduling"
 
 _DEFAULT_FEATURES: Dict[str, VersionedSpecs] = {
     TimeSlicingSettings: VersionedSpecs((
@@ -67,6 +70,9 @@ _DEFAULT_FEATURES: Dict[str, VersionedSpecs] = {
     )),
     TPUDeviceHealthCheck: VersionedSpecs((
         ("0.1.0", FeatureSpec(default=True, pre_release=BETA)),
+    )),
+    TopologyAwareScheduling: VersionedSpecs((
+        ("0.1.0", FeatureSpec(default=False, pre_release=ALPHA)),
     )),
 }
 

@@ -254,6 +254,27 @@ SCHED_CLAIMS_GCED = DefaultRegistry.counter(
     "template-owned ResourceClaims garbage-collected after pod death, "
     "labeled by path (event|sweep)")
 
+# -- ICI topology subsystem (tpu_dra.topology + the scheduler's
+# topology-scored pick path, SURVEY §11) ------------------------------------
+
+TOPO_ALLOCS = DefaultRegistry.counter(
+    "tpu_dra_topo_allocations",
+    "multi-chip device picks, labeled by outcome: contiguous (topology-"
+    "scored cuboid), fallback (node publishes no usable topology -> "
+    "first-fit), unplaceable (no contiguous cuboid fits the free set; "
+    "the claim waits). Contiguity ratio = contiguous/(contiguous+fallback)")
+TOPO_SCORE_SECONDS = DefaultRegistry.histogram(
+    "tpu_dra_topo_score_seconds",
+    "wall seconds spent on the topology path per multi-chip pick: "
+    "placement scan+score plus the free-cuboid fragmentation observe",
+    buckets=(0.00005, 0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+             0.01, 0.025, 0.05, 0.1, 0.5))
+TOPO_FREE_CUBOID = DefaultRegistry.histogram(
+    "tpu_dra_topo_free_cuboid_chips",
+    "largest free cuboid (chips) remaining on the node after each "
+    "topology-scored placement — the fragmentation observable",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256))
+
 
 class Timer:
     """Context manager observing elapsed seconds into a Histogram."""

@@ -73,6 +73,10 @@ class Chip:
     slice_id: str = ""
     worker_index: int = 0
     coords: Tuple[int, int, int] = (0, 0, 0)
+    # Declared dims of the slice this chip belongs to ("4x4x4"); empty
+    # when the backend does not know (topology then falls back to the
+    # discovered coordinate bounding box).
+    slice_topology: str = ""
     healthy: bool = True
 
     @property
@@ -282,16 +286,37 @@ class NativeBackend(TpuInfoBackend):
 # ---------------------------------------------------------------------------
 
 def default_fake_chips(count: int = 4, generation: str = "v5e",
-                       slice_id: str = "", worker_index: int = 0) -> List[Chip]:
+                       slice_id: str = "", worker_index: int = 0,
+                       total_workers: int = 1) -> List[Chip]:
+    """`count` fake chips laid out as a real per-generation slice: 3D
+    near-cubic torus dims for v4/v5p, 2D (z=1) for v5e/v6e
+    (tpu_dra.topology.mesh.topology_dims). Multi-host slices: the slice
+    spans `total_workers` hosts of `count` chips each and this host is
+    `worker_index` — coords are the host's block of the GLOBAL slice
+    coordinate space, so the union across workers is a valid dense mesh
+    and each worker's block is disjoint."""
+    from tpu_dra.topology.mesh import format_topology, topology_dims
+
+    if not 0 <= worker_index < total_workers:
+        raise ValueError(f"worker_index {worker_index} outside "
+                         f"total_workers {total_workers}")
     cores, hbm = GEN_SPECS[generation]
-    return [
-        Chip(index=i, uuid=f"tpu-{generation}-{i:02d}-fake", generation=generation,
-             tensorcore_count=cores, hbm_bytes=hbm,
-             pci_address=f"0000:0{i}:00.0", driver_version="1.0.0-fake",
-             slice_id=slice_id, worker_index=worker_index,
-             coords=(i % 2, i // 2, 0))
-        for i in range(count)
-    ]
+    dims = topology_dims(generation, count * total_workers)
+    topo = format_topology(dims)
+    out: List[Chip] = []
+    for i in range(count):
+        g = worker_index * count + i  # global position within the slice
+        coords = (g % dims[0], (g // dims[0]) % dims[1],
+                  g // (dims[0] * dims[1]))
+        out.append(Chip(
+            index=i, uuid=f"tpu-{generation}-{worker_index}-{i:02d}-fake"
+            if total_workers > 1 else f"tpu-{generation}-{i:02d}-fake",
+            generation=generation,
+            tensorcore_count=cores, hbm_bytes=hbm,
+            pci_address=f"0000:0{i}:00.0", driver_version="1.0.0-fake",
+            slice_id=slice_id, worker_index=worker_index,
+            coords=coords, slice_topology=topo))
+    return out
 
 
 class FakeBackend(TpuInfoBackend):
@@ -308,7 +333,10 @@ class FakeBackend(TpuInfoBackend):
             gen = os.environ.get("TPU_DRA_FAKE_GENERATION", "v5e")
             slice_id = os.environ.get("TPU_DRA_FAKE_SLICE_ID", "")
             worker = int(os.environ.get("TPU_DRA_FAKE_WORKER_INDEX", "0"))
-            chips = default_fake_chips(count, gen, slice_id, worker)
+            workers = int(os.environ.get("TPU_DRA_FAKE_TOTAL_WORKERS", "0"))
+            chips = default_fake_chips(count, gen, slice_id, worker,
+                                       total_workers=max(workers,
+                                                         worker + 1, 1))
         self._chips: Dict[int, Chip] = {c.index: c for c in chips}
         self.timeslices: Dict[int, int] = {}
         self.exclusive: Dict[int, bool] = {}

@@ -31,6 +31,7 @@ matrix (hack/chaos.sh); violations exit non-zero.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import random
 import shutil
@@ -57,6 +58,8 @@ from tpu_dra.tpuplugin.device_state import DeviceState
 from tpu_dra.tpuplugin.driver import TpuDriver
 from tpu_dra.tpuplugin.health import RECOVERED_KIND
 from tpu_dra.tpuplugin.sharing import TimeSlicingManager
+
+log = logging.getLogger("simcluster.chaos")
 
 # Sites the random walk may arm. health.chip_event is injected directly
 # (driver callback) for determinism; cddaemon.spawn belongs to the CD
@@ -735,8 +738,160 @@ def run_sched_schedule(seed: int, n_events: int = 60) -> ChaosReport:
     return SchedulerChaosHarness(seed).run(n_events)
 
 
-def run_sched_matrix(seeds: List[int], n_events: int = 60) -> Dict:
-    reports = [run_sched_schedule(seed, n_events) for seed in seeds]
+# ---------------------------------------------------------------------------
+# Topology walk (ICI-contiguous allocation under churn + faults)
+# ---------------------------------------------------------------------------
+
+# Claim sizes the topology walk mixes (weights mirror a realistic
+# single-chip-heavy load with a multi-chip tail).
+TOPO_CLAIM_SIZES = (1, 1, 2, 2, 4)
+
+
+class TopologyChaosHarness(SchedulerChaosHarness):
+    """The scheduler walk with the TopologyAwareScheduling gate ON over
+    a coordinate-publishing inventory (2 ICI slices x 2 hosts x 16
+    chips), churning a mix of 1/2/4-chip pods. On top of the base
+    invariants, after quiesce:
+
+    5. every allocated multi-chip claim is an ICI-contiguous cuboid;
+    6. the topology free-set derived from the allocation index exactly
+       matches the one derived from cluster truth
+       (Scheduler.verify_topology).
+
+    In-flight chip load is capped below capacity so quiesce stays
+    satisfiable: the topology path deliberately REFUSES non-contiguous
+    placements, and a walk pinned at 100% utilization could wedge a
+    final multi-chip pod behind fragmentation no future free will clear
+    (no deletes happen after the walk)."""
+
+    def __init__(self, seed: int, *, nodes: int = 4,
+                 chips_per_node: int = 16):
+        self._topo_gates = featuregates.Features.overrides_snapshot()
+        featuregates.Features.set_from_string("TopologyAwareScheduling=true")
+        try:
+            super().__init__(seed, nodes=nodes,
+                             chips_per_node=chips_per_node)
+        except Exception:
+            featuregates.Features.restore_overrides(self._topo_gates)
+            raise
+        self.pod_chips: Dict[str, int] = {}
+        self.chip_budget = (self.capacity * 3) // 4
+
+    def _seed_inventory(self) -> None:
+        from tpu_dra.testing import seed_sched_inventory
+        seed_sched_inventory(self.cluster, nodes=self.nodes,
+                             chips_per_node=self.chips,
+                             generation="v5p", hosts_per_slice=2,
+                             claim_counts=(2, 4))
+
+    def _op_create_pod(self) -> None:
+        n = self.rng.choice(TOPO_CLAIM_SIZES)
+        if sum(self.pod_chips.values()) + n > self.chip_budget:
+            return
+        from tpu_dra.testing import make_sched_pod
+        name = f"tp-{self.seed}-{self._pod_seq}"
+        self._pod_seq += 1
+        make_sched_pod(self.cluster, name,
+                       template="tmpl" if n == 1 else f"tmpl{n}")
+        self.live[name] = None
+        self.pod_chips[name] = n
+        self.report.prepares += 1
+
+    def _op_delete_pod(self) -> None:
+        if not self.live:
+            return
+        name = self.rng.choice(sorted(self.live))
+        self.cluster.delete(PODS, name, "default")
+        self.live.pop(name, None)
+        self.pod_chips.pop(name, None)
+        self.report.unprepares += 1
+
+    def _prune_wedged(self) -> None:
+        """Strict gate-on semantics can leave a pod PROVABLY
+        unplaceable at quiesce: no node's remaining free coordinate set
+        admits a contiguous cuboid of its size, and with the walk over
+        no delete will ever free one (free sets only shrink). That is
+        the documented wait-for-capacity behavior, not a scheduler bug
+        — delete such pods (the operator's move) instead of letting the
+        convergence deadline record a false violation. Pruning requires
+        proof: every node rejects the pod's count against cluster-truth
+        free sets. Called from the convergence poll, so a pod that
+        becomes provably wedged only after a sibling allocates is still
+        caught."""
+        from tpu_dra import topology
+        from tpu_dra.k8s import RESOURCESLICES
+        from tpu_dra.simcluster.scheduler import _parent_of, claim_entries
+
+        unbound = []
+        pods = {p["metadata"]["name"]: p
+                for p in self.cluster.list(PODS, namespace="default")}
+        for name in sorted(self.live):
+            pod = pods.get(name)
+            if pod is not None and not pod["spec"].get("nodeName"):
+                unbound.append(name)
+        if not unbound:
+            return
+        claims = self.cluster.list(RESOURCECLAIMS, namespace="default")
+        taken: Dict[str, set] = {}
+        for claim in claims:
+            owner = (claim["metadata"].get("annotations") or {}).get(
+                "sim/owner-pod")
+            if owner and owner not in pods:
+                # Dead pod's claim: GC will free these chips — counting
+                # them as taken could prune a pod the scheduler would
+                # legitimately place once the drain completes, masking a
+                # real wedge bug behind a premature prune.
+                continue
+            for _drv, pool, dev in claim_entries(claim):
+                taken.setdefault(pool, set()).add(_parent_of(dev))
+        frees = []
+        for sl in self.cluster.list(RESOURCESLICES):
+            node = (sl.get("spec") or {}).get("nodeName")
+            topo = topology.node_topology_from_slices([sl])
+            if node and topo is not None:
+                frees.append((topo, {
+                    c for dev, c in topo.coord_of.items()
+                    if dev not in taken.get(node, set())}))
+        for name in unbound:
+            n = self.pod_chips.get(name, 1)
+            if any(topology.best_placement(topo.mesh, free, n) is not None
+                   for topo, free in frees):
+                continue  # placeable: let the scheduler get there
+            self.cluster.delete(PODS, name, "default")
+            self.live.pop(name, None)
+            self.pod_chips.pop(name, None)
+            self.report.unprepares += 1
+            log.info("topology chaos: pruned provably-unplaceable pod %s "
+                     "(%d chips, fragmentation wedge)", name, n)
+
+    def _converged(self) -> List[str]:
+        self._prune_wedged()
+        return super()._converged()
+
+    def quiesce_and_verify(self) -> None:
+        super().quiesce_and_verify()
+        self.report.violations.extend(self.sched.verify_topology())
+
+    def close(self) -> None:
+        try:
+            super().close()
+        finally:
+            featuregates.Features.restore_overrides(self._topo_gates)
+
+
+def run_topo_schedule(seed: int, n_events: int = 60) -> ChaosReport:
+    """One seeded topology walk to quiesce."""
+    return TopologyChaosHarness(seed).run(n_events)
+
+
+def run_topo_matrix(seeds: List[int], n_events: int = 60) -> Dict:
+    return _pod_matrix_summary(
+        [run_topo_schedule(seed, n_events) for seed in seeds])
+
+
+def _pod_matrix_summary(reports: List[ChaosReport]) -> Dict:
+    """Aggregate a pod-churn walk matrix (scheduler + topology walks
+    share this shape: prepares/unprepares count pod lifecycles)."""
     injected: Dict[str, int] = {}
     for r in reports:
         for site, n in r.injected.items():
@@ -750,6 +905,11 @@ def run_sched_matrix(seeds: List[int], n_events: int = 60) -> Dict:
         "violations": [f"seed {r.seed}: {msg}"
                        for r in reports for msg in r.violations],
     }
+
+
+def run_sched_matrix(seeds: List[int], n_events: int = 60) -> Dict:
+    return _pod_matrix_summary(
+        [run_sched_schedule(seed, n_events) for seed in seeds])
 
 
 # ---------------------------------------------------------------------------
@@ -865,10 +1025,14 @@ def main(argv=None) -> int:
     # control plane (informers + incremental allocation index + guarded
     # resync) under the sched.* fault sites.
     summary["scheduler"] = run_sched_matrix(seeds, n_events=args.events)
+    # Topology walk over the same seed matrix: contiguity + free-set
+    # invariants with the TopologyAwareScheduling gate on.
+    summary["topology"] = run_topo_matrix(seeds, n_events=args.events)
     print(json.dumps(summary, indent=2))
     return 1 if (summary["violations"]
                  or summary["watch_flake_violations"]
-                 or summary["scheduler"]["violations"]) else 0
+                 or summary["scheduler"]["violations"]
+                 or summary["topology"]["violations"]) else 0
 
 
 if __name__ == "__main__":

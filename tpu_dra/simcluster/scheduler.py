@@ -44,10 +44,12 @@ import time
 from collections import deque
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
+from tpu_dra.infra import featuregates
 from tpu_dra.infra.faults import FAULTS, FaultInjected
 from tpu_dra.infra.metrics import (
     SCHED_CLAIMS_GCED, SCHED_FULL_RELISTS, SCHED_PODS_BOUND,
-    SCHED_WATCH_EVENTS,
+    SCHED_WATCH_EVENTS, TOPO_ALLOCS, TOPO_FREE_CUBOID, TOPO_SCORE_SECONDS,
+    Timer,
 )
 from tpu_dra.infra.workqueue import (
     ExponentialFailureRateLimiter, WorkQueue,
@@ -61,6 +63,7 @@ from tpu_dra.k8s.resources import (
     RESOURCESLICES,
 )
 from tpu_dra.simcluster import cel
+from tpu_dra import topology
 
 log = logging.getLogger("simcluster.scheduler")
 
@@ -364,6 +367,12 @@ class Scheduler:
         # re-extracting selector lists per allocation; the compiled
         # programs themselves are cached process-wide in simcluster.cel.
         self._class_cache: Dict[str, Tuple[str, List[str]]] = {}
+        # Node -> (slice (name, rv) fingerprint, NodeTopology|None): the
+        # per-node fabric view extracted from published ResourceSlices,
+        # rebuilt only when a slice's resourceVersion moves. Worker-thread
+        # only (same single-writer discipline as _class_cache).
+        self._topo_cache: Dict[
+            str, Tuple[tuple, Optional[topology.NodeTopology]]] = {}
         self._started = False
 
     # -- lifecycle ----------------------------------------------------------
@@ -385,6 +394,7 @@ class Scheduler:
             self._pending.clear()
             self._done.clear()
         self._class_cache.clear()
+        self._topo_cache.clear()
         self._queue = WorkQueue(
             # No global token bucket: event enqueues are explicit-delay
             # (after=0) and failures back off per item; a bucket would
@@ -900,7 +910,37 @@ class Scheduler:
             labels = node["metadata"].get("labels") or {}
             if all(labels.get(k) == v for k, v in selector.items()):
                 names.append(node["metadata"]["name"])
+        if (len(names) > 1
+                and featuregates.enabled(
+                    featuregates.TopologyAwareScheduling)):
+            # Inter-node ICI adjacency: group candidates by the physical
+            # slice their chips report, biggest slice group first, worker
+            # order within — the pods of a multi-node ComputeDomain then
+            # fill ONE slice in rank order instead of scattering across
+            # slices in node-name order.
+            infos = []
+            for name in names:
+                topo = self._node_topology(name)
+                infos.append((name, topo.slice_id if topo else "",
+                              topo.worker_index if topo else 0))
+            return topology.rank_candidate_nodes(infos)
         return names
+
+    def _node_topology(self, node: str) -> Optional[topology.NodeTopology]:
+        """This node's fabric view (mesh + device-name<->coord maps) from
+        its published ResourceSlices; None when the node publishes no
+        usable coordinates. Cached against the slices' resourceVersions.
+        Worker-thread only."""
+        slices = self._slices_for_node(node)
+        key = tuple(sorted(
+            (sl["metadata"]["name"],
+             sl["metadata"].get("resourceVersion", "")) for sl in slices))
+        cached = self._topo_cache.get(node)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        topo = topology.node_topology_from_slices(slices)
+        self._topo_cache[node] = (key, topo)
+        return topo
 
     def _try_allocate_all(self, claims: List[Dict], node: str) -> bool:
         """Allocate every unallocated claim on `node`; all-or-nothing per
@@ -1005,25 +1045,77 @@ class Scheduler:
         """Devices on `node` matching EVERY compiled CEL program, as
         (driver, name) pairs. CEL is evaluated for real against the
         published attributes (simcluster.cel): a wrong attribute name or
-        type mismatch selects nothing instead of everything."""
-        available = []
-        for sl in self._slices_for_node(node):
+        type mismatch selects nothing instead of everything.
+
+        Iteration is deterministic — slices and devices are scanned in
+        name order — so first-fit picks and topology scores reproduce
+        across runs and chaos seeds regardless of dict/watch ordering.
+
+        With the TopologyAwareScheduling gate on, multi-chip requests on
+        a node that publishes chip coordinates take the topology-scored
+        path: the pick must be an ICI-contiguous cuboid, chosen by the
+        fragmentation score (tpu_dra.topology.best_placement). No cuboid
+        fits -> the claim WAITS (None) rather than degrade to a
+        scattered allocation; nodes without usable topology keep
+        first-fit (counted as fallback)."""
+        gate_on = (count > 1 and featuregates.enabled(
+            featuregates.TopologyAwareScheduling))
+        # A node with no usable topology keeps the first-fit early exit
+        # even under the gate: scanning its whole inventory just to fall
+        # back would turn O(count) picks into O(devices) on every
+        # coordinate-less node (mixed fleets, sysfs without topology/).
+        topo = self._node_topology(node) if gate_on else None
+        topo_path = topo is not None
+        available: List[Tuple[str, str]] = []
+        for sl in sorted(self._slices_for_node(node),
+                         key=lambda s: s["metadata"]["name"]):
             spec = sl.get("spec") or {}
             driver = spec.get("driver", "")
-            for dev in spec.get("devices") or []:
+            for dev in sorted(spec.get("devices") or [],
+                              key=lambda d: d["name"]):
                 if not all(p.matches(dev, driver) for p in progs):
                     continue
                 if self._index.is_taken(driver, node, dev["name"],
                                         overlay=overlay):
                     continue
                 available.append((driver, dev["name"]))
-                if len(available) == count:
-                    break
-            if len(available) == count:
-                break
+                if not topo_path and len(available) == count:
+                    if gate_on:
+                        TOPO_ALLOCS.inc(labels={"outcome": "fallback"})
+                    return available  # first-fit: done at count
         if len(available) < count:
             return None
-        return available[:count]
+        if not topo_path:
+            return available[:count]
+        return self._pick_topology(topo, available, count)
+
+    def _pick_topology(self, topo: "topology.NodeTopology",
+                       available: List[Tuple[str, str]],
+                       count: int) -> Optional[List[Tuple[str, str]]]:
+        """Topology-scored pick over the CEL-matched free devices."""
+        if any(name not in topo.coord_of for _d, name in available):
+            # The match includes devices the chip mesh cannot lay out
+            # (subslices, foreign drivers): no fabric model for this
+            # request — first-fit, honestly counted.
+            TOPO_ALLOCS.inc(labels={"outcome": "fallback"})
+            return available[:count]
+        free = {topo.coord_of[name] for _d, name in available}
+        with Timer(TOPO_SCORE_SECONDS):
+            placed = topology.best_placement(topo.mesh, free, count)
+            if placed is not None:
+                # Observed inside the timed region: the free-cuboid scan
+                # is the same order of work as the placement scan, and
+                # leaving it outside would under-attribute the topology
+                # path's real per-pick overhead.
+                TOPO_FREE_CUBOID.observe(topology.max_free_cuboid(
+                    topo.mesh, free.difference(placed)))
+        if placed is None:
+            TOPO_ALLOCS.inc(labels={"outcome": "unplaceable"})
+            return None  # wait for a contiguous window, never scatter
+        TOPO_ALLOCS.inc(labels={"outcome": "contiguous"})
+        driver_of = dict((name, drv) for drv, name in available)
+        return [(driver_of[topo.name_of[c]], topo.name_of[c])
+                for c in placed]
 
     # -- introspection --------------------------------------------------------
 
@@ -1032,6 +1124,44 @@ class Scheduler:
         (a fresh apiserver claim listing); empty = consistent. Chaos
         invariant after quiesce."""
         return self._index.diff_against(self._client.list(RESOURCECLAIMS))
+
+    def verify_topology(self) -> List[str]:
+        """Topology invariants against cluster truth (chaos, after
+        quiesce): (1) every allocated multi-chip claim on a node that
+        publishes coordinates is an ICI-contiguous cuboid; (2) for each
+        such node, the free coordinate set DERIVED from the incremental
+        AllocationIndex equals the one derived from a fresh claim
+        listing — the index owns allocation state (SURVEY §11), so a
+        divergent derived free-set means the topology view (mesh/coord
+        cache) broke, not the bookkeeping."""
+        claims = self._client.list(RESOURCECLAIMS)
+        slices = self._client.list(RESOURCESLICES)
+        out = topology.allocation_violations(claims, slices)
+        taken_truth: Dict[str, Set[str]] = {}
+        for claim in claims:
+            for _driver, pool, dev in claim_entries(claim):
+                taken_truth.setdefault(pool, set()).add(_parent_of(dev))
+        by_node: Dict[str, List[Dict]] = {}
+        for sl in slices:
+            node = (sl.get("spec") or {}).get("nodeName")
+            if node:
+                by_node.setdefault(node, []).append(sl)
+        for node in sorted(by_node):
+            topo = topology.node_topology_from_slices(by_node[node])
+            if topo is None:
+                continue
+            free_truth = {c for name, c in topo.coord_of.items()
+                          if name not in taken_truth.get(node, set())}
+            free_index = {c for name, c in topo.coord_of.items()
+                          if not self._index.is_taken(
+                              topo.driver_of[name], node, name)}
+            if free_truth != free_index:
+                out.append(
+                    f"topology free-set on {node} diverges from the "
+                    f"allocation index: index-only "
+                    f"{sorted(free_index - free_truth)}, truth-only "
+                    f"{sorted(free_truth - free_index)}")
+        return out
 
     def pending_pods(self) -> Set[str]:
         with self._plock:

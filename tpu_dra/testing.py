@@ -480,16 +480,25 @@ def seed_sched_inventory(client, *, nodes: int, chips_per_node: int,
                          node_fmt: str = "n{i}",
                          selector_exprs=None,
                          generation: str = "v5p",
-                         namespace: str = "default"):
+                         namespace: str = "default",
+                         hosts_per_slice: int = 1,
+                         claim_counts=()):
     """Seed the control-plane churn fixture in ONE place: DeviceClass
     ``tpu.dev`` (CEL selectors), ResourceClaimTemplate ``tmpl``, and
     `nodes` Nodes each publishing a ResourceSlice of `chips_per_node`
-    whole-chip devices (attributes: type=chip, generation). Returns the
-    node names. A schema change here changes bench, chaos, and tests
-    together instead of drifting across three hand-copied fixtures."""
+    whole-chip devices with the full topology attribute set (type,
+    generation, coordX/Y/Z, sliceTopology, sliceID, workerIndex —
+    coords from the same per-generation layout the fake backend
+    publishes). Returns the node names. `hosts_per_slice` groups
+    consecutive nodes into one physical ICI slice (shared sliceID,
+    workerIndex 0..h-1); `claim_counts` additionally creates a
+    ``tmpl<n>`` ResourceClaimTemplate requesting n devices for each n.
+    A schema change here changes bench, chaos, and tests together
+    instead of drifting across three hand-copied fixtures."""
     from tpu_dra.k8s.resources import (
         DEVICECLASSES, NODES, RESOURCECLAIMTEMPLATES, RESOURCESLICES,
     )
+    from tpu_dra.native.tpuinfo import default_fake_chips
 
     exprs = (list(selector_exprs) if selector_exprs
              else [DEFAULT_SCHED_SELECTOR])
@@ -497,16 +506,26 @@ def seed_sched_inventory(client, *, nodes: int, chips_per_node: int,
         "apiVersion": "resource.k8s.io/v1", "kind": "DeviceClass",
         "metadata": {"name": "tpu.dev"},
         "spec": {"selectors": [{"cel": {"expression": e}} for e in exprs]}})
-    client.create(RESOURCECLAIMTEMPLATES, {
-        "apiVersion": "resource.k8s.io/v1", "kind": "ResourceClaimTemplate",
-        "metadata": {"name": "tmpl", "namespace": namespace},
-        "spec": {"spec": {"devices": {"requests": [
-            {"name": "tpu", "exactly": {"deviceClassName": "tpu.dev"}}]}}},
-    }, namespace=namespace)
+    for count in (None,) + tuple(claim_counts):
+        req = {"name": "tpu", "exactly": {"deviceClassName": "tpu.dev"}}
+        if count is not None:
+            req["exactly"]["count"] = count
+        client.create(RESOURCECLAIMTEMPLATES, {
+            "apiVersion": "resource.k8s.io/v1",
+            "kind": "ResourceClaimTemplate",
+            "metadata": {"name": "tmpl" if count is None else f"tmpl{count}",
+                         "namespace": namespace},
+            "spec": {"spec": {"devices": {"requests": [req]}}},
+        }, namespace=namespace)
     names = []
     for i in range(nodes):
         name = node_fmt.format(i=i)
         names.append(name)
+        chips = default_fake_chips(
+            chips_per_node, generation,
+            slice_id=f"ici-{i // hosts_per_slice}",
+            worker_index=i % hosts_per_slice,
+            total_workers=hosts_per_slice)
         client.create(NODES, {"apiVersion": "v1", "kind": "Node",
                               "metadata": {"name": name, "labels": {}}})
         client.create(RESOURCESLICES, {
@@ -514,16 +533,24 @@ def seed_sched_inventory(client, *, nodes: int, chips_per_node: int,
             "metadata": {"name": f"{name}-tpu.dev"},
             "spec": {"driver": "tpu.dev", "nodeName": name,
                      "pool": {"name": name, "generation": 1},
-                     "devices": [{"name": f"chip-{j}", "attributes": {
+                     "devices": [{"name": f"chip-{c.index}", "attributes": {
                          "type": {"string": "chip"},
-                         "generation": {"string": generation}}}
-                         for j in range(chips_per_node)]}})
+                         "generation": {"string": generation},
+                         "coordX": {"int": c.coords[0]},
+                         "coordY": {"int": c.coords[1]},
+                         "coordZ": {"int": c.coords[2]},
+                         "sliceTopology": {"string": c.slice_topology},
+                         "sliceID": {"string": c.slice_id},
+                         "workerIndex": {"int": c.worker_index}}}
+                         for c in chips]}})
     return names
 
 
-def make_sched_pod(client, name: str, namespace: str = "default"):
-    """A pod claiming one device via the ``tmpl`` template (the churn
-    fixture's pod shape)."""
+def make_sched_pod(client, name: str, namespace: str = "default",
+                   template: str = "tmpl"):
+    """A pod claiming devices via `template` (the churn fixture's pod
+    shape; multi-chip templates are the ``tmpl<n>`` variants that
+    seed_sched_inventory's claim_counts stamps)."""
     from tpu_dra.k8s.resources import PODS
 
     return client.create(PODS, {
@@ -531,5 +558,5 @@ def make_sched_pod(client, name: str, namespace: str = "default"):
         "metadata": {"name": name, "namespace": namespace},
         "spec": {"containers": [{"name": "c", "image": "x"}],
                  "resourceClaims": [
-                     {"name": "t", "resourceClaimTemplateName": "tmpl"}]},
+                     {"name": "t", "resourceClaimTemplateName": template}]},
     }, namespace=namespace)

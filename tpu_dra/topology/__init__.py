@@ -1,0 +1,31 @@
+"""ICI topology subsystem: fabric model + placement scoring.
+
+The blueprint's core TPU-native claim is that ICI-connected slice
+provisioning replaces IMEX/MNNVL — which means the driver, not the
+workload, owns the fabric model (the composable-driver argument of
+arxiv 2506.23628). This package models the ICI mesh/torus per TPU
+generation and gives every placement decision a topology to consume:
+
+- ``mesh``       — the :class:`Mesh` model (dims, wraparound, neighbor /
+  distance functions), canonical per-generation slice shapes, and
+  publish-time coordinate validation.
+- ``placement``  — the slice-shape library (cuboid sub-shapes for a chip
+  count), the free-set scanner with fragmentation-aware scoring, the
+  contiguity verifier, and node-set ranking by inter-node ICI adjacency
+  (``sliceId``/``workerIndex``).
+
+Ownership rules (SURVEY §11): the topology layer holds NO allocation
+state of its own. The scheduler's ``AllocationIndex`` stays the single
+source of truth for taken devices; this package derives a free
+coordinate set from it per decision and scores placements over that.
+"""
+
+from tpu_dra.topology.mesh import (  # noqa: F401
+    Mesh, TopologyError, format_topology, parse_topology, topology_dims,
+    validate_chips,
+)
+from tpu_dra.topology.placement import (  # noqa: F401
+    NodeTopology, allocation_violations, best_placement, domain_topology,
+    enumerate_placements, enumerate_shapes, is_contiguous_block,
+    max_free_cuboid, node_topology_from_slices, rank_candidate_nodes,
+)

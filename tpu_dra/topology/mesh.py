@@ -1,0 +1,233 @@
+"""ICI mesh/torus model.
+
+A TPU slice is a cuboid of chips wired as a per-generation mesh or
+torus: v4/v5p are 3D tori (wraparound links close each ring once the
+slice spans the full dimension), v5e/v6e are 2D meshes (z is always 1).
+The driver discovers per-chip ``coords`` (native sysfs topology files,
+``native/tpuinfo.py``); this module turns those into a validated
+:class:`Mesh` the placement layer can scan.
+
+Coordinate validation happens at publish time (``DeviceState`` building
+its allocatable inventory): duplicate or out-of-bounds coordinates mean
+the inventory lies about the fabric, and every topology-scored decision
+downstream would be wrong — reject early, loudly.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+Coord = Tuple[int, int, int]
+
+# Generations whose ICI closes into a torus once a dimension spans the
+# full slice extent. 2D generations (v5e/v6e) are modeled as meshes.
+TORUS_GENERATIONS = frozenset({"v4", "v5p"})
+
+# Native dimensionality of each generation's ICI fabric.
+GEN_NDIMS: Dict[str, int] = {"v4": 3, "v5p": 3, "v5e": 2, "v6e": 2}
+
+
+class TopologyError(ValueError):
+    """Invalid fabric description (duplicate/out-of-bounds coords,
+    malformed topology strings)."""
+
+
+def format_topology(dims: Sequence[int]) -> str:
+    """(4, 4, 4) -> '4x4x4' (the ``tpu.dev/sliceTopology`` attribute)."""
+    return "x".join(str(d) for d in dims)
+
+
+def parse_topology(text: str) -> Optional[Tuple[int, int, int]]:
+    """'4x4x4' -> (4, 4, 4); '4x4' -> (4, 4, 1); None when malformed."""
+    if not text:
+        return None
+    parts = text.lower().split("x")
+    if not 1 <= len(parts) <= 3 or not all(p.isdigit() for p in parts):
+        return None
+    dims = [int(p) for p in parts]
+    if any(d < 1 for d in dims):
+        return None
+    while len(dims) < 3:
+        dims.append(1)
+    return (dims[0], dims[1], dims[2])
+
+
+def _balanced_factors(n: int, ndims: int) -> List[int]:
+    """Factor n into `ndims` factors as near-equal as possible (largest
+    first). Greedy: peel the divisor closest to the remaining
+    ndims-th root, preferring the smaller-or-equal side so 8 -> [2,2,2],
+    16 -> [4,2,2], 12 -> [3,2,2], primes degrade to [n,1,..]."""
+    dims: List[int] = []
+    remaining = n
+    for k in range(ndims, 1, -1):
+        target = round(remaining ** (1.0 / k)) or 1
+        best = 1
+        for d in range(target, 0, -1):
+            if remaining % d == 0:
+                best = d
+                break
+        dims.append(best)
+        remaining //= best
+    dims.append(remaining)
+    return sorted(dims, reverse=True)
+
+
+def topology_dims(generation: str, count: int) -> Tuple[int, int, int]:
+    """Canonical slice dims for `count` chips of `generation`: 3D
+    near-cubic for v4/v5p, 2D near-square (z=1) for v5e/v6e. 4 v5p
+    chips -> (2,2,1); 64 -> (4,4,4); 16 v5e -> (4,4,1)."""
+    if count < 1:
+        raise TopologyError(f"chip count must be >= 1, got {count}")
+    ndims = GEN_NDIMS.get(generation, 3)
+    dims = _balanced_factors(count, ndims)
+    while len(dims) < 3:
+        dims.append(1)
+    return (dims[0], dims[1], dims[2])
+
+
+@dataclass(frozen=True)
+class Mesh:
+    """One cuboid fabric block: dims plus per-dim wraparound. Coords are
+    local to the block (0-based); ``neighbors``/``distance`` honor the
+    torus closure where wrap is set."""
+
+    dims: Tuple[int, int, int]
+    wrap: Tuple[bool, bool, bool] = (False, False, False)
+
+    @property
+    def volume(self) -> int:
+        return self.dims[0] * self.dims[1] * self.dims[2]
+
+    def contains(self, c: Coord) -> bool:
+        return all(0 <= c[i] < self.dims[i] for i in range(3))
+
+    def all_coords(self) -> List[Coord]:
+        return [(x, y, z)
+                for x in range(self.dims[0])
+                for y in range(self.dims[1])
+                for z in range(self.dims[2])]
+
+    def neighbors(self, c: Coord) -> List[Coord]:
+        """ICI-linked coords of `c` inside this block (wraparound links
+        included where wrap is set; a dim of size <= 1 has no links;
+        size 2 has one direct link, never a duplicate wrap edge)."""
+        out: List[Coord] = []
+        for axis in range(3):
+            size = self.dims[axis]
+            if size <= 1:
+                continue
+            for step in (-1, 1):
+                v = c[axis] + step
+                if 0 <= v < size:
+                    pass
+                elif self.wrap[axis] and size > 2:
+                    v %= size
+                else:
+                    continue
+                n = list(c)
+                n[axis] = v
+                t = (n[0], n[1], n[2])
+                if t not in out:
+                    out.append(t)
+        return out
+
+    def distance(self, a: Coord, b: Coord) -> int:
+        """Hop distance over the fabric (per-dim ring distance where the
+        dim wraps, Manhattan otherwise)."""
+        total = 0
+        for axis in range(3):
+            d = abs(a[axis] - b[axis])
+            if self.wrap[axis] and self.dims[axis] > 2:
+                d = min(d, self.dims[axis] - d)
+            total += d
+        return total
+
+
+def for_slice(generation: str, count: int) -> Mesh:
+    """The canonical full-slice mesh for `count` chips: torus closure on
+    every dim a torus generation spans fully (and meaningfully: a ring
+    of 2 is just the direct link)."""
+    dims = topology_dims(generation, count)
+    torus = generation in TORUS_GENERATIONS
+    return Mesh(dims=dims, wrap=tuple(torus and d > 2 for d in dims))
+
+
+def block_mesh(coords: Iterable[Coord], generation: str = "",
+               slice_dims: Optional[Tuple[int, int, int]] = None,
+               ) -> Tuple[Mesh, Coord]:
+    """(mesh, offset) for a host's sub-block of a slice: dims are the
+    bounding extent of `coords`, offset the per-dim minimum (callers
+    normalize by subtracting it). Wraparound applies only where the
+    block spans the FULL slice dim of a torus generation — a partial
+    ring has no closure. Raises TopologyError on duplicates, negative
+    coords, or coords outside declared `slice_dims`."""
+    pts = list(coords)
+    seen = set()
+    for c in pts:
+        if c in seen:
+            raise TopologyError(f"duplicate chip coordinate {c}")
+        seen.add(c)
+        if any(v < 0 for v in c):
+            raise TopologyError(f"negative chip coordinate {c}")
+        if slice_dims is not None and any(c[i] >= slice_dims[i]
+                                          for i in range(3)):
+            raise TopologyError(
+                f"chip coordinate {c} outside declared slice topology "
+                f"{format_topology(slice_dims)}")
+    if not pts:
+        return Mesh(dims=(0, 0, 0)), (0, 0, 0)
+    lo = tuple(min(c[i] for c in pts) for i in range(3))
+    hi = tuple(max(c[i] for c in pts) for i in range(3))
+    dims = tuple(hi[i] - lo[i] + 1 for i in range(3))
+    torus = generation in TORUS_GENERATIONS
+    wrap = tuple(
+        torus and dims[i] > 2
+        and slice_dims is not None and dims[i] == slice_dims[i]
+        for i in range(3))
+    return Mesh(dims=dims, wrap=wrap), lo  # type: ignore[return-value]
+
+
+def validate_chips(chips: Iterable) -> None:
+    """Publish-time validation of a discovered chip inventory
+    (``DeviceState`` building its allocatable set): within each
+    (slice_id, worker_index) host block, coordinates must be unique,
+    non-negative, and inside the declared ``slice_topology`` when one is
+    published. Raises TopologyError — an inventory that lies about the
+    fabric must not reach a ResourceSlice.
+
+    A block where EVERY chip sits at the default (0,0,0) with no
+    declared topology published no fabric information at all (real
+    accel sysfs without topology/ files zero-fills coords) — that is
+    "no topology", not a duplicate-coordinate lie, and must not refuse
+    plugin startup; the scheduler's topology path falls back to
+    first-fit for such nodes."""
+    groups: Dict[Tuple[str, int], List] = {}
+    for chip in chips:
+        groups.setdefault((chip.slice_id, chip.worker_index),
+                          []).append(chip)
+    for (slice_id, worker), members in groups.items():
+        if (len(members) > 1
+                and all(c.coords == (0, 0, 0) for c in members)
+                and not any(getattr(c, "slice_topology", "")
+                            for c in members)):
+            continue  # coordinate-less inventory: nothing to validate
+        declared = None
+        for chip in members:
+            topo = parse_topology(getattr(chip, "slice_topology", ""))
+            if topo is not None:
+                if declared is not None and topo != declared:
+                    raise TopologyError(
+                        f"chips of slice {slice_id!r} worker {worker} "
+                        f"declare conflicting topologies "
+                        f"{format_topology(declared)} vs "
+                        f"{format_topology(topo)}")
+                declared = topo
+        try:
+            block_mesh((c.coords for c in members),
+                       generation=members[0].generation,
+                       slice_dims=declared)
+        except TopologyError as e:
+            raise TopologyError(
+                f"invalid chip topology (slice={slice_id!r} "
+                f"worker={worker}): {e}") from e

@@ -1,0 +1,331 @@
+"""Slice-shape library, free-set scanner, and fragmentation scoring.
+
+An N-chip request on an ICI fabric is only useful as a *cuboid* — the
+compiler lays collectives over contiguous sub-tori, and a scattered
+allocation silently degrades every all-reduce to DCN hops. This module
+enumerates the valid cuboid sub-shapes for a chip count, scans a free
+coordinate set for placements, and scores them by a fragmentation
+metric: prefer placements that consume already-fragmented regions
+(fewest free neighbors left around the placement) so large free cuboids
+survive for the next big claim — best-fit packing, adapted to a torus.
+
+Also home to the kube-facing adapters: ``node_topology_from_slices``
+(published ResourceSlice devices -> per-node topology view),
+``rank_candidate_nodes`` (inter-node ICI adjacency ordering by
+``sliceId``/``workerIndex``), ``domain_topology`` (ComputeDomain member
+alignment), and the chaos verifier ``allocation_violations``.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tpu_dra.topology.mesh import (
+    Coord, Mesh, TopologyError, block_mesh, parse_topology,
+)
+
+Shape = Tuple[int, int, int]
+
+
+def _surface(shape: Shape) -> int:
+    a, b, c = shape
+    return 2 * (a * b + b * c + c * a)
+
+
+def enumerate_shapes(count: int, dims: Shape) -> List[Shape]:
+    """All cuboid orientations (a,b,c) with a*b*c == count that fit in
+    `dims`, most compact first (smallest surface area == best ICI
+    bisection and least boundary to fragment against), deterministic
+    tie-break on the shape tuple."""
+    shapes: Set[Shape] = set()
+    for a in range(1, min(count, dims[0]) + 1):
+        if count % a:
+            continue
+        rest = count // a
+        for b in range(1, min(rest, dims[1]) + 1):
+            if rest % b:
+                continue
+            c = rest // b
+            if c <= dims[2]:
+                shapes.add((a, b, c))
+    return sorted(shapes, key=lambda s: (_surface(s), s))
+
+
+def _axis_bases(size: int, dim: int, wrap: bool) -> range:
+    """Base offsets along one axis: every offset when the ring wraps (a
+    placement may straddle the seam), sliding-window otherwise; a
+    full-span shape has exactly one distinct placement."""
+    if size == dim:
+        return range(1)
+    if wrap:
+        return range(dim)
+    return range(dim - size + 1)
+
+
+def placement_coords(base: Coord, shape: Shape, mesh: Mesh
+                     ) -> Tuple[Coord, ...]:
+    axes = []
+    for i in range(3):
+        if mesh.wrap[i]:
+            axes.append([(base[i] + d) % mesh.dims[i]
+                         for d in range(shape[i])])
+        else:
+            axes.append([base[i] + d for d in range(shape[i])])
+    return tuple(itertools.product(*axes))  # type: ignore[return-value]
+
+
+def enumerate_placements(mesh: Mesh, count: int
+                         ) -> Iterable[Tuple[Shape, Coord, Tuple[Coord, ...]]]:
+    """Every (shape, base, coords) placement of `count` chips on `mesh`
+    — each is a contiguous cuboid by construction, within bounds, with
+    `count` mutually distinct coords."""
+    for shape in enumerate_shapes(count, mesh.dims):
+        for bx in _axis_bases(shape[0], mesh.dims[0], mesh.wrap[0]):
+            for by in _axis_bases(shape[1], mesh.dims[1], mesh.wrap[1]):
+                for bz in _axis_bases(shape[2], mesh.dims[2], mesh.wrap[2]):
+                    base = (bx, by, bz)
+                    yield shape, base, placement_coords(base, shape, mesh)
+
+
+def fragmentation_score(coords: Iterable[Coord], free_after: Set[Coord],
+                        mesh: Mesh) -> int:
+    """Free cells ICI-adjacent to the placement once it is carved out:
+    LOW means the placement nests into an already-fragmented pocket
+    (against allocations or the fabric edge), HIGH means it was punched
+    into the middle of a large free region — the fragmenting move."""
+    score = 0
+    for c in coords:
+        for n in mesh.neighbors(c):
+            if n in free_after:
+                score += 1
+    return score
+
+
+def best_placement(mesh: Mesh, free: Set[Coord], count: int
+                   ) -> Optional[Tuple[Coord, ...]]:
+    """The best-scoring contiguous placement of `count` chips inside
+    `free`, or None when no cuboid of that count fits. Deterministic:
+    ties break on (shape enumeration order, base coord)."""
+    if count <= 0 or count > len(free):
+        return None
+    best: Optional[Tuple[Coord, ...]] = None
+    best_key: Optional[Tuple[int, int, Coord]] = None
+    for shape_idx, (shape, base, coords) in enumerate_index(mesh, count):
+        if not all(c in free for c in coords):
+            continue
+        after = free.difference(coords)
+        key = (fragmentation_score(coords, after, mesh), shape_idx, base)
+        if best_key is None or key < best_key:
+            best_key = key
+            best = coords
+    return best
+
+
+def enumerate_index(mesh: Mesh, count: int):
+    """enumerate_placements with a shape-order index for tie-breaking."""
+    shape_order: Dict[Shape, int] = {}
+    for shape, base, coords in enumerate_placements(mesh, count):
+        idx = shape_order.setdefault(shape, len(shape_order))
+        yield idx, (shape, base, coords)
+
+
+def max_free_cuboid(mesh: Mesh, free: Set[Coord]) -> int:
+    """Volume of the largest cuboid wholly inside `free` (the
+    fragmentation observable: a churned fabric whose max free cuboid
+    collapses can no longer host big claims even at low utilization).
+    Scans candidate volumes descending and returns on first fit."""
+    if not free:
+        return 0
+    volumes = sorted({a * b * c
+                      for a in range(1, mesh.dims[0] + 1)
+                      for b in range(1, mesh.dims[1] + 1)
+                      for c in range(1, mesh.dims[2] + 1)
+                      if a * b * c <= len(free)}, reverse=True)
+    for vol in volumes:
+        for _shape, _base, coords in enumerate_placements(mesh, vol):
+            if all(c in free for c in coords):
+                return vol
+    return 1 if free else 0
+
+
+def _circular_run(vals: List[int], dim: int, wrap: bool
+                  ) -> Optional[List[int]]:
+    """The ordered run the sorted distinct values form along one axis:
+    a plain interval, the full axis, or (when wrapping) an interval
+    straddling the seam; None when the values are not one run."""
+    k = len(vals)
+    if k == dim:
+        return vals
+    if vals == list(range(vals[0], vals[0] + k)):
+        return vals
+    if wrap:
+        present = set(vals)
+        for start in vals:
+            run = [(start + i) % dim for i in range(k)]
+            if set(run) == present:
+                return run
+    return None
+
+
+def is_contiguous_block(coords: Iterable[Coord], mesh: Mesh) -> bool:
+    """True iff `coords` is exactly one cuboid placement on `mesh`
+    (axis projections each form a single run — modulo the ring where
+    the axis wraps — and the set is their full cartesian product)."""
+    pts = list(coords)
+    block = set(pts)
+    if len(block) != len(pts) or not pts:
+        return False
+    runs = []
+    for axis in range(3):
+        vals = sorted({c[axis] for c in block})
+        run = _circular_run(vals, mesh.dims[axis],
+                            mesh.wrap[axis] and mesh.dims[axis] > 2)
+        if run is None:
+            return False
+        runs.append(run)
+    if len(block) != len(runs[0]) * len(runs[1]) * len(runs[2]):
+        return False
+    return block == set(itertools.product(*runs))
+
+
+# ---------------------------------------------------------------------------
+# Kube adapters: published ResourceSlice devices -> topology views
+# ---------------------------------------------------------------------------
+
+def _attr(dev: Dict, name: str, kind: str):
+    a = (dev.get("attributes") or {}).get(name) or {}
+    return a.get(kind)
+
+
+@dataclass
+class NodeTopology:
+    """One node's view of the fabric, extracted from its published
+    ResourceSlice chip devices. Coords are normalized to the node's own
+    block (offset removed) so the scanner works in local space."""
+
+    mesh: Mesh
+    coord_of: Dict[str, Coord] = field(default_factory=dict)   # device name
+    name_of: Dict[Coord, str] = field(default_factory=dict)
+    driver_of: Dict[str, str] = field(default_factory=dict)
+    slice_id: str = ""
+    worker_index: int = 0
+
+
+def node_topology_from_slices(slices: List[Dict]) -> Optional[NodeTopology]:
+    """Build a NodeTopology from one node's ResourceSlices, or None when
+    the node publishes no usable topology (no chip devices carry
+    coordinates, or the coordinates are invalid — an invalid fabric
+    must not be scored, only validated at publish time)."""
+    raw: Dict[str, Tuple[Coord, str]] = {}
+    slice_id = ""
+    worker = 0
+    generation = ""
+    declared: Optional[Tuple[int, int, int]] = None
+    for sl in sorted(slices, key=lambda s: s["metadata"]["name"]):
+        spec = sl.get("spec") or {}
+        driver = spec.get("driver", "")
+        for dev in spec.get("devices") or []:
+            if _attr(dev, "type", "string") not in (None, "chip"):
+                continue  # subslices partition a chip; the chip carries coords
+            cx = _attr(dev, "coordX", "int")
+            cy = _attr(dev, "coordY", "int")
+            cz = _attr(dev, "coordZ", "int")
+            if cx is None or cy is None or cz is None:
+                continue
+            raw[dev["name"]] = ((int(cx), int(cy), int(cz)), driver)
+            slice_id = slice_id or (_attr(dev, "sliceID", "string") or "")
+            worker = int(_attr(dev, "workerIndex", "int") or 0)
+            generation = generation or (_attr(dev, "generation", "string")
+                                        or "")
+            declared = declared or parse_topology(
+                _attr(dev, "sliceTopology", "string") or "")
+    if len(raw) < 2:
+        return None  # nothing to lay out
+    try:
+        mesh, offset = block_mesh((c for c, _ in raw.values()),
+                                  generation=generation, slice_dims=declared)
+    except TopologyError:
+        return None
+    topo = NodeTopology(mesh=mesh, slice_id=slice_id, worker_index=worker)
+    for name, (c, driver) in raw.items():
+        local = (c[0] - offset[0], c[1] - offset[1], c[2] - offset[2])
+        topo.coord_of[name] = local
+        topo.name_of[local] = name
+        topo.driver_of[name] = driver
+    return topo
+
+
+def rank_candidate_nodes(infos: List[Tuple[str, str, int]]) -> List[str]:
+    """Order candidate nodes so multi-node placements land on ONE
+    physical slice: group by sliceId, largest slice group first (a small
+    group exhausts before a big ComputeDomain fills), inside a group by
+    workerIndex (ranks then match the fabric's worker order); nodes
+    with no slice identity trail in name order. `infos` is
+    (node_name, slice_id, worker_index)."""
+    groups: Dict[str, List[Tuple[int, str]]] = {}
+    loose: List[str] = []
+    for name, slice_id, worker in infos:
+        if slice_id:
+            groups.setdefault(slice_id, []).append((worker, name))
+        else:
+            loose.append(name)
+    out: List[str] = []
+    for slice_id in sorted(groups, key=lambda s: (-len(groups[s]), s)):
+        out.extend(name for _w, name in sorted(groups[slice_id]))
+    out.extend(sorted(loose))
+    return out
+
+
+def domain_topology(members: List[Dict]) -> Dict:
+    """ComputeDomain member-set ICI summary from ``cd.status.nodes``
+    entries (each carries the daemon-registered ``sliceID``/``index``):
+    how many physical slices the domain spans and whether it is
+    slice-aligned (one slice, contiguous worker indices) — the
+    multi-node analog of an intra-node contiguous cuboid."""
+    slice_ids = sorted({n.get("sliceID", "") for n in members})
+    aligned = False
+    if len(slice_ids) == 1 and members:
+        idx = sorted(n.get("index", 0) for n in members)
+        aligned = idx == list(range(idx[0], idx[0] + len(idx)))
+    return {"slices": len(slice_ids), "sliceAligned": aligned}
+
+
+def allocation_violations(claims: List[Dict], slices: List[Dict]
+                          ) -> List[str]:
+    """Chaos invariant: every allocated multi-chip claim on a node that
+    publishes coordinates must be an ICI-contiguous cuboid. Built from
+    cluster truth (claim listing + ResourceSlice listing), independent
+    of any scheduler state."""
+    by_node: Dict[str, List[Dict]] = {}
+    for sl in slices:
+        node = (sl.get("spec") or {}).get("nodeName")
+        if node:
+            by_node.setdefault(node, []).append(sl)
+    topos: Dict[str, Optional[NodeTopology]] = {
+        node: node_topology_from_slices(sls)
+        for node, sls in by_node.items()}
+    out: List[str] = []
+    for claim in claims:
+        alloc = (claim.get("status") or {}).get("allocation") or {}
+        results = (alloc.get("devices") or {}).get("results") or []
+        per_pool: Dict[str, List[str]] = {}
+        for r in results:
+            per_pool.setdefault(r.get("pool", ""), []).append(
+                r.get("device", ""))
+        for pool, devices in per_pool.items():
+            topo = topos.get(pool)
+            if topo is None or len(devices) < 2:
+                continue
+            coords = [topo.coord_of[d] for d in devices
+                      if d in topo.coord_of]
+            if len(coords) != len(devices):
+                continue  # subslice/unknown devices: no chip-level layout
+            if not is_contiguous_block(coords, topo.mesh):
+                name = claim.get("metadata", {}).get("name", "?")
+                out.append(
+                    f"claim {name}: devices {sorted(devices)} on {pool} "
+                    f"are not an ICI-contiguous cuboid (coords "
+                    f"{sorted(coords)})")
+    return out

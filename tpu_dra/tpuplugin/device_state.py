@@ -34,6 +34,7 @@ from tpu_dra.tpuplugin.checkpoint import (
 )
 from tpu_dra.tpuplugin.passthrough import PassthroughManager
 from tpu_dra.tpuplugin.sharing import MultiprocessManager, TimeSlicingManager
+from tpu_dra.topology import mesh as topology_mesh
 
 
 log = logging.getLogger("tpu_dra.tpuplugin")
@@ -118,8 +119,14 @@ class DeviceState:
         self._mp_manager = mp_manager
         self._pt_manager = pt_manager
         self._lock = threading.Lock()
+        chips = backend.chips()
+        # Publish-time fabric validation: duplicate or out-of-bounds chip
+        # coordinates mean the inventory lies about the ICI mesh — every
+        # topology-scored placement downstream would be wrong. Reject
+        # before anything reaches a ResourceSlice.
+        topology_mesh.validate_chips(chips)
         self.allocatable = deviceinfo.enumerate_allocatable(
-            backend.chips(), include_subslices=include_subslices)
+            chips, include_subslices=include_subslices)
         self._unhealthy_uuids: set = set()
         # Per-phase ms of the last non-idempotent prepare (see prepare()).
         self.last_prepare_breakdown: Dict[str, float] = {}

@@ -98,6 +98,9 @@ class AllocatableDevice:
             "coordX": {"int": chip.coords[0]},
             "coordY": {"int": chip.coords[1]},
             "coordZ": {"int": chip.coords[2]},
+            # Declared slice dims ("4x4x4"): lets CEL selectors constrain
+            # by topology and the topology scorer bound wraparound.
+            "sliceTopology": {"string": chip.slice_topology},
         }
         if self.type == DEVICE_TYPE_CHIP:
             capacity = {
