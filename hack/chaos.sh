@@ -24,7 +24,8 @@ JAX_PLATFORMS=cpu TPU_DRA_TPUINFO_BACKEND=fake \
     --seeds "$SEEDS" --seed-start "${CHAOS_SEED_START:-0}" \
     --events "$EVENTS"
 
-echo ">> chaos soak (slow-marked pytest tier)"
-JAX_PLATFORMS=cpu python -m pytest "$REPO_ROOT/tests/test_chaos.py" \
+echo ">> chaos soak (slow-marked pytest tier, lock witness on)"
+JAX_PLATFORMS=cpu TPU_DRA_LOCK_WITNESS=1 \
+  python -m pytest "$REPO_ROOT/tests/test_chaos.py" \
   -m slow -q -p no:cacheprovider
 echo ">> chaos tier green"

@@ -6,6 +6,10 @@ REPO_ROOT="$(cd "$(dirname "$0")/.." && pwd)"
 WORK="$(mktemp -d /tmp/tdra-XXXXXX)"
 ENV_FILE="$WORK/env.sh"
 
+# Lint gate before any cluster spin-up: an invariant violation fails in
+# seconds here instead of minutes into the e2e run.
+"$REPO_ROOT/hack/lint.sh" || exit 1
+
 "$REPO_ROOT/hack/e2e-up.sh" "$ENV_FILE" "$@" || exit 1
 # shellcheck disable=SC1090
 source "$ENV_FILE"

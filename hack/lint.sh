@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# Lint tier (the reference's `go vet` + golangci-lint analog, Makefile):
+#
+#   hack/lint.sh
+#
+# 1. compileall — syntax over the whole tree (dralint skips files that
+#    do not parse; this step makes them loud).
+# 2. dralint — the project-invariant analyzer (tpu_dra/analysis):
+#    R1 *_locked call discipline, R2 no blocking work under data locks,
+#    R3 zero-copy informer reads are read-only, R4 fault-site registry
+#    coverage, R5 metric catalog, R6 feature-gate names. Any
+#    unsuppressed finding fails.
+# 3. The fault-site coverage report (informational): guard + arm
+#    locations per registered site.
+set -euo pipefail
+REPO_ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+
+echo ">> compileall"
+python -m compileall -q \
+  "$REPO_ROOT/tpu_dra" "$REPO_ROOT/tests" "$REPO_ROOT/bench.py" \
+  "$REPO_ROOT/hack"
+
+echo ">> dralint (R1-R6) + fault-site coverage"
+python -m tpu_dra.analysis --root "$REPO_ROOT" --sites-report
+
+echo ">> lint tier green"

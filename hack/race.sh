@@ -3,14 +3,22 @@
 #
 #   hack/race.sh [ITERATIONS]
 #
-# 1. Builds the threaded C++ daemons under ThreadSanitizer and drives them
+# 1. Lint gate first: dralint's static rules are the cheap half of the
+#    race tier — a blocking call under a data lock fails here before any
+#    TSan cycle is spent.
+# 2. Builds the threaded C++ daemons under ThreadSanitizer and drives them
 #    with concurrent clients (TSAN_OPTIONS halt_on_error: any report fails).
-# 2. Repeat-runs the heavily threaded Python suites (informers, workqueues,
+# 3. Repeat-runs the heavily threaded Python suites (informers, workqueues,
 #    three-process CD convergence, watchdogs) N times — the flake surface
-#    scales with iterations, not wall-clock.
+#    scales with iterations, not wall-clock — with the LOCK-ORDER WITNESS
+#    installed (TPU_DRA_LOCK_WITNESS=1): conftest fails the session on an
+#    acquisition-order cycle.
 set -euo pipefail
 REPO_ROOT="$(cd "$(dirname "$0")/.." && pwd)"
 N="${1:-3}"
+
+echo ">> lint gate (dralint)"
+"$REPO_ROOT/hack/lint.sh"
 
 echo ">> TSan build + drive"
 make -C "$REPO_ROOT/native" tsan -s
@@ -19,9 +27,10 @@ TSAN_COORD="$REPO_ROOT/native/build-tsan/tpu-multiprocess-coordinator" \
 TSAN_DAEMON="$REPO_ROOT/native/build-tsan/tpu-slice-daemon" \
   python "$REPO_ROOT/hack/tsan_drive.py"
 
-echo ">> ${N}x repeat of the threaded Python suites"
+echo ">> ${N}x repeat of the threaded Python suites (lock witness on)"
 for i in $(seq 1 "$N"); do
   echo "-- iteration $i/$N"
+  TPU_DRA_LOCK_WITNESS=1 \
   python -m pytest "$REPO_ROOT/tests/test_cd_integration.py" \
     "$REPO_ROOT/tests/test_stress_failover.py" \
     "$REPO_ROOT/tests/test_multiprocess_e2e.py" -q -p no:cacheprovider

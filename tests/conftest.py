@@ -15,6 +15,29 @@ import faulthandler  # noqa: E402
 
 import pytest  # noqa: E402
 
+# Lock-order witness for the threaded tiers (hack/race.sh, hack/chaos.sh
+# export TPU_DRA_LOCK_WITNESS=1): every Lock/RLock tpu_dra code creates
+# from here on joins the acquisition-order graph, and the session FAILS
+# if the graph ever contains a cycle (potential deadlock) — the dynamic
+# complement to dralint's static R1/R2 (SURVEY §12). Installed before
+# any tpu_dra import so module-global singletons are witnessed too.
+_WITNESS_SESSION = bool(os.environ.get("TPU_DRA_LOCK_WITNESS"))
+if _WITNESS_SESSION:
+    from tpu_dra.infra import lockwitness
+    lockwitness.install()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if not _WITNESS_SESSION:
+        return
+    from tpu_dra.infra import lockwitness
+    cycles = lockwitness.WITNESS.cycles()
+    if cycles:
+        print("\n!! lock-order witness violations:")
+        for c in cycles:
+            print(f"   {c}")
+        session.exitstatus = 3
+
 # Hung chaos/stress tests must print every thread's stack instead of
 # timing out opaquely inside the tier timeout: re-armed per test below.
 # exit=False: the dump is diagnostic — the test (and the tier's own

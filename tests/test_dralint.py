@@ -1,0 +1,434 @@
+"""dralint (tpu_dra/analysis): per-rule positive/negative fixtures,
+suppression-comment behavior, and the whole-tree zero-findings
+tripwire that makes the analyzer a hard gate (ISSUE 5)."""
+
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from tpu_dra import analysis
+from tpu_dra.analysis import ProjectContext, lint_source
+
+
+def lint(src, rules, relpath="fixture.py", ctx=None):
+    return lint_source(textwrap.dedent(src), relpath=relpath, ctx=ctx,
+                       rule_ids=set(rules.split(",")))
+
+
+def rule_ids(findings):
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# R1: *_locked call discipline
+# ---------------------------------------------------------------------------
+
+class TestR1LockedCalls:
+    def test_fires_on_unlocked_call(self):
+        out = lint("""
+            class M:
+                def bad(self):
+                    self._spawn_locked()
+        """, "R1")
+        assert rule_ids(out) == ["R1"]
+        assert "_spawn_locked" in out[0].message
+
+    def test_allowed_under_with_lock(self):
+        out = lint("""
+            class M:
+                def ok(self):
+                    with self._lock:
+                        self._spawn_locked()
+        """, "R1")
+        assert out == []
+
+    def test_allowed_from_other_locked_method(self):
+        out = lint("""
+            class M:
+                def _outer_locked(self):
+                    self._inner_locked()
+        """, "R1")
+        assert out == []
+
+    def test_condition_counts_as_lock(self):
+        # Holding a condition variable IS holding its lock (workqueue).
+        out = lint("""
+            class Q:
+                def enqueue(self):
+                    with self._cond:
+                        self._push_locked(1)
+        """, "R1")
+        assert out == []
+
+    def test_callback_defined_under_lock_is_not_under_lock(self):
+        # The nested function runs later, without the lock.
+        out = lint("""
+            class M:
+                def bad(self):
+                    with self._lock:
+                        def cb():
+                            self._spawn_locked()
+                        return cb
+        """, "R1")
+        assert rule_ids(out) == ["R1"]
+
+
+# ---------------------------------------------------------------------------
+# R2: no blocking work under a data lock
+# ---------------------------------------------------------------------------
+
+class TestR2BlockingUnderLock:
+    @pytest.mark.parametrize("call", [
+        "time.sleep(1)",
+        "subprocess.Popen(argv)",
+        "subprocess.run(argv)",
+        "proc.wait(timeout=5)",
+        "self._stop.wait(0.5)",
+        "t.join()",
+        "t.join(timeout=2)",
+        "fcntl.flock(fd, fcntl.LOCK_EX)",
+        "self._client.list(PODS)",
+        "self._client.update_status(CLAIMS, obj)",
+    ])
+    def test_fires_under_with_lock(self, call):
+        out = lint(f"""
+            class M:
+                def bad(self):
+                    with self._lock:
+                        {call}
+        """, "R2")
+        assert rule_ids(out) == ["R2"], (call, out)
+
+    def test_fires_inside_locked_function(self):
+        out = lint("""
+            class M:
+                def _spawn_locked(self):
+                    subprocess.Popen(self._argv)
+        """, "R2")
+        assert rule_ids(out) == ["R2"]
+
+    @pytest.mark.parametrize("src", [
+        # Blocking work with no lock held is fine.
+        "def f():\n    time.sleep(1)\n",
+        # Condition.wait releases the lock it guards.
+        """
+        class Q:
+            def get(self):
+                with self._cond:
+                    self._cond.wait(timeout=0.5)
+        """,
+        # str.join takes a positional iterable — not a thread join.
+        """
+        class M:
+            def fmt(self):
+                with self._lock:
+                    return ",".join(self._parts)
+        """,
+        # Operation gates (Flock's _flock/_tlock) are long-held by
+        # design and exempt from the data-lock naming pattern.
+        """
+        class D:
+            def prepare(self):
+                with self._flock:
+                    time.sleep(0.1)
+        """,
+        # A callback defined under the lock runs later, lock-free.
+        """
+        class M:
+            def arm(self):
+                with self._lock:
+                    cb = lambda: time.sleep(1)
+                    return cb
+        """,
+        # In-memory work under the lock is the intended use.
+        """
+        class M:
+            def ok(self):
+                with self._lock:
+                    self._state["a"] = 1
+                    heapq.heappush(self._heap, 2)
+        """,
+    ])
+    def test_negative(self, src):
+        assert lint(src, "R2") == []
+
+
+# ---------------------------------------------------------------------------
+# R3: zero-copy informer reads are read-only
+# ---------------------------------------------------------------------------
+
+class TestR3ZeroCopyViews:
+    def test_subscript_assign_on_lister_list(self):
+        out = lint("""
+            class S:
+                def bad(self):
+                    pods = self._informers["pods"].lister.list()
+                    pods[0]["spec"]["nodeName"] = "n1"
+        """, "R3")
+        assert rule_ids(out) == ["R3"]
+
+    def test_mutation_of_loop_var_over_view(self):
+        out = lint("""
+            class S:
+                def bad(self):
+                    for pod in self.inf.lister.list():
+                        pod["status"] = {}
+        """, "R3")
+        assert rule_ids(out) == ["R3"]
+
+    def test_mutator_method_on_view(self):
+        out = lint("""
+            class S:
+                def bad(self):
+                    cd = self.inf.lister.get("x", "ns")
+                    cd["metadata"]["labels"].update({"a": "b"})
+        """, "R3")
+        assert rule_ids(out) == ["R3"]
+
+    def test_get_by_index_is_a_view(self):
+        out = lint("""
+            class S:
+                def bad(self):
+                    hits = self.inf.get_by_index("uid", uid)
+                    hits[0].setdefault("status", {})
+        """, "R3")
+        assert rule_ids(out) == ["R3"]
+
+    def test_deepcopy_launders_the_view(self):
+        out = lint("""
+            class S:
+                def ok(self):
+                    pod = self.inf.lister.get("x", "ns")
+                    upd = copy.deepcopy(pod)
+                    upd["spec"]["nodeName"] = "n1"
+                    upd.setdefault("status", {})
+        """, "R3")
+        assert out == []
+
+    def test_reads_are_fine(self):
+        out = lint("""
+            class S:
+                def ok(self):
+                    for pod in sorted(self.inf.lister.list()):
+                        name = pod["metadata"].get("name")
+                        if pod.get("status"):
+                            self.note(name)
+        """, "R3")
+        assert out == []
+
+    def test_handler_params_tainted_in_zero_copy_event_module(self):
+        src = """
+            class S:
+                def __init__(self, client):
+                    self.inf = Informer(client, PODS, copy_events=False)
+
+                def _on_pod(self, pod):
+                    pod["metadata"]["labels"] = {}
+        """
+        assert rule_ids(lint(src, "R3")) == ["R3"]
+
+    def test_handler_params_free_when_events_are_copied(self):
+        src = """
+            class S:
+                def __init__(self, client):
+                    self.inf = Informer(client, PODS)
+
+                def _on_pod(self, pod):
+                    pod["metadata"]["labels"] = {}
+        """
+        assert lint(src, "R3") == []
+
+    def test_reassignment_clears_taint(self):
+        out = lint("""
+            class S:
+                def ok(self):
+                    pod = self.inf.lister.get("x")
+                    pod = self._client.get(PODS, "x")
+                    pod["spec"]["nodeName"] = "n1"
+        """, "R3")
+        assert out == []
+
+
+# ---------------------------------------------------------------------------
+# R4: fault-site registry coverage
+# ---------------------------------------------------------------------------
+
+def _sites_ctx(**sites):
+    return ProjectContext(root=Path("."), fault_sites=sites or {"a.b": 3},
+                          fault_sites_path="tpu_dra/infra/faults.py")
+
+
+class TestR4FaultSites:
+    def test_unknown_site_literal_fires(self):
+        out = lint("""
+            FAULTS.check("a.typo")
+        """, "R4", ctx=_sites_ctx())
+        assert any("unknown fault site 'a.typo'" in f.message for f in out)
+
+    def test_known_guard_plus_test_arm_is_clean(self):
+        ctx = _sites_ctx()
+        prod = lint('FAULTS.check("a.b")\n', "R4", ctx=ctx,
+                    relpath="tpu_dra/mod.py")
+        assert not [f for f in prod if "unknown" in f.message]
+
+    def test_orphan_registered_site_reported(self):
+        # Registered but never armed by a test/chaos module and never
+        # guarded in production: both orphan directions fire.
+        out = lint("x = 1\n", "R4", ctx=_sites_ctx())
+        msgs = [f.message for f in out]
+        assert any("never armed" in m for m in msgs)
+        assert any("no production guard" in m for m in msgs)
+        assert all(f.path == "tpu_dra/infra/faults.py" for f in out)
+
+    def test_locally_registered_site_is_known(self):
+        out = lint("""
+            FAULTS.register_site("test.only", "desc")
+            FAULTS.arm("test.only", EveryNth(1))
+        """, "R4", ctx=_sites_ctx(), relpath="tests/test_x.py")
+        assert not [f for f in out if "unknown" in f.message]
+
+    def test_dynamic_site_expression_is_skipped(self):
+        out = lint("""
+            site = pick()
+            FAULTS.arm(site, EveryNth(1))
+        """, "R4", ctx=_sites_ctx(), relpath="tests/test_x.py")
+        assert not [f for f in out if "unknown" in f.message]
+
+
+# ---------------------------------------------------------------------------
+# R5: metric catalog coverage
+# ---------------------------------------------------------------------------
+
+def _metrics_ctx():
+    return ProjectContext(root=Path("."),
+                          metric_catalog={"tpu_dra_known_total": 5},
+                          metric_catalog_path="tpu_dra/infra/metrics.py")
+
+
+class TestR5Metrics:
+    def test_uncataloged_name_fires(self):
+        out = lint('C = DefaultRegistry.counter("tpu_dra_new_total")\n',
+                   "R5", ctx=_metrics_ctx())
+        assert any("not declared" in f.message for f in out)
+
+    def test_bad_prefix_fires(self):
+        out = lint('C = DefaultRegistry.counter("up_total")\n',
+                   "R5", ctx=_metrics_ctx())
+        assert any("naming contract" in f.message for f in out)
+
+    def test_cataloged_registration_clean_and_orphan_detected(self):
+        out = lint('C = DefaultRegistry.counter("tpu_dra_known_total")\n',
+                   "R5", ctx=_metrics_ctx())
+        assert out == []
+        orphan = lint('C = DefaultRegistry.counter("tpu_dra_known_total")\n'
+                      'G = DefaultRegistry.gauge("tpu_dra_known_total")\n',
+                      "R5", ctx=ProjectContext(
+                          root=Path("."),
+                          metric_catalog={"tpu_dra_known_total": 1,
+                                          "tpu_dra_ghost_total": 2},
+                          metric_catalog_path="m.py"))
+        assert any("orphan catalog entry" in f.message for f in orphan)
+
+    def test_tests_are_exempt(self):
+        out = lint('C = r.counter("up_test")\n', "R5", ctx=_metrics_ctx(),
+                   relpath="tests/test_m.py")
+        assert not [f for f in out if "naming contract" in f.message]
+
+
+# ---------------------------------------------------------------------------
+# R6: feature-gate names
+# ---------------------------------------------------------------------------
+
+def _gates_ctx():
+    return ProjectContext(root=Path("."), gate_names={"GateA", "GateB"})
+
+
+class TestR6Gates:
+    def test_unknown_gate_in_enabled(self):
+        out = lint('featuregates.enabled("GateTypo")\n', "R6",
+                   ctx=_gates_ctx())
+        assert rule_ids(out) == ["R6"]
+
+    def test_unknown_gate_in_gate_string(self):
+        out = lint('Features.set_from_string("GateA=true,GateTypo=false")\n',
+                   "R6", ctx=_gates_ctx())
+        assert rule_ids(out) == ["R6"]
+        assert "GateTypo" in out[0].message
+
+    def test_known_gates_clean(self):
+        out = lint("""
+            featuregates.enabled("GateA")
+            Features.set_from_string("GateA=true, GateB=false")
+        """, "R6", ctx=_gates_ctx())
+        assert out == []
+
+
+# ---------------------------------------------------------------------------
+# Suppressions
+# ---------------------------------------------------------------------------
+
+class TestSuppressions:
+    SRC = """
+        class M:
+            def bad(self):
+                with self._lock:
+                    time.sleep(1){same_line}
+    """
+
+    def test_same_line_rule_suppression(self):
+        src = self.SRC.format(same_line="  # dralint: ignore[R2]")
+        assert lint(src, "R2") == []
+
+    def test_line_above_suppression(self):
+        out = lint("""
+            class M:
+                def bad(self):
+                    with self._lock:
+                        # dralint: ignore[R2] — justified: <why>
+                        time.sleep(1)
+        """, "R2")
+        assert out == []
+
+    def test_bare_ignore_suppresses_all_rules(self):
+        out = lint("""
+            class M:
+                def bad(self):
+                    with self._lock:
+                        time.sleep(1)  # dralint: ignore
+        """, "R2")
+        assert out == []
+
+    def test_other_rule_id_does_not_suppress(self):
+        src = self.SRC.format(same_line="  # dralint: ignore[R1]")
+        assert rule_ids(lint(src, "R2")) == ["R2"]
+
+    def test_suppressed_findings_still_counted_in_report(self):
+        root = Path(analysis.find_root(Path(__file__)))
+        report = analysis.run([root / "tests" / "test_featuregates.py"],
+                              root=root)
+        assert [f.rule for f in report.suppressed].count("R6") == 2
+
+
+# ---------------------------------------------------------------------------
+# The tripwire: the whole tree is clean
+# ---------------------------------------------------------------------------
+
+class TestWholeTree:
+    def test_zero_unsuppressed_findings(self):
+        """dralint is a hard gate, not a report: any unsuppressed
+        finding anywhere in the tree fails this test (and hack/lint.sh,
+        and therefore race/e2e entry points)."""
+        root = Path(analysis.find_root(Path(__file__)))
+        paths = [root / "tpu_dra", root / "tests", root / "bench.py"]
+        report = analysis.run([p for p in paths if p.exists()], root=root)
+        assert report.files > 100  # the run actually saw the tree
+        assert report.ok, "dralint findings:\n" + "\n".join(
+            f.format() for f in report.findings)
+
+    def test_registries_parsed_from_infra(self):
+        root = Path(analysis.find_root(Path(__file__)))
+        ctx = ProjectContext.load(root)
+        assert "k8s.api.request" in ctx.fault_sites
+        assert "tpu_dra_sched_full_relists" in ctx.metric_catalog
+        assert "TopologyAwareScheduling" in ctx.gate_names

@@ -34,10 +34,12 @@ class TestParsing:
 
     def test_unknown_gate(self):
         with pytest.raises(ValueError, match="unknown feature gate"):
+            # dralint: ignore[R6] — deliberately unknown gate
             Features.set_from_string("NotAGate=true")
 
     def test_partial_failure_is_atomic(self):
         with pytest.raises(ValueError):
+            # dralint: ignore[R6] — deliberately unknown gate
             Features.set_from_string("TimeSlicingSettings=true,Bogus=true")
         assert not Features.enabled(TimeSlicingSettings)
 

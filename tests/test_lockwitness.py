@@ -1,0 +1,262 @@
+"""Lock-order witness (infra/lockwitness.py): cycle detection on the
+acquisition-order graph, hold-time outliers, RLock reentrancy, the
+same-class self-nest carve-out, and the refcounted install() patch."""
+
+import threading
+
+import pytest
+
+from tpu_dra.infra import lockwitness as lw
+
+
+@pytest.fixture
+def witness():
+    """A private witness so tests never touch the process-global graph
+    (which a TPU_DRA_LOCK_WITNESS session is actively using)."""
+    w = lw.LockWitness()
+    saved = lw.WITNESS
+    lw.WITNESS = w
+    yield w
+    lw.WITNESS = saved
+
+
+def _lock(key):
+    return lw.WitnessLock(threading._allocate_lock(), key)
+
+
+def _in_thread(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    t.join(timeout=10)
+    assert not t.is_alive()
+
+
+def _nested(outer, inner):
+    def fn():
+        with outer:
+            with inner:
+                pass
+    return fn
+
+
+class TestCycleDetection:
+    def test_opposite_order_on_two_threads_is_a_cycle(self, witness):
+        A, B = _lock("mod.py:1"), _lock("mod.py:2")
+
+        def t1():
+            with A:
+                with B:
+                    pass
+
+        def t2():
+            with B:
+                with A:
+                    pass
+
+        _in_thread(t1)
+        assert witness.cycles() == []  # one order alone is fine
+        _in_thread(t2)
+        cycles = witness.cycles()
+        assert len(cycles) == 1
+        assert "mod.py:1" in cycles[0] and "mod.py:2" in cycles[0]
+        assert "potential deadlock" in cycles[0]
+
+    def test_consistent_order_is_acyclic(self, witness):
+        A, B, C = (_lock(f"mod.py:{i}") for i in (1, 2, 3))
+
+        def t():
+            with A:
+                with B:
+                    with C:
+                        pass
+
+        for _ in range(3):
+            _in_thread(t)
+        assert witness.cycles() == []
+        assert witness.violations(max_hold_s=5.0) == []
+
+    def test_transitive_cycle_through_three_locks(self, witness):
+        A, B, C = (_lock(f"mod.py:{i}") for i in (1, 2, 3))
+        _in_thread(_nested(A, B))
+        _in_thread(_nested(B, C))
+        assert witness.cycles() == []
+        _in_thread(_nested(C, A))
+        cycles = witness.cycles()
+        assert len(cycles) == 1
+        assert all(k in cycles[0] for k in
+                   ("mod.py:1", "mod.py:2", "mod.py:3"))
+
+    def test_duplicate_cycle_reported_once(self, witness):
+        A, B = _lock("m.py:1"), _lock("m.py:2")
+
+        def forward():
+            with A:
+                with B:
+                    pass
+
+        def backward():
+            with B:
+                with A:
+                    pass
+
+        _in_thread(forward)
+        for _ in range(3):
+            _in_thread(backward)
+        assert len(witness.cycles()) == 1
+
+    def test_same_class_nesting_is_self_nest_not_cycle(self, witness):
+        # Two per-chip locks share a creation site; sorted-order nested
+        # acquisition must not read as a deadlock.
+        L1, L2 = _lock("chips.py:9"), _lock("chips.py:9")
+
+        def t():
+            with L1:
+                with L2:
+                    pass
+
+        _in_thread(t)
+        assert witness.cycles() == []
+        assert witness.stats()["chips.py:9"]["self_nests"] == 1
+
+
+class TestHoldTracking:
+    def test_hold_outlier_reported(self, witness):
+        L = _lock("slow.py:1")
+        with L:
+            import time
+            time.sleep(0.03)
+        out = witness.hold_outliers(0.01)
+        assert len(out) == 1 and "slow.py:1" in out[0]
+        assert witness.hold_outliers(1.0) == []
+
+    def test_violations_combines_cycles_and_outliers(self, witness):
+        L = _lock("slow.py:2")
+        with L:
+            import time
+            time.sleep(0.03)
+        assert witness.violations() == []          # no threshold: cycles only
+        assert len(witness.violations(max_hold_s=0.01)) == 1
+
+    def test_rlock_reentry_no_self_edge_single_hold_time(self, witness):
+        R = lw.WitnessRLock(threading.RLock(), "re.py:1")
+        with R:
+            with R:
+                pass
+        assert witness.cycles() == []
+        st = witness.stats()["re.py:1"]
+        assert st["acquisitions"] == 1 and st["self_nests"] == 0
+
+    def test_reset_clears_graph(self, witness):
+        A, B = _lock("r.py:1"), _lock("r.py:2")
+        _in_thread(_nested(A, B))
+        _in_thread(_nested(B, A))
+        assert witness.cycles()
+        witness.reset()
+        assert witness.cycles() == []
+        assert witness.edges() == {}
+
+
+class TestConditionInterop:
+    def test_condition_wait_releases_witnessed_rlock(self, witness):
+        R = lw.WitnessRLock(threading.RLock(), "cond.py:1")
+        cond = threading.Condition(R)
+        other = _lock("cond.py:2")
+
+        def waiter():
+            with cond:
+                cond.wait(timeout=0.05)
+
+        def toucher():
+            # If wait() failed to pop the witness's held stack, this
+            # acquisition (same thread pool pattern) would add edges
+            # from a lock the thread no longer holds.
+            with other:
+                pass
+
+        _in_thread(waiter)
+        _in_thread(toucher)
+        assert witness.cycles() == []
+        # wait() went through _release_save/_acquire_restore: the rlock
+        # was released and re-acquired, so two acquisitions.
+        assert witness.stats()["cond.py:1"]["acquisitions"] == 2
+
+    def test_reentrant_cond_wait_not_booked_as_hold(self, witness):
+        # cond.wait() under REENTRANT hold fully releases the RLock:
+        # the wait must not count as lock-hold time (a 50ms wait would
+        # otherwise read as a 50ms hold — a false R2-style outlier).
+        R = lw.WitnessRLock(threading.RLock(), "cond.py:9")
+        cond = threading.Condition(R)
+
+        def reentrant_waiter():
+            with R:           # depth 1
+                with cond:    # depth 2 (same inner RLock)
+                    cond.wait(timeout=0.05)
+
+        _in_thread(reentrant_waiter)
+        assert witness.cycles() == []
+        assert witness.hold_outliers(0.02) == []
+        # Fully re-acquired at depth 2 after the wait, fully released
+        # on exit: no residual held state, 2 windows booked.
+        assert witness.stats()["cond.py:9"]["acquisitions"] == 2
+
+
+class TestWindows:
+    def test_violations_since_reports_only_the_window(self, witness):
+        import time
+        pre = _lock("w.py:1")
+        with pre:
+            time.sleep(0.03)          # pre-window outlier
+        snap = witness.snapshot()
+        assert witness.violations_since(snap, max_hold_s=0.01) == []
+        A, B = _lock("w.py:4"), _lock("w.py:5")
+        _in_thread(_nested(A, B))
+        _in_thread(_nested(B, A))     # in-window cycle
+        out = witness.violations_since(snap, max_hold_s=0.01)
+        assert any("w.py:4" in v or "w.py:5" in v for v in out)
+        assert not any("w.py:1" in v for v in out)  # pre-window outlier excluded
+        # the un-windowed view still sees everything
+        assert any("w.py:1" in v
+                   for v in witness.violations(max_hold_s=0.01))
+
+
+class TestInstall:
+    def test_factory_wraps_tpu_dra_created_locks_only(self):
+        from tpu_dra.infra.workqueue import ExponentialFailureRateLimiter
+        lw.install(reset=False)
+        try:
+            rl = ExponentialFailureRateLimiter(0.1, 1.0)
+            assert isinstance(rl._lock, lw.WitnessLock)
+            here = threading.Lock()  # created from tests/: left raw
+            assert not isinstance(here, lw.WitnessLock)
+        finally:
+            lw.uninstall()
+
+    def test_refcounted_uninstall(self):
+        was_installed = lw.installed()  # TPU_DRA_LOCK_WITNESS sessions
+        lw.install(reset=False)
+        lw.install(reset=False)
+        lw.uninstall()
+        assert lw.installed()
+        lw.uninstall()
+        assert lw.installed() == was_installed
+
+    def test_witnessed_stack_runs_clean(self):
+        """A real driver-stack slice (workqueue + informer-style locks)
+        under the witness: no cycles, sane stats."""
+        from tpu_dra.infra.workqueue import WorkQueue
+        w = lw.LockWitness()
+        saved = lw.WITNESS
+        lw.WITNESS = w
+        lw.install(reset=False)
+        try:
+            q = WorkQueue()
+            done = threading.Event()
+            q.enqueue("x", lambda obj: done.set(), key="k")
+            t = q.run_in_thread()
+            assert done.wait(5)
+            q.shutdown()
+            t.join(timeout=5)
+            assert w.cycles() == []
+        finally:
+            lw.uninstall()
+            lw.WITNESS = saved

@@ -1,0 +1,76 @@
+"""dralint CLI: ``python -m tpu_dra.analysis [paths...]``.
+
+Exit status 0 = zero unsuppressed findings (the hack/lint.sh gate);
+1 = findings. ``--sites-report`` prints the fault-site coverage table
+(guard + arm locations per registered site) instead of linting.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from tpu_dra.analysis import core, rules
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tpu_dra.analysis",
+        description="dralint: project-invariant static analyzer")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: tpu_dra, tests, "
+                         "bench.py under --root)")
+    ap.add_argument("--root", type=Path, default=None,
+                    help="repo root (default: discovered from paths/cwd)")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--rules", default="",
+                    help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--show-suppressed", action="store_true")
+    ap.add_argument("--sites-report", action="store_true",
+                    help="also print the fault-site coverage table "
+                         "(guard + arm locations per registered site), "
+                         "from the same scan")
+    args = ap.parse_args(argv)
+
+    root = args.root or core.find_root(
+        Path(args.paths[0]) if args.paths else Path.cwd())
+    if args.paths:
+        paths = [Path(p) for p in args.paths]
+        missing = [p for p in paths if not p.exists()]
+        if missing:
+            # A typo'd path silently linting nothing would turn the
+            # hard gate green for the wrong reason: fail loudly.
+            print("dralint: no such path(s): "
+                  + ", ".join(str(p) for p in missing), file=sys.stderr)
+            return 2
+    else:
+        paths = [p for p in (root / "tpu_dra", root / "tests",
+                             root / "bench.py") if p.exists()]
+
+    rule_ids = ({r.strip() for r in args.rules.split(",") if r.strip()}
+                or None)
+    if args.sites_report and rule_ids is not None:
+        rule_ids.add("R4")  # the table is R4's collection; always run it
+    active = core.all_rules()
+    report = core.run(paths, root=root, rules=active, rule_ids=rule_ids)
+    print(core.render(report, as_json=args.as_json,
+                      show_suppressed=args.show_suppressed))
+    if args.sites_report:
+        # Reuses the lint pass's R4 collection and parsed registries —
+        # one tree scan, one registry parse total.
+        r4 = next(r for r in active
+                  if isinstance(r, rules.FaultSiteRegistry))
+        ctx = report.ctx
+        print(f"{'site':34} {'guards':>7} {'arms':>5}")
+        for site, guards, arms in rules.site_coverage(r4, ctx):
+            print(f"{site:34} {len(guards):7d} {len(arms):5d}")
+            for loc in guards:
+                print(f"    guard {loc}")
+            for loc in arms:
+                print(f"    arm   {loc}")
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

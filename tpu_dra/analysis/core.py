@@ -1,0 +1,355 @@
+"""dralint core: AST lint framework for project invariants.
+
+The reference driver keeps its heavily threaded control plane honest
+with `go vet`, golangci-lint and `go test -race` (Makefile:96). This
+package is the Python reproduction's analog, except the rules are not
+generic style checks — they are THIS project's concurrency and
+ownership invariants (SURVEY §§8-12), machine-checked:
+
+- visitor-based rules over ``ast`` trees (one parse per file, every
+  rule sees every module);
+- findings carry ``file:line:col``, a stable rule id, and a message;
+- ``# dralint: ignore[R2]`` (or bare ``# dralint: ignore``) on the
+  finding's line or the line directly above suppresses it — the
+  suppression count is reported, so waivers stay visible;
+- human (``path:line:col: Rn message``) and ``--json`` output;
+- cross-file rules (orphan detection) run in a ``finalize`` phase
+  after every module has been scanned.
+
+Registries (fault sites, the metric catalog, feature-gate names) are
+parsed from the infra modules' ASTs, not imported — linting must not
+execute project code or depend on import-time side effects.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import re
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*dralint:\s*ignore(?:\[(?P<rules>[^\]]*)\])?")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation, anchored to a source location."""
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+    def to_dict(self) -> Dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message}
+
+
+@dataclass
+class Module:
+    """One parsed source file plus its suppression map."""
+    path: Path
+    relpath: str          # repo-root-relative, for stable output
+    source: str
+    tree: ast.AST
+    # line -> None (suppress all rules) or the set of suppressed rule ids
+    suppressions: Dict[int, Optional[Set[str]]] = field(default_factory=dict)
+
+    @property
+    def is_test(self) -> bool:
+        parts = Path(self.relpath).parts
+        return "tests" in parts or Path(self.relpath).name.startswith("test_")
+
+    @property
+    def is_chaos(self) -> bool:
+        return "chaos" in Path(self.relpath).name
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        """A finding at `line` is waived by an ignore comment on the
+        same line or the line directly above it."""
+        for ln in (line, line - 1):
+            rules = self.suppressions.get(ln, _MISSING)
+            if rules is _MISSING:
+                continue
+            if rules is None or rule in rules:
+                return True
+        return False
+
+
+_MISSING = object()
+
+
+def _parse_suppressions(source: str) -> Dict[int, Optional[Set[str]]]:
+    out: Dict[int, Optional[Set[str]]] = {}
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if not m:
+                continue
+            raw = m.group("rules")
+            if raw is None:
+                out[tok.start[0]] = None
+            else:
+                rules = {r.strip() for r in raw.split(",") if r.strip()}
+                prev = out.get(tok.start[0], _MISSING)
+                if prev is None:
+                    continue  # bare ignore on the same line already wins
+                merged = rules if prev is _MISSING else (prev | rules)
+                out[tok.start[0]] = merged
+    except (tokenize.TokenError, IndentationError):
+        pass  # unparseable comments: no suppressions, findings stand
+    return out
+
+
+def parse_module(path: Path, root: Path) -> Optional[Module]:
+    source = path.read_text(encoding="utf-8")
+    try:
+        tree = ast.parse(source, filename=str(path))
+    except SyntaxError:
+        return None  # compileall (hack/lint.sh) owns syntax errors
+    try:
+        rel = str(path.relative_to(root))
+    except ValueError:
+        rel = str(path)
+    return Module(path=path, relpath=rel, source=source, tree=tree,
+                  suppressions=_parse_suppressions(source))
+
+
+# ---------------------------------------------------------------------------
+# Project registries (parsed, never imported)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ProjectContext:
+    """Shared state for a lint run: the project registries plus anchors
+    for cross-file orphan findings."""
+    root: Path
+    fault_sites: Dict[str, int] = field(default_factory=dict)   # site -> line
+    fault_sites_path: str = ""
+    metric_catalog: Dict[str, int] = field(default_factory=dict)
+    metric_catalog_path: str = ""
+    gate_names: Set[str] = field(default_factory=set)
+    # Relpaths this run scanned. Orphan rules (R4/R5) only report
+    # registry entries as unused when the registry's own file was in
+    # view — a single-file lint is not evidence of project-wide orphans.
+    scanned: Set[str] = field(default_factory=set)
+
+    @classmethod
+    def load(cls, root: Path) -> "ProjectContext":
+        ctx = cls(root=root)
+        faults = root / "tpu_dra" / "infra" / "faults.py"
+        if faults.exists():
+            ctx.fault_sites_path = str(faults.relative_to(root))
+            ctx.fault_sites = _dict_literal_keys(faults, "SITES")
+        metrics = root / "tpu_dra" / "infra" / "metrics.py"
+        if metrics.exists():
+            ctx.metric_catalog_path = str(metrics.relative_to(root))
+            ctx.metric_catalog = _dict_literal_keys(metrics, "METRICS_CATALOG")
+        gates = root / "tpu_dra" / "infra" / "featuregates.py"
+        if gates.exists():
+            ctx.gate_names = _string_constants(gates)
+        return ctx
+
+
+def _dict_literal_keys(path: Path, name: str) -> Dict[str, int]:
+    """String keys (and their line numbers) of the module-level dict
+    literal assigned to `name`."""
+    tree = ast.parse(path.read_text(encoding="utf-8"))
+    for node in tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        for t in targets:
+            if (isinstance(t, ast.Name) and t.id == name
+                    and isinstance(node.value, ast.Dict)):
+                return {k.value: k.lineno for k in node.value.keys
+                        if isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)}
+    return {}
+
+
+def _string_constants(path: Path) -> Set[str]:
+    """Module-level ``Name = "Name"`` assignments — the feature-gate
+    constant idiom (featuregates.py)."""
+    tree = ast.parse(path.read_text(encoding="utf-8"))
+    out: Set[str] = set()
+    for node in tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)
+                and node.targets[0].id == node.value.value):
+            out.add(node.value.value)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Rule registry
+# ---------------------------------------------------------------------------
+
+class Rule:
+    """One lint rule. ``scan`` runs per module; ``finalize`` once after
+    all modules (cross-file orphan checks). Rules are instantiated per
+    run — they may keep collection state between scan and finalize."""
+
+    rule_id: str = ""
+    title: str = ""
+
+    def scan(self, module: Module, ctx: ProjectContext) -> Iterator[Finding]:
+        return iter(())
+
+    def finalize(self, ctx: ProjectContext) -> Iterator[Finding]:
+        return iter(())
+
+
+_RULE_CLASSES: List[type] = []
+
+
+def register(cls: type) -> type:
+    _RULE_CLASSES.append(cls)
+    return cls
+
+
+def all_rules() -> List[Rule]:
+    return [cls() for cls in _RULE_CLASSES]
+
+
+# ---------------------------------------------------------------------------
+# Runner
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Report:
+    findings: List[Finding] = field(default_factory=list)
+    suppressed: List[Finding] = field(default_factory=list)
+    files: int = 0
+    # The context the run was performed against (registries + scanned
+    # set) — lets callers (e.g. --sites-report) reuse the parse.
+    ctx: Optional["ProjectContext"] = None
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def to_dict(self) -> Dict:
+        return {"files": self.files,
+                "findings": [f.to_dict() for f in self.findings],
+                "suppressed": [f.to_dict() for f in self.suppressed]}
+
+
+def iter_python_files(paths: Sequence[Path]) -> Iterator[Path]:
+    seen: Set[Path] = set()
+    for p in paths:
+        if p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                if "__pycache__" in f.parts or f in seen:
+                    continue
+                seen.add(f)
+                yield f
+        elif p.suffix == ".py" and p not in seen:
+            seen.add(p)
+            yield p
+
+
+def find_root(start: Path) -> Path:
+    """The repo root: the nearest ancestor holding the infra registries."""
+    cur = start.resolve()
+    if cur.is_file():
+        cur = cur.parent
+    while True:
+        if (cur / "tpu_dra" / "infra" / "faults.py").exists():
+            return cur
+        if cur.parent == cur:
+            return start.resolve() if start.is_dir() else start.parent
+        cur = cur.parent
+
+
+def run(paths: Sequence[Path], root: Optional[Path] = None,
+        rules: Optional[Iterable[Rule]] = None,
+        rule_ids: Optional[Set[str]] = None) -> Report:
+    paths = [Path(p) for p in paths]
+    root = Path(root) if root else find_root(paths[0] if paths else Path("."))
+    ctx = ProjectContext.load(root)
+    active = list(rules) if rules is not None else all_rules()
+    if rule_ids:
+        active = [r for r in active if r.rule_id in rule_ids]
+    report = Report(ctx=ctx)
+    modules: List[Module] = []
+    for f in iter_python_files(paths):
+        mod = parse_module(f, root)
+        if mod is not None:
+            modules.append(mod)
+    report.files = len(modules)
+    ctx.scanned = {m.relpath for m in modules}
+    for mod in modules:
+        for rule in active:
+            for finding in rule.scan(mod, ctx):
+                if mod.suppressed(finding.rule, finding.line):
+                    report.suppressed.append(finding)
+                else:
+                    report.findings.append(finding)
+    by_rel = {m.relpath: m for m in modules}
+    for rule in active:
+        for finding in rule.finalize(ctx):
+            mod = by_rel.get(finding.path)
+            if mod is not None and mod.suppressed(finding.rule, finding.line):
+                report.suppressed.append(finding)
+            else:
+                report.findings.append(finding)
+    report.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    report.suppressed.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return report
+
+
+def lint_source(source: str, relpath: str = "fixture.py",
+                ctx: Optional[ProjectContext] = None,
+                rule_ids: Optional[Set[str]] = None) -> List[Finding]:
+    """Lint a source string (the test seam): returns UNSUPPRESSED
+    findings, using a synthetic context unless one is given."""
+    ctx = ctx or ProjectContext(root=Path("."))
+    tree = ast.parse(source)
+    mod = Module(path=Path(relpath), relpath=relpath, source=source,
+                 tree=tree, suppressions=_parse_suppressions(source))
+    # The test seam acts as a full-project run: orphan rules see the
+    # registries as in-view so fixtures can exercise both directions.
+    ctx.scanned = ({mod.relpath, ctx.fault_sites_path,
+                    ctx.metric_catalog_path} | ctx.scanned)
+    out: List[Finding] = []
+    for rule in all_rules():
+        if rule_ids and rule.rule_id not in rule_ids:
+            continue
+        for finding in rule.scan(mod, ctx):
+            if not mod.suppressed(finding.rule, finding.line):
+                out.append(finding)
+        for finding in rule.finalize(ctx):
+            if (finding.path != mod.relpath
+                    or not mod.suppressed(finding.rule, finding.line)):
+                out.append(finding)
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out
+
+
+def render(report: Report, as_json: bool = False,
+           show_suppressed: bool = False) -> str:
+    if as_json:
+        return json.dumps(report.to_dict(), indent=2)
+    lines = [f.format() for f in report.findings]
+    if show_suppressed:
+        lines += [f"{f.format()} (suppressed)" for f in report.suppressed]
+    lines.append(f"dralint: {report.files} files, "
+                 f"{len(report.findings)} findings, "
+                 f"{len(report.suppressed)} suppressed")
+    return "\n".join(lines)

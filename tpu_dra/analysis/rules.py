@@ -1,0 +1,676 @@
+"""dralint rules: the project invariants, machine-checked.
+
+The rule set encodes the ownership and concurrency discipline PRs 1-4
+rely on (SURVEY §§8-12). Naming conventions the rules key on:
+
+- **data locks** are attributes/names matching ``*_lock`` / ``*_locks``
+  (or exactly ``lock``), plus condition variables ``*_cond``. They are
+  hold-time-bounded: no blocking work inside their ``with`` bodies.
+- **operation gates** — ``Flock`` file locks (``_flock``), the flock's
+  in-process serializer (``_tlock``), spawn slots — are long-held BY
+  DESIGN and deliberately do not match the data-lock pattern; the
+  runtime lock witness (infra/lockwitness.py) still watches them.
+- ``*_locked``-suffixed functions assert "my caller holds the lock".
+
+All rules are lexical: they see one function at a time and do not chase
+data flow across call boundaries. That is the point — the conventions
+are designed so that the invariant is CHECKABLE at the call site, and
+the rules fail loudly where the convention is skipped, not silently
+where an alias laundered a view through a helper.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from tpu_dra.analysis.core import (
+    Finding, Module, ProjectContext, Rule, register,
+)
+
+# ---------------------------------------------------------------------------
+# Shared AST helpers
+# ---------------------------------------------------------------------------
+
+
+def attr_chain(node: ast.AST) -> List[str]:
+    """Dotted-name components of an Attribute/Name chain, looking through
+    subscripts and calls: ``self._informers["x"].lister.list`` ->
+    ``["self", "_informers", "lister", "list"]``."""
+    out: List[str] = []
+    while True:
+        if isinstance(node, ast.Attribute):
+            out.append(node.attr)
+            node = node.value
+        elif isinstance(node, ast.Subscript):
+            node = node.value
+        elif isinstance(node, ast.Call):
+            node = node.func
+        elif isinstance(node, ast.Name):
+            out.append(node.id)
+            break
+        else:
+            break
+    return list(reversed(out))
+
+
+def _norm(name: str) -> str:
+    return name.lstrip("_").lower()
+
+
+def is_data_lock_name(name: str) -> bool:
+    n = _norm(name)
+    return (n in ("lock", "locks", "cond")
+            or n.endswith(("_lock", "_locks", "_cond")))
+
+
+def is_cond_name(name: str) -> bool:
+    n = _norm(name)
+    return n == "cond" or n.endswith("_cond")
+
+
+def lockish_context(item: ast.withitem) -> Optional[str]:
+    """The lock's display name when a with-item acquires a data lock."""
+    chain = attr_chain(item.context_expr)
+    if chain and is_data_lock_name(chain[-1]):
+        return ".".join(chain)
+    return None
+
+
+def base_name(node: ast.AST) -> Optional[str]:
+    """The root Name of an Attribute/Subscript chain (``pod`` for
+    ``pod["spec"]["nodeName"]``), or None."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+# ---------------------------------------------------------------------------
+# R1/R2 shared visitor: lexical lock context
+# ---------------------------------------------------------------------------
+
+class _LockContextVisitor(ast.NodeVisitor):
+    """Tracks, per lexical position, which data locks the surrounding
+    code provably holds: enclosing ``with *_lock`` bodies plus an
+    enclosing ``*_locked`` function. A nested non-``_locked`` function
+    body runs LATER, not under the lock, so entering one clears the
+    stack (callbacks defined under a lock are not 'under the lock')."""
+
+    def __init__(self, module: Module, ctx: ProjectContext):
+        self.module = module
+        self.ctx = ctx
+        self.lock_stack: List[str] = []
+        self.func_stack: List[str] = []
+        self.findings: List[Finding] = []
+
+    # -- scope handling -----------------------------------------------------
+
+    def _visit_function(self, node) -> None:
+        saved = self.lock_stack
+        self.lock_stack = ([f"{node.name}()"]
+                           if node.name.endswith("_locked") else [])
+        self.func_stack.append(node.name)
+        self.generic_visit(node)
+        self.func_stack.pop()
+        self.lock_stack = saved
+
+    visit_FunctionDef = _visit_function
+    visit_AsyncFunctionDef = _visit_function
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        saved = self.lock_stack
+        self.lock_stack = []
+        self.generic_visit(node)
+        self.lock_stack = saved
+
+    def visit_With(self, node: ast.With) -> None:
+        held = [lockish_context(item) for item in node.items]
+        held = [h for h in held if h]
+        for item in node.items:
+            self.visit(item)
+        self.lock_stack.extend(held)
+        for stmt in node.body:
+            self.visit(stmt)
+        if held:
+            del self.lock_stack[-len(held):]
+
+    def holds_lock(self) -> bool:
+        return bool(self.lock_stack)
+
+    def emit(self, rule: str, node: ast.AST, message: str) -> None:
+        self.findings.append(Finding(
+            rule=rule, path=self.module.relpath, line=node.lineno,
+            col=node.col_offset, message=message))
+
+
+@register
+class LockedCallDiscipline(Rule):
+    """R1: ``*_locked`` functions may only be called with the lock
+    provably held — from a ``with *_lock`` body or from another
+    ``*_locked`` function."""
+
+    rule_id = "R1"
+    title = "locked-call discipline"
+
+    class _V(_LockContextVisitor):
+        def visit_Call(self, node: ast.Call) -> None:
+            chain = attr_chain(node.func)
+            if chain and chain[-1].endswith("_locked"):
+                if not self.holds_lock():
+                    self.emit("R1", node,
+                              f"{chain[-1]}() called without holding a "
+                              "lock (call it from a 'with *_lock' body "
+                              "or from another *_locked method)")
+            self.generic_visit(node)
+
+    def scan(self, module: Module, ctx: ProjectContext) -> Iterator[Finding]:
+        v = self._V(module, ctx)
+        v.visit(module.tree)
+        return iter(v.findings)
+
+
+# -- R2: blocking work under a data lock ------------------------------------
+
+_CLIENT_VERBS = {"get", "list", "create", "update", "delete", "patch",
+                 "watch", "update_status", "list_with_rv", "request"}
+_MUTEX_WAITERS = {"wait", "communicate"}
+
+
+def blocking_reason(node: ast.Call) -> Optional[str]:
+    """Why this call blocks, or None. Deliberately conservative: plain
+    file I/O is allowed (checkpoint stores under the state lock are the
+    crash-consistency design), condition-variable waits release the
+    lock they guard, and thread-safe in-memory work is fine."""
+    chain = attr_chain(node.func)
+    if not chain:
+        return None
+    last = chain[-1]
+    recv = chain[:-1]
+    if chain[-2:] == ["time", "sleep"] or chain == ["sleep"]:
+        return "time.sleep"
+    if chain[0] == "subprocess":
+        return f"subprocess.{last} (fork/exec)"
+    if last == "Popen":
+        return "Popen (fork/exec)"
+    if chain[0] == "socket" and last in ("socket", "create_connection"):
+        return f"socket.{last}"
+    if chain[0] == "fcntl" and last in ("flock", "lockf"):
+        return f"fcntl.{last} (file-lock syscall)"
+    if chain[0] == "os" and last in ("system", "popen", "waitpid"):
+        return f"os.{last}"
+    if last in _MUTEX_WAITERS and recv:
+        if is_cond_name(recv[-1]):
+            return None  # Condition.wait releases the lock it guards
+        return f".{last}() (blocks the holder)"
+    if last == "join" and recv and not node.args:
+        # str.join always takes a positional iterable; a thread/process
+        # join takes none (timeout is keyword-only in this codebase).
+        return ".join() (blocks on another thread)"
+    if last in _CLIENT_VERBS and any("client" in _norm(c) for c in recv):
+        return f"API-client .{last}() (network round-trip w/ retries)"
+    return None
+
+
+@register
+class NoBlockingUnderLock(Rule):
+    """R2: no blocking operations inside a ``with *_lock`` body or a
+    ``*_locked`` function — sleeps, subprocess spawns, socket/API-client
+    verbs and flock syscalls stall every other thread queued on the
+    lock (and the watchdog/readiness paths behind them)."""
+
+    rule_id = "R2"
+    title = "no blocking work under a data lock"
+
+    class _V(_LockContextVisitor):
+        def visit_Call(self, node: ast.Call) -> None:
+            if self.holds_lock():
+                reason = blocking_reason(node)
+                if reason:
+                    self.emit("R2", node,
+                              f"blocking call {reason} while holding "
+                              f"{self.lock_stack[-1]}")
+            self.generic_visit(node)
+
+    def scan(self, module: Module, ctx: ProjectContext) -> Iterator[Finding]:
+        v = self._V(module, ctx)
+        v.visit(module.tree)
+        return iter(v.findings)
+
+
+# ---------------------------------------------------------------------------
+# R3: zero-copy informer reads are read-only
+# ---------------------------------------------------------------------------
+
+_VIEW_TAILS = (("lister", "list"), ("lister", "get"))
+_MUTATORS = {"update", "append", "extend", "insert", "setdefault", "pop",
+             "popitem", "clear", "remove", "sort", "add", "discard"}
+_READERS = {"get", "keys", "values", "items", "copy", "index", "count"}
+_PROPAGATORS = {"sorted", "list", "reversed", "iter", "next", "tuple",
+                "filter", "enumerate"}
+
+
+def _is_view_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    chain = attr_chain(node.func)
+    return (tuple(chain[-2:]) in _VIEW_TAILS
+            or (chain and chain[-1] == "get_by_index"))
+
+
+class _TaintWalker:
+    """Statement-order taint tracking within one function: names bound
+    to informer-cache views (lister reads, index lookups, watch-event
+    payloads in ``copy_events=False`` modules) must not be mutated.
+    ``copy.deepcopy`` launders a view into a private object."""
+
+    def __init__(self, module: Module, zero_copy_events: bool):
+        self.module = module
+        self.zero_copy_events = zero_copy_events
+        self.findings: List[Finding] = []
+
+    # -- expression classification -----------------------------------------
+
+    def _tainted_expr(self, node: ast.AST, tainted: Set[str]) -> bool:
+        if _is_view_call(node):
+            return True
+        if isinstance(node, ast.Name):
+            return node.id in tainted
+        if isinstance(node, (ast.Attribute, ast.Subscript)):
+            base = base_name(node)
+            return base in tainted if base else False
+        if isinstance(node, ast.Call):
+            chain = attr_chain(node.func)
+            if chain[-2:] == ["copy", "deepcopy"]:
+                return False  # the sanctioned escape hatch
+            if chain and chain[-1] in _PROPAGATORS and len(chain) == 1:
+                return any(self._tainted_expr(a, tainted)
+                           for a in node.args)
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _READERS):
+                return self._tainted_expr(node.func.value, tainted)
+            return False
+        if isinstance(node, ast.BoolOp):
+            return any(self._tainted_expr(v, tainted) for v in node.values)
+        if isinstance(node, ast.IfExp):
+            return (self._tainted_expr(node.body, tainted)
+                    or self._tainted_expr(node.orelse, tainted))
+        return False
+
+    # -- statement walk ------------------------------------------------------
+
+    def run(self, fn) -> None:
+        tainted: Set[str] = set()
+        if self.zero_copy_events and fn.name.startswith("_on_"):
+            args = fn.args
+            for a in (args.posonlyargs + args.args + args.kwonlyargs):
+                if a.arg not in ("self", "cls"):
+                    tainted.add(a.arg)
+        self._walk(fn.body, tainted)
+
+    def _taint_target(self, target: ast.AST, is_view: bool,
+                      tainted: Set[str]) -> None:
+        if isinstance(target, ast.Name):
+            (tainted.add if is_view else tainted.discard)(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._taint_target(elt, is_view, tainted)
+
+    def _check_write_target(self, target: ast.AST, tainted: Set[str],
+                            what: str) -> None:
+        if isinstance(target, (ast.Attribute, ast.Subscript)):
+            base = base_name(target)
+            if base and base in tainted:
+                self.findings.append(Finding(
+                    rule="R3", path=self.module.relpath,
+                    line=target.lineno, col=target.col_offset,
+                    message=f"{what} on '{base}', a zero-copy informer "
+                            "view (copy.deepcopy it before writing — "
+                            "SURVEY §10 ownership rule)"))
+
+    def _check_mutator_calls(self, node: ast.AST, tainted: Set[str]) -> None:
+        for call in ast.walk(node):
+            if (isinstance(call, ast.Call)
+                    and isinstance(call.func, ast.Attribute)
+                    and call.func.attr in _MUTATORS):
+                base = base_name(call.func.value)
+                if base and base in tainted:
+                    self.findings.append(Finding(
+                        rule="R3", path=self.module.relpath,
+                        line=call.lineno, col=call.col_offset,
+                        message=f".{call.func.attr}() on '{base}', a "
+                                "zero-copy informer view (copy.deepcopy "
+                                "it before mutating)"))
+
+    def _walk(self, stmts, tainted: Set[str]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, ast.Assign):
+                is_view = self._tainted_expr(stmt.value, tainted)
+                for t in stmt.targets:
+                    self._check_write_target(t, tainted, "assignment")
+                self._check_mutator_calls(stmt.value, tainted)
+                for t in stmt.targets:
+                    self._taint_target(t, is_view, tainted)
+            elif isinstance(stmt, ast.AugAssign):
+                self._check_write_target(stmt.target, tainted,
+                                         "augmented assignment")
+                self._check_mutator_calls(stmt.value, tainted)
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                self._check_write_target(stmt.target, tainted, "assignment")
+                self._taint_target(stmt.target,
+                                   self._tainted_expr(stmt.value, tainted),
+                                   tainted)
+            elif isinstance(stmt, ast.Delete):
+                for t in stmt.targets:
+                    self._check_write_target(t, tainted, "del")
+            elif isinstance(stmt, ast.For):
+                self._check_mutator_calls(stmt.iter, tainted)
+                self._taint_target(stmt.target,
+                                   self._tainted_expr(stmt.iter, tainted),
+                                   tainted)
+                self._walk(stmt.body, tainted)
+                self._walk(stmt.orelse, tainted)
+            elif isinstance(stmt, ast.While):
+                self._check_mutator_calls(stmt.test, tainted)
+                self._walk(stmt.body, tainted)
+                self._walk(stmt.orelse, tainted)
+            elif isinstance(stmt, ast.If):
+                self._check_mutator_calls(stmt.test, tainted)
+                self._walk(stmt.body, tainted)
+                self._walk(stmt.orelse, tainted)
+            elif isinstance(stmt, ast.With):
+                for item in stmt.items:
+                    self._check_mutator_calls(item.context_expr, tainted)
+                    if item.optional_vars is not None:
+                        self._taint_target(
+                            item.optional_vars,
+                            self._tainted_expr(item.context_expr, tainted),
+                            tainted)
+                self._walk(stmt.body, tainted)
+            elif isinstance(stmt, ast.Try):
+                self._walk(stmt.body, tainted)
+                for h in stmt.handlers:
+                    self._walk(h.body, tainted)
+                self._walk(stmt.orelse, tainted)
+                self._walk(stmt.finalbody, tainted)
+            elif isinstance(stmt, (ast.Expr, ast.Return)):
+                if stmt.value is not None:
+                    self._check_mutator_calls(stmt.value, tainted)
+            # nested defs: a fresh scope, fresh taint — handled by the
+            # rule driving one _TaintWalker per FunctionDef.
+
+
+@register
+class ZeroCopyViewsReadOnly(Rule):
+    """R3: objects read zero-copy from an informer cache (lister.list /
+    lister.get / get_by_index results; handler payloads in modules that
+    build ``copy_events=False`` informers) are views of live cache
+    state — mutating one corrupts every other reader and the watch-
+    event diffing built on the cache."""
+
+    rule_id = "R3"
+    title = "zero-copy informer reads are read-only"
+
+    @staticmethod
+    def _module_has_zero_copy_events(module: Module) -> bool:
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call):
+                for kw in node.keywords:
+                    if (kw.arg == "copy_events"
+                            and isinstance(kw.value, ast.Constant)
+                            and kw.value.value is False):
+                        return True
+        return False
+
+    def scan(self, module: Module, ctx: ProjectContext) -> Iterator[Finding]:
+        zero_copy = self._module_has_zero_copy_events(module)
+        findings: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                walker = _TaintWalker(module, zero_copy)
+                walker.run(node)
+                findings.extend(walker.findings)
+        return iter(findings)
+
+
+# ---------------------------------------------------------------------------
+# R4: fault-site registry coverage (both directions)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class _SiteUse:
+    site: str
+    path: str
+    line: int
+    kind: str  # "guard" | "arm"
+
+
+@register
+class FaultSiteRegistry(Rule):
+    """R4: every fault-site literal consulted (``FAULTS.check/fires/
+    pull``) or armed (``arm/disarm/armed``) must be declared in the
+    central ``SITES`` registry (a typo'd site chaos-tests nothing), and
+    every registered site must be exercised by at least one chaos walk
+    or test AND consulted by at least one production guard — orphans in
+    either direction rot the failure model."""
+
+    rule_id = "R4"
+    title = "fault-site registry coverage"
+
+    _GUARDS = {"check", "fires", "pull"}
+    _ARMS = {"arm", "disarm", "armed"}
+
+    def __init__(self):
+        self.uses: List[_SiteUse] = []
+        self.local_registered: Dict[str, Set[str]] = {}  # relpath -> sites
+        self.exercised: Set[str] = set()
+        self.guarded: Set[str] = set()
+
+    def scan(self, module: Module, ctx: ProjectContext) -> Iterator[Finding]:
+        local: Set[str] = set()
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            if not chain or len(chain) < 2:
+                continue
+            recv_is_faults = any(_norm(c) == "faults" for c in chain[:-1])
+            if not recv_is_faults:
+                continue
+            kind = None
+            if chain[-1] in self._GUARDS:
+                kind = "guard"
+            elif chain[-1] in self._ARMS:
+                kind = "arm"
+            elif chain[-1] == "register_site":
+                if (node.args and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)):
+                    local.add(node.args[0].value)
+                continue
+            if kind is None:
+                continue
+            if not (node.args and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue  # dynamic site expression (chaos rearm loops)
+            site = node.args[0].value
+            self.uses.append(_SiteUse(site=site, path=module.relpath,
+                                      line=node.lineno, kind=kind))
+            if kind == "guard" and not (module.is_test or module.is_chaos):
+                self.guarded.add(site)
+            if kind == "arm" and (module.is_test or module.is_chaos):
+                self.exercised.add(site)
+        self.local_registered[module.relpath] = local
+        # Any registered-site literal appearing in a test or chaos module
+        # counts as exercised (CHAOS_SITES tuples, parametrized tests) —
+        # recorded as a use too so the --sites-report table shows the
+        # same evidence the gate accepts (a dynamically armed site must
+        # not read as 'arms 0').
+        if module.is_test or module.is_chaos:
+            arm_lines = {(u.site, u.line) for u in self.uses
+                         if u.path == module.relpath}
+            for node in ast.walk(module.tree):
+                if (isinstance(node, ast.Constant)
+                        and isinstance(node.value, str)
+                        and node.value in ctx.fault_sites):
+                    self.exercised.add(node.value)
+                    if (node.value, node.lineno) not in arm_lines:
+                        self.uses.append(_SiteUse(
+                            site=node.value, path=module.relpath,
+                            line=node.lineno, kind="literal"))
+        return iter(())
+
+    def finalize(self, ctx: ProjectContext) -> Iterator[Finding]:
+        dynamic: Set[str] = set()
+        for sites in self.local_registered.values():
+            dynamic |= sites
+        known = set(ctx.fault_sites) | dynamic
+        for use in self.uses:
+            if use.site not in known:
+                yield Finding(
+                    rule="R4", path=use.path, line=use.line, col=0,
+                    message=f"unknown fault site {use.site!r}: not in "
+                            "infra/faults.py SITES (a typo here "
+                            "chaos-tests nothing)")
+        if ctx.fault_sites_path not in ctx.scanned:
+            return  # partial run: no orphan evidence
+        for site, line in sorted(ctx.fault_sites.items()):
+            if site not in self.exercised:
+                yield Finding(
+                    rule="R4", path=ctx.fault_sites_path, line=line, col=0,
+                    message=f"registered fault site {site!r} is never "
+                            "armed by any chaos walk or test (orphan: "
+                            "its failure mode is unexercised)")
+            if site not in self.guarded:
+                yield Finding(
+                    rule="R4", path=ctx.fault_sites_path, line=line, col=0,
+                    message=f"registered fault site {site!r} has no "
+                            "production guard (FAULTS.check/fires/pull) "
+                            "— arming it does nothing)")
+
+
+# ---------------------------------------------------------------------------
+# R5: metric names centrally cataloged, tpu_dra_-prefixed
+# ---------------------------------------------------------------------------
+
+_METRIC_NAME_RE = re.compile(r"^tpu_dra_[a-z0-9_]+$")
+_REGISTER_VERBS = {"counter", "gauge", "histogram"}
+
+
+@register
+class MetricCatalog(Rule):
+    """R5: every metric registered in production code must carry the
+    ``tpu_dra_`` prefix and be declared in ``METRICS_CATALOG``
+    (infra/metrics.py) — the one place dashboards, the bench gates and
+    SURVEY point at; and every cataloged name must actually be
+    registered somewhere (orphan detection both directions)."""
+
+    rule_id = "R5"
+    title = "metric catalog coverage"
+
+    def __init__(self):
+        self.registered: Set[str] = set()
+
+    def scan(self, module: Module, ctx: ProjectContext) -> Iterator[Finding]:
+        if module.is_test:
+            return iter(())
+        findings: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _REGISTER_VERBS
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            name = node.args[0].value
+            self.registered.add(name)
+            if not _METRIC_NAME_RE.match(name):
+                findings.append(Finding(
+                    rule="R5", path=module.relpath, line=node.lineno, col=0,
+                    message=f"metric {name!r} does not match the "
+                            "tpu_dra_[a-z0-9_]+ naming contract"))
+            elif ctx.metric_catalog and name not in ctx.metric_catalog:
+                findings.append(Finding(
+                    rule="R5", path=module.relpath, line=node.lineno, col=0,
+                    message=f"metric {name!r} is not declared in "
+                            "infra/metrics.py METRICS_CATALOG"))
+        return iter(findings)
+
+    def finalize(self, ctx: ProjectContext) -> Iterator[Finding]:
+        if not self.registered or ctx.metric_catalog_path not in ctx.scanned:
+            return  # partial run (e.g. tests only): no orphan evidence
+        for name, line in sorted(ctx.metric_catalog.items()):
+            if name not in self.registered:
+                yield Finding(
+                    rule="R5", path=ctx.metric_catalog_path, line=line,
+                    col=0,
+                    message=f"cataloged metric {name!r} is never "
+                            "registered (orphan catalog entry)")
+
+
+# ---------------------------------------------------------------------------
+# R6: feature-gate names must exist
+# ---------------------------------------------------------------------------
+
+@register
+class FeatureGateNames(Rule):
+    """R6: gate names referenced as strings — ``enabled("...")`` and
+    ``set_from_string("A=true,B=false")`` — must exist in
+    infra/featuregates.py. The runtime raises on unknown gates, but
+    only on the code path that consults them; the linter catches the
+    typo before a gate silently never flips."""
+
+    rule_id = "R6"
+    title = "feature-gate names exist"
+
+    def scan(self, module: Module, ctx: ProjectContext) -> Iterator[Finding]:
+        if not ctx.gate_names:
+            return iter(())
+        findings: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Call) and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            chain = attr_chain(node.func)
+            if not chain:
+                continue
+            if (chain[-1] == "enabled"
+                    and any(c in ("featuregates", "Features")
+                            for c in chain[:-1])):
+                name = node.args[0].value
+                if name not in ctx.gate_names:
+                    findings.append(Finding(
+                        rule="R6", path=module.relpath, line=node.lineno,
+                        col=0,
+                        message=f"unknown feature gate {name!r}"))
+            elif chain[-1] == "set_from_string":
+                for part in node.args[0].value.split(","):
+                    name = part.split("=", 1)[0].strip()
+                    if name and name not in ctx.gate_names:
+                        findings.append(Finding(
+                            rule="R6", path=module.relpath,
+                            line=node.lineno, col=0,
+                            message=f"unknown feature gate {name!r} in "
+                                    "gate string"))
+        return iter(findings)
+
+
+# ---------------------------------------------------------------------------
+# Site-coverage report (informational; hack/lint.sh --sites-report)
+# ---------------------------------------------------------------------------
+
+def site_coverage(report_rule: FaultSiteRegistry,
+                  ctx: ProjectContext) -> List[Tuple[str, List[str], List[str]]]:
+    """(site, guard locations, arm/exercise locations) per registered
+    site — the arm column includes literal evidence in test/chaos
+    modules (dynamic arms via site tuples), matching what R4 accepts."""
+    out = []
+    for site in sorted(ctx.fault_sites):
+        guards = [f"{u.path}:{u.line}" for u in report_rule.uses
+                  if u.site == site and u.kind == "guard"]
+        arms = [f"{u.path}:{u.line}" for u in report_rule.uses
+                if u.site == site and u.kind in ("arm", "literal")]
+        out.append((site, guards, arms))
+    return out

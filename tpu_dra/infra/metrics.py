@@ -159,6 +159,37 @@ class Registry:
 
 DefaultRegistry = Registry()
 
+# ---------------------------------------------------------------------------
+# Metric catalog (dralint R5)
+# ---------------------------------------------------------------------------
+# Every metric the project registers, wherever its DefaultRegistry.
+# counter/gauge/histogram call lives — the single place dashboards, the
+# perf gates (hack/perf.sh) and SURVEY reference. dralint enforces both
+# directions: a registration whose name is missing here fails lint, and
+# a cataloged name nobody registers is an orphan. Names must match
+# ``tpu_dra_[a-z0-9_]+``.
+METRICS_CATALOG: Dict[str, str] = {
+    # tpuplugin/driver.py — kubelet-facing prepare pipeline
+    "tpu_dra_claim_prepare_seconds": "tpuplugin/driver.py",
+    "tpu_dra_prepare_batch_size": "tpuplugin/driver.py",
+    # cdplugin/driver.py — ComputeDomain channel prepare
+    "tpu_dra_cd_claim_prepare_seconds": "cdplugin/driver.py",
+    # cdcontroller/controller.py — CD reconcile loop
+    "tpu_dra_cd_reconciles_total": "cdcontroller/controller.py",
+    "tpu_dra_cd_teardowns_total": "cdcontroller/controller.py",
+    # infra/metrics.py — shared control-plane instruments (below)
+    "tpu_dra_cel_cache_hits": "infra/metrics.py",
+    "tpu_dra_cel_cache_misses": "infra/metrics.py",
+    "tpu_dra_cel_compiles": "infra/metrics.py",
+    "tpu_dra_sched_full_relists": "infra/metrics.py",
+    "tpu_dra_sched_watch_events": "infra/metrics.py",
+    "tpu_dra_sched_pods_bound": "infra/metrics.py",
+    "tpu_dra_sched_claims_gced": "infra/metrics.py",
+    "tpu_dra_topo_allocations": "infra/metrics.py",
+    "tpu_dra_topo_score_seconds": "infra/metrics.py",
+    "tpu_dra_topo_free_cuboid_chips": "infra/metrics.py",
+}
+
 
 class MetricsServer:
     """Serves /metrics (text exposition), /debug/stacks (pprof analog) and

@@ -43,7 +43,7 @@ from typing import Dict, List, Optional
 
 from tpu_dra.api.types import API_VERSION, TPU_DRIVER_NAME
 from tpu_dra.cdi.handler import CDIHandler
-from tpu_dra.infra import featuregates
+from tpu_dra.infra import featuregates, lockwitness
 from tpu_dra.infra.faults import (
     FAULTS, EveryNth, OneShot, Probabilistic, Schedule,
 )
@@ -76,6 +76,11 @@ TS_CONFIG = [{"source": "FromClaim", "requests": [], "opaque": {
         "apiVersion": API_VERSION, "kind": "TpuConfig",
         "sharing": {"strategy": "TimeSlicing",
                     "timeSlicingConfig": {"interval": "Short"}}}}}]
+
+# Lock-hold outlier threshold for the witness invariant: generous
+# against CI scheduling jitter, tight enough that real blocking work
+# (a subprocess spawn, an API retry loop) under a data lock trips it.
+LOCK_HOLD_OUTLIER_S = 5.0
 
 
 @dataclass
@@ -126,8 +131,24 @@ class ChaosHarness:
 
     MAX_QUIESCE_RETRIES = 30
 
+    # Class-level defaults so close() is safe on a partially built
+    # harness (an __init__ failure still releases the witness/gates/tmp).
+    driver: Optional[TpuDriver] = None
+    state: Optional[DeviceState] = None
+    cdi: Optional[CDIHandler] = None
+    tmp = ""
+    _witnessed = False
+
     def __init__(self, seed: int, *, chips: int = 4,
                  generation: str = "v5p"):
+        # Witness BEFORE any stack lock exists: every Lock/RLock the
+        # driver stack creates below joins the acquisition-order graph,
+        # and quiesce asserts it stayed acyclic (dralint's dynamic half).
+        lockwitness.install()
+        self._witnessed = True
+        # Under a session-level install (TPU_DRA_LOCK_WITNESS=1) the
+        # graph predates this harness: report only THIS walk's window.
+        self._witness_snap = lockwitness.WITNESS.snapshot()
         self.seed = seed
         self.rng = random.Random(seed)
         self.report = ChaosReport(seed=seed)
@@ -137,25 +158,28 @@ class ChaosHarness:
         # callback (deterministic, and no 0.5s monitor join per crash —
         # the monitor's own pipeline has dedicated tests).
         self._gates = featuregates.Features.overrides_snapshot()
-        featuregates.Features.set_from_string(
-            "TimeSlicingSettings=true,TPUDeviceHealthCheck=false")
-        self.tmp = tempfile.mkdtemp(prefix=f"tpu-dra-chaos-{seed}-")
-        self.cluster = FakeCluster()
-        # Fast backoff: chaos turns the crank; wall-clock realism is the
-        # schedule's job, not the sleep's.
-        self.client = RetryingApiClient(
-            self.cluster, max_attempts=4, base_delay=0.001,
-            max_delay=0.01, rng=random.Random(seed ^ 0x5EED))
-        self.backend = FakeBackend(
-            default_fake_chips(chips, generation, slice_id="chaos"))
-        self.n_chips = chips
-        self.driver: Optional[TpuDriver] = None
-        self.state: Optional[DeviceState] = None
-        self.cdi: Optional[CDIHandler] = None
-        # uid -> claim object, by expected terminal state
-        self.prepared: Dict[str, Dict] = {}   # last prepare succeeded
-        self.pending: Dict[str, Dict] = {}    # attempted, not yet ready
-        self._build_stack()
+        try:
+            featuregates.Features.set_from_string(
+                "TimeSlicingSettings=true,TPUDeviceHealthCheck=false")
+            self.tmp = tempfile.mkdtemp(prefix=f"tpu-dra-chaos-{seed}-")
+            self.cluster = FakeCluster()
+            # Fast backoff: chaos turns the crank; wall-clock realism is
+            # the schedule's job, not the sleep's.
+            self.client = RetryingApiClient(
+                self.cluster, max_attempts=4, base_delay=0.001,
+                max_delay=0.01, rng=random.Random(seed ^ 0x5EED))
+            self.backend = FakeBackend(
+                default_fake_chips(chips, generation, slice_id="chaos"))
+            self.n_chips = chips
+            # uid -> claim object, by expected terminal state
+            self.prepared: Dict[str, Dict] = {}  # last prepare succeeded
+            self.pending: Dict[str, Dict] = {}   # attempted, not yet ready
+            self._build_stack()
+        except BaseException:
+            # Partial init: close() tolerates missing stack pieces (class
+            # defaults) and always releases gates/tmp/witness.
+            self.close()
+            raise
 
     # -- stack lifecycle ----------------------------------------------------
 
@@ -206,9 +230,19 @@ class ChaosHarness:
         self._build_stack()
 
     def close(self) -> None:
-        self._teardown_stack()
-        featuregates.Features.restore_overrides(self._gates)
-        shutil.rmtree(self.tmp, ignore_errors=True)
+        # Nested finally: a teardown failure must not skip the gate
+        # restore, the tmpdir removal, or the witness uninstall.
+        try:
+            self._teardown_stack()
+        finally:
+            try:
+                featuregates.Features.restore_overrides(self._gates)
+            finally:
+                if self.tmp:
+                    shutil.rmtree(self.tmp, ignore_errors=True)
+                if self._witnessed:
+                    self._witnessed = False
+                    lockwitness.uninstall()
 
     # -- claim plumbing -----------------------------------------------------
 
@@ -488,6 +522,13 @@ class ChaosHarness:
             v.append("checkpoint entries left after full teardown: "
                      f"{self.state.prepared_claim_uids()}")
 
+        # 8. Lock-order witness: the whole walk (prepare storms, crash
+        # restarts, health events across watch/workqueue/gRPC threads)
+        # must leave an ACYCLIC acquisition-order graph and no data lock
+        # held across outlier-length work (SURVEY §12).
+        v.extend(lockwitness.WITNESS.violations_since(
+            self._witness_snap, max_hold_s=LOCK_HOLD_OUTLIER_S))
+
 
 def run_schedule(seed: int, n_events: int = 40, chips: int = 4) -> ChaosReport:
     """One seeded fault schedule to quiesce; the chaos tier's unit."""
@@ -576,25 +617,38 @@ class SchedulerChaosHarness:
     def __init__(self, seed: int, *, nodes: int = 4, chips_per_node: int = 2):
         from tpu_dra.simcluster.scheduler import Scheduler
 
+        # Witness the scheduler's lock population (informer RLocks,
+        # allocation-index lock, pending-set lock, rate-limiter locks):
+        # quiesce asserts the acquisition-order graph stayed acyclic.
+        lockwitness.install()
+        self._witnessed = True
+        self._witness_snap = lockwitness.WITNESS.snapshot()
         self.seed = seed
         self.rng = random.Random(seed ^ 0x5C4ED)
         self.report = ChaosReport(seed=seed)
         self.nodes = nodes
         self.chips = chips_per_node
         self.capacity = nodes * chips_per_node
-        self.cluster = FakeCluster()
-        self.cluster.EVENT_LOG_CAP = 48  # tight history: drops hit 410s
-        self.client = RetryingApiClient(
-            self.cluster, max_attempts=4, base_delay=0.001,
-            max_delay=0.01, rng=random.Random(seed ^ 0xD15C))
-        self._seed_inventory()
-        self.sched = Scheduler(self.client, resync_interval=0.05,
-                               gc_sweep_interval=0.2)
-        self.sched.start()
-        for inf in self.sched._informers.values():
-            inf.RELIST_BACKOFF_BASE = 0.01  # keep the chaos tier fast
-        self.live: Dict[str, None] = {}
-        self._pod_seq = 0
+        try:
+            self.cluster = FakeCluster()
+            self.cluster.EVENT_LOG_CAP = 48  # tight history: drops hit 410s
+            self.client = RetryingApiClient(
+                self.cluster, max_attempts=4, base_delay=0.001,
+                max_delay=0.01, rng=random.Random(seed ^ 0xD15C))
+            self._seed_inventory()
+            self.sched = Scheduler(self.client, resync_interval=0.05,
+                                   gc_sweep_interval=0.2)
+            self.sched.start()
+            for inf in self.sched._informers.values():
+                inf.RELIST_BACKOFF_BASE = 0.01  # keep the chaos tier fast
+            self.live: Dict[str, None] = {}
+            self._pod_seq = 0
+        except BaseException:
+            # Anything after install() failing must release the witness
+            # refcount, or threading.Lock stays patched process-wide.
+            self._witnessed = False
+            lockwitness.uninstall()
+            raise
 
     def _seed_inventory(self) -> None:
         from tpu_dra.testing import seed_sched_inventory
@@ -728,9 +782,19 @@ class SchedulerChaosHarness:
         claims = self.cluster.list(RESOURCECLAIMS, namespace="default")
         v.extend(_chip_conflicts(claims))
         v.extend(self.sched.verify_index())
+        # Lock-order witness over the event-driven control plane: the
+        # walk's informer/workqueue/worker interleavings must leave an
+        # acyclic lock graph and no outlier-length data-lock hold.
+        v.extend(lockwitness.WITNESS.violations_since(
+            self._witness_snap, max_hold_s=LOCK_HOLD_OUTLIER_S))
 
     def close(self) -> None:
-        self.sched.stop()
+        try:
+            self.sched.stop()
+        finally:
+            if self._witnessed:
+                self._witnessed = False
+                lockwitness.uninstall()
 
 
 def run_sched_schedule(seed: int, n_events: int = 60) -> ChaosReport:
