@@ -1,0 +1,36 @@
+#!/usr/bin/env bash
+# drmc tier (SURVEY §13): the deterministic model checker as a CI gate.
+#
+#   hack/drmc.sh [BUDGET]
+#
+# Runs `python -m tpu_dra.analysis.drmc` over the gate scenarios:
+#
+# 1. Interleaving explorer — DPOR-lite systematic exploration of the
+#    scheduler-churn (WorkQueue + AllocationIndex) and batch-prepare
+#    (concurrent DeviceState batches) scenarios, asserting the chaos
+#    invariants (no double allocation, index == truth, checkpoint/CDI
+#    consistency, acyclic lock witness) at EVERY terminal state. The
+#    gate requires >= 200 distinct interleavings total (--min-schedules)
+#    so a silently shrunken scenario cannot go green by exploring
+#    nothing.
+# 2. Crash-point enumerator — 100% of the batch-prepare-crash
+#    scenario's durable ops crashed (clean / all-persisted / torn
+#    variants) with recovery invariants asserted after each restart.
+#
+# Any invariant violation fails with the schedule trace (or crash
+# point) printed; replay the trace with:
+#   python -m tpu_dra.analysis.drmc --scenario NAME --replay-trace '[...]'
+# Extra arguments after BUDGET pass straight through to the module
+# (race.sh uses `drmc.sh 600 --skip-crash` for its deep re-exploration:
+# the crash matrix is budget-independent and already ran in lint.sh).
+set -euo pipefail
+REPO_ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+BUDGET="${1:-200}"
+shift || true
+
+echo ">> drmc: interleaving exploration + crash-point enumeration"
+JAX_PLATFORMS=cpu python -m tpu_dra.analysis.drmc \
+  --budget "$BUDGET" --min-schedules 200 --min-crash-points 30 \
+  --deadline 180 "$@"
+
+echo ">> drmc tier green"

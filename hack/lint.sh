@@ -8,10 +8,16 @@
 # 2. dralint — the project-invariant analyzer (tpu_dra/analysis):
 #    R1 *_locked call discipline, R2 no blocking work under data locks,
 #    R3 zero-copy informer reads are read-only, R4 fault-site registry
-#    coverage, R5 metric catalog, R6 feature-gate names. Any
-#    unsuppressed finding fails.
+#    coverage, R5 metric catalog, R6 feature-gate names, R7 prepare-
+#    pipeline except paths unwind, R8 no success externalization before
+#    the terminal store. Any unsuppressed finding fails. Whole-tree
+#    runs are incremental (per-file result cache, .dralint-cache.json);
+#    DRALINT_NO_CACHE=1 forces a cold run.
 # 3. The fault-site coverage report (informational): guard + arm
 #    locations per registered site.
+# 4. drmc — the deterministic model checker gate (hack/drmc.sh):
+#    interleaving exploration + crash-point enumeration over the
+#    scheduler-churn and batch-prepare scenarios.
 set -euo pipefail
 REPO_ROOT="$(cd "$(dirname "$0")/.." && pwd)"
 
@@ -20,7 +26,10 @@ python -m compileall -q \
   "$REPO_ROOT/tpu_dra" "$REPO_ROOT/tests" "$REPO_ROOT/bench.py" \
   "$REPO_ROOT/hack"
 
-echo ">> dralint (R1-R6) + fault-site coverage"
-python -m tpu_dra.analysis --root "$REPO_ROOT" --sites-report
+echo ">> dralint (R1-R8) + fault-site coverage"
+python -m tpu_dra.analysis --root "$REPO_ROOT" --sites-report \
+  ${DRALINT_NO_CACHE:+--no-cache}
+
+"$REPO_ROOT/hack/drmc.sh"
 
 echo ">> lint tier green"

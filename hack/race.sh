@@ -5,10 +5,14 @@
 #
 # 1. Lint gate first: dralint's static rules are the cheap half of the
 #    race tier — a blocking call under a data lock fails here before any
-#    TSan cycle is spent.
-# 2. Builds the threaded C++ daemons under ThreadSanitizer and drives them
+#    TSan cycle is spent. (lint.sh also runs drmc at the default budget.)
+# 2. drmc at a deeper exploration budget: the race tier buys more
+#    distinct interleavings of the scheduler-churn and batch-prepare
+#    scenarios than the per-PR lint gate pays for. --skip-crash: the
+#    crash matrix is budget-independent and lint.sh just ran it.
+# 3. Builds the threaded C++ daemons under ThreadSanitizer and drives them
 #    with concurrent clients (TSAN_OPTIONS halt_on_error: any report fails).
-# 3. Repeat-runs the heavily threaded Python suites (informers, workqueues,
+# 4. Repeat-runs the heavily threaded Python suites (informers, workqueues,
 #    three-process CD convergence, watchdogs) N times — the flake surface
 #    scales with iterations, not wall-clock — with the LOCK-ORDER WITNESS
 #    installed (TPU_DRA_LOCK_WITNESS=1): conftest fails the session on an
@@ -19,6 +23,9 @@ N="${1:-3}"
 
 echo ">> lint gate (dralint)"
 "$REPO_ROOT/hack/lint.sh"
+
+echo ">> drmc deep exploration"
+"$REPO_ROOT/hack/drmc.sh" 600 --skip-crash
 
 echo ">> TSan build + drive"
 make -C "$REPO_ROOT/native" tsan -s

@@ -560,3 +560,27 @@ class TestLegacyCheckpointBackfill:
         assert uid not in harness["state"].prepared_claim_uids()
         node = cluster.get(NODES, "node-a")
         assert LABEL not in (node["metadata"].get("labels") or {})
+
+
+class TestLostSpecRetry:
+    def test_completed_claim_with_lost_spec_reprepares(self, harness):
+        """drmc crash class (SURVEY §13): the terminal checkpoint sync
+        survives a crash but the claim spec's never-synced rename does
+        not. The idempotent fast path must NOT vouch for the vanished
+        file — the retry re-runs the prepare and rewrites it."""
+        cluster = harness["cluster"]
+        cd = make_cd(cluster)
+        register_node(cluster, cd, "node-a", "10.0.0.1", "slice-A",
+                      ready=True)
+        register_node(cluster, cd, "node-b", "10.0.0.2", "slice-A",
+                      ready=True)
+        claim = make_channel_claim(cluster, cd)
+        assert prepare(harness, claim).error == ""
+        uid = claim["metadata"]["uid"]
+        spec_path = harness["cdi"].claim_spec_path(uid)
+        os.unlink(spec_path)               # the crash-lost rename
+        res = prepare(harness, claim)      # kubelet retry
+        assert res.error == ""
+        assert os.path.exists(spec_path)
+        env = claim_env(harness, uid)
+        assert env["COMPUTE_DOMAIN_UUID"] == cd["metadata"]["uid"]

@@ -432,3 +432,287 @@ class TestWholeTree:
         assert "k8s.api.request" in ctx.fault_sites
         assert "tpu_dra_sched_full_relists" in ctx.metric_catalog
         assert "TopologyAwareScheduling" in ctx.gate_names
+
+
+# ---------------------------------------------------------------------------
+# R7: prepare-pipeline except paths unwind
+# ---------------------------------------------------------------------------
+
+class TestR7PrepareUnwind:
+    def test_fires_on_logging_only_handler(self):
+        out = lint("""
+            class S:
+                def prepare_batch(self):
+                    self._claims["u"] = 1
+                    try:
+                        self._mgr.store(self._cp)
+                    except Exception:
+                        log.warning("oops")
+        """, "R7")
+        assert rule_ids(out) == ["R7"]
+        assert "prepare_batch" in out[0].message
+
+    def test_compensating_mutation_passes(self):
+        out = lint("""
+            class S:
+                def prepare_batch(self):
+                    self._claims["u"] = 1
+                    try:
+                        self._mgr.store(self._cp)
+                    except Exception:
+                        self._claims.pop("u", None)
+        """, "R7")
+        assert out == []
+
+    def test_unwind_call_passes(self):
+        out = lint("""
+            class S:
+                def unprepare_batch(self):
+                    del self._claims["u"]
+                    try:
+                        self._mgr.store(self._cp)
+                    except Exception as e:
+                        self._unwind_claim("u")
+        """, "R7")
+        assert out == []
+
+    def test_reraise_passes(self):
+        out = lint("""
+            class S:
+                def prepare(self):
+                    self._claims["u"] = 1
+                    try:
+                        self._mgr.store(self._cp)
+                    except Exception:
+                        raise
+        """, "R7")
+        assert out == []
+
+    def test_handler_before_any_mutation_exempt(self):
+        # The pure phase: nothing mutated yet, nothing to unwind.
+        out = lint("""
+            class S:
+                def prepare_batch(self):
+                    try:
+                        cfg = self._resolve(1)
+                    except Exception as e:
+                        results = str(e)
+                    self._claims["u"] = cfg
+        """, "R7")
+        assert out == []
+
+    def test_non_prepare_function_exempt(self):
+        out = lint("""
+            class S:
+                def reconcile(self):
+                    self._claims["u"] = 1
+                    try:
+                        self._mgr.store(self._cp)
+                    except Exception:
+                        log.warning("oops")
+        """, "R7")
+        assert out == []
+
+    def test_test_module_exempt(self):
+        out = lint("""
+            class S:
+                def prepare_batch(self):
+                    self._claims["u"] = 1
+                    try:
+                        self._mgr.store(self._cp)
+                    except Exception:
+                        pass
+        """, "R7", relpath="tests/test_x.py")
+        assert out == []
+
+
+# ---------------------------------------------------------------------------
+# R8: no success externalization before the terminal store
+# ---------------------------------------------------------------------------
+
+class TestR8SuccessOrdering:
+    def test_fires_on_result_fill_before_store(self):
+        out = lint("""
+            class S:
+                def prepare(self, results):
+                    self._checkpoint.claims["u"] = 1
+                    results["u"] = PrepareResult(devices=[])
+                    self._ckpt_mgr.store(self._checkpoint)
+        """, "R8")
+        assert rule_ids(out) == ["R8"]
+        assert "PrepareResult" in out[0].message
+
+    def test_fill_after_store_passes(self):
+        out = lint("""
+            class S:
+                def prepare(self, results):
+                    self._checkpoint.claims["u"] = 1
+                    self._ckpt_mgr.store(self._checkpoint)
+                    results["u"] = PrepareResult(devices=[])
+        """, "R8")
+        assert out == []
+
+    def test_idempotent_fast_path_passes(self):
+        # A fill BEFORE any checkpoint mutation vouches for already-
+        # durable state (the idempotent fast path) — legal.
+        out = lint("""
+            class S:
+                def prepare(self, results):
+                    results["u"] = PrepareResult(devices=[])
+                    self._checkpoint.claims["u"] = 1
+                    self._ckpt_mgr.store(self._checkpoint)
+        """, "R8")
+        assert out == []
+
+    def test_error_fill_is_not_success(self):
+        out = lint("""
+            class S:
+                def prepare(self, results):
+                    self._checkpoint.claims["u"] = 1
+                    results["u"] = PrepareResult(error="nope")
+                    self._ckpt_mgr.store(self._checkpoint)
+        """, "R8")
+        assert out == []
+
+    def test_success_counter_before_fdatasync_fires(self):
+        out = lint("""
+            class S:
+                def prepare(self):
+                    del self._checkpoint.claims["u"]
+                    PREPARE_SUCCESS_TOTAL.inc()
+                    vfs.fdatasync(self._fd)
+        """, "R8")
+        assert rule_ids(out) == ["R8"]
+
+    def test_function_without_store_exempt(self):
+        out = lint("""
+            class S:
+                def prepare(self, results):
+                    self._checkpoint.claims["u"] = 1
+                    results["u"] = PrepareResult(devices=[])
+        """, "R8")
+        assert out == []
+
+
+# ---------------------------------------------------------------------------
+# Per-file result cache (ISSUE 6 satellite)
+# ---------------------------------------------------------------------------
+
+class TestResultCache:
+    BAD = ("import time\n"
+           "class M:\n"
+           "    def f(self):\n"
+           "        with self._lock:\n"
+           "            time.sleep(1)\n")
+
+    @staticmethod
+    def _tree(tmp_path):
+        """A minimal rooted tree: the registries make tmp_path a root."""
+        infra = tmp_path / "tpu_dra" / "infra"
+        infra.mkdir(parents=True)
+        (infra / "faults.py").write_text("SITES = {}\n")
+        (infra / "metrics.py").write_text("METRICS_CATALOG = {}\n")
+        (infra / "featuregates.py").write_text("")
+        return tmp_path
+
+    def test_cache_hit_reuses_findings(self, tmp_path):
+        root = self._tree(tmp_path)
+        mod = root / "mod.py"
+        mod.write_text(self.BAD)
+        r1 = analysis.run([mod], root=root, use_cache=True)
+        assert [f.rule for f in r1.findings] == ["R2"]
+        assert (root / ".dralint-cache.json").exists()
+        # Same stat key: the second run must not even parse the file.
+        import tpu_dra.analysis.core as core
+
+        real_parse = core.parse_module
+        calls = []
+
+        def counting_parse(path, rootp):
+            calls.append(path)
+            return real_parse(path, rootp)
+
+        core.parse_module = counting_parse
+        try:
+            r2 = analysis.run([mod], root=root, use_cache=True)
+        finally:
+            core.parse_module = real_parse
+        assert calls == []
+        assert [f.to_dict() for f in r2.findings] \
+            == [f.to_dict() for f in r1.findings]
+        assert r2.files == r1.files
+
+    def test_mtime_change_invalidates(self, tmp_path):
+        import os
+        root = self._tree(tmp_path)
+        mod = root / "mod.py"
+        mod.write_text(self.BAD)
+        analysis.run([mod], root=root, use_cache=True)
+        mod.write_text(self.BAD.replace("time.sleep(1)", "pass"))
+        os.utime(mod, ns=(1, 1))  # force a distinct stat key either way
+        r2 = analysis.run([mod], root=root, use_cache=True)
+        assert r2.findings == []
+
+    def test_rules_version_change_invalidates(self, tmp_path):
+        import json
+        root = self._tree(tmp_path)
+        mod = root / "mod.py"
+        mod.write_text(self.BAD)
+        analysis.run([mod], root=root, use_cache=True)
+        cache_file = root / ".dralint-cache.json"
+        doc = json.loads(cache_file.read_text())
+        doc["rules_version"] = "stale"
+        cache_file.write_text(json.dumps(doc))
+        r2 = analysis.run([mod], root=root, use_cache=True)
+        assert [f.rule for f in r2.findings] == ["R2"]
+
+    def test_cached_suppressions_still_reported(self, tmp_path):
+        root = self._tree(tmp_path)
+        mod = root / "mod.py"
+        mod.write_text(self.BAD.replace(
+            "time.sleep(1)", "time.sleep(1)  # dralint: ignore[R2]"))
+        r1 = analysis.run([mod], root=root, use_cache=True)
+        r2 = analysis.run([mod], root=root, use_cache=True)
+        assert r1.findings == [] and r2.findings == []
+        assert [f.rule for f in r1.suppressed] \
+            == [f.rule for f in r2.suppressed] == ["R2"]
+
+    def test_cross_file_facts_survive_cache(self, tmp_path):
+        """R5 orphan detection needs every file's registration facts;
+        a fully-cached run must reach the same finalize verdict."""
+        root = self._tree(tmp_path)
+        (root / "tpu_dra" / "infra" / "metrics.py").write_text(
+            'METRICS_CATALOG = {"tpu_dra_orphan_total": "x"}\n')
+        mod = root / "prod.py"
+        mod.write_text("REG.counter('tpu_dra_live_total')\n")
+        r1 = analysis.run([root], root=root, use_cache=True)
+        r2 = analysis.run([root], root=root, use_cache=True)
+        for rep in (r1, r2):
+            msgs = [f.message for f in rep.findings]
+            assert any("tpu_dra_orphan_total" in m for m in msgs), msgs
+            assert any("tpu_dra_live_total" in m for m in msgs), msgs
+
+    def test_whole_tree_cached_run_matches_cold(self, tmp_path):
+        """The real tree: a cache-backed rerun reproduces the cold
+        verdict byte for byte (the lint.sh incremental path)."""
+        root = Path(analysis.find_root(Path(__file__)))
+        paths = [p for p in (root / "tpu_dra", root / "tests",
+                             root / "bench.py") if p.exists()]
+        import shutil
+        import tpu_dra.analysis.core as core
+        scratch = tmp_path / "cachedir"
+        scratch.mkdir()
+        # Redirect the cache file into the sandbox so the test does not
+        # touch (or depend on) the repo's own cache state.
+        orig = core.CACHE_FILENAME
+        core.CACHE_FILENAME = str(scratch / "cache.json")
+        try:
+            cold = analysis.run(paths, root=root, use_cache=True)
+            warm = analysis.run(paths, root=root, use_cache=True)
+        finally:
+            core.CACHE_FILENAME = orig
+        assert [f.to_dict() for f in warm.findings] \
+            == [f.to_dict() for f in cold.findings]
+        assert [f.to_dict() for f in warm.suppressed] \
+            == [f.to_dict() for f in cold.suppressed]
+        assert warm.files == cold.files

@@ -1,0 +1,404 @@
+"""drmc (tpu_dra/analysis/drmc, ISSUE 6): the deterministic model
+checker — controlled-scheduler semantics, DPOR-lite exploration,
+byte-for-byte schedule replay, the recording VFS's crash-image
+semantics, and the crash matrices (CheckpointManager.store_batch and
+the full mixed-outcome batch-prepare pipeline)."""
+
+import json
+import os
+import threading
+
+import pytest
+
+from tpu_dra.analysis.drmc import crash as drmc_crash
+from tpu_dra.analysis.drmc import explore as drmc_explore
+from tpu_dra.analysis.drmc import scenarios as drmc_scenarios
+from tpu_dra.analysis.drmc.sched import (
+    CooperativeScheduler, scenario_lock,
+)
+from tpu_dra.infra import vfs
+
+
+# ---------------------------------------------------------------------------
+# Controlled scheduler substrate
+# ---------------------------------------------------------------------------
+
+class _CounterScenario:
+    """Two tasks doing read-modify-write under a shared witnessed lock:
+    correct under every schedule (the lock serializes), so exploration
+    must terminate everywhere with counter == 2."""
+
+    name = "counter"
+
+    def build(self, sched):
+        lock = scenario_lock()    # witnessed despite the tests/ home
+        state = {"n": 0}
+
+        def bump():
+            with lock:
+                state["n"] += 1
+
+        sched.spawn("t1", bump)
+        sched.spawn("t2", bump)
+        return state
+
+    def check(self, state):
+        return [] if state["n"] == 2 else [f"lost update: n={state['n']}"]
+
+    def cleanup(self, state):
+        pass
+
+
+class _DeadlockScenario:
+    """The AB-BA classic. Some schedule interleaves into the deadlock;
+    every schedule at least records the order cycle in the witness."""
+
+    name = "deadlock"
+
+    def build(self, sched):
+        lock_a = scenario_lock()
+        lock_b = scenario_lock()
+
+        def ab():
+            with lock_a:
+                with lock_b:
+                    pass
+
+        def ba():
+            with lock_b:
+                with lock_a:
+                    pass
+
+        sched.spawn("ab", ab)
+        sched.spawn("ba", ba)
+        return {}
+
+    def check(self, ctx):
+        return []
+
+    def cleanup(self, ctx):
+        pass
+
+
+class TestControlledScheduler:
+    def test_all_schedules_terminate_and_hold_invariant(self):
+        report = drmc_explore.explore(_CounterScenario(), budget=50)
+        assert report.schedules >= 2
+        assert report.violation is None
+
+    def test_trace_replay_is_deterministic(self):
+        result, violations = drmc_explore.run_schedule(_CounterScenario())
+        assert violations == []
+        again = drmc_explore.replay(_CounterScenario(), result.trace)
+        assert again.trace == result.trace
+        assert again.ops == result.ops
+
+    def test_deadlock_is_detected(self):
+        report = drmc_explore.explore(_DeadlockScenario(), budget=50,
+                                      stop_on_violation=True)
+        assert report.violation is not None
+        text = "\n".join(report.violation.violations)
+        assert "deadlock" in text or "lock-order cycle" in text
+
+    def test_replay_divergence_is_loud(self):
+        # A trace pointing at a task id that is never enabled must be a
+        # harness error, not a silent different execution.
+        outcome = drmc_explore.replay(_CounterScenario(), [17])
+        assert any("replay divergence" in v or "harness" in v
+                   for v in outcome.violations)
+
+    def test_uncontrolled_threads_pass_through(self):
+        # While no run is active the hooks are uninstalled: plain
+        # threaded code over witnessed primitives keeps working.
+        sched = CooperativeScheduler()
+        assert sched.result.trace == []
+        lock = threading.Lock()
+        with lock:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Seeded replay of a recorded violating schedule (acceptance criterion)
+# ---------------------------------------------------------------------------
+
+class TestViolationReplay:
+    def test_racy_index_violation_found_and_replays_byte_for_byte(self):
+        report = drmc_explore.explore(drmc_scenarios.RacyIndexScenario(),
+                                      budget=50)
+        assert report.violation is not None, \
+            "the planted check-then-act race must be found"
+        assert any("allocated to" in v
+                   for v in report.violation.violations)
+        recorded = {"trace": report.violation.trace,
+                    "ops": report.violation.ops,
+                    "violations": report.violation.violations}
+        replayed = drmc_explore.replay(drmc_scenarios.RacyIndexScenario(),
+                                       report.violation.trace)
+        assert json.dumps(recorded, sort_keys=True) == json.dumps(
+            {"trace": replayed.trace, "ops": replayed.ops,
+             "violations": replayed.violations}, sort_keys=True)
+
+    def test_serialized_variant_is_clean(self):
+        # The same shape with the discipline kept (sched-churn's bind
+        # callback) must explore clean — the rule, not the checker,
+        # distinguishes them.
+        report = drmc_explore.explore(drmc_scenarios.SchedChurnScenario(),
+                                      budget=40)
+        assert report.violation is None
+
+
+# ---------------------------------------------------------------------------
+# Gate scenarios
+# ---------------------------------------------------------------------------
+
+class TestGateScenarios:
+    def test_sched_churn_explores_clean(self):
+        report = drmc_explore.explore(drmc_scenarios.SchedChurnScenario(),
+                                      budget=60)
+        assert report.schedules == 60          # rich frontier
+        assert report.distinct == 60
+        assert report.violation is None
+
+    def test_batch_prepare_explores_clean(self):
+        report = drmc_explore.explore(
+            drmc_scenarios.BatchPrepareScenario(), budget=25)
+        assert report.distinct >= 25
+        assert report.violation is None
+
+    def test_metrics_are_bumped(self):
+        from tpu_dra.infra.metrics import DRMC_SCHEDULES
+        before = DRMC_SCHEDULES.value(labels={"scenario": "counter"})
+        drmc_explore.explore(_CounterScenario(), budget=5)
+        after = DRMC_SCHEDULES.value(labels={"scenario": "counter"})
+        assert after >= before + 1
+
+
+# ---------------------------------------------------------------------------
+# Recording VFS crash-image semantics
+# ---------------------------------------------------------------------------
+
+class TestRecordingVfs:
+    def _write_file(self, path, sync):
+        fd = vfs.open_fd(str(path), os.O_RDWR | os.O_CREAT)
+        vfs.pwrite(fd, b"hello world", 0)
+        if sync:
+            vfs.fdatasync(fd)
+        vfs.close_fd(fd)
+
+    def test_clean_image_drops_unsynced_writes(self, tmp_path):
+        rec = drmc_crash.RecordingVfs()
+        vfs.install(rec)
+        try:
+            rec.arm()
+            self._write_file(tmp_path / "a", sync=False)
+            self._write_file(tmp_path / "b", sync=True)
+        finally:
+            vfs.uninstall()
+        rec.materialize_crash_image()
+        assert not (tmp_path / "a").exists()       # never durable
+        assert (tmp_path / "b").read_bytes() == b"hello world"
+
+    def test_persisted_image_keeps_everything(self, tmp_path):
+        rec = drmc_crash.RecordingVfs(variant="persisted")
+        vfs.install(rec)
+        try:
+            rec.arm()
+            self._write_file(tmp_path / "a", sync=False)
+        finally:
+            vfs.uninstall()
+        rec.materialize_crash_image()
+        assert (tmp_path / "a").read_bytes() == b"hello world"
+
+    def test_torn_image_applies_write_prefix(self, tmp_path):
+        path = tmp_path / "slot"
+        path.write_bytes(b"x" * 16)                # pre-existing, durable
+        rec = drmc_crash.RecordingVfs(crash_at=0, variant="torn")
+        vfs.install(rec)
+        try:
+            rec.arm()
+            fd = os.open(str(path), os.O_RDWR)     # raw: not an op
+            with pytest.raises(drmc_crash.CrashPoint):
+                rec._fd_paths[fd] = str(path)
+                vfs.pwrite(fd, b"REPLACEMENT-DATA", 0)
+            os.close(fd)
+        finally:
+            vfs.uninstall()
+        rec.materialize_crash_image()
+        data = path.read_bytes()
+        assert data.startswith(b"REPLACE")          # the torn prefix
+        assert data[drmc_crash.TORN_PREFIX_BYTES:] \
+            == b"x" * (16 - drmc_crash.TORN_PREFIX_BYTES)
+
+    def test_unsynced_rename_reverts_in_clean_image(self, tmp_path):
+        dst = tmp_path / "spec.json"
+        dst.write_bytes(b"old")
+        # Make the pre-existing content the SYNCED state by first touch.
+        rec = drmc_crash.RecordingVfs()
+        vfs.install(rec)
+        try:
+            rec.arm()
+            vfs.write_text(str(tmp_path / "spec.json.tmp"), "new")
+            vfs.replace(str(tmp_path / "spec.json.tmp"), str(dst))
+        finally:
+            vfs.uninstall()
+        assert dst.read_bytes() == b"new"           # live state
+        rec.materialize_crash_image()
+        assert dst.read_bytes() == b"old"           # crash state
+        assert not (tmp_path / "spec.json.tmp").exists()
+
+    def test_double_install_refused(self):
+        rec = drmc_crash.RecordingVfs()
+        vfs.install(rec)
+        try:
+            with pytest.raises(RuntimeError):
+                vfs.install(drmc_crash.RecordingVfs())
+        finally:
+            vfs.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# Crash matrices
+# ---------------------------------------------------------------------------
+
+class _StoreBatchMatrixScenario:
+    """CheckpointManager.store_batch in isolation: a mixed intent +
+    terminal + removal sequence, crash-enumerated. Recovery invariant:
+    load() always yields one of the states the sequence passed through
+    — never a torn in-between, never total corruption — and the manager
+    keeps working (a fresh store round-trips). Generalizes PR 2's
+    single crash-restart test to EVERY enumerated crash point."""
+
+    name = "store-batch-matrix"
+
+    # The consistent states the durable image may legally show, as
+    # frozensets of (uid, state).
+    def __init__(self):
+        from tpu_dra.tpuplugin.checkpoint import (
+            PREPARE_COMPLETED, PREPARE_STARTED,
+        )
+        self.legal = [
+            frozenset(),
+            frozenset({("a", PREPARE_STARTED), ("b", PREPARE_STARTED)}),
+            frozenset({("a", PREPARE_COMPLETED),
+                       ("b", PREPARE_COMPLETED)}),
+            frozenset({("b", PREPARE_COMPLETED)}),
+        ]
+
+    def setup(self):
+        import tempfile
+        from tpu_dra.tpuplugin.checkpoint import CheckpointManager
+        tmp = tempfile.mkdtemp(prefix="drmc-sbm-")
+        mgr = CheckpointManager(os.path.join(tmp, "cp"))
+        cp = mgr.load_or_init()
+        return {"tmp": tmp, "mgr": mgr, "cp": cp}
+
+    def body(self, ctx):
+        from tpu_dra.tpuplugin.checkpoint import (
+            PREPARE_COMPLETED, PreparedClaim,
+        )
+        cp, mgr = ctx["cp"], ctx["mgr"]
+        cp.claims["a"] = PreparedClaim(uid="a")
+        cp.claims["b"] = PreparedClaim(uid="b")
+        mgr.store_batch(cp, present=["a", "b"], intent=True)
+        cp.claims["a"].state = PREPARE_COMPLETED
+        cp.claims["b"].state = PREPARE_COMPLETED
+        mgr.store_batch(cp, present=["a", "b"])
+        del cp.claims["a"]
+        mgr.store_batch(cp, absent=["a"])
+
+    def dispose(self, ctx):
+        ctx["mgr"].close()
+
+    def recover_and_check(self, ctx):
+        import shutil
+        from tpu_dra.tpuplugin.checkpoint import (
+            CheckpointManager, PreparedClaim,
+        )
+        v = []
+        mgr2 = CheckpointManager(os.path.join(ctx["tmp"], "cp"))
+        try:
+            try:
+                cp2 = mgr2.load_or_init()
+            except Exception as e:  # noqa: BLE001
+                return [f"recovery failed: {e}"]
+            got = frozenset((uid, pc.state)
+                            for uid, pc in cp2.claims.items())
+            if got not in self.legal:
+                v.append(f"recovered state {sorted(got)} is not any "
+                         "state the sequence passed through")
+            # The manager must keep working over the repaired slots.
+            cp2.claims["post"] = PreparedClaim(uid="post")
+            mgr2.store_batch(cp2, present=["post"])
+            reread = CheckpointManager(os.path.join(ctx["tmp"], "cp"))
+            try:
+                cp3 = reread.load()
+                if cp3 is None or "post" not in cp3.claims:
+                    v.append("post-recovery store did not round-trip")
+            finally:
+                reread.close()
+            return v
+        finally:
+            mgr2.close()
+            shutil.rmtree(ctx["tmp"], ignore_errors=True)
+
+
+class TestCrashMatrices:
+    def test_store_batch_recovers_at_every_crash_point(self):
+        report = drmc_crash.enumerate_crashes(_StoreBatchMatrixScenario())
+        assert report.points_enumerated > 20
+        assert report.points_run == report.points_enumerated
+        assert report.violations == [], "\n".join(report.violations)
+
+    def test_mixed_outcome_batch_prepare_full_matrix(self):
+        """The ISSUE's crash-matrix acceptance: the mixed-outcome
+        prepare batch + unprepare, crashed after EVERY durable op in
+        every variant, recovers with externalized successes committed,
+        the loser rolled back, and a faultless replay converging."""
+        report = drmc_crash.enumerate_crashes(
+            drmc_scenarios.BatchPrepareCrashScenario())
+        assert report.points_run == report.points_enumerated
+        assert report.coverage == 1.0
+        assert report.points_enumerated >= 30
+        # The op trace must cover the whole durability surface.
+        kinds = " ".join(report.ops)
+        for probe in ("pwrite", "fdatasync", "write_text", "replace",
+                      "unlink", "flock"):
+            assert probe in kinds, f"no {probe} op enumerated: {kinds}"
+        assert report.violations == [], "\n".join(report.violations)
+
+    def test_crashpoint_escapes_except_exception(self):
+        # The simulated SIGKILL must not be swallowable by the broad
+        # `except Exception` recovery paths in the stack under test.
+        assert not issubclass(drmc_crash.CrashPoint, Exception)
+        assert issubclass(drmc_crash.CrashPoint, BaseException)
+
+
+# ---------------------------------------------------------------------------
+# The CLI gate (hack/drmc.sh)
+# ---------------------------------------------------------------------------
+
+class TestCli:
+    def test_gate_invocation_small_budget(self, capsys):
+        from tpu_dra.analysis.drmc.__main__ import main
+        rc = main(["--budget", "10", "--skip-crash"])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "sched-churn" in out and "batch-prepare" in out
+
+    def test_min_schedules_floor_enforced(self, capsys):
+        from tpu_dra.analysis.drmc.__main__ import main
+        rc = main(["--budget", "3", "--min-schedules", "1000",
+                   "--skip-crash"])
+        assert rc == 1
+        assert "distinct interleavings" in capsys.readouterr().out
+
+    def test_replay_cli_roundtrip(self, capsys):
+        from tpu_dra.analysis.drmc.__main__ import main
+        report = drmc_explore.explore(drmc_scenarios.RacyIndexScenario(),
+                                      budget=50)
+        assert report.violation is not None
+        rc = main(["--scenario", "racy-index", "--replay-trace",
+                   json.dumps(report.violation.trace)])
+        assert rc == 1                       # the violation reproduces
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["violations"] == report.violation.violations

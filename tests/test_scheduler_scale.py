@@ -13,7 +13,7 @@ import bench
 from tpu_dra.infra.faults import FAULTS, EveryNth
 from tpu_dra.infra.metrics import SCHED_FULL_RELISTS
 from tpu_dra.k8s import FakeCluster, PODS, RESOURCECLAIMS
-from tpu_dra.simcluster.chaos import SchedulerChaosHarness, _chip_conflicts
+from tpu_dra.simcluster.chaos import SchedulerChaosHarness, chip_conflicts
 from tpu_dra.simcluster.scheduler import AllocationIndex, Scheduler
 from tpu_dra.testing import make_sched_pod, seed_sched_inventory
 
@@ -149,7 +149,7 @@ class TestEventDrivenScheduler:
             assert SCHED_FULL_RELISTS.value() > relists0, \
                 "drops must have routed through the guarded resync"
             claims = c.list(RESOURCECLAIMS, namespace="default")
-            assert _chip_conflicts(claims) == []
+            assert chip_conflicts(claims) == []
             assert s.verify_index() == []
         finally:
             s.stop()

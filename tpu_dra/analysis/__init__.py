@@ -1,12 +1,16 @@
 """dralint: project-invariant static analysis (SURVEY §12).
 
 ``python -m tpu_dra.analysis`` lints the tree against the concurrency
-and ownership invariants the control plane depends on (R1-R6);
+and ownership invariants the control plane depends on (R1-R8);
 ``tests/test_dralint.py`` makes a zero-finding run a hard test gate and
-``hack/lint.sh`` the CI-style entry point.
+``hack/lint.sh`` the CI-style entry point. Whole-tree runs are
+incremental via the per-file result cache (core.run(use_cache=True),
+``--no-cache`` to disable). The dynamic complement — the drmc
+deterministic model checker — lives in ``tpu_dra.analysis.drmc``
+(SURVEY §13).
 """
 
-from tpu_dra.analysis import rules as _rules  # noqa: F401 — registers R1-R6
+from tpu_dra.analysis import rules as _rules  # noqa: F401 — registers R1-R8
 from tpu_dra.analysis.core import (
     Finding, Module, ProjectContext, Report, Rule, all_rules, find_root,
     lint_source, render, run,

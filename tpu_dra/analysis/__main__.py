@@ -26,6 +26,9 @@ def main(argv=None) -> int:
     ap.add_argument("--json", action="store_true", dest="as_json")
     ap.add_argument("--rules", default="",
                     help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="ignore and do not write the per-file result "
+                         "cache (.dralint-cache.json)")
     ap.add_argument("--show-suppressed", action="store_true")
     ap.add_argument("--sites-report", action="store_true",
                     help="also print the fault-site coverage table "
@@ -53,7 +56,8 @@ def main(argv=None) -> int:
     if args.sites_report and rule_ids is not None:
         rule_ids.add("R4")  # the table is R4's collection; always run it
     active = core.all_rules()
-    report = core.run(paths, root=root, rules=active, rule_ids=rule_ids)
+    report = core.run(paths, root=root, rules=active, rule_ids=rule_ids,
+                      use_cache=not args.no_cache)
     print(core.render(report, as_json=args.as_json,
                       show_suppressed=args.show_suppressed))
     if args.sites_report:

@@ -75,16 +75,25 @@ class Module:
     def suppressed(self, rule: str, line: int) -> bool:
         """A finding at `line` is waived by an ignore comment on the
         same line or the line directly above it."""
-        for ln in (line, line - 1):
-            rules = self.suppressions.get(ln, _MISSING)
-            if rules is _MISSING:
-                continue
-            if rules is None or rule in rules:
-                return True
-        return False
+        return _lookup_suppressed(self.suppressions, rule, line)
 
 
 _MISSING = object()
+
+
+def _lookup_suppressed(lines: Dict[int, Optional[Set[str]]],
+                       rule: str, line: int) -> bool:
+    """The one definition of waiver semantics (same line or line
+    directly above; None = all rules), shared by parsed modules and the
+    result cache's replayed suppression maps — warm and cold runs must
+    agree byte for byte."""
+    for ln in (line, line - 1):
+        rules = lines.get(ln, _MISSING)
+        if rules is _MISSING:
+            continue
+        if rules is None or rule in rules:
+            return True
+    return False
 
 
 def _parse_suppressions(source: str) -> Dict[int, Optional[Set[str]]]:
@@ -203,7 +212,15 @@ def _string_constants(path: Path) -> Set[str]:
 class Rule:
     """One lint rule. ``scan`` runs per module; ``finalize`` once after
     all modules (cross-file orphan checks). Rules are instantiated per
-    run — they may keep collection state between scan and finalize."""
+    run — they may keep collection state between scan and finalize.
+
+    Rules with cross-file state additionally speak the FACTS protocol
+    so the per-file result cache can skip re-scanning unchanged files:
+    ``module_facts()`` (called right after ``scan(module)``) returns the
+    JSON-able contribution that module made to the rule's aggregate
+    state, and ``absorb_facts`` replays a cached contribution for a
+    file the runner did not re-parse. Per-file findings are cached
+    separately by the runner; finalize always recomputes."""
 
     rule_id: str = ""
     title: str = ""
@@ -213,6 +230,13 @@ class Rule:
 
     def finalize(self, ctx: ProjectContext) -> Iterator[Finding]:
         return iter(())
+
+    def module_facts(self) -> Optional[Dict]:
+        return None
+
+    def absorb_facts(self, relpath: str, facts: Dict,
+                     ctx: ProjectContext) -> None:
+        pass
 
 
 _RULE_CLASSES: List[type] = []
@@ -277,31 +301,167 @@ def find_root(start: Path) -> Path:
         cur = cur.parent
 
 
+# ---------------------------------------------------------------------------
+# Per-file result cache
+# ---------------------------------------------------------------------------
+# Whole-tree lint re-parses ~190 files per run even though almost none
+# changed between runs; hack/lint.sh runs on every tier. Entries are
+# keyed by (path, mtime_ns, size) and the cache as a whole by a
+# rules-version hash (the analyzer's own sources) plus a registries
+# hash (faults/metrics/featuregates — their content changes the verdict
+# for OTHER files, e.g. an unknown-site finding). An entry stores the
+# file's scan-phase findings, its suppression map (finalize findings
+# must still honor line-level waivers in unparsed files), and the
+# cross-file FACTS each rule contributed (Rule.module_facts), which are
+# replayed through absorb_facts so finalize sees the whole tree.
+
+CACHE_VERSION = 1
+CACHE_FILENAME = ".dralint-cache.json"
+
+_RULES_SOURCES = ("core.py", "rules.py")
+_REGISTRY_SOURCES = ("infra/faults.py", "infra/metrics.py",
+                     "infra/featuregates.py")
+
+
+def _hash_sources(files: Iterable[Path]) -> str:
+    import hashlib
+    h = hashlib.sha1()
+    for f in files:
+        try:
+            h.update(f.read_bytes())
+        except OSError:
+            h.update(b"?")
+    return h.hexdigest()
+
+
+def cache_keys(root: Path) -> Dict[str, str]:
+    analysis = Path(__file__).resolve().parent
+    return {
+        "rules_version": _hash_sources(analysis / n
+                                       for n in _RULES_SOURCES),
+        "registries": _hash_sources(root / "tpu_dra" / n
+                                    for n in _REGISTRY_SOURCES),
+    }
+
+
+def _load_cache(path: Path, keys: Dict[str, str]) -> Dict:
+    try:
+        doc = json.loads(path.read_text())
+    except (OSError, json.JSONDecodeError, ValueError):
+        return {"files": {}}
+    if (not isinstance(doc, dict) or doc.get("version") != CACHE_VERSION
+            or doc.get("rules_version") != keys["rules_version"]
+            or doc.get("registries") != keys["registries"]
+            or not isinstance(doc.get("files"), dict)):
+        return {"files": {}}
+    return doc
+
+
+class _CachedSuppressions:
+    """Module.suppressed() semantics over a cached suppression map —
+    finalize findings anchored in an unparsed file still honor its
+    waiver comments."""
+
+    def __init__(self, doc: Dict):
+        self._lines: Dict[int, Optional[Set[str]]] = {}
+        for line, rules in (doc or {}).items():
+            self._lines[int(line)] = (None if rules is None
+                                      else set(rules))
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        return _lookup_suppressed(self._lines, rule, line)
+
+
+def _suppressions_doc(mod: Module) -> Dict[str, Optional[List[str]]]:
+    return {str(ln): (None if rules is None else sorted(rules))
+            for ln, rules in mod.suppressions.items()}
+
+
+def _rel(path: Path, root: Path) -> str:
+    try:
+        return str(path.relative_to(root))
+    except ValueError:
+        return str(path)
+
+
 def run(paths: Sequence[Path], root: Optional[Path] = None,
         rules: Optional[Iterable[Rule]] = None,
-        rule_ids: Optional[Set[str]] = None) -> Report:
+        rule_ids: Optional[Set[str]] = None,
+        use_cache: bool = False) -> Report:
     paths = [Path(p) for p in paths]
     root = Path(root) if root else find_root(paths[0] if paths else Path("."))
     ctx = ProjectContext.load(root)
     active = list(rules) if rules is not None else all_rules()
     if rule_ids:
         active = [r for r in active if r.rule_id in rule_ids]
+    # The cache stores full-rule-set results; a rule-filtered run must
+    # not read partial entries as authoritative nor poison future runs.
+    # (Callers passing explicit `rules` with use_cache=True — the CLI —
+    # are expected to pass the full registry.)
+    use_cache = use_cache and rule_ids is None
+    cache_path = root / CACHE_FILENAME
+    keys = cache_keys(root) if use_cache else {}
+    cache = _load_cache(cache_path, keys) if use_cache else {"files": {}}
+
     report = Report(ctx=ctx)
     modules: List[Module] = []
+    cached: Dict[str, Dict] = {}     # relpath -> valid cache entry
+    stats: Dict[str, Dict] = {}      # relpath -> fresh stat for new entry
     for f in iter_python_files(paths):
+        rel = _rel(f, root)
+        try:
+            st = f.stat()
+        except OSError:
+            continue
+        entry = cache["files"].get(rel) if use_cache else None
+        if (entry is not None and entry.get("mtime_ns") == st.st_mtime_ns
+                and entry.get("size") == st.st_size):
+            cached[rel] = entry
+            continue
         mod = parse_module(f, root)
         if mod is not None:
             modules.append(mod)
-    report.files = len(modules)
-    ctx.scanned = {m.relpath for m in modules}
+            stats[rel] = {"mtime_ns": st.st_mtime_ns, "size": st.st_size}
+    report.files = len(modules) + len(cached)
+    ctx.scanned = {m.relpath for m in modules} | set(cached)
+
+    for rel in sorted(cached):
+        entry = cached[rel]
+        for rule in active:
+            facts = (entry.get("facts") or {}).get(rule.rule_id)
+            if facts is not None:
+                rule.absorb_facts(rel, facts, ctx)
+        report.findings.extend(Finding(**d) for d in entry["findings"])
+        report.suppressed.extend(Finding(**d) for d in entry["suppressed"])
+
+    new_entries: Dict[str, Dict] = {}
     for mod in modules:
+        mod_findings: List[Finding] = []
+        mod_suppressed: List[Finding] = []
+        facts: Dict[str, Dict] = {}
         for rule in active:
             for finding in rule.scan(mod, ctx):
                 if mod.suppressed(finding.rule, finding.line):
-                    report.suppressed.append(finding)
+                    mod_suppressed.append(finding)
                 else:
-                    report.findings.append(finding)
-    by_rel = {m.relpath: m for m in modules}
+                    mod_findings.append(finding)
+            rule_facts = rule.module_facts()
+            if rule_facts is not None:
+                facts[rule.rule_id] = rule_facts
+        report.findings.extend(mod_findings)
+        report.suppressed.extend(mod_suppressed)
+        if use_cache and mod.relpath in stats:
+            new_entries[mod.relpath] = {
+                **stats[mod.relpath],
+                "findings": [f.to_dict() for f in mod_findings],
+                "suppressed": [f.to_dict() for f in mod_suppressed],
+                "suppressions": _suppressions_doc(mod),
+                "facts": facts,
+            }
+
+    by_rel: Dict[str, object] = {m.relpath: m for m in modules}
+    for rel, entry in cached.items():
+        by_rel[rel] = _CachedSuppressions(entry.get("suppressions") or {})
     for rule in active:
         for finding in rule.finalize(ctx):
             mod = by_rel.get(finding.path)
@@ -311,6 +471,18 @@ def run(paths: Sequence[Path], root: Optional[Path] = None,
                 report.findings.append(finding)
     report.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     report.suppressed.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+
+    if use_cache:
+        # Merge, never replace wholesale: a single-file lint must not
+        # evict the rest of the tree's entries. Vanished files linger
+        # harmlessly (their stat key can never match again).
+        files = dict(cache["files"])
+        files.update(new_entries)
+        doc = {"version": CACHE_VERSION, **keys, "files": files}
+        try:
+            cache_path.write_text(json.dumps(doc))
+        except OSError:
+            pass  # read-only checkout: cache is best-effort
     return report
 
 

@@ -1,0 +1,468 @@
+"""drmc scenarios: small, terminating models of the risky subsystems.
+
+Two families share the scenario registry:
+
+**Interleaving scenarios** (for explore.explore) spawn controlled tasks
+against real components and assert the chaos tier's safety invariants
+at every terminal state: no device double-allocation
+(simcluster.chaos.chip_conflicts), allocation index == truth
+(AllocationIndex.diff_against), checkpoint/CDI consistency, and an
+acyclic lock-order graph (the witness runs under every schedule).
+``sched-churn`` drives the WorkQueue + AllocationIndex pair ROADMAP
+item 1's multi-worker refactor will stress; ``batch-prepare`` drives
+concurrent DeviceState prepare/unprepare/health batches. ``racy-index``
+is the deliberately-buggy fixture — an unserialized check-then-act on
+the index — whose violating schedule the tests record and replay.
+
+**Crash scenarios** (for crash.enumerate_crashes) run a durable-op
+sequence once per enumerated crash point and assert the recovery
+invariants after restart: recovery never throws, externalized successes
+are durable, externalized failures stay rolled back, CDI specs never
+outlive their checkpoint entries, and a faultless replay converges to
+the expected final state. ``batch-prepare-crash`` is the mixed-outcome
+batch (one member fails mid-apply while its siblings group-commit)
+under the node flock — the exact pipeline ROADMAP item 5's journal
+refactor will rewrite.
+
+Scenarios must be deterministic given a schedule: no wall-clock
+branching (zero-delay rate limiter), no unseeded randomness, bounded
+work per task.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import shutil
+import tempfile
+import threading
+from typing import Dict, List, Optional
+
+from tpu_dra.infra.workqueue import RateLimiter, WorkQueue
+
+_DRIVER = "tpu.k8s.tpu.dev"
+_POOL = "drmc-node"
+
+
+class _ZeroLimiter(RateLimiter):
+    """No backoff: ready_at == enqueue time, so heap order is push
+    order and schedules never depend on the wall clock."""
+
+    def when(self, item_id: int) -> float:
+        return 0.0
+
+
+def _mk_claim(name: str, devices: List[str], rv: int,
+              uid: Optional[str] = None) -> Dict:
+    return {
+        "apiVersion": "resource.k8s.io/v1", "kind": "ResourceClaim",
+        "metadata": {"name": name, "namespace": "default",
+                     "uid": uid or f"uid-{name}",
+                     "resourceVersion": str(rv)},
+        "spec": {"devices": {"requests": [{"name": "tpu"}]}},
+        "status": {"allocation": {"devices": {"results": [
+            {"request": "tpu", "driver": _DRIVER, "pool": _POOL,
+             "device": d} for d in devices], "config": []}}},
+    }
+
+
+# ---------------------------------------------------------------------------
+# sched-churn: WorkQueue + AllocationIndex under controlled interleaving
+# ---------------------------------------------------------------------------
+
+class SchedChurnScenario:
+    """A single-worker queue processing keyed bind/unbind reconciles
+    against an AllocationIndex, while two producers enqueue (same-key
+    dedupe included) and a stopper shuts the queue down mid-stream.
+    Which pods end up bound is schedule-dependent BY DESIGN (an unbind
+    racing its bind is real churn); the invariants are the safety
+    properties that must hold under every ordering."""
+
+    name = "sched-churn"
+
+    def build(self, sched) -> Dict:
+        from tpu_dra.simcluster.scheduler import AllocationIndex
+
+        queue = WorkQueue(rate_limiter=_ZeroLimiter())
+        index = AllocationIndex()
+        truth: Dict[str, Dict] = {}
+        truth_lock = threading.Lock()   # witnessed: created under install
+        rvs = itertools.count(1)
+        devices = ["chip-0", "chip-1", "chip-2"]
+
+        def bind(key: str):
+            def cb(_obj) -> None:
+                # Serialized check-then-act: the pick, the index apply
+                # and the truth record commit atomically under the
+                # truth lock — the discipline racy-index drops.
+                with truth_lock:
+                    used = {d for c in truth.values()
+                            for _, _, d in _entries(c)}
+                    free = sorted(set(devices) - used)
+                    if not free or key in truth:
+                        return
+                    claim = _mk_claim(key, [free[0]], next(rvs))
+                    index.apply(claim)
+                    truth[key] = claim
+            return cb
+
+        def unbind(key: str):
+            def cb(_obj) -> None:
+                with truth_lock:
+                    claim = truth.pop(key, None)
+                    if claim is not None:
+                        index.remove(claim, force=True)
+            return cb
+
+        def worker() -> None:
+            queue.run()
+
+        def producer1() -> None:
+            queue.enqueue(None, bind("pod-a"), key="pod-a")
+            queue.enqueue(None, bind("pod-b"), key="pod-b", dedupe=True)
+            # Same-key storm: must absorb into the queued pod-b item.
+            queue.enqueue(None, bind("pod-b"), key="pod-b", dedupe=True)
+
+        def producer2() -> None:
+            queue.enqueue(None, bind("pod-c"), key="pod-c")
+            queue.enqueue(None, unbind("pod-a"), key="pod-a")
+
+        def stopper() -> None:
+            queue.shutdown()
+
+        sched.spawn("worker", worker)
+        sched.spawn("producer1", producer1)
+        sched.spawn("producer2", producer2)
+        sched.spawn("stopper", stopper)
+        return {"queue": queue, "index": index, "truth": truth}
+
+    def check(self, ctx) -> List[str]:
+        from tpu_dra.simcluster.chaos import chip_conflicts
+
+        queue, index, truth = ctx["queue"], ctx["index"], ctx["truth"]
+        # Quiesce: a shutdown racing the producers legitimately strands
+        # queued items; drain them the way a restarted worker would.
+        import heapq
+        while queue._heap:
+            _, _, item = heapq.heappop(queue._heap)
+            item.callback(item.obj)
+        claims = [truth[k] for k in sorted(truth)]
+        violations = list(index.diff_against(claims))
+        violations.extend(chip_conflicts(claims))
+        return violations
+
+    def cleanup(self, ctx) -> None:
+        ctx["queue"].shutdown()
+
+
+def _entries(claim: Dict):
+    from tpu_dra.simcluster.scheduler import claim_entries
+    return claim_entries(claim)
+
+
+# ---------------------------------------------------------------------------
+# racy-index: the deliberately-buggy fixture (violation demo + replay)
+# ---------------------------------------------------------------------------
+
+class RacyIndexScenario:
+    """Check-then-act on the AllocationIndex WITHOUT serializing the
+    pick against the apply: two reconciles can both observe the one
+    free device between each other's index lock sections and
+    double-allocate it. drmc must find a violating schedule, and the
+    recorded trace must replay to the identical violation — the
+    seeded-replay acceptance test."""
+
+    name = "racy-index"
+
+    def build(self, sched) -> Dict:
+        from tpu_dra.simcluster.scheduler import AllocationIndex
+
+        index = AllocationIndex()
+        truth: Dict[str, Dict] = {}
+        rvs = itertools.count(1)
+
+        def racy_bind(key: str):
+            def body() -> None:
+                # BUG (on purpose): the is_taken read and the apply
+                # each take the index lock, but nothing serializes the
+                # pair — a sibling can interleave between them.
+                if index.is_taken(_DRIVER, _POOL, "chip-0"):
+                    return
+                claim = _mk_claim(key, ["chip-0"], next(rvs))
+                index.apply(claim)
+                truth[key] = claim
+            return body
+
+        sched.spawn("bind-a", racy_bind("pod-a"))
+        sched.spawn("bind-b", racy_bind("pod-b"))
+        return {"index": index, "truth": truth}
+
+    def check(self, ctx) -> List[str]:
+        from tpu_dra.simcluster.chaos import chip_conflicts
+        claims = [ctx["truth"][k] for k in sorted(ctx["truth"])]
+        violations = list(ctx["index"].diff_against(claims))
+        violations.extend(chip_conflicts(claims))
+        return violations
+
+    def cleanup(self, ctx) -> None:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# batch-prepare: concurrent DeviceState batches under controlled scheduling
+# ---------------------------------------------------------------------------
+
+class BatchPrepareScenario:
+    """Two prepare batches and a health-event storm interleaved against
+    one DeviceState: the global state lock, the per-chip locks and the
+    group-commit checkpoint pipeline under every explored ordering.
+    Terminal invariants are the chaos harness's: checkpoint == expected
+    completed set, CDI specs == checkpoint, idempotent re-prepare, and
+    the health marks fully reversed."""
+
+    name = "batch-prepare"
+
+    def build(self, sched) -> Dict:
+        from tpu_dra.cdi.handler import CDIHandler
+        from tpu_dra.native.tpuinfo import FakeBackend, default_fake_chips
+        from tpu_dra.tpuplugin.checkpoint import CheckpointManager
+        from tpu_dra.tpuplugin.device_state import DeviceState
+
+        tmp = tempfile.mkdtemp(prefix="drmc-bp-")
+        backend = FakeBackend(default_fake_chips(4, "v5p",
+                                                 slice_id="drmc"))
+        cdi = CDIHandler(os.path.join(tmp, "cdi"),
+                         driver_root=os.path.join(tmp, "drv"))
+        state = DeviceState(
+            backend=backend, cdi=cdi,
+            checkpoints=CheckpointManager(os.path.join(tmp, "plugin")),
+            driver_name=_DRIVER, node_name=_POOL)
+
+        claims = {n: _mk_claim(n, [f"chip-{i}"], rv=1)
+                  for i, n in enumerate(("ca", "cb", "cc"))}
+        results: Dict[str, Dict] = {}
+
+        def batch1() -> None:
+            res = state.prepare_batch([claims["ca"], claims["cb"]])
+            results.update({uid: r.error for uid, r in res.items()})
+            errs = state.unprepare_batch([claims["ca"]["metadata"]["uid"]])
+            results["unprep-ca"] = errs[claims["ca"]["metadata"]["uid"]]
+
+        def batch2() -> None:
+            res = state.prepare_batch([claims["cc"]])
+            results.update({uid: r.error for uid, r in res.items()})
+
+        def health() -> None:
+            state.mark_unhealthy(3)
+            state.healthy_devices()
+            state.mark_healthy(3)
+
+        sched.spawn("batch1", batch1)
+        sched.spawn("batch2", batch2)
+        sched.spawn("health", health)
+        return {"tmp": tmp, "state": state, "cdi": cdi,
+                "claims": claims, "results": results}
+
+    def check(self, ctx) -> List[str]:
+        from tpu_dra.tpuplugin.checkpoint import PREPARE_COMPLETED
+
+        state, claims = ctx["state"], ctx["claims"]
+        v: List[str] = []
+        for key, err in sorted(ctx["results"].items()):
+            if err:
+                v.append(f"operation {key} failed: {err}")
+        want = {claims["cb"]["metadata"]["uid"],
+                claims["cc"]["metadata"]["uid"]}
+        snap = state.checkpoint_snapshot()
+        if set(snap.claims) != want:
+            v.append(f"checkpoint {sorted(snap.claims)} != "
+                     f"expected {sorted(want)}")
+        for uid, pc in snap.claims.items():
+            if pc.state != PREPARE_COMPLETED:
+                v.append(f"claim {uid} left {pc.state}")
+        specs = set(ctx["cdi"].list_claim_uids())
+        if specs != want:
+            v.append(f"CDI specs {sorted(specs)} != expected "
+                     f"{sorted(want)}")
+        # Idempotent re-prepare (uncontrolled: the run is over).
+        res = state.prepare_batch([claims["cb"]])
+        err = res[claims["cb"]["metadata"]["uid"]].error
+        if err:
+            v.append(f"idempotent re-prepare failed: {err}")
+        if len(state.healthy_devices()) != len(state.allocatable):
+            v.append("health marks not fully reversed")
+        return v
+
+    def cleanup(self, ctx) -> None:
+        try:
+            ctx["state"].close()
+        finally:
+            shutil.rmtree(ctx["tmp"], ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# batch-prepare-crash: the crash-point scenario (crash.enumerate_crashes)
+# ---------------------------------------------------------------------------
+
+class BatchPrepareCrashScenario:
+    """A mixed-outcome prepare batch (member `cb` fails mid-apply via
+    the prepare.batch_apply fault site; its siblings group-commit) and
+    a follow-up unprepare, both under the node flock — then a crash at
+    every durable op. Recovery invariants per the ISSUE: recovery never
+    throws, externalized successes are durable, the externalized loser
+    stays rolled back, CDI specs never outlive checkpoint entries, and
+    the kubelet-style faultless replay converges."""
+
+    name = "batch-prepare-crash"
+
+    def setup(self) -> Dict:
+        from tpu_dra.cdi.handler import CDIHandler
+        from tpu_dra.native.tpuinfo import FakeBackend, default_fake_chips
+        from tpu_dra.tpuplugin.checkpoint import CheckpointManager
+        from tpu_dra.tpuplugin.device_state import DeviceState
+
+        tmp = tempfile.mkdtemp(prefix="drmc-crash-")
+        backend = FakeBackend(default_fake_chips(4, "v5p",
+                                                 slice_id="drmc"))
+        cdi = CDIHandler(os.path.join(tmp, "cdi"),
+                         driver_root=os.path.join(tmp, "drv"))
+        state = DeviceState(
+            backend=backend, cdi=cdi,
+            checkpoints=CheckpointManager(os.path.join(tmp, "plugin")),
+            driver_name=_DRIVER, node_name=_POOL)
+        claims = {n: _mk_claim(n, [f"chip-{i}"], rv=1)
+                  for i, n in enumerate(("ca", "cb", "cc"))}
+        return {"tmp": tmp, "state": state, "cdi": cdi,
+                "claims": claims, "externalized": {}}
+
+    def body(self, ctx) -> None:
+        from tpu_dra.infra.faults import FAULTS, Always
+        from tpu_dra.infra.flock import Flock
+
+        state, claims = ctx["state"], ctx["claims"]
+        ext: Dict[str, str] = ctx["externalized"]
+        loser = claims["cb"]["metadata"]["uid"]
+
+        def fail_loser(claim_uid=None, **_ctx) -> None:
+            if claim_uid == loser:
+                raise RuntimeError("drmc injected mid-apply failure")
+
+        lock = Flock(os.path.join(ctx["tmp"], "prep.lock"))
+        with lock:
+            with FAULTS.armed("prepare.batch_apply", Always(),
+                              action=fail_loser):
+                res = state.prepare_batch(
+                    [claims["ca"], claims["cb"], claims["cc"]])
+        # The RPC returned: these outcomes are now externalized — from
+        # here on, a crash may not un-happen them.
+        for uid, r in res.items():
+            ext[uid] = "failed" if r.error else "completed"
+        # Once the unprepare is REQUESTED the claim is transitioning by
+        # kubelet's own intent: a crash may legitimately land on either
+        # side of its removal, so the survival invariant relaxes to
+        # "completed or cleanly gone" until the result externalizes.
+        uid_ca = claims["ca"]["metadata"]["uid"]
+        ext[uid_ca] = "unprepare-requested"
+        with lock:
+            errs = state.unprepare_batch([uid_ca])
+        if errs[uid_ca] is None:
+            ext[uid_ca] = "unprepared"
+
+    def dispose(self, ctx) -> None:
+        """The simulated process death: release fds, store nothing."""
+        ctx["state"].close()
+
+    def recover_and_check(self, ctx) -> List[str]:
+        from tpu_dra.cdi.handler import CDIHandler
+        from tpu_dra.native.tpuinfo import FakeBackend, default_fake_chips
+        from tpu_dra.tpuplugin.checkpoint import (
+            PREPARE_COMPLETED, CheckpointManager,
+        )
+        from tpu_dra.tpuplugin.device_state import DeviceState
+
+        tmp, claims = ctx["tmp"], ctx["claims"]
+        ext: Dict[str, str] = ctx["externalized"]
+        v: List[str] = []
+        state2 = None
+        try:
+            backend = FakeBackend(default_fake_chips(4, "v5p",
+                                                     slice_id="drmc"))
+            cdi2 = CDIHandler(os.path.join(tmp, "cdi"),
+                              driver_root=os.path.join(tmp, "drv"))
+            try:
+                state2 = DeviceState(
+                    backend=backend, cdi=cdi2,
+                    checkpoints=CheckpointManager(
+                        os.path.join(tmp, "plugin")),
+                    driver_name=_DRIVER, node_name=_POOL)
+            except Exception as e:  # noqa: BLE001 — THE invariant:
+                # recovery must never be unable to come up.
+                return [f"recovery failed to start: {e}"]
+            snap = state2.checkpoint_snapshot()
+            for uid, status in sorted(ext.items()):
+                pc = snap.claims.get(uid)
+                if status == "completed" and (
+                        pc is None or pc.state != PREPARE_COMPLETED):
+                    v.append(f"externalized success for {uid} lost "
+                             "(success before the terminal sync?)")
+                elif status == "failed" and pc is not None \
+                        and pc.state == PREPARE_COMPLETED:
+                    v.append(f"externalized failure for {uid} "
+                             "resurrected as completed")
+                elif status == "unprepared" and pc is not None:
+                    v.append(f"externalized unprepare of {uid} "
+                             "resurrected")
+                elif status == "unprepare-requested" and pc is not None \
+                        and pc.state != PREPARE_COMPLETED:
+                    v.append(f"in-flight unprepare left {uid} in "
+                             f"{pc.state} (neither committed nor gone)")
+            orphans = set(cdi2.list_claim_uids()) - set(snap.claims)
+            if orphans:
+                v.append(f"CDI specs outlive checkpoint: {sorted(orphans)}")
+
+            # Kubelet-style faultless replay: re-issue both RPCs; the
+            # pipeline must be idempotent from ANY crash image and
+            # converge to the canonical final state.
+            res = state2.prepare_batch(
+                [claims["ca"], claims["cb"], claims["cc"]])
+            for uid, r in sorted(res.items()):
+                if r.error:
+                    v.append(f"replay prepare of {uid} failed: {r.error}")
+            errs = state2.unprepare_batch(
+                [claims["ca"]["metadata"]["uid"]])
+            err = errs[claims["ca"]["metadata"]["uid"]]
+            if err is not None:
+                v.append(f"replay unprepare failed: {err}")
+            final = state2.checkpoint_snapshot()
+            want = {claims["cb"]["metadata"]["uid"],
+                    claims["cc"]["metadata"]["uid"]}
+            if set(final.claims) != want:
+                v.append(f"replay converged to {sorted(final.claims)}, "
+                         f"expected {sorted(want)}")
+            for uid, pc in final.claims.items():
+                if pc.state != PREPARE_COMPLETED:
+                    v.append(f"replay left {uid} {pc.state}")
+            specs = set(cdi2.list_claim_uids())
+            if specs != want:
+                v.append(f"replay CDI specs {sorted(specs)} != "
+                         f"{sorted(want)}")
+            return v
+        finally:
+            if state2 is not None:
+                state2.close()
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
+INTERLEAVING_SCENARIOS = {
+    SchedChurnScenario.name: SchedChurnScenario,
+    BatchPrepareScenario.name: BatchPrepareScenario,
+    RacyIndexScenario.name: RacyIndexScenario,
+}
+
+# Scenarios the CI gate runs (racy-index is the negative fixture: it is
+# SUPPOSED to violate, so it lives in tests, not the gate).
+GATE_SCENARIOS = (SchedChurnScenario.name, BatchPrepareScenario.name)
+
+CRASH_SCENARIOS = {
+    BatchPrepareCrashScenario.name: BatchPrepareCrashScenario,
+}

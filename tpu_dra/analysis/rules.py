@@ -198,6 +198,8 @@ def blocking_reason(node: ast.Call) -> Optional[str]:
         return f"socket.{last}"
     if chain[0] == "fcntl" and last in ("flock", "lockf"):
         return f"fcntl.{last} (file-lock syscall)"
+    if chain[-2:] == ["vfs", "flock"]:
+        return "vfs.flock (file-lock syscall behind the durable-op seam)"
     if chain[0] == "os" and last in ("system", "popen", "waitpid"):
         return f"os.{last}"
     if last in _MUTEX_WAITERS and recv:
@@ -466,9 +468,11 @@ class FaultSiteRegistry(Rule):
         self.local_registered: Dict[str, Set[str]] = {}  # relpath -> sites
         self.exercised: Set[str] = set()
         self.guarded: Set[str] = set()
+        self._last_facts: Optional[Dict] = None
 
     def scan(self, module: Module, ctx: ProjectContext) -> Iterator[Finding]:
         local: Set[str] = set()
+        uses: List[_SiteUse] = []
         for node in ast.walk(module.tree):
             if not isinstance(node, ast.Call):
                 continue
@@ -494,31 +498,48 @@ class FaultSiteRegistry(Rule):
                     and isinstance(node.args[0].value, str)):
                 continue  # dynamic site expression (chaos rearm loops)
             site = node.args[0].value
-            self.uses.append(_SiteUse(site=site, path=module.relpath,
-                                      line=node.lineno, kind=kind))
-            if kind == "guard" and not (module.is_test or module.is_chaos):
-                self.guarded.add(site)
-            if kind == "arm" and (module.is_test or module.is_chaos):
-                self.exercised.add(site)
-        self.local_registered[module.relpath] = local
+            uses.append(_SiteUse(site=site, path=module.relpath,
+                                 line=node.lineno, kind=kind))
         # Any registered-site literal appearing in a test or chaos module
         # counts as exercised (CHAOS_SITES tuples, parametrized tests) —
         # recorded as a use too so the --sites-report table shows the
         # same evidence the gate accepts (a dynamically armed site must
         # not read as 'arms 0').
         if module.is_test or module.is_chaos:
-            arm_lines = {(u.site, u.line) for u in self.uses
-                         if u.path == module.relpath}
+            arm_lines = {(u.site, u.line) for u in uses}
             for node in ast.walk(module.tree):
                 if (isinstance(node, ast.Constant)
                         and isinstance(node.value, str)
-                        and node.value in ctx.fault_sites):
-                    self.exercised.add(node.value)
-                    if (node.value, node.lineno) not in arm_lines:
-                        self.uses.append(_SiteUse(
-                            site=node.value, path=module.relpath,
-                            line=node.lineno, kind="literal"))
+                        and node.value in ctx.fault_sites
+                        and (node.value, node.lineno) not in arm_lines):
+                    uses.append(_SiteUse(
+                        site=node.value, path=module.relpath,
+                        line=node.lineno, kind="literal"))
+        # The module's contribution, both merged into the aggregate and
+        # exported as cacheable facts (absorb_facts replays them for
+        # files the runner skipped).
+        facts = {"uses": [[u.site, u.line, u.kind] for u in uses],
+                 "registered": sorted(local),
+                 "is_exercising": bool(module.is_test or module.is_chaos)}
+        self._last_facts = facts
+        self.absorb_facts(module.relpath, facts, ctx)
         return iter(())
+
+    def module_facts(self) -> Optional[Dict]:
+        facts, self._last_facts = self._last_facts, None
+        return facts
+
+    def absorb_facts(self, relpath: str, facts: Dict,
+                     ctx: ProjectContext) -> None:
+        exercising = facts.get("is_exercising", False)
+        for site, line, kind in facts.get("uses", ()):
+            self.uses.append(_SiteUse(site=site, path=relpath,
+                                      line=line, kind=kind))
+            if kind == "guard" and not exercising:
+                self.guarded.add(site)
+            if kind in ("arm", "literal") and exercising:
+                self.exercised.add(site)
+        self.local_registered[relpath] = set(facts.get("registered", ()))
 
     def finalize(self, ctx: ProjectContext) -> Iterator[Finding]:
         dynamic: Set[str] = set()
@@ -570,10 +591,20 @@ class MetricCatalog(Rule):
 
     def __init__(self):
         self.registered: Set[str] = set()
+        self._last_facts: Optional[Dict] = None
+
+    def module_facts(self) -> Optional[Dict]:
+        facts, self._last_facts = self._last_facts, None
+        return facts
+
+    def absorb_facts(self, relpath: str, facts: Dict,
+                     ctx: ProjectContext) -> None:
+        self.registered.update(facts.get("registered", ()))
 
     def scan(self, module: Module, ctx: ProjectContext) -> Iterator[Finding]:
         if module.is_test:
             return iter(())
+        mod_registered: Set[str] = set()
         findings: List[Finding] = []
         for node in ast.walk(module.tree):
             if not (isinstance(node, ast.Call)
@@ -584,6 +615,7 @@ class MetricCatalog(Rule):
                     and isinstance(node.args[0].value, str)):
                 continue
             name = node.args[0].value
+            mod_registered.add(name)
             self.registered.add(name)
             if not _METRIC_NAME_RE.match(name):
                 findings.append(Finding(
@@ -595,6 +627,7 @@ class MetricCatalog(Rule):
                     rule="R5", path=module.relpath, line=node.lineno, col=0,
                     message=f"metric {name!r} is not declared in "
                             "infra/metrics.py METRICS_CATALOG"))
+        self._last_facts = {"registered": sorted(mod_registered)}
         return iter(findings)
 
     def finalize(self, ctx: ProjectContext) -> Iterator[Finding]:
@@ -658,6 +691,216 @@ class FeatureGateNames(Rule):
 
 
 # ---------------------------------------------------------------------------
+# R7: mutation-under-try needs a paired unwind in the handler
+# ---------------------------------------------------------------------------
+
+_STATE_MUTATORS = {"pop", "popitem", "update", "append", "extend",
+                   "insert", "clear", "remove", "add", "discard",
+                   "setdefault", "sort"}
+_UNWIND_NAME_RE = re.compile(r"unwind|rollback|abort|reinsert|restore",
+                             re.IGNORECASE)
+
+
+def _self_state_mutations(fn) -> List[int]:
+    """Line numbers of lexical mutations of ``self``-rooted state in
+    `fn`: attribute/subscript assignment, ``del``, augmented
+    assignment, or a mutator-method call on a ``self.*`` receiver."""
+    out: List[int] = []
+
+    def rooted_at_self(node: ast.AST) -> bool:
+        chain = attr_chain(node)
+        return bool(chain) and chain[0] == "self" and len(chain) > 1
+
+    for node in ast.walk(fn):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        elif isinstance(node, ast.Delete):
+            targets = node.targets
+        for t in targets:
+            if isinstance(t, (ast.Attribute, ast.Subscript)) \
+                    and rooted_at_self(t):
+                out.append(t.lineno)
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _STATE_MUTATORS
+                and rooted_at_self(node.func.value)):
+            out.append(node.lineno)
+    return out
+
+
+def _handler_has_unwind(handler: ast.ExceptHandler) -> bool:
+    """A handler 'pairs' the mutation when it re-raises, calls an
+    unwind/rollback helper, or compensates with its own self-state
+    mutation (reinserting what the failed operation removed)."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            chain = attr_chain(node.func)
+            if chain and _UNWIND_NAME_RE.search(chain[-1]):
+                return True
+    return bool(_self_state_mutations(handler))
+
+
+@register
+class PrepareUnwindDiscipline(Rule):
+    """R7: in the prepare pipelines (functions whose name contains
+    ``prepare``), an ``except`` path that swallows an error AFTER the
+    function has mutated driver state must carry a paired unwind — a
+    ``*unwind*``/``*rollback*`` call, a compensating self-state
+    mutation, or a re-raise. A handler that just logs and moves on
+    leaves memory ahead of disk: exactly the bug class chaos seed 5
+    found on the unprepare path (SURVEY §9), now checked lexically."""
+
+    rule_id = "R7"
+    title = "prepare-pipeline except paths unwind"
+
+    def scan(self, module: Module, ctx: ProjectContext) -> Iterator[Finding]:
+        if module.is_test or module.is_chaos:
+            return iter(())
+        findings: List[Finding] = []
+        for fn in ast.walk(module.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if "prepare" not in fn.name.lower():
+                continue
+            mutations = _self_state_mutations(fn)
+            if not mutations:
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Try):
+                    continue
+                for handler in node.handlers:
+                    # Mutations lexically before this handler (earlier
+                    # statements or the try body it guards) are at
+                    # stake; later ones never ran when it fires.
+                    if not any(ln < handler.lineno for ln in mutations):
+                        continue
+                    if _handler_has_unwind(handler):
+                        continue
+                    findings.append(Finding(
+                        rule="R7", path=module.relpath,
+                        line=handler.lineno, col=handler.col_offset,
+                        message=f"except path in {fn.name}() swallows "
+                                "an error after mutating driver state "
+                                "with no paired unwind/rollback "
+                                "(compensate, call *_unwind_*, or "
+                                "re-raise — SURVEY §9)"))
+        return iter(findings)
+
+
+# ---------------------------------------------------------------------------
+# R8: no success externalization before the terminal durable store
+# ---------------------------------------------------------------------------
+
+def _is_terminal_store(node: ast.Call) -> bool:
+    chain = attr_chain(node.func)
+    if not chain:
+        return False
+    if chain[-1] in ("fdatasync", "fsync"):
+        return True
+    return (chain[-1] in ("store", "store_batch")
+            and any("ckpt" in _norm(c) or "checkpoint" in _norm(c)
+                    for c in chain[:-1]))
+
+
+def _is_checkpoint_mutation(node: ast.AST) -> Optional[int]:
+    """Line of a mutation of checkpoint state (component named
+    *checkpoint* or ``claims``), else None."""
+    def matches(target: ast.AST) -> bool:
+        if not isinstance(target, (ast.Attribute, ast.Subscript)):
+            return False
+        chain = attr_chain(target)
+        return any("checkpoint" in _norm(c) or _norm(c) == "claims"
+                   for c in chain)
+
+    targets = []
+    if isinstance(node, ast.Assign):
+        targets = node.targets
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        targets = [node.target]
+    elif isinstance(node, ast.Delete):
+        targets = node.targets
+    elif (isinstance(node, ast.Call)
+          and isinstance(node.func, ast.Attribute)
+          and node.func.attr in _STATE_MUTATORS
+          and matches(node.func.value)):
+        return node.lineno
+    for t in targets:
+        if matches(t):
+            return t.lineno
+    return None
+
+
+def _success_externalizations(fn) -> List[Tuple[int, str]]:
+    """(line, what) of success externalization points: a success
+    PrepareResult filled into a result map (no ``error`` kwarg), or a
+    success-metric ``.inc()``."""
+    out: List[Tuple[int, str]] = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            val = node.value
+            if (isinstance(val, ast.Call)
+                    and attr_chain(val.func)[-1:] == ["PrepareResult"]
+                    and not any(kw.arg == "error" for kw in val.keywords)
+                    and any(isinstance(t, ast.Subscript)
+                            for t in node.targets)):
+                out.append((node.lineno, "success PrepareResult fill"))
+        elif (isinstance(node, ast.Call)
+              and isinstance(node.func, ast.Attribute)
+              and node.func.attr == "inc"
+              and any("success" in _norm(c)
+                      for c in attr_chain(node.func.value))):
+            out.append((node.lineno, "success-counter inc"))
+    return out
+
+
+@register
+class NoSuccessBeforeTerminalStore(Rule):
+    """R8: no success externalization — a success RPC-result fill, a
+    success-metric increment — lexically between a checkpoint mutation
+    and the terminal ``store``/``fdatasync`` that persists it. Anyone
+    observing the success (kubelet starting a container, a dashboard)
+    would be ahead of disk: a crash in that window un-happens what was
+    already announced. The durable-ordering rule drmc checks
+    dynamically (crash enumeration), stated lexically."""
+
+    rule_id = "R8"
+    title = "no success externalization before the terminal store"
+
+    def scan(self, module: Module, ctx: ProjectContext) -> Iterator[Finding]:
+        if module.is_test or module.is_chaos:
+            return iter(())
+        findings: List[Finding] = []
+        for fn in ast.walk(module.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            stores = [n.lineno for n in ast.walk(fn)
+                      if isinstance(n, ast.Call) and _is_terminal_store(n)]
+            if not stores:
+                continue
+            mutations = [ln for n in ast.walk(fn)
+                         for ln in [_is_checkpoint_mutation(n)]
+                         if ln is not None]
+            if not mutations:
+                continue
+            for line, what in _success_externalizations(fn):
+                if (any(m < line for m in mutations)
+                        and any(s > line for s in stores)):
+                    findings.append(Finding(
+                        rule="R8", path=module.relpath, line=line, col=0,
+                        message=f"{what} in {fn.name}() after a "
+                                "checkpoint mutation but before the "
+                                "terminal store — success must not be "
+                                "externalized until the state backing "
+                                "it is durable (SURVEY §13)"))
+        return iter(findings)
+
+
+# ---------------------------------------------------------------------------
 # Site-coverage report (informational; hack/lint.sh --sites-report)
 # ---------------------------------------------------------------------------
 
@@ -668,9 +911,11 @@ def site_coverage(report_rule: FaultSiteRegistry,
     modules (dynamic arms via site tuples), matching what R4 accepts."""
     out = []
     for site in sorted(ctx.fault_sites):
-        guards = [f"{u.path}:{u.line}" for u in report_rule.uses
-                  if u.site == site and u.kind == "guard"]
-        arms = [f"{u.path}:{u.line}" for u in report_rule.uses
-                if u.site == site and u.kind in ("arm", "literal")]
+        # Sorted: the collection order differs between fresh scans and
+        # cache-replayed facts; the table must not.
+        guards = sorted(f"{u.path}:{u.line}" for u in report_rule.uses
+                        if u.site == site and u.kind == "guard")
+        arms = sorted({f"{u.path}:{u.line}" for u in report_rule.uses
+                       if u.site == site and u.kind in ("arm", "literal")})
         out.append((site, guards, arms))
     return out

@@ -27,6 +27,7 @@ import json
 import os
 from typing import Dict, List, Optional
 
+from tpu_dra.infra import vfs
 from tpu_dra.infra.faults import FAULTS
 from tpu_dra.native.tpuinfo import Chip
 
@@ -151,6 +152,13 @@ class CDIHandler:
         env back from the spec exactly the way containerd would."""
         return self._claim_spec_path(claim_uid)
 
+    def claim_spec_exists(self, claim_uid: str) -> bool:
+        """Idempotency guard for the prepare fast path: a crash can lose
+        the spec's (never-synced) rename while the checkpoint already
+        shows PrepareCompleted — found by drmc's crash enumerator; the
+        fast path must re-apply, not vouch for a file that is gone."""
+        return os.path.exists(self._claim_spec_path(claim_uid))
+
     def list_claim_uids(self) -> List[str]:
         """UIDs of all transient per-claim specs currently on disk (startup
         orphan GC: a crash between a prepare's CDI write and its checkpoint
@@ -165,7 +173,7 @@ class CDIHandler:
 
     def delete_claim_spec_file(self, claim_uid: str) -> None:
         try:
-            os.unlink(self._claim_spec_path(claim_uid))
+            vfs.unlink(self._claim_spec_path(claim_uid))
         except FileNotFoundError:
             pass
 
@@ -175,10 +183,15 @@ class CDIHandler:
 
 
 def _atomic_write_json(path: str, doc: Dict) -> None:
+    # Through the vfs seam: a CDI spec write is part of the durability
+    # contract (orphan GC reconciles a spec whose claim never committed),
+    # so drmc's crash enumerator must see both the tmp write and the
+    # rename as distinct crash points — a rename without a directory
+    # sync is exactly the kind of "maybe persisted" op recovery must
+    # tolerate in either outcome.
     tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(doc, f, indent=2, sort_keys=True)
-    os.replace(tmp, path)
+    vfs.write_text(tmp, json.dumps(doc, indent=2, sort_keys=True))
+    vfs.replace(tmp, path)
 
 
 def visible_chips_env(chip_indices: List[int]) -> Dict[str, str]:

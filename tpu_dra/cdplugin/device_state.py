@@ -91,7 +91,14 @@ class DeviceState:
         uid = claim["metadata"]["uid"]
         with self._lock:
             existing = self._checkpoint.claims.get(uid)
-            if existing is not None and existing.state == PREPARE_COMPLETED:
+            if existing is not None and existing.state == PREPARE_COMPLETED \
+                    and self._cdi.claim_spec_exists(uid):
+                # Same gate as tpuplugin's fast path (drmc crash
+                # enumeration, SURVEY §13): a crash can persist the
+                # terminal checkpoint sync yet lose the claim spec's
+                # never-synced rename — vouching for the vanished file
+                # would fail container creation forever. Fall through
+                # and re-run the prepare (idempotent) to rewrite it.
                 return PrepareResult(devices=[
                     self._rehydrate(r) for r in existing.devices])
 

@@ -16,6 +16,8 @@ import threading
 import time
 from typing import Optional
 
+from tpu_dra.infra import vfs
+
 
 class FlockTimeout(TimeoutError):
     pass
@@ -44,15 +46,25 @@ class Flock:
             while True:
                 if cancel is not None and cancel.is_set():
                     raise FlockTimeout(f"lock acquisition on {self._path} cancelled")
-                fd = os.open(self._path, os.O_CREAT | os.O_RDWR, 0o644)
+                fd = vfs.open_fd(self._path, os.O_CREAT | os.O_RDWR, 0o644)
                 try:
-                    fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                    # Through the vfs seam: the enumerator treats the
+                    # acquire as a crash point — an flock dies with its
+                    # holder, so recovery must simply re-acquire.
+                    vfs.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
                     self._fd = fd
                     return
                 except OSError as e:
-                    os.close(fd)
+                    vfs.close_fd(fd)
                     if e.errno not in (errno.EAGAIN, errno.EACCES):
                         raise
+                except BaseException:
+                    # A simulated crash (drmc CrashPoint) fired inside
+                    # the flock syscall seam: close the fd — process
+                    # death would have — or the exclusive lock leaks
+                    # into the long-lived harness process.
+                    vfs.close_fd(fd)
+                    raise
                 if time.monotonic() >= deadline:
                     raise FlockTimeout(
                         f"flock on {self._path} not acquired within {timeout}s")
@@ -62,13 +74,19 @@ class Flock:
             raise
 
     def release(self) -> None:
-        if self._fd is not None:
-            try:
-                fcntl.flock(self._fd, fcntl.LOCK_UN)
-            finally:
-                os.close(self._fd)
-                self._fd = None
-        self._tlock.release()
+        # Nested finally: the unlock op can raise through the vfs seam
+        # (drmc crash point on LOCK_UN) — the fd close and the
+        # in-process serializer release must both still happen, or the
+        # next acquire on this instance wedges on _tlock.
+        try:
+            if self._fd is not None:
+                try:
+                    vfs.flock(self._fd, fcntl.LOCK_UN)
+                finally:
+                    vfs.close_fd(self._fd)
+                    self._fd = None
+        finally:
+            self._tlock.release()
 
     def __enter__(self) -> "Flock":
         self.acquire()

@@ -271,6 +271,34 @@ WITNESS = LockWitness()
 
 
 # ---------------------------------------------------------------------------
+# Yield-point hook (drmc's controlled-scheduler seam)
+# ---------------------------------------------------------------------------
+# The witness's instrumentation points double as the deterministic model
+# checker's yield points (tpu_dra/analysis/drmc): when a hook is set,
+# every witnessed acquire/release first reports to it. The hook decides
+# whether the calling thread is under controlled scheduling — for a
+# controlled thread, "lock.acquire" BLOCKS until the cooperative
+# scheduler grants the op (and guarantees the lock is model-free, so
+# the real acquire below cannot block); uncontrolled threads pass
+# through untouched. Events:
+#   lock.acquire  — before the real acquire (the schedulable point)
+#   lock.acquired — after a successful acquire (model bookkeeping only)
+#   lock.release  — before the real release (model-release on grant)
+
+_yield_hook = None
+
+
+def set_yield_hook(fn) -> None:
+    global _yield_hook
+    _yield_hook = fn
+
+
+def clear_yield_hook() -> None:
+    global _yield_hook
+    _yield_hook = None
+
+
+# ---------------------------------------------------------------------------
 # Instrumented locks
 # ---------------------------------------------------------------------------
 
@@ -284,12 +312,20 @@ class _WitnessBase:
         self._key = key
 
     def acquire(self, blocking: bool = True, timeout: float = -1):
+        hook = _yield_hook
+        if hook is not None:
+            hook("lock.acquire", self._key, id(self), blocking)
         ok = self._inner.acquire(blocking, timeout)
         if ok:
+            if hook is not None:
+                hook("lock.acquired", self._key, id(self), blocking)
             WITNESS.acquired(self._key, id(self))
         return ok
 
     def release(self) -> None:
+        hook = _yield_hook
+        if hook is not None:
+            hook("lock.release", self._key, id(self), True)
         self._inner.release()
         WITNESS.released(self._key, id(self))
 
@@ -318,6 +354,12 @@ class WitnessRLock(_WitnessBase):
         return self._inner._is_owned()
 
     def _release_save(self):
+        hook = _yield_hook
+        if hook is not None:
+            # Full-depth release (cond.wait entry): the model must drop
+            # the whole ownership or a controlled sibling could never
+            # acquire past the "held" entry of a parked waiter.
+            hook("lock.release_save", self._key, id(self), True)
         state = self._inner._release_save()
         # The inner RLock is now FULLY released whatever the recursion
         # depth: close the hold window entirely, or a reentrant
@@ -326,8 +368,13 @@ class WitnessRLock(_WitnessBase):
         return (state, depth)
 
     def _acquire_restore(self, state) -> None:
+        hook = _yield_hook
+        if hook is not None:
+            hook("lock.acquire", self._key, id(self), True)
         inner_state, depth = state
         self._inner._acquire_restore(inner_state)
+        if hook is not None:
+            hook("lock.acquired", self._key, id(self), True)
         WITNESS.force_acquire(self._key, id(self), depth)
 
 

@@ -188,6 +188,10 @@ METRICS_CATALOG: Dict[str, str] = {
     "tpu_dra_topo_allocations": "infra/metrics.py",
     "tpu_dra_topo_score_seconds": "infra/metrics.py",
     "tpu_dra_topo_free_cuboid_chips": "infra/metrics.py",
+    # infra/metrics.py — drmc model-checker exploration stats (consumed
+    # by hack/drmc.sh gates; labeled by scenario)
+    "tpu_dra_drmc_schedules_total": "infra/metrics.py",
+    "tpu_dra_drmc_crashpoints_total": "infra/metrics.py",
 }
 
 
@@ -305,6 +309,21 @@ TOPO_FREE_CUBOID = DefaultRegistry.histogram(
     "largest free cuboid (chips) remaining on the node after each "
     "topology-scored placement — the fragmentation observable",
     buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256))
+
+# -- drmc deterministic model checker (tpu_dra/analysis/drmc, SURVEY
+# §13): exploration volume counters the hack/drmc.sh gate asserts on —
+# defined here (not in the analysis package) for the same reason as the
+# scheduler instruments above: the bench/CI tier reads them
+# cross-process and the catalog is their one canonical home. ----------------
+
+DRMC_SCHEDULES = DefaultRegistry.counter(
+    "tpu_dra_drmc_schedules_total",
+    "controlled-scheduler interleavings executed by the drmc explorer, "
+    "labeled by scenario")
+DRMC_CRASHPOINTS = DefaultRegistry.counter(
+    "tpu_dra_drmc_crashpoints_total",
+    "crash-point variants (post-op, torn, all-persisted) enumerated and "
+    "recovered by the drmc crash engine, labeled by scenario")
 
 
 class Timer:

@@ -25,6 +25,31 @@ from typing import Any, Callable, Dict, Optional
 
 
 # ---------------------------------------------------------------------------
+# drmc seam (tpu_dra/analysis/drmc): deterministic-scheduler hooks
+# ---------------------------------------------------------------------------
+# When installed, the model checker virtualizes the queue's condition
+# variable for threads under controlled scheduling — wait() parks the
+# task in the scheduler's model (a timed wait can always wake, so a
+# waiting task stays schedulable as a timeout when nothing else can
+# run) and notify() wakes modeled waiters — and sees enqueue/pop as
+# yield points carrying the item key (the DPOR conflict label).
+# Uncontrolled threads fall through to the real Condition, so a live
+# process with a checker installed elsewhere keeps working.
+
+_drmc = None
+
+
+def set_drmc_hooks(hooks) -> None:
+    global _drmc
+    _drmc = hooks
+
+
+def clear_drmc_hooks() -> None:
+    global _drmc
+    _drmc = None
+
+
+# ---------------------------------------------------------------------------
 # Rate limiters
 # ---------------------------------------------------------------------------
 
@@ -179,7 +204,13 @@ class WorkQueue:
         self._rl = rate_limiter or default_controller_rate_limiter()
         self._heap: list = []  # (ready_at, seq, WorkItem)
         self._seq = itertools.count()
-        self._cond = threading.Condition()
+        # Condition over an EXPLICIT Lock (not the default RLock the
+        # Condition would allocate inside threading's own frame): a lock
+        # created here, in tpu_dra code, is witnessable — the lock-order
+        # witness sees the queue's critical sections and drmc can model
+        # them. The queue never re-enters its own condition, so a plain
+        # Lock is sufficient.
+        self._cond = threading.Condition(threading.Lock())
         self._active_ops: Dict[str, WorkItem] = {}
         # key -> number of items still queued (in the heap, not yet
         # popped); backs dedupe=True below.
@@ -207,6 +238,7 @@ class WorkQueue:
         capacity-freed events all nudging the same pending pods)
         collapses to one queued item per key instead of N."""
         with self._cond:
+            self._yield_op("queue.add", key)
             if dedupe and key and self._queued_keys.get(key, 0) > 0:
                 return
             item = WorkItem(key=key, obj=obj, callback=callback)
@@ -215,12 +247,34 @@ class WorkQueue:
                 item.counted = True
                 self._queued_keys[key] = self._queued_keys.get(key, 0) + 1
             self._push_locked(item, after=after)
-            self._cond.notify()
+            self._notify()
 
     def _push_locked(self, item: WorkItem,
                      after: Optional[float] = None) -> None:
         delay = self._rl.when(item.item_id) if after is None else after
         heapq.heappush(self._heap, (time.monotonic() + delay, next(self._seq), item))
+
+    # -- drmc indirections ---------------------------------------------------
+
+    def _yield_op(self, kind: str, key: str) -> None:
+        hooks = _drmc
+        if hooks is not None:
+            hooks.yield_op(kind, key)
+
+    def _notify(self, all_waiters: bool = False) -> None:
+        hooks = _drmc
+        if hooks is not None and hooks.notify(self._cond, all_waiters):
+            return
+        if all_waiters:
+            self._cond.notify_all()
+        else:
+            self._cond.notify()
+
+    def _wait(self, timeout: float) -> None:
+        hooks = _drmc
+        if hooks is not None and hooks.wait(self._cond, timeout):
+            return
+        self._cond.wait(timeout=timeout)
 
     # -- consumer -----------------------------------------------------------
 
@@ -240,7 +294,7 @@ class WorkQueue:
     def shutdown(self) -> None:
         with self._cond:
             self._shutdown = True
-            self._cond.notify_all()
+            self._notify(all_waiters=True)
 
     def _get(self, stop_event: Optional[threading.Event]) -> Optional[WorkItem]:
         with self._cond:
@@ -252,6 +306,7 @@ class WorkQueue:
                     now = time.monotonic()
                     if ready_at <= now:
                         heapq.heappop(self._heap)
+                        self._yield_op("queue.get", item.key)
                         if item.key and item.counted:
                             item.counted = False  # a retry re-push stays
                             #   uncounted: dedupe must not absorb into it
@@ -261,9 +316,9 @@ class WorkQueue:
                             else:
                                 self._queued_keys.pop(item.key, None)
                         return item
-                    self._cond.wait(timeout=min(ready_at - now, 0.5))
+                    self._wait(min(ready_at - now, 0.5))
                 else:
-                    self._cond.wait(timeout=0.5)
+                    self._wait(0.5)
 
     def _process(self, item: WorkItem) -> None:
         attempts = self._rl.num_requeues(item.item_id)
@@ -285,7 +340,7 @@ class WorkQueue:
                     self._rl.forget(item.item_id)
                 else:
                     self._push_locked(item)
-                    self._cond.notify()
+                    self._notify()
             return
         with self._cond:
             if item.key and self._active_ops.get(item.key) is item:

@@ -566,10 +566,12 @@ SCHED_CHAOS_SITES = ("k8s.api.request", "k8s.watch.drop",
                      "sched.watch_event", "sched.index_apply")
 
 
-def _chip_conflicts(claims: List[Dict]) -> List[str]:
+def chip_conflicts(claims: List[Dict]) -> List[str]:
     """Device double-allocations across allocated claims, with partition
     semantics: the same device twice, or a whole chip plus any of its
-    subslices, in DIFFERENT claims."""
+    subslices, in DIFFERENT claims. Public: the drmc model checker
+    asserts it at every explored terminal state (analysis/drmc), the
+    scheduler chaos walk at quiesce."""
     from tpu_dra.simcluster.scheduler import (
         _parent_of, claim_entries, claim_key,
     )
@@ -780,7 +782,7 @@ class SchedulerChaosHarness:
         v.extend(problems)
         # Hard invariants, on cluster truth after convergence:
         claims = self.cluster.list(RESOURCECLAIMS, namespace="default")
-        v.extend(_chip_conflicts(claims))
+        v.extend(chip_conflicts(claims))
         v.extend(self.sched.verify_index())
         # Lock-order witness over the event-driven control plane: the
         # walk's informer/workqueue/worker interleavings must leave an

@@ -22,6 +22,7 @@ import zlib
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from tpu_dra.infra import vfs
 from tpu_dra.infra.faults import FAULTS
 
 PREPARE_STARTED = "PrepareStarted"
@@ -176,7 +177,7 @@ class CheckpointManager:
     def close(self) -> None:
         for fd in self._fds.values():
             try:
-                os.close(fd)
+                vfs.close_fd(fd)
             except OSError:
                 pass
         self._fds.clear()
@@ -187,7 +188,7 @@ class CheckpointManager:
         fd = self._fds.get(path)
         if fd is None:
             existed = os.path.exists(path)
-            fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o600)
+            fd = vfs.open_fd(path, os.O_RDWR | os.O_CREAT, 0o600)
             self._fds[path] = fd
             self._sizes[path] = os.fstat(fd).st_size
             if not existed:
@@ -195,26 +196,22 @@ class CheckpointManager:
                 # inode data, not the directory entry — without this a
                 # post-crash reboot can show no file at all, losing the
                 # store-before-side-effects guarantee. Once per file.
-                dfd = os.open(os.path.dirname(path) or ".", os.O_RDONLY)
-                try:
-                    os.fsync(dfd)
-                finally:
-                    os.close(dfd)
+                vfs.fsync_dir(os.path.dirname(path))
         off = 0
         while off < len(padded):  # POSIX permits short writes
-            n = os.pwrite(fd, padded[off:], off)
+            n = vfs.pwrite(fd, padded[off:], off)
             if n <= 0:
                 raise CheckpointError(f"short write to {path} at {off}")
             off += n
         if self._sizes[path] != len(padded):
-            os.ftruncate(fd, len(padded))
+            vfs.ftruncate(fd, len(padded))
             self._sizes[path] = len(padded)
         # Data-only sync: the durability point for the claim state machine
         # (store-before-side-effects). fdatasync is POSIX-but-not-macOS;
         # fall back to fsync there. sync=False callers (the terminal
         # store's side-slot copy) get durability from a later synced slot.
         if sync:
-            getattr(os, "fdatasync", os.fsync)(fd)
+            vfs.fdatasync(fd)
             self.slot_syncs += 1
 
     def store(self, cp: Checkpoint, version: str = "v2",

@@ -253,7 +253,17 @@ class DeviceState:
                     continue  # duplicate uid in one RPC: one result
                 existing = self._checkpoint.claims.get(uid)
                 if existing is not None and \
-                        existing.state == PREPARE_COMPLETED:
+                        existing.state == PREPARE_COMPLETED and \
+                        self._cdi.claim_spec_exists(uid):
+                    # Idempotent fast path — but only while the claim
+                    # CDI spec is actually on disk. A crash can persist
+                    # the terminal checkpoint sync yet lose the spec's
+                    # never-synced rename (drmc crash point: every
+                    # clean-image crash past the fdatasync); vouching
+                    # for the lost file would hand kubelet CDI ids that
+                    # fail container creation. Fall through instead:
+                    # the full pipeline re-applies side effects
+                    # idempotently and rewrites the spec.
                     results[uid] = PrepareResult(devices=[
                         _prepared_device_from_record(r)
                         for r in existing.devices])
@@ -385,9 +395,15 @@ class DeviceState:
                         deferred[b.uid] = str(e2)
                     try:
                         self._ckpt_mgr.store(self._checkpoint)
-                    except Exception:  # noqa: BLE001 — the durable
-                        # intent record (if hazardous) still names the
-                        # members' chips for the next start's recovery.
+                    # Deliberate R7 waiver: every member was already
+                    # degraded to a deferred PrepareStarted record just
+                    # above (the compensation), and this is the RETRY of
+                    # the rollback store itself failing — nothing is
+                    # left to unwind; the durable intent record (if
+                    # hazardous) still names the members' chips for the
+                    # next start's recovery.
+                    # dralint: ignore[R7]
+                    except Exception:  # noqa: BLE001
                         log.warning("failed-batch record store failed",
                                     exc_info=True)
             batch_timings["checkpoint_final"] = time.perf_counter() - t0
