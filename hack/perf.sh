@@ -6,12 +6,20 @@
 #
 # 1. The group-commit tripwire tests (tests/test_batch_prepare.py): a
 #    batched prepare/unprepare of N claims must land exactly ONE
-#    terminal checkpoint store / device sync (asserted against the
-#    CheckpointManager store counters) — N syncs means the group commit
-#    silently degraded back to per-claim commits.
-# 2. A quick claim-to-ready probe through the real gRPC path (single
-#    claim p50 + batched per-claim p50 on a fake 4-chip v5p inventory),
-#    printed as one JSON line for eyeballing against BENCH_r*.json.
+#    terminal journal append / group sync (asserted against the
+#    CheckpointManager journal counters) — N appends means the group
+#    commit silently degraded back to per-claim commits.
+# 2. A claim-to-ready probe through the real gRPC path (single claim
+#    p50 + batched per-claim p50 on a fake 4-chip v5p inventory +
+#    batch-64 on a 64-chip one), printed as one JSON line for
+#    eyeballing against BENCH_r*.json — plus the ISSUE 7 gates:
+#    concurrent RPC load must coalesce journal fdatasyncs (group syncs
+#    strictly below group commits), single-claim p50 under
+#    PERF_P50_GATE_MS (default 1.6, noise-padded: measured ~1.1-1.4
+#    here vs ~1.4-1.5 pre-journal; the Python-gRPC unix-socket
+#    round-trip alone floors ~0.4-0.6ms of it), batch-64 per-claim
+#    under PERF_BATCH64_GATE_MS (default 0.3; quiet-hardware target
+#    0.2).
 # 3. Scheduler churn gates on the fake backend (SCHED_NODES x
 #    SCHED_PODS, defaults 100x500): steady-state full relists MUST be 0
 #    (event-driven, not poll-and-scan), CEL compiles MUST not exceed
@@ -26,12 +34,17 @@ echo ">> group-commit tripwire (one terminal sync per batch)"
 JAX_PLATFORMS=cpu python -m pytest "$REPO_ROOT/tests/test_batch_prepare.py" \
   -q -p no:cacheprovider
 
-echo ">> claim-to-ready probe (${CYCLES} cycles, fake v5p 4-chip)"
+echo ">> claim-to-ready probe (${CYCLES} cycles, fake v5p 4-chip + batch-64 + concurrent load)"
 cd "$REPO_ROOT"
-JAX_PLATFORMS=cpu TPU_DRA_TPUINFO_BACKEND=fake python - "$CYCLES" <<'EOF'
+JAX_PLATFORMS=cpu TPU_DRA_TPUINFO_BACKEND=fake \
+  PERF_P50_GATE_MS="${PERF_P50_GATE_MS:-1.6}" \
+  PERF_BATCH64_GATE_MS="${PERF_BATCH64_GATE_MS:-0.3}" \
+  python - "$CYCLES" <<'EOF'
 import json
+import os
 import statistics
 import sys
+import threading
 
 from tpu_dra.native.tpuinfo import FakeBackend, default_fake_chips
 
@@ -49,21 +62,75 @@ try:
     p50_batch = statistics.median(sorted(
         bd.batch_cycle(f"b{i}", 4, breakdown=breakdown)
         for i in range(n)))
+    ck = bd.state._ckpt_mgr
+    # Cross-RPC group-commit amortization (ISSUE 7): concurrent RPC
+    # load MUST coalesce journal fdatasyncs — group syncs strictly
+    # below appends, or the cross-RPC group commit silently degraded
+    # to a sync per RPC. Coalescing is opportunistic (a follower must
+    # reach the barrier while the leader's fdatasync is in flight), so
+    # on very fast storage a single round can legitimately sync every
+    # append alone — retry up to 5 rounds and gate on the cumulative
+    # counts; a real degradation never coalesces.
+    a0, g0 = ck.journal_appends, ck.journal_group_syncs
+
+    def load_worker(i):
+        for j in range(max(10, n // 2)):
+            bd.cycle(f"load-{i}-{j}")
+
+    appends = group_syncs = 0
+    for round_no in range(1, 6):
+        threads = [threading.Thread(target=load_worker, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        appends = ck.journal_appends - a0
+        group_syncs = ck.journal_group_syncs - g0
+        if group_syncs < appends:
+            break
     out = {
         "claim_to_ready_p50_1chip_ms": round(p50_one, 3),
         "claim_to_ready_p50_batch_per_claim_ms": round(p50_batch, 3),
         "batch_amortization_x": round(p50_one / p50_batch, 2),
-        "terminal_stores": bd.state._ckpt_mgr.terminal_stores,
-        "slot_syncs": bd.state._ckpt_mgr.slot_syncs,
+        "journal_appends_concurrent": appends,
+        "journal_group_syncs_concurrent": group_syncs,
+        "slot_syncs": ck.slot_syncs,
+        "journal_compactions": ck.journal_compactions,
     }
     for k, vals in sorted(breakdown.items()):
         if k != "n_claims":
             out[f"batch_{k}_ms"] = round(statistics.median(vals), 4)
 finally:
     bd.close()
+
+# Batch-64 (ISSUE 7 acceptance: <= 0.2 ms/claim on quiet hardware; the
+# gate default carries headroom for CI noise — tune PERF_BATCH64_GATE_MS).
+bd64 = bench._BenchDriver(FakeBackend(default_fake_chips(64, "v5p")),
+                          prefix="tpu-dra-perf64-")
+try:
+    bd64.batch_cycle("warm", 64)
+    p50_b64 = statistics.median(sorted(
+        bd64.batch_cycle(f"b{i}", 64) for i in range(max(10, n // 3))))
+    out["claim_to_ready_p50_batch64_per_claim_ms"] = round(p50_b64, 4)
+finally:
+    bd64.close()
 print(json.dumps(out))
+
 if p50_batch >= p50_one:
     sys.exit("REGRESSION: batched per-claim p50 not below single-claim p50")
+if group_syncs >= appends:
+    sys.exit(f"REGRESSION: {group_syncs} journal group syncs for "
+             f"{appends} concurrent group commits — the cross-RPC "
+             "group commit is not coalescing fdatasyncs")
+gate = float(os.environ["PERF_P50_GATE_MS"])
+if p50_one > gate:
+    sys.exit(f"REGRESSION: claim_to_ready_p50_1chip_ms {p50_one:.3f} > "
+             f"{gate} (PERF_P50_GATE_MS)")
+gate64 = float(os.environ["PERF_BATCH64_GATE_MS"])
+if p50_b64 > gate64:
+    sys.exit(f"REGRESSION: claim_to_ready_p50_batch64_per_claim_ms "
+             f"{p50_b64:.4f} > {gate64} (PERF_BATCH64_GATE_MS)")
 EOF
 
 echo ">> CEL compile-cache tripwire tests"

@@ -93,36 +93,43 @@ class TestBatchPrepare:
 
 class TestGroupCommit:
     """The regression tripwire (hack/perf.sh): a batch of N claims lands
-    exactly ONE terminal checkpoint store / device sync — N syncs means
-    the group commit silently degraded to per-claim commits."""
+    exactly ONE terminal journal append and AT MOST one group sync — N
+    appends/syncs means the group commit silently degraded to per-claim
+    commits, and ANY slot sync on the hot path means the journal
+    degraded back to full-image stores."""
 
-    def test_batch_prepare_one_terminal_sync(self, harness):  # noqa: F811
+    def test_batch_prepare_one_append_one_sync(self, harness):  # noqa: F811
         ckpt = harness["ckpt"]
         objs = make_batch(harness, 4)
-        t0, s0 = ckpt.terminal_stores, ckpt.slot_syncs
+        a0, g0, s0 = (ckpt.journal_appends, ckpt.journal_group_syncs,
+                      ckpt.slot_syncs)
         resp = batch_prepare(harness, objs)
         assert all(resp[o["metadata"]["uid"]].error == "" for o in objs)
-        # Default configs are non-hazardous: no intent store, so the
-        # whole 4-claim batch costs exactly 1 terminal store = 1 sync.
-        assert ckpt.terminal_stores - t0 == 1
-        assert ckpt.slot_syncs - s0 == 1
+        # Default configs are non-hazardous: no intent record, so the
+        # whole 4-claim batch costs exactly 1 journal append = 1 sync,
+        # and the slot files are never touched (no compaction due).
+        assert ckpt.journal_appends - a0 == 1
+        assert ckpt.journal_group_syncs - g0 == 1
+        assert ckpt.slot_syncs - s0 == 0
 
-    def test_batch_unprepare_one_terminal_sync(self, harness):  # noqa: F811
+    def test_batch_unprepare_one_append_one_sync(self, harness):  # noqa: F811
         ckpt = harness["ckpt"]
         objs = make_batch(harness, 4)
         batch_prepare(harness, objs)
-        t0, s0 = ckpt.terminal_stores, ckpt.slot_syncs
+        a0, g0, s0 = (ckpt.journal_appends, ckpt.journal_group_syncs,
+                      ckpt.slot_syncs)
         resp = batch_unprepare(harness, objs)
         for obj in objs:
             assert resp[obj["metadata"]["uid"]].error == ""
-        assert ckpt.terminal_stores - t0 == 1
-        assert ckpt.slot_syncs - s0 == 1
+        assert ckpt.journal_appends - a0 == 1
+        assert ckpt.journal_group_syncs - g0 == 1
+        assert ckpt.slot_syncs - s0 == 0
         assert harness["state"].prepared_claim_uids() == []
 
     def test_hazardous_batch_one_intent_one_terminal(self, harness):  # noqa: F811
-        """Hazardous members share ONE durable intent store covering all
-        of them, then the batch's one terminal store: 2 syncs total for
-        the whole batch, not 2 per claim."""
+        """Hazardous members share ONE durable intent record covering
+        all of them, then the batch's one terminal record: 2 appends /
+        2 syncs total for the whole batch, not 2 per claim."""
         featuregates.Features.set_from_string("MultiprocessSupport=true")
         cluster = harness["cluster"]
 
@@ -140,11 +147,11 @@ class TestGroupCommit:
         objs = [make_claim(cluster, [f"chip-{i}"], configs=[mp])
                 for i in range(3)]
         ckpt = harness["ckpt"]
-        n0, s0 = ckpt.stores, ckpt.slot_syncs
+        a0, g0 = ckpt.journal_appends, ckpt.journal_group_syncs
         resp = batch_prepare(harness, objs)
         assert all(resp[o["metadata"]["uid"]].error == "" for o in objs)
-        assert ckpt.stores - n0 == 2      # one intent + one terminal
-        assert ckpt.slot_syncs - s0 == 2
+        assert ckpt.journal_appends - a0 == 2   # one intent + one terminal
+        assert ckpt.journal_group_syncs - g0 == 2
 
     def test_store_batch_refuses_inconsistent_commit(self, tmp_path):
         """The group-commit seam's postcondition check: memory running
@@ -268,6 +275,256 @@ class TestBatchUnprepareStoreFailure:
             assert resp2[uid].error == ""
         assert harness["state"].prepared_claim_uids() == []
 
+    def test_device_unwind_runs_outside_global_lock(self, harness):  # noqa: F811
+        """The unprepare device unwind waits on hazard/chip locks that a
+        concurrent batch's apply phase can hold for seconds — it must
+        NOT do that waiting under the global state lock, or one slow
+        apply convoys every pipelined RPC's pure phase behind it."""
+        import threading
+        state = harness["state"]
+        objs = make_batch(harness, 1)
+        resp = batch_prepare(harness, objs)
+        assert resp[objs[0]["metadata"]["uid"]].error == ""
+        entered, release = threading.Event(), threading.Event()
+        real_unwind = state._unprepare_devices
+
+        def blocking_unwind(uid, prepared):
+            entered.set()
+            assert release.wait(10)
+            return real_unwind(uid, prepared)
+
+        state._unprepare_devices = blocking_unwind
+        th = threading.Thread(
+            target=lambda: batch_unprepare(harness, objs))
+        th.start()
+        try:
+            assert entered.wait(10)
+            # The global lock must be free while the unwind blocks.
+            assert state._lock.acquire(timeout=2.0), \
+                "device unwind held the global state lock"
+            state._lock.release()
+        finally:
+            state._unprepare_devices = real_unwind
+            release.set()
+            th.join(20)
+        assert harness["state"].prepared_claim_uids() == []
+
+
+class TestJournalRecovery:
+    """ISSUE 7 satellite: the append-only journal's crash contract,
+    unit-tier (drmc's crash enumerator covers the same windows
+    exhaustively on the real pipeline). Torn tails drop, an unsynced
+    append may land on either side of the crash, compaction failure
+    degrades instead of breaking commits, and a faultless replay
+    converges — mirroring PR 2's crash-restart matrix."""
+
+    def _mgr(self, tmp_path, **kw):
+        from tpu_dra.tpuplugin.checkpoint import CheckpointManager
+        return CheckpointManager(str(tmp_path / "cp"), **kw)
+
+    def _commit(self, mgr, cp, **kw):
+        tok = mgr.journal_commit(cp, **kw)
+        mgr.journal_barrier(tok)
+
+    def test_torn_tail_record_dropped(self, tmp_path):
+        from tpu_dra.tpuplugin.checkpoint import PreparedClaim
+        mgr = self._mgr(tmp_path)
+        cp = mgr.load_or_init()
+        cp.claims["a"] = PreparedClaim(uid="a", state=PREPARE_COMPLETED)
+        self._commit(mgr, cp, present=["a"])
+        journal = mgr.path + ".journal"
+        mgr.close()
+        # A crash tears the record being appended: valid JSON prefix,
+        # broken envelope, right at the tail.
+        with open(journal, "r+b") as f:
+            f.seek(0, 2)
+            f.write(b'{"checksum": 123, "torn')
+        mgr2 = self._mgr(tmp_path)
+        cp2 = mgr2.load()
+        assert sorted(cp2.claims) == ["a"]  # tail dropped, 'a' durable
+        # The manager keeps appending over the shredded tail.
+        cp2.claims["b"] = PreparedClaim(uid="b", state=PREPARE_COMPLETED)
+        self._commit(mgr2, cp2, present=["b"])
+        mgr2.close()
+        mgr3 = self._mgr(tmp_path)
+        assert sorted(mgr3.load().claims) == ["a", "b"]
+        mgr3.close()
+
+    def test_crash_between_append_and_group_sync(self, tmp_path):
+        """An appended-but-unsynced record may land on EITHER side of a
+        crash; recovery must accept both images (nothing was
+        externalized before the barrier)."""
+        import shutil
+        from tpu_dra.tpuplugin.checkpoint import PreparedClaim
+        mgr = self._mgr(tmp_path)
+        cp = mgr.load_or_init()
+        cp.claims["a"] = PreparedClaim(uid="a", state=PREPARE_COMPLETED)
+        self._commit(mgr, cp, present=["a"])
+        journal = mgr.path + ".journal"
+        size_before = mgr._journal_tail
+        # Append WITHOUT the barrier: the crash window under test.
+        cp.claims["b"] = PreparedClaim(uid="b", state=PREPARE_COMPLETED)
+        mgr.journal_commit(cp, present=["b"])
+        mgr.close()
+        kept = tmp_path / "kept"
+        shutil.copytree(tmp_path / "cp", kept)
+        # Outcome 1: the record persisted (lucky ceiling).
+        mgr2 = self._mgr(tmp_path)
+        assert sorted(mgr2.load().claims) == ["a", "b"]
+        mgr2.close()
+        # Outcome 2: the record was lost (guaranteed floor) — truncate
+        # back to the synced tail.
+        with open(kept / "checkpoint.json.journal", "r+b") as f:
+            f.truncate(size_before)
+        from tpu_dra.tpuplugin.checkpoint import CheckpointManager
+        mgr3 = CheckpointManager(str(kept))
+        assert sorted(mgr3.load().claims) == ["a"]
+        mgr3.close()
+
+    def test_compaction_failure_degrades_and_recovers(self, tmp_path,
+                                                      monkeypatch):
+        """A failed compaction (swap rename EIO) must not fail the
+        commit it rode on: lag keeps growing, appends keep landing, and
+        the next append past the threshold retries the compaction."""
+        from tpu_dra.infra import vfs
+        from tpu_dra.tpuplugin.checkpoint import PreparedClaim
+        mgr = self._mgr(tmp_path, journal_compact_lag=2)
+        cp = mgr.load_or_init()
+        real_replace = vfs.replace
+        blown = {"n": 0}
+
+        def exploding_replace(src, dst):
+            blown["n"] += 1
+            raise OSError("injected EIO on compaction rename")
+
+        monkeypatch.setattr(vfs, "replace", exploding_replace)
+        for i in range(2):
+            cp.claims[f"u{i}"] = PreparedClaim(uid=f"u{i}",
+                                               state=PREPARE_COMPLETED)
+            self._commit(mgr, cp, present=[f"u{i}"])
+        assert blown["n"] == 1          # compaction attempted and failed
+        assert mgr.journal_lag >= 2     # lag NOT reset
+        assert mgr.journal_compactions == 0
+        monkeypatch.setattr(vfs, "replace", real_replace)
+        cp.claims["u2"] = PreparedClaim(uid="u2", state=PREPARE_COMPLETED)
+        self._commit(mgr, cp, present=["u2"])  # threshold still crossed
+        assert mgr.journal_compactions == 1
+        assert mgr.journal_lag == 0
+        mgr.close()
+        mgr2 = self._mgr(tmp_path)
+        assert sorted(mgr2.load().claims) == ["u0", "u1", "u2"]
+        mgr2.close()
+
+    def test_post_rename_dir_sync_failure_keeps_new_journal(
+            self, tmp_path, monkeypatch):
+        """A compaction whose DIRECTORY sync fails after the rename
+        landed must leave the manager appending to the NEW journal
+        inode (never the unlinked old one) and defer the dir sync to
+        the next group sync's leader — a barrier must not declare
+        post-swap records durable until it lands, and acknowledged
+        commits stay recoverable throughout."""
+        from tpu_dra.infra import vfs
+        from tpu_dra.tpuplugin.checkpoint import PreparedClaim
+        mgr = self._mgr(tmp_path, journal_compact_lag=2)
+        cp = mgr.load_or_init()
+        real_fsync_dir = vfs.fsync_dir
+
+        def failing_fsync_dir(path):
+            raise OSError("injected EIO on journal dir sync")
+
+        cp.claims["a"] = PreparedClaim(uid="a", state=PREPARE_COMPLETED)
+        self._commit(mgr, cp, present=["a"])
+        # Prime the second ping-pong side slot: its first-creation dir
+        # sync must not eat the injection aimed at the journal swap.
+        mgr.store(cp)
+        monkeypatch.setattr(vfs, "fsync_dir", failing_fsync_dir)
+        # Crosses lag=2: compaction runs, the rename lands, the dir
+        # sync fails and is deferred (the commit itself still
+        # succeeds — b is settled by the compaction's slot store).
+        cp.claims["b"] = PreparedClaim(uid="b", state=PREPARE_COMPLETED)
+        self._commit(mgr, cp, present=["b"])
+        assert mgr.journal_compactions == 1
+        assert mgr._dir_dirty
+        # While the dir sync keeps failing, a post-swap record's
+        # barrier must FAIL rather than vouch for durability the
+        # directory cannot deliver.
+        cp.claims["c"] = PreparedClaim(uid="c", state=PREPARE_COMPLETED)
+        tok = mgr.journal_commit(cp, present=["c"])
+        with pytest.raises(OSError):
+            mgr.journal_barrier(tok)
+        # Fault clears: retrying the SAME token completes the deferred
+        # dir sync and the record becomes durable.
+        monkeypatch.setattr(vfs, "fsync_dir", real_fsync_dir)
+        mgr.journal_barrier(tok)
+        assert not mgr._dir_dirty
+        mgr.close()
+        mgr2 = self._mgr(tmp_path)
+        assert sorted(mgr2.load().claims) == ["a", "b", "c"]
+        mgr2.close()
+
+    def test_crash_mid_compaction_replays_consistently(self, tmp_path,
+                                                       monkeypatch):
+        """A crash between the compaction's slot store and the journal
+        swap leaves stale journal records BELOW the slot image's seq —
+        recovery must skip them, not double-apply."""
+        from tpu_dra.infra import vfs
+        from tpu_dra.tpuplugin.checkpoint import PreparedClaim
+
+        def crashing_replace(src, dst):
+            raise KeyboardInterrupt("simulated SIGKILL mid-compaction")
+
+        mgr = self._mgr(tmp_path, journal_compact_lag=2)
+        cp = mgr.load_or_init()
+        cp.claims["a"] = PreparedClaim(uid="a", state=PREPARE_COMPLETED)
+        self._commit(mgr, cp, present=["a"])
+        monkeypatch.setattr(vfs, "replace", crashing_replace)
+        cp.claims["b"] = PreparedClaim(uid="b", state=PREPARE_COMPLETED)
+        with pytest.raises(KeyboardInterrupt):
+            # Crosses the threshold: slot store lands, swap "crashes".
+            mgr.journal_commit(cp, present=["b"])
+        monkeypatch.undo()
+        mgr.close()
+        mgr2 = self._mgr(tmp_path)
+        cp2 = mgr2.load()
+        # The slot image already holds a AND b; the leftover journal
+        # records (seq <= slot seq) must not resurrect stale states.
+        assert sorted(cp2.claims) == ["a", "b"]
+        assert all(c.state == PREPARE_COMPLETED
+                   for c in cp2.claims.values())
+        mgr2.close()
+
+    def test_faultless_replay_converges(self, harness):  # noqa: F811
+        """PR 2's crash-restart matrix shape on the journaled pipeline:
+        prepare a batch, unprepare part of it, 'crash' (rebuild state
+        over the same dirs without shutdown), replay the same RPCs —
+        the final state converges."""
+        objs = make_batch(harness, 4)
+        resp = batch_prepare(harness, objs)
+        assert all(resp[o["metadata"]["uid"]].error == "" for o in objs)
+        gone = objs[:2]
+        resp_u = batch_unprepare(harness, gone)
+        assert all(resp_u[o["metadata"]["uid"]].error == "" for o in gone)
+        state2 = DeviceState(
+            backend=harness["backend"], cdi=harness["cdi"],
+            checkpoints=harness["ckpt"], driver_name=TPU_DRIVER_NAME,
+            node_name="node-a")
+        try:
+            # Replay both RPCs kubelet-style against the rebuilt state.
+            res = state2.prepare_batch(objs)
+            assert all(res[o["metadata"]["uid"]].error is None
+                       or res[o["metadata"]["uid"]].error == ""
+                       for o in objs)
+            errs = state2.unprepare_batch(
+                [o["metadata"]["uid"] for o in gone])
+            assert all(v is None for v in errs.values())
+            final = state2.checkpoint_snapshot()
+            assert set(final.claims) == {o["metadata"]["uid"]
+                                         for o in objs[2:]}
+            for pc in final.claims.values():
+                assert pc.state == PREPARE_COMPLETED
+        finally:
+            state2.close()
+
 
 class TestBatchBreakdown:
     def test_batch_breakdown_recorded(self, harness):  # noqa: F811
@@ -288,5 +545,5 @@ class TestBatchBreakdown:
         assert batch_prepare(harness, [obj])[
             obj["metadata"]["uid"]].error == ""
         assert set(harness["state"].last_prepare_breakdown) == {
-            "decode", "sharing", "guards", "cdi_write",
-            "checkpoint_final", "total"}
+            "decode", "sharing", "guards", "cdi_write", "cdi_io",
+            "cdi_wait", "checkpoint_final", "total"}

@@ -593,6 +593,31 @@ class TestR8SuccessOrdering:
         """, "R8")
         assert out == []
 
+    def test_fires_on_fill_before_journal_barrier(self):
+        # The journaled hot path: journal_commit appends the terminal
+        # record, journal_barrier is its durability point — a success
+        # fill between mutation and the barrier is ahead of disk.
+        out = lint("""
+            class S:
+                def prepare(self, results):
+                    self._checkpoint.claims["u"] = 1
+                    tok = self._ckpt_mgr.journal_commit(self._checkpoint)
+                    results["u"] = PrepareResult(devices=[])
+                    self._ckpt_mgr.journal_barrier(tok)
+        """, "R8")
+        assert rule_ids(out) == ["R8"]
+
+    def test_fill_after_journal_barrier_passes(self):
+        out = lint("""
+            class S:
+                def prepare(self, results):
+                    self._checkpoint.claims["u"] = 1
+                    tok = self._ckpt_mgr.journal_commit(self._checkpoint)
+                    self._ckpt_mgr.journal_barrier(tok)
+                    results["u"] = PrepareResult(devices=[])
+        """, "R8")
+        assert out == []
+
 
 # ---------------------------------------------------------------------------
 # Per-file result cache (ISSUE 6 satellite)

@@ -147,8 +147,9 @@ class TestPrepareBasic:
         bd = harness["state"].last_prepare_breakdown
         # No checkpoint_start: the default (non-hazardous) config skips
         # the durable intent store — its absence IS the fast path.
-        assert set(bd) == {"decode", "sharing", "guards", "cdi_write",
-                           "checkpoint_final", "total"}
+        # cdi_wait is the commit-barrier stall on the async spec write.
+        assert set(bd) == {"decode", "sharing", "guards", "cdi_write", "cdi_io",
+                           "cdi_wait", "checkpoint_final", "total"}
         for phase, ms in bd.items():
             assert 0 <= ms <= bd["total"] + 1e-6, (phase, bd)
         # Idempotent re-prepare takes the completed-claim fast path and
@@ -902,6 +903,16 @@ class TestTimesliceReconciliation:
                 if intent:
                     intent_docs.append(cp.to_v2_doc())
                 super().store(cp, version=version, intent=intent)
+
+            def journal_commit(self, cp, *, present=(), absent=(),
+                               intent=False):
+                # Intent records ride the journal now; the invariant
+                # under test (chips named before side effects) is the
+                # same either way.
+                if intent:
+                    intent_docs.append(cp.to_v2_doc())
+                return super().journal_commit(
+                    cp, present=present, absent=absent, intent=intent)
 
         state = DeviceState(
             backend=backend, cdi=cdi,

@@ -251,6 +251,173 @@ class TestFlock:
             holder.wait()
 
 
+class TestSharedFlock:
+    def test_refcounted_sharing(self, tmp_path):
+        """Concurrent in-process holders share ONE flock acquisition;
+        the file lock is held while any holder remains and released by
+        the last one out (the pipelined server's contract)."""
+        from tpu_dra.infra.flock import SharedFlock
+        shared = SharedFlock(Flock(str(tmp_path / "l"), poll_interval=0.01))
+        shared.acquire()
+        shared.acquire()          # second holder: refcount, no syscall
+        assert shared._refs == 2
+        shared.release()
+        assert shared._refs == 1  # still held
+        # Another process must NOT be able to take the flock now.
+        import fcntl
+        fd = os.open(str(tmp_path / "l"), os.O_RDWR)
+        with pytest.raises(OSError):
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        shared.release()
+        fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)  # now free
+        fcntl.flock(fd, fcntl.LOCK_UN)
+        os.close(fd)
+
+    def test_sustained_sharing_drains_for_other_processes(self, tmp_path):
+        """Fairness: once a continuous shared hold exceeds the bound,
+        new joiners wait for a full release (the handoff window a
+        rolling-upgrade peer process needs) instead of keeping the OS
+        flock pinned forever."""
+        from tpu_dra.infra.flock import SharedFlock
+        shared = SharedFlock(Flock(str(tmp_path / "l"), poll_interval=0.01),
+                             max_shared_hold_s=0.05)
+        shared.acquire()
+        time.sleep(0.1)           # hold runs past the bound
+        joined = threading.Event()
+
+        def late_joiner():
+            shared.acquire(timeout=5.0)   # must drain, not piggyback
+            joined.set()
+            shared.release()
+
+        th = threading.Thread(target=late_joiner)
+        th.start()
+        time.sleep(0.05)
+        assert not joined.is_set()        # parked until full release
+        shared.release()                  # refs -> 0: flock released
+        assert joined.wait(2)             # joiner reacquired fresh
+        th.join()
+        assert shared._refs == 0
+
+    def test_many_threads_share_and_release(self, tmp_path):
+        from tpu_dra.infra.flock import SharedFlock
+        shared = SharedFlock(Flock(str(tmp_path / "l"), poll_interval=0.01))
+        errors = []
+
+        def worker():
+            try:
+                for _ in range(20):
+                    shared.acquire(timeout=5.0)
+                    shared.release()
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert errors == []
+        assert shared._refs == 0
+        shared.acquire()          # still usable after the storm
+        shared.release()
+
+
+class TestRpcPipeline:
+    def test_disjoint_rpcs_overlap(self):
+        from tpu_dra.kubeletplugin.pipeline import RpcPipeline
+        p = RpcPipeline(window=4)
+        t1 = p.admit(["a"])
+        t2 = p.admit(["b"])
+        p.order(t1)
+        p.order(t2)               # no predecessors: returns immediately
+        p.done(t2)
+        p.done(t1)
+
+    def test_same_claim_rpcs_serialize_in_admission_order(self):
+        """Two RPCs touching the same uid never reorder: the second's
+        order() blocks until the first completes."""
+        from tpu_dra.kubeletplugin.pipeline import RpcPipeline
+        p = RpcPipeline(window=4)
+        t1 = p.admit(["u", "v"])
+        t2 = p.admit(["u"])
+        events = []
+        done2 = threading.Event()
+
+        def second():
+            p.order(t2)
+            events.append("second-ran")
+            p.done(t2)
+            done2.set()
+
+        th = threading.Thread(target=second)
+        th.start()
+        time.sleep(0.05)
+        assert events == []       # parked behind t1's gate
+        events.append("first-done")
+        p.done(t1)
+        assert done2.wait(2)
+        th.join()
+        assert events == ["first-done", "second-ran"]
+
+    def test_window_bounds_inflight(self):
+        from tpu_dra.kubeletplugin.pipeline import RpcPipeline
+        p = RpcPipeline(window=2)
+        t1 = p.admit(["a"])
+        t2 = p.admit(["b"])
+        admitted = threading.Event()
+
+        def third():
+            t3 = p.admit(["c"])   # blocks until a slot frees
+            admitted.set()
+            p.done(t3)
+
+        th = threading.Thread(target=third)
+        th.start()
+        time.sleep(0.05)
+        assert not admitted.is_set()
+        p.done(t1)
+        assert admitted.wait(2)
+        th.join()
+        p.done(t2)
+
+    def test_order_times_out_on_wedged_predecessor(self):
+        """A wedged predecessor RPC must surface as THIS RPC's error
+        (PipelineTimeout), not wedge the plugin silently."""
+        from tpu_dra.kubeletplugin.pipeline import (
+            PipelineTimeout, RpcPipeline,
+        )
+        p = RpcPipeline(window=4, timeout_s=0.1)
+        t1 = p.admit(["u"])       # never completed: the wedge
+        t2 = p.admit(["u"])
+        with pytest.raises(PipelineTimeout, match="predecessor"):
+            p.order(t2)
+        p.done(t2)
+        p.done(t1)
+
+    def test_admit_times_out_when_window_wedged(self):
+        from tpu_dra.kubeletplugin.pipeline import (
+            PipelineTimeout, RpcPipeline,
+        )
+        p = RpcPipeline(window=1, timeout_s=0.1)
+        t1 = p.admit(["a"])
+        with pytest.raises(PipelineTimeout, match="window"):
+            p.admit(["b"])
+        p.done(t1)
+
+    def test_done_is_idempotent_for_stale_registrations(self):
+        """A later RPC on the same uid replaces the registration; the
+        earlier done() must not evict the newer gate."""
+        from tpu_dra.kubeletplugin.pipeline import RpcPipeline
+        p = RpcPipeline(window=4)
+        t1 = p.admit(["u"])
+        t2 = p.admit(["u"])       # replaces u's registration
+        p.done(t1)                # must NOT drop t2's registration
+        assert p._last_gate["u"] is t2.gate
+        p.done(t2)
+        assert "u" not in p._last_gate
+
+
 class TestMetrics:
     def test_counter_and_labels(self):
         r = Registry()

@@ -233,10 +233,13 @@ class BatchPrepareScenario:
                                                  slice_id="drmc"))
         cdi = CDIHandler(os.path.join(tmp, "cdi"),
                          driver_root=os.path.join(tmp, "drv"))
+        # async_cdi off: the writer-pool thread is not a controlled
+        # task, so its scheduling would leak uncontrolled concurrency
+        # into the explored interleavings.
         state = DeviceState(
             backend=backend, cdi=cdi,
             checkpoints=CheckpointManager(os.path.join(tmp, "plugin")),
-            driver_name=_DRIVER, node_name=_POOL)
+            driver_name=_DRIVER, node_name=_POOL, async_cdi=False)
 
         claims = {n: _mk_claim(n, [f"chip-{i}"], rv=1)
                   for i, n in enumerate(("ca", "cb", "cc"))}
@@ -326,10 +329,16 @@ class BatchPrepareCrashScenario:
                                                  slice_id="drmc"))
         cdi = CDIHandler(os.path.join(tmp, "cdi"),
                          driver_root=os.path.join(tmp, "drv"))
+        # async_cdi is bypassed anyway while the recorder is installed
+        # (determinism of the durable-op sequence); journal_compact_lag
+        # is forced low so the body CROSSES the compaction threshold —
+        # the compaction's slot store + journal swap ops (the
+        # "compaction rename") get crash-enumerated too.
         state = DeviceState(
             backend=backend, cdi=cdi,
-            checkpoints=CheckpointManager(os.path.join(tmp, "plugin")),
-            driver_name=_DRIVER, node_name=_POOL)
+            checkpoints=CheckpointManager(os.path.join(tmp, "plugin"),
+                                          journal_compact_lag=2),
+            driver_name=_DRIVER, node_name=_POOL, async_cdi=False)
         claims = {n: _mk_claim(n, [f"chip-{i}"], rv=1)
                   for i, n in enumerate(("ca", "cb", "cc"))}
         return {"tmp": tmp, "state": state, "cdi": cdi,
@@ -394,7 +403,8 @@ class BatchPrepareCrashScenario:
                     backend=backend, cdi=cdi2,
                     checkpoints=CheckpointManager(
                         os.path.join(tmp, "plugin")),
-                    driver_name=_DRIVER, node_name=_POOL)
+                    driver_name=_DRIVER, node_name=_POOL,
+                    async_cdi=False)
             except Exception as e:  # noqa: BLE001 — THE invariant:
                 # recovery must never be unable to come up.
                 return [f"recovery failed to start: {e}"]

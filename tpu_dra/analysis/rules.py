@@ -802,7 +802,11 @@ def _is_terminal_store(node: ast.Call) -> bool:
         return False
     if chain[-1] in ("fdatasync", "fsync"):
         return True
-    return (chain[-1] in ("store", "store_batch")
+    # journal_commit appends the terminal record and journal_barrier is
+    # its durability point (the cross-RPC group fdatasync) — both count,
+    # so the rule keeps its teeth on the journaled hot path.
+    return (chain[-1] in ("store", "store_batch", "journal_commit",
+                          "journal_barrier")
             and any("ckpt" in _norm(c) or "checkpoint" in _norm(c)
                     for c in chain[:-1]))
 

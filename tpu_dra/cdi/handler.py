@@ -122,13 +122,14 @@ class CDIHandler:
         _atomic_write_json(path, spec)
         return path
 
-    def create_claim_spec_file(self, claim_uid: str,
-                               env: Dict[str, str],
-                               mounts: Optional[List[Dict]] = None,
-                               device_nodes: Optional[List[Dict]] = None) -> str:
-        """Transient per-claim spec carrying claim-scoped edits — sharing
-        env, ComputeDomain coordination env, multiprocess mounts
-        (CreateClaimSpecFile analog)."""
+    def serialize_claim_spec(self, claim_uid: str,
+                             env: Dict[str, str],
+                             mounts: Optional[List[Dict]] = None,
+                             device_nodes: Optional[List[Dict]] = None):
+        """(path, text) of the transient per-claim spec — the CPU half
+        of create_claim_spec_file, split out so an async writer can run
+        the pure-I/O half off-thread without dragging json serialization
+        (GIL-bound) into the overlap window."""
         # Injection site: a failed claim-spec write is the canonical
         # mid-prepare failure (full disk, ENOSPC on /var/run/cdi) —
         # the prepare rollback path must unwind cleanly from here.
@@ -144,7 +145,25 @@ class CDIHandler:
             "devices": [{"name": claim_uid, "containerEdits": edits}],
         }
         path = self._claim_spec_path(claim_uid)
-        _atomic_write_json(path, spec)
+        return path, json.dumps(spec, indent=2, sort_keys=True)
+
+    def write_claim_spec(self, path: str, text: str) -> None:
+        """The I/O half: tmp write + rename through the vfs seam (see
+        _atomic_write_json for why both are crash points)."""
+        tmp = path + ".tmp"
+        vfs.write_text(tmp, text)
+        vfs.replace(tmp, path)
+
+    def create_claim_spec_file(self, claim_uid: str,
+                               env: Dict[str, str],
+                               mounts: Optional[List[Dict]] = None,
+                               device_nodes: Optional[List[Dict]] = None) -> str:
+        """Transient per-claim spec carrying claim-scoped edits — sharing
+        env, ComputeDomain coordination env, multiprocess mounts
+        (CreateClaimSpecFile analog)."""
+        path, text = self.serialize_claim_spec(
+            claim_uid, env, mounts=mounts, device_nodes=device_nodes)
+        self.write_claim_spec(path, text)
         return path
 
     def claim_spec_path(self, claim_uid: str) -> str:

@@ -134,6 +134,16 @@ SITES: Dict[str, str] = {
     "checkpoint.store":
         "checkpoint store fails; threatens: claim state-machine "
         "durability, prepare idempotency",
+    "prepare.journal_append":
+        "append-only checkpoint journal append fails (ENOSPC on the "
+        "journal while the slot scheme may still work); threatens: "
+        "terminal group-commit durability — the caller must unwind "
+        "exactly like a failed terminal store",
+    "prepare.journal_compact":
+        "bounded-lag journal compaction fails (slot store ENOSPC, "
+        "swap rename EIO); threatens: recovery replay length and "
+        "journal growth — appends must keep landing and lag must "
+        "recover once the fault clears",
     "checkpoint.corrupt":
         "slot file torn/corrupted after a store (action scribbles on the "
         "written paths); threatens: recovery after crash",

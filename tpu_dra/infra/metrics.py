@@ -172,6 +172,16 @@ METRICS_CATALOG: Dict[str, str] = {
     # tpuplugin/driver.py — kubelet-facing prepare pipeline
     "tpu_dra_claim_prepare_seconds": "tpuplugin/driver.py",
     "tpu_dra_prepare_batch_size": "tpuplugin/driver.py",
+    "tpu_dra_prepare_wire_decode_seconds": "tpuplugin/driver.py",
+    "tpu_dra_prepare_wire_queue_seconds": "tpuplugin/driver.py",
+    "tpu_dra_prepare_wire_encode_seconds": "tpuplugin/driver.py",
+    # kubeletplugin/pipeline.py — pipelined RPC admission
+    "tpu_dra_prepare_inflight_rpcs": "kubeletplugin/pipeline.py",
+    # tpuplugin/checkpoint.py — append-only journal + group commit
+    "tpu_dra_journal_appends_total": "tpuplugin/checkpoint.py",
+    "tpu_dra_journal_group_syncs_total": "tpuplugin/checkpoint.py",
+    "tpu_dra_journal_compactions_total": "tpuplugin/checkpoint.py",
+    "tpu_dra_journal_lag_records": "tpuplugin/checkpoint.py",
     # cdplugin/driver.py — ComputeDomain channel prepare
     "tpu_dra_cd_claim_prepare_seconds": "cdplugin/driver.py",
     # cdcontroller/controller.py — CD reconcile loop

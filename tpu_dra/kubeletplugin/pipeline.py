@@ -1,0 +1,123 @@
+"""Pipelined RPC admission: bounded in-flight window + keyed ordering.
+
+The DRA gRPC server hands every RPC its own handler thread; what makes
+them a *pipeline* is how little of each RPC is exclusive. This module
+owns the two pieces the server/driver pair needs for that (SURVEY §14):
+
+- **Bounded in-flight window** — at most `window` RPCs past admission
+  at once (kubelet retry storms and chaos harnesses must not pile
+  unbounded threads onto the claim-fetch fan-out), with the current
+  depth exported as ``tpu_dra_prepare_inflight_rpcs``.
+
+- **Per-claim-set keyed serialization** — two RPCs touching the same
+  claim uid never reorder: each admitted RPC registers a completion
+  gate per uid and waits for the gates of every predecessor holding one
+  of its uids. RPCs on disjoint claim sets proceed concurrently — the
+  whole point: while RPC N sits in its commit fdatasync, RPC N+1 is
+  decoding and claim-fetching. The waits-for graph follows registration
+  order, so it is acyclic by construction (no deadlock).
+
+Ordering + the window compose safely: gates are registered at
+admission, and an admitted RPC only ever waits on gates registered
+BEFORE its own, whose owners are admitted and will complete.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Iterable, List
+
+from tpu_dra.infra.metrics import DefaultRegistry
+
+INFLIGHT_RPCS = DefaultRegistry.gauge(
+    "tpu_dra_prepare_inflight_rpcs",
+    "prepare/unprepare RPCs currently admitted into the pipelined "
+    "server (bounded by the in-flight window)")
+
+
+class _Ticket:
+    """One admitted RPC: its completion gate plus the predecessor gates
+    it must wait out before touching driver state."""
+
+    def __init__(self, uids: List[str], gate: threading.Event,
+                 predecessors: List[threading.Event]):
+        self.uids = uids
+        self.gate = gate
+        self.predecessors = predecessors
+        self.queue_s = 0.0  # admission wait + predecessor wait
+
+
+class PipelineTimeout(TimeoutError):
+    pass
+
+
+class RpcPipeline:
+    # Fail-fast bound on queueing (admission + ordering): a wedged
+    # predecessor RPC must surface as THIS RPC's error for kubelet to
+    # retry, not wedge the whole plugin silently — the bound the
+    # pre-pipeline per-RPC flock timeout used to provide.
+    DEFAULT_TIMEOUT_S = 30.0
+
+    def __init__(self, window: int = 16,
+                 timeout_s: float = DEFAULT_TIMEOUT_S):
+        self._window = threading.Semaphore(window)
+        self._timeout_s = timeout_s
+        self._gates_lock = threading.Lock()
+        # uid -> the gate of the LAST admitted RPC touching it.
+        self._last_gate: Dict[str, threading.Event] = {}
+        self._inflight = 0
+
+    def admit(self, uids: Iterable[str]) -> _Ticket:
+        """Block for a window slot (bounded), then register this RPC's
+        gates. Registration order IS the serialization order for
+        overlapping claim sets. Raises PipelineTimeout when the window
+        never frees — the caller fails the RPC."""
+        unique = list(dict.fromkeys(uids))
+        t0 = time.perf_counter()
+        if not self._window.acquire(timeout=self._timeout_s):
+            raise PipelineTimeout(
+                f"prepare pipeline window full for {self._timeout_s}s "
+                "(in-flight RPCs wedged?)")
+        gate = threading.Event()
+        with self._gates_lock:
+            predecessors = [self._last_gate[u] for u in unique
+                            if u in self._last_gate]
+            for u in unique:
+                self._last_gate[u] = gate
+            self._inflight += 1
+            INFLIGHT_RPCS.set(self._inflight)
+        ticket = _Ticket(unique, gate, predecessors)
+        ticket.queue_s = time.perf_counter() - t0
+        return ticket
+
+    def order(self, ticket: _Ticket) -> None:
+        """Wait (bounded) for every predecessor RPC sharing a claim
+        uid. Call after any prefetch work that may overlap (the claim
+        fan-out reads the API server, not driver state) and before
+        touching DeviceState. Raises PipelineTimeout on a wedged
+        predecessor; the caller must still done() its ticket."""
+        t0 = time.perf_counter()
+        deadline = t0 + self._timeout_s
+        for gate in ticket.predecessors:
+            if not gate.wait(timeout=max(0.0, deadline
+                                         - time.perf_counter())):
+                ticket.queue_s += time.perf_counter() - t0
+                raise PipelineTimeout(
+                    f"predecessor RPC on a shared claim still running "
+                    f"after {self._timeout_s}s")
+        ticket.queue_s += time.perf_counter() - t0
+
+    def done(self, ticket: _Ticket) -> None:
+        """Release the RPC: open its gate for successors, drop its
+        uid registrations (only where it is still the latest), free the
+        window slot. Always runs (finally) — an RPC that errors must
+        not wedge its successors."""
+        ticket.gate.set()
+        with self._gates_lock:
+            for u in ticket.uids:
+                if self._last_gate.get(u) is ticket.gate:
+                    del self._last_gate[u]
+            self._inflight -= 1
+            INFLIGHT_RPCS.set(self._inflight)
+        self._window.release()

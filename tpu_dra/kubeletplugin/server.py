@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from concurrent import futures
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
@@ -68,11 +69,20 @@ class DriverCallbacks:
         """uid -> error string ('' = success)."""
         raise NotImplementedError
 
+    def record_wire(self, stage_s: Dict[str, float]) -> None:
+        """Server-side wire-time attribution hook: per-RPC seconds for
+        the request-decode and response-encode stages plus the whole
+        handler wall ({'decode','encode','handler'}). Drivers that
+        attribute claim-to-ready override this (tpuplugin); the default
+        drops it."""
+
 
 def _dra_service(callbacks: DriverCallbacks) -> grpc.GenericRpcHandler:
     def node_prepare(request: dra.NodePrepareResourcesRequest, context):
+        t_in = time.perf_counter()
         claims = [Claim(uid=c.uid, name=c.name, namespace=c.namespace)
                   for c in request.claims]
+        t_decoded = time.perf_counter()
         results = dict(callbacks.prepare_claims(claims))
         for claim in claims:
             # A driver bug that dropped a claim from the result map must
@@ -81,6 +91,7 @@ def _dra_service(callbacks: DriverCallbacks) -> grpc.GenericRpcHandler:
             results.setdefault(
                 claim.uid,
                 PrepareResult(error="driver returned no result for claim"))
+        t_done = time.perf_counter()
         resp = dra.NodePrepareResourcesResponse()
         for uid, res in results.items():
             # Built in place: the map entry materializes on first access,
@@ -95,6 +106,10 @@ def _dra_service(callbacks: DriverCallbacks) -> grpc.GenericRpcHandler:
                     dev.device_name = d.device_name
                     dev.cdi_device_ids.extend(d.cdi_device_ids)
                     dev.request_names.extend(d.request_names)
+        t_out = time.perf_counter()
+        callbacks.record_wire({"decode": t_decoded - t_in,
+                               "encode": t_out - t_done,
+                               "handler": t_out - t_in})
         return resp
 
     def node_unprepare(request: dra.NodeUnprepareResourcesRequest, context):

@@ -66,10 +66,13 @@ log = logging.getLogger("simcluster.chaos")
 # daemon stack, exercised by its own tests. The prepare.batch_* sites
 # fire inside the batched prepare pipeline (driver fetch fan-out and
 # DeviceState parallel apply), so the group-commit rollback machinery is
-# chaos-tested on the exact production path.
+# chaos-tested on the exact production path; the prepare.journal_* sites
+# break the append-only journal's append and bounded-lag compaction the
+# same way (SURVEY §14).
 CHAOS_SITES = ("k8s.api.request", "cdi.claim_write", "checkpoint.store",
                "checkpoint.corrupt", "prepare.batch_fetch",
-               "prepare.batch_apply")
+               "prepare.batch_apply", "prepare.journal_append",
+               "prepare.journal_compact")
 
 TS_CONFIG = [{"source": "FromClaim", "requests": [], "opaque": {
     "driver": TPU_DRIVER_NAME, "parameters": {

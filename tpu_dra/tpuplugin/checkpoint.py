@@ -17,16 +17,42 @@ devices: [...]}}}.
 from __future__ import annotations
 
 import json
+import logging
 import os
+import threading
 import zlib
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from tpu_dra.infra import vfs
 from tpu_dra.infra.faults import FAULTS
+from tpu_dra.infra.metrics import DefaultRegistry
+
+log = logging.getLogger("tpu_dra.tpuplugin")
 
 PREPARE_STARTED = "PrepareStarted"
 PREPARE_COMPLETED = "PrepareCompleted"
+
+# Cross-RPC journal observability (SURVEY §14): the perf tier's
+# amortization tripwire reads the per-manager counters; these aggregate
+# process-wide for dashboards.
+JOURNAL_APPENDS = DefaultRegistry.counter(
+    "tpu_dra_journal_appends_total",
+    "append-only checkpoint journal records appended (one per "
+    "prepare/unprepare group commit; the delta, not the full image)")
+JOURNAL_GROUP_SYNCS = DefaultRegistry.counter(
+    "tpu_dra_journal_group_syncs_total",
+    "journal fdatasyncs actually issued; under concurrent RPCs one sync "
+    "covers many appends (group commit), so this stays below "
+    "tpu_dra_journal_appends_total under load")
+JOURNAL_COMPACTIONS = DefaultRegistry.counter(
+    "tpu_dra_journal_compactions_total",
+    "journal compactions: full-image slot store + journal swap once the "
+    "record lag crosses the bounded-lag threshold")
+JOURNAL_LAG = DefaultRegistry.gauge(
+    "tpu_dra_journal_lag_records",
+    "journal records appended since the last compaction (recovery replay "
+    "length; bounded by the compaction threshold)")
 
 
 class CheckpointError(Exception):
@@ -141,11 +167,24 @@ class CheckpointManager:
     """
 
     SLOT_PAD = 4096
+    # Journal preallocation chunk: appends land inside already-allocated
+    # blocks, so the group fdatasync stays a pure data sync (a growing
+    # file would drag block-allocation metadata into every sync — the
+    # same cost class the slot scheme's in-place overwrites avoid).
+    JOURNAL_ALLOC = 256 * 1024
+    # Bounded-lag compaction threshold: recovery replays at most this
+    # many journal records over the last compacted slot image, and the
+    # journal file size stays bounded. One full-image slot store per
+    # LAG appends amortizes to noise on the hot path.
+    JOURNAL_COMPACT_LAG = 64
 
-    def __init__(self, directory: str, filename: str = "checkpoint.json"):
+    def __init__(self, directory: str, filename: str = "checkpoint.json",
+                 journal_compact_lag: Optional[int] = None):
         os.makedirs(directory, exist_ok=True)
         self._path = os.path.join(directory, filename)
         self._side_paths = (self._path + ".b", self._path + ".c")
+        self._journal_path = self._path + ".journal"
+        self._compact_lag = journal_compact_lag or self.JOURNAL_COMPACT_LAG
         self._fds: Dict[str, int] = {}
         self._sizes: Dict[str, int] = {}
         # Observability counters (the group-commit regression tripwire,
@@ -157,6 +196,14 @@ class CheckpointManager:
         self.stores: int = 0
         self.terminal_stores: int = 0
         self.slot_syncs: int = 0
+        # Journal counters (the cross-RPC amortization tripwire): one
+        # append per group commit; group syncs stay BELOW appends under
+        # concurrent RPCs or the cross-RPC group commit degraded to a
+        # sync per RPC.
+        self.journal_appends: int = 0
+        self.journal_group_syncs: int = 0
+        self.journal_compactions: int = 0
+        self.journal_lag: int = 0
         # Seed per-slot seqs from whatever is on disk so a manager that
         # stores before loading (e.g. a tool force-writing a downgrade
         # image) still supersedes stale slots from an earlier process,
@@ -169,6 +216,43 @@ class CheckpointManager:
             r = self._load_slot(p)
             self._slot_seqs[p] = (r[0] or 0) if isinstance(r, tuple) else 0
         self._seq = max(self._slot_seqs.values())
+        # Mutation side (append/compact) is additionally serialized by
+        # the CALLER's data lock (DeviceState._lock — the manager is a
+        # single-logical-writer component); _journal_lock only protects
+        # the tail bookkeeping against the barrier side reading it.
+        self._journal_lock = threading.Lock()
+        # Group-commit barrier state: leader/follower fdatasync
+        # coalescing (journal_barrier). Guards _synced_seq /
+        # _appended_seq / _sync_in_flight. Condition over an EXPLICIT
+        # Lock created in THIS frame (workqueue precedent): the lock
+        # witness only instruments tpu_dra-created locks, and the
+        # barrier never re-enters its own condition.
+        self._sync_cond = threading.Condition(threading.Lock())
+        self._sync_in_flight = False
+        self._synced_seq = 0
+        self._appended_seq = 0
+        # True while a journal swap's rename still needs its directory
+        # sync: the next group sync's leader retries it before any
+        # post-swap record may be declared durable (see _swap_journal).
+        self._dir_dirty = False
+        # Journal recovery scan: find the valid tail, seed _seq past any
+        # journal record so new stores supersede the replay, and count
+        # the replayable lag.
+        records, valid_end = self._read_journal()
+        if records:
+            self._seq = max(self._seq, max(seq for seq, _ in records))
+            best_slot = max(self._slot_seqs.values())
+            self.journal_lag = sum(1 for seq, _ in records
+                                   if seq > best_slot)
+        existed = os.path.exists(self._journal_path)
+        self._journal_fd = vfs.open_fd(self._journal_path,
+                                       os.O_RDWR | os.O_CREAT, 0o600)
+        if not existed:
+            vfs.fsync_dir(os.path.dirname(self._journal_path))
+        self._journal_tail = valid_end
+        self._journal_alloc = os.fstat(self._journal_fd).st_size
+        self._synced_seq = self._appended_seq = self._seq
+        JOURNAL_LAG.set(self.journal_lag)
 
     @property
     def path(self) -> str:
@@ -182,6 +266,26 @@ class CheckpointManager:
                 pass
         self._fds.clear()
         self._sizes.clear()
+        if self._journal_fd is not None:
+            try:
+                vfs.close_fd(self._journal_fd)
+            except OSError:
+                pass
+            self._journal_fd = None
+
+    def _envelope(self, payload: str, seq: int) -> bytes:
+        """Checksummed envelope shared by slots and journal records.
+        Assembled around the already-serialized payload (it is the
+        checksum's exact input, so embedding it verbatim both avoids a
+        second serialization and makes the checksum self-evidently
+        consistent). `seqsum` covers the seq, which sits outside the
+        data checksum (kept payload-only for legacy compatibility both
+        ways): without it, a seq mangled into a different valid integer
+        would silently reorder slot selection and could resurrect stale
+        state. Legacy readers ignore the unknown keys."""
+        return ('{"checksum": %d, "seq": %d, "seqsum": %d, "data": %s}'
+                % (zlib.crc32(payload.encode()), seq,
+                   zlib.crc32(b"%d" % seq), payload)).encode()
 
     def _write_slot(self, path: str, data: bytes, sync: bool = True) -> None:
         padded = data + b" " * (-len(data) % self.SLOT_PAD)
@@ -229,17 +333,7 @@ class CheckpointManager:
         doc = cp.to_v1_doc() if version == "v1" else cp.to_v2_doc()
         payload = json.dumps(doc, sort_keys=True, separators=(",", ":"))
         self._seq += 1
-        # Envelope assembled around the already-serialized payload (it is
-        # the checksum's exact input, so embedding it verbatim both avoids
-        # a second serialization and makes the checksum self-evidently
-        # consistent). `seqsum` covers the seq, which sits outside the
-        # data checksum (kept payload-only for legacy compatibility both
-        # ways): without it, a seq mangled into a different valid integer
-        # would silently reorder slot selection and could resurrect stale
-        # state. Legacy readers ignore the unknown key.
-        envelope = ('{"checksum": %d, "seq": %d, "seqsum": %d, "data": %s}'
-                    % (zlib.crc32(payload.encode()), self._seq,
-                       zlib.crc32(b"%d" % self._seq), payload)).encode()
+        envelope = self._envelope(payload, self._seq)
         # Ping-pong: overwrite the STALER side slot, so the fresher one
         # still holds the previous state if this write tears.
         side = min(self._side_paths, key=lambda p: self._slot_seqs[p])
@@ -291,6 +385,276 @@ class CheckpointManager:
                 f"lingering={lingering}")
         self.store(cp, version=version, intent=intent)
 
+    # ------------------------------------------------------------------
+    # Append-only journal (SURVEY §14)
+    # ------------------------------------------------------------------
+    # The hot-path replacement for full-image terminal stores: each
+    # prepare/unprepare group commit appends ONE delta record (the
+    # claims it touched), and durability comes from journal_barrier's
+    # leader/follower group fdatasync — concurrent RPCs whose barriers
+    # overlap share a single device sync. The slot files become the
+    # compaction image: once the record lag crosses the bounded-lag
+    # threshold, the full state is stored through the slot scheme and a
+    # fresh journal is swapped in (tmp + rename). Recovery = newest
+    # valid slot image + replay of journal records with seq beyond it,
+    # stopping at the first torn/invalid record (the tail a crash may
+    # legally shred).
+
+    def journal_commit(self, cp: Checkpoint, *, present=(), absent=(),
+                       intent: bool = False) -> int:
+        """Append one group-commit delta record; returns the sync token
+        for journal_barrier. NOT durable until the barrier. Caller must
+        hold its data lock (single logical writer — same contract as
+        store()); the barrier must then be awaited WITHOUT that lock so
+        concurrent RPCs coalesce their fdatasyncs.
+
+        `present`/`absent` are both the postcondition check (as in
+        store_batch) and the delta itself: present uids are serialized
+        from `cp`, absent uids become removal markers."""
+        # Same site as the slot path: a journal append IS the hot-path
+        # checkpoint store; chaos arms one site to break both schemes.
+        FAULTS.check("checkpoint.store", intent=intent)
+        # Injection site: the append itself fails (ENOSPC on the
+        # journal) while the slot scheme may still work — the caller
+        # must unwind exactly like a failed terminal store.
+        FAULTS.check("prepare.journal_append", intent=intent)
+        missing = [u for u in present if u not in cp.claims]
+        lingering = [u for u in absent if u in cp.claims]
+        if missing or lingering:
+            raise CheckpointError(
+                f"group commit inconsistent: missing={missing} "
+                f"lingering={lingering}")
+        payload = json.dumps(
+            {"intent": bool(intent),
+             "upsert": {uid: cp.claims[uid].to_v2() for uid in present},
+             "remove": sorted(absent)},
+            sort_keys=True, separators=(",", ":"))
+        with self._journal_lock:
+            fd = self._ensure_journal_fd()
+            self._seq += 1
+            seq = self._seq
+            record = self._envelope(payload, seq) + b"\n"
+            end = self._journal_tail + len(record)
+            if end > self._journal_alloc:
+                # Extend the preallocation ahead of the tail so the
+                # group sync never pays block-allocation metadata.
+                grow = max(self.JOURNAL_ALLOC, len(record))
+                vfs.pwrite(fd, b"\0" * grow, self._journal_alloc)
+                self._journal_alloc += grow
+            off = 0
+            while off < len(record):  # POSIX permits short writes
+                n = vfs.pwrite(fd, record[off:],
+                               self._journal_tail + off)
+                if n <= 0:
+                    raise CheckpointError(
+                        f"short journal write at {self._journal_tail}")
+                off += n
+            self._journal_tail = end
+            self.journal_appends += 1
+            self.journal_lag += 1
+            JOURNAL_APPENDS.inc()
+            JOURNAL_LAG.set(self.journal_lag)
+        with self._sync_cond:
+            self._appended_seq = seq
+        # (No checkpoint.corrupt injection here: tearing the appended
+        # record would shred the commit's ONLY copy while the RPC still
+        # reports success — a torn journal tail is only reachable
+        # through a crash, which is exactly what drmc's torn crash
+        # variant models. The slot scheme keeps its injection because
+        # it writes two copies and recovery uses the survivor.)
+        if self.journal_lag >= self._compact_lag:
+            self._compact(cp)
+        return seq
+
+    def journal_barrier(self, token: int) -> None:
+        """Block until every journal record up to `token` is durable.
+        Leader/follower group commit: the first waiter to find no sync
+        in flight becomes the leader and issues ONE fdatasync covering
+        the whole appended tail; followers whose records that sync
+        covers just wait — N concurrent RPCs, 1 device sync. Call
+        WITHOUT holding the data lock, or nothing can coalesce."""
+        while True:
+            with self._sync_cond:
+                if self._synced_seq >= token:
+                    return
+                if self._sync_in_flight:
+                    self._sync_cond.wait()
+                    continue
+                self._sync_in_flight = True
+                target = self._appended_seq
+                dir_dirty = self._dir_dirty
+                with self._journal_lock:
+                    fd = self._ensure_journal_fd()
+            try:
+                vfs.fdatasync(fd)
+                if dir_dirty:
+                    # A journal swap's rename is still awaiting its
+                    # directory sync: without it a crash could recover
+                    # the OLD dirent and lose every post-swap record
+                    # this fdatasync just settled into the new inode.
+                    vfs.fsync_dir(os.path.dirname(self._journal_path))
+            except BaseException:
+                with self._sync_cond:
+                    self._sync_in_flight = False
+                    self._sync_cond.notify_all()
+                raise
+            with self._sync_cond:
+                self._sync_in_flight = False
+                if dir_dirty:
+                    self._dir_dirty = False
+                self._synced_seq = max(self._synced_seq, target)
+                self.journal_group_syncs += 1
+                JOURNAL_GROUP_SYNCS.inc()
+                self._sync_cond.notify_all()
+
+    def _ensure_journal_fd(self) -> int:
+        """Reopen the journal fd after close() — managers outlive the
+        DeviceState that closed them in test/recovery rebuilds, exactly
+        like the lazily-reopened slot fds. Caller holds _journal_lock.
+        The tail survives (same file, same process); only the
+        allocation is re-read."""
+        if self._journal_fd is None:
+            self._journal_fd = vfs.open_fd(
+                self._journal_path, os.O_RDWR | os.O_CREAT, 0o600)
+            self._journal_alloc = os.fstat(self._journal_fd).st_size
+        return self._journal_fd
+
+    def _compact(self, cp: Checkpoint) -> None:
+        """Bounded-lag compaction: persist the full image through the
+        slot scheme (durable, seq past every journal record), then swap
+        a fresh journal in via tmp + rename. Crash-safe at every step:
+        after the slot store the journal records are stale (seq <= slot
+        seq, recovery skips them), and a swap that never lands just
+        leaves stale records behind. Failure is DEGRADED, not raised —
+        compaction is maintenance; the commit it rode in on already
+        appended, so surfacing an error here would un-report a success.
+        The lag keeps growing and the next append retries."""
+        try:
+            # Injection site: compaction fails (slot ENOSPC, rename
+            # EIO) — the journal must keep absorbing appends and lag
+            # must recover once the fault clears.
+            FAULTS.check("prepare.journal_compact")
+            self.store(cp)
+            self._swap_journal(self._seq)
+            self.journal_compactions += 1
+            JOURNAL_COMPACTIONS.inc()
+        except Exception:  # noqa: BLE001 — maintenance must not fail
+            # the commit; bounded lag degrades to growing lag until the
+            # fault clears (metric + retry on the next append).
+            log.warning("journal compaction failed (lag %d, retrying on "
+                        "next append)", self.journal_lag, exc_info=True)
+
+    def _swap_journal(self, settled_seq: int) -> None:
+        """Swap a fresh empty journal in (tmp + rename) after a full
+        slot store settled everything up to `settled_seq`. Waits out an
+        in-flight group sync so the old fd is never closed under it.
+
+        The replacement fd is opened on the TMP file BEFORE the rename
+        (the fd follows the inode), so once the rename lands there is no
+        failure window left in which the manager could keep appending to
+        the old, now-unlinked inode — acknowledged commits must never
+        land on an orphan file a crash cannot recover. The rename's own
+        directory sync is allowed to fail: the dirty flag defers it to
+        the next group sync's leader, which must complete it before any
+        post-swap record is declared durable."""
+        with self._sync_cond:
+            while self._sync_in_flight:
+                self._sync_cond.wait()
+            tmp = self._journal_path + ".tmp"
+            # Created EMPTY via open_fd so the fresh journal keeps the
+            # 0o600 mode every other journal open uses (write_text
+            # would widen it to 0o644 for the file's whole life).
+            new_fd = vfs.open_fd(
+                tmp, os.O_RDWR | os.O_CREAT | os.O_TRUNC, 0o600)
+            try:
+                vfs.replace(tmp, self._journal_path)
+            except BaseException:
+                # Swap never landed: the old journal stays current and
+                # consistent; just drop the orphan tmp fd.
+                try:
+                    vfs.close_fd(new_fd)
+                except OSError:
+                    pass
+                raise
+            old_fd = self._journal_fd
+            self._journal_fd = new_fd
+            with self._journal_lock:
+                self._journal_tail = 0
+                self._journal_alloc = 0
+                self.journal_lag = 0
+            self._synced_seq = max(self._synced_seq, settled_seq)
+            self._dir_dirty = True
+            JOURNAL_LAG.set(0)
+            self._sync_cond.notify_all()
+        if old_fd is not None:
+            try:
+                vfs.close_fd(old_fd)
+            except OSError:
+                pass
+        try:
+            vfs.fsync_dir(os.path.dirname(self._journal_path))
+            with self._sync_cond:
+                self._dir_dirty = False
+        except OSError:
+            log.warning("journal swap dir sync failed; retrying at the "
+                        "next group sync", exc_info=True)
+
+    def _read_journal(self):
+        """-> ([(seq, delta_doc)...], valid_end_offset). Stops at the
+        first invalid line: a torn tail, preallocated zeros, or garbage
+        — everything after the last valid record is dead weight a crash
+        legally shredded."""
+        try:
+            with open(self._journal_path, "rb") as f:
+                buf = f.read()
+        except FileNotFoundError:
+            return [], 0
+        records = []
+        off = 0
+        while True:
+            nl = buf.find(b"\n", off)
+            if nl < 0:
+                break
+            line = buf[off:nl]
+            if not line.startswith(b"{"):
+                break
+            try:
+                envelope = json.loads(line)
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                break
+            doc = (envelope.get("data")
+                   if isinstance(envelope, dict) else None)
+            seq = envelope.get("seq") if isinstance(envelope, dict) else None
+            if doc is None or not isinstance(seq, int):
+                break
+            payload = json.dumps(doc, sort_keys=True, separators=(",", ":"))
+            if zlib.crc32(payload.encode()) != envelope.get("checksum"):
+                break
+            if envelope.get("seqsum") != zlib.crc32(b"%d" % seq):
+                break
+            records.append((seq, doc))
+            off = nl + 1
+        return records, off
+
+    def _replay_journal(self, cp: Optional[Checkpoint],
+                        base_seq: int) -> Optional[Checkpoint]:
+        """Apply journal records with seq > base_seq (the slot image's)
+        over `cp`, in append order. Records at or below the base are the
+        compaction's leftovers; the torn tail was already dropped by the
+        scan."""
+        records, _ = self._read_journal()
+        for seq, doc in records:
+            if seq <= base_seq:
+                continue
+            if cp is None:
+                cp = Checkpoint()
+            for uid, entry in (doc.get("upsert") or {}).items():
+                cp.claims[uid] = PreparedClaim.from_v2(uid, entry)
+            for uid in doc.get("remove") or ():
+                cp.claims.pop(uid, None)
+            self._seq = max(self._seq, seq)
+        return cp
+
     def _load_slot(self, path: str):
         """-> (seq | None-for-legacy, doc) or None (absent/empty) or
         'corrupt'. The doc is NOT deserialized into a Checkpoint here so
@@ -332,9 +696,12 @@ class CheckpointManager:
     def load(self) -> Optional[Checkpoint]:
         """None when no checkpoint exists (first start). A *legacy*
         (seq-less, rename-scheme) primary is authoritative: it means a
-        downgraded driver wrote last, and whatever side slots remain
-        predate the downgrade. Otherwise the highest-seq valid slot
-        wins. Raises only when every present slot is corrupt."""
+        downgraded driver wrote last, whatever side slots AND journal
+        records remain predate the downgrade, and nothing is replayed
+        over it. Otherwise the highest-seq valid slot wins and the
+        journal tail (records with seq beyond the slot image) is
+        replayed over it. Raises only when every present slot is
+        corrupt."""
         # (The __init__ seq seeding also parsed these slots; re-reading
         # here costs ~3 4KiB files once per process and keeps load()
         # correct after intervening stores — not worth a cache.)
@@ -348,22 +715,35 @@ class CheckpointManager:
         if valid:
             seq, doc = max(valid, key=lambda r: r[0])
             self._seq = max(self._seq, seq)
-            return Checkpoint.from_doc(doc)
+            return self._replay_journal(Checkpoint.from_doc(doc), seq)
         corrupt = [p for p, r in results.items() if r == "corrupt"]
         if corrupt:
+            # Every slot shredded: fail LOUDLY. The journal is NOT a
+            # substitute image here — after any compaction it holds
+            # only post-compaction deltas, and nothing in the file
+            # attests full coverage; replaying it from empty would
+            # silently drop every earlier claim (leaked side effects,
+            # double allocation) behind a clean-looking startup.
             raise CheckpointError(
                 f"checkpoint corrupt, no valid slot: {', '.join(corrupt)}")
-        return None
+        # No slot file at all (a state no crash can produce — slot
+        # dirents are fsync'd at creation and every journal record
+        # postdates the first store): if a journal is nonetheless
+        # present, replaying what it holds beats silently starting
+        # fresh over possibly-live side effects.
+        return self._replay_journal(None, 0)
 
     def load_or_init(self) -> Checkpoint:
         """Load at process start, initializing an empty checkpoint on
         first run — and ALWAYS re-storing what was loaded. The store
         repairs whatever the load tolerated (a slot torn by a crash, a
-        stale loser slot) so the every-slot-valid invariant is restored
-        before new in-place overwrites put it at risk again, and it
-        migrates a legacy (seq-less, rename-scheme) primary into the
-        slot scheme so a post-upgrade crash cannot out-rank newer intent
-        records with the legacy file."""
+        stale loser slot, a journal tail) so the every-slot-valid
+        invariant is restored before new in-place overwrites put it at
+        risk again, it migrates a legacy (seq-less, rename-scheme)
+        primary into the slot scheme so a post-upgrade crash cannot
+        out-rank newer intent records with the legacy file, and it
+        folds the replayed journal tail into the compacted image (the
+        journal restarts empty: startup is a free compaction point)."""
         cp = self.load()
         if cp is None:
             cp = Checkpoint()
@@ -374,4 +754,12 @@ class CheckpointManager:
         # sides of an up/downgrade handle the state, and the v1 view
         # drops non-completed claims by construction (to_v1_doc).
         self.store(cp)
+        if self._journal_tail:
+            try:
+                self._swap_journal(self._seq)
+            except Exception:  # noqa: BLE001 — the repair store above
+                # already made every journal record stale; a failed swap
+                # only leaves dead records to skip on the next load.
+                log.warning("journal swap at startup failed",
+                            exc_info=True)
         return cp

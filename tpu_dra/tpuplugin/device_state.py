@@ -23,7 +23,7 @@ from typing import Dict, List, Optional, Tuple
 from tpu_dra.api import scheme as apischeme
 from tpu_dra.api import types as apitypes
 from tpu_dra.cdi.handler import CDIHandler, visible_chips_env
-from tpu_dra.infra import featuregates
+from tpu_dra.infra import featuregates, vfs
 from tpu_dra.infra.faults import FAULTS
 from tpu_dra.kubeletplugin.server import PreparedDevice, PrepareResult
 from tpu_dra.native.tpuinfo import Chip, TpuInfoBackend
@@ -100,6 +100,15 @@ class _BatchClaim:
     slow_apply: bool = False   # apply blocks (exec / API round trips)
     timings: Dict[str, float] = field(default_factory=dict)
     error: Optional[str] = None
+    # Serialized-but-unwritten claim spec (path, text), produced by the
+    # apply phase; the batch submits ONE writer task for all members
+    # (sub-ms tasks fanned out per-member thrash the GIL instead of
+    # overlapping — measured 7x slower than a single sequential task).
+    cdi_spec: Optional[tuple] = None
+    # The batch's shared in-flight spec-write future (None once awaited
+    # or when specs were written synchronously). The commit barrier
+    # awaits it before any result externalizes.
+    cdi_future: Optional[object] = None
 
 
 class DeviceState:
@@ -109,7 +118,8 @@ class DeviceState:
                  ts_manager: Optional[TimeSlicingManager] = None,
                  mp_manager: Optional[MultiprocessManager] = None,
                  pt_manager: Optional[PassthroughManager] = None,
-                 include_subslices: bool = True):
+                 include_subslices: bool = True,
+                 async_cdi: bool = True):
         self._backend = backend
         self._cdi = cdi
         self._ckpt_mgr = checkpoints
@@ -145,6 +155,20 @@ class DeviceState:
             c.index: threading.Lock() for c in backend.chips()}
         self._hazard_lock = threading.Lock()
         self._apply_pool: Optional[ThreadPoolExecutor] = None
+        # Async claim-spec writer pool (SURVEY §14): spec tmp-write +
+        # rename overlap the terminal checkpoint append + group sync;
+        # the commit barrier (_await_cdi) runs before any result
+        # externalizes. Disabled per-batch while a drmc vfs recorder is
+        # installed — the crash enumerator needs a deterministic
+        # durable-op sequence, and the sync fallback exercises the same
+        # crash windows (a never-dir-synced rename is lost in the clean
+        # image either way).
+        # (Constructed eagerly — worker threads only materialize on the
+        # first submit, so an unused pool costs nothing.)
+        self._cdi_pool: Optional[ThreadPoolExecutor] = (
+            ThreadPoolExecutor(max_workers=4,
+                               thread_name_prefix="tpu-dra-cdi-write")
+            if async_cdi else None)
         # Standard per-node CDI spec is written once at startup
         # (NewDeviceState analog, device_state.go:59-145).
         self._cdi.create_standard_device_spec_file(backend.chips())
@@ -190,6 +214,9 @@ class DeviceState:
         if self._apply_pool is not None:
             self._apply_pool.shutdown(wait=True)
             self._apply_pool = None
+        if self._cdi_pool is not None:
+            self._cdi_pool.shutdown(wait=True)
+            self._cdi_pool = None
         self._ckpt_mgr.close()
 
     @property
@@ -307,18 +334,19 @@ class DeviceState:
                     name=b.claim["metadata"].get("name", ""),
                     namespace=b.claim["metadata"].get("namespace", ""),
                     devices=b.records)
+            intent_token: Optional[int] = None
             hazardous = [b for b in todo if b.hazardous]
             if hazardous:
-                # ONE transient mid-prepare record covering every
-                # hazardous member: side slot (checkpoint.py — terminal
-                # states land on the primary for downgrade safety).
-                # Non-hazardous members skip the durable intent
-                # entirely: their only side effect is the claim CDI
-                # spec, which startup orphan GC and the unconditional
-                # unprepare delete reconcile without a record.
+                # ONE transient mid-prepare journal record covering
+                # every hazardous member. Non-hazardous members skip the
+                # durable intent entirely: their only side effect is the
+                # claim CDI spec, which startup orphan GC and the
+                # unconditional unprepare delete reconcile without a
+                # record. The group sync happens OUTSIDE the state lock
+                # (below) so concurrent RPCs coalesce their fdatasyncs.
                 t0 = time.perf_counter()
                 try:
-                    self._ckpt_mgr.store_batch(
+                    intent_token = self._ckpt_mgr.journal_commit(
                         self._checkpoint,
                         present=[b.uid for b in hazardous], intent=True)
                 except Exception as e:  # noqa: BLE001 — no side effects
@@ -331,6 +359,21 @@ class DeviceState:
                             error=f"intent store: {e}")
                     return results
                 batch_timings["checkpoint_start"] = time.perf_counter() - t0
+        if intent_token is not None:
+            # Durable intent BEFORE any side effect runs — the same
+            # store-before-side-effects contract as the slot scheme,
+            # with the sync group-committed across RPCs.
+            t0 = time.perf_counter()
+            try:
+                self._ckpt_mgr.journal_barrier(intent_token)
+            except Exception as e:  # noqa: BLE001 — sync failed before
+                # any side effect: abort the batch. The appended intent
+                # record may still be durable; a restart replays it as
+                # PrepareStarted and unprepare/GC finish the cleanup —
+                # the same recovery as a crash mid-prepare.
+                self._abort_unsynced_intent(todo, results, e)
+                return results
+            batch_timings["checkpoint_start"] += time.perf_counter() - t0
 
         # Side-effect application OUTSIDE the global lock: members on
         # disjoint chip sets run concurrently, chip locks serialize
@@ -338,17 +381,29 @@ class DeviceState:
         # hazard lock serializes configs whose effects span beyond the
         # claim's own chips. Checkpoint reads (exclusivity guards) stay
         # stable because every mutation waits for the terminal phase.
+        # Claim-spec writes are SUBMITTED here (async pool) and awaited
+        # at the commit barrier below, overlapping the terminal append
+        # + group sync.
         t0 = time.perf_counter()
         self._apply_batch(todo)
+        # One writer task for the whole batch's claim specs: its
+        # write+rename loop overlaps the terminal append + group sync.
+        self._submit_spec_writes(todo)
         batch_timings["apply"] = time.perf_counter() - t0
 
+        token: Optional[int] = None
+        failed: List[_BatchClaim] = []
+        survivors: List[_BatchClaim] = []
+        # uid -> rollback error for members whose unwind could not
+        # complete (degraded to a deferred PrepareStarted record).
+        deferred: Dict[str, str] = {}
         with self._lock:
             failed = [b for b in todo if b.error is not None]
             survivors = [b for b in todo if b.error is None]
-            # uid -> rollback error for members whose unwind could not
-            # complete (degraded to a deferred PrepareStarted record).
-            deferred: Dict[str, str] = {}
             for b in failed:
+                # Failed members never submitted a spec write (the
+                # submission is the apply's last step), so the unwind's
+                # spec delete cannot race a pending write.
                 err = self._unwind_claim(b.uid)
                 if err is not None:
                     deferred[b.uid] = err
@@ -358,57 +413,54 @@ class DeviceState:
             try:
                 # The group commit: every member's terminal outcome —
                 # survivors completed, failures erased, deferred unwinds
-                # parked PrepareStarted — in ONE durable store.
-                self._ckpt_mgr.store_batch(
+                # parked PrepareStarted — in ONE journal record; the
+                # durable sync is the barrier below, outside this lock.
+                token = self._ckpt_mgr.journal_commit(
                     self._checkpoint,
                     present=[b.uid for b in survivors]
                     + sorted(deferred),
                     absent=[b.uid for b in failed
                             if b.uid not in deferred])
-            except Exception as e:  # noqa: BLE001 — terminal store
+            except Exception as e:  # noqa: BLE001 — terminal append
                 # failed: survivors are fully applied but not durably
                 # completed; a crash now would replay them as
                 # PrepareStarted. Unwind them too and persist the
                 # rollback, so the kubelet retry starts from a clean
                 # slate instead of half-committed state.
-                for b in survivors:
-                    b.error = f"checkpoint store: {e}"
-                    err = self._unwind_claim(b.uid)
-                    if err is not None:
-                        deferred[b.uid] = err
-                try:
-                    self._ckpt_mgr.store(self._checkpoint)
-                except Exception as e2:  # noqa: BLE001 — rollback store
-                    # failed as well: degrade every not-yet-deferred
-                    # member to a deferred PrepareStarted record so a
-                    # later unprepare — or the next driver start — can
-                    # finish the unwind. Never silently dropped.
-                    for b in todo:
-                        if b.uid in deferred:
-                            continue
-                        self._checkpoint.claims[b.uid] = PreparedClaim(
-                            uid=b.uid, state=PREPARE_STARTED,
-                            name=b.claim["metadata"].get("name", ""),
-                            namespace=b.claim["metadata"].get(
-                                "namespace", ""),
-                            devices=b.records)
-                        deferred[b.uid] = str(e2)
-                    try:
-                        self._ckpt_mgr.store(self._checkpoint)
-                    # Deliberate R7 waiver: every member was already
-                    # degraded to a deferred PrepareStarted record just
-                    # above (the compensation), and this is the RETRY of
-                    # the rollback store itself failing — nothing is
-                    # left to unwind; the durable intent record (if
-                    # hazardous) still names the members' chips for the
-                    # next start's recovery.
-                    # dralint: ignore[R7]
-                    except Exception:  # noqa: BLE001
-                        log.warning("failed-batch record store failed",
-                                    exc_info=True)
+                self._await_cdi(todo)
+                self._rollback_survivors_locked(
+                    todo, survivors, deferred, f"checkpoint store: {e}")
             batch_timings["checkpoint_final"] = time.perf_counter() - t0
-            batch_timings["total"] = time.perf_counter() - t_total
 
+        if token is not None:
+            t0 = time.perf_counter()
+            try:
+                # The durable half of the group commit: one fdatasync
+                # shared by every RPC whose barrier overlaps.
+                self._ckpt_mgr.journal_barrier(token)
+            except Exception as e:  # noqa: BLE001 — the record may or
+                # may not be durable; roll the survivors back and
+                # persist the erasure through the synced slot path.
+                self._rollback_after_sync_failure(
+                    todo, survivors, deferred, e)
+                token = None
+            batch_timings["checkpoint_final"] += time.perf_counter() - t0
+        if token is not None:
+            # Commit barrier: claim-spec writes must have landed before
+            # any success externalizes. A member whose spec write failed
+            # is rolled back — its terminal record is superseded by a
+            # synced full-image store.
+            cdi_failed = self._await_cdi(todo)
+            if cdi_failed:
+                with self._lock:
+                    self._rollback_survivors_locked(
+                        todo, cdi_failed, deferred, "claim spec write")
+                lost = {b.uid for b in cdi_failed}
+                survivors = [b for b in survivors if b.uid not in lost]
+                failed = failed + cdi_failed
+
+        with self._lock:
+            batch_timings["total"] = time.perf_counter() - t_total
             for b in todo:
                 if b.uid in deferred:
                     log.warning(
@@ -434,6 +486,7 @@ class DeviceState:
                     and not deferred:
                 b = todo[0]
                 timings = dict(b.timings)
+                timings.setdefault("cdi_wait", 0.0)
                 timings["decode"] = batch_timings["decode"]
                 if "checkpoint_start" in batch_timings:
                     timings["checkpoint_start"] = \
@@ -444,6 +497,108 @@ class DeviceState:
                 self.last_prepare_breakdown = {
                     k: v * 1e3 for k, v in timings.items()}
         return results
+
+    def _abort_unsynced_intent(self, todo: List[_BatchClaim],
+                               results: Dict[str, PrepareResult],
+                               e: Exception) -> None:
+        """Intent group sync failed before any side effect: erase the
+        batch from memory and fail every member (kubelet retries from
+        scratch). The appended record's durability is unknown; a
+        restart that replays it sees plain crash-mid-prepare state."""
+        with self._lock:
+            for b in todo:
+                self._checkpoint.claims.pop(b.uid, None)
+                results[b.uid] = PrepareResult(
+                    error=f"intent store: {e}")
+
+    def _await_cdi(self, todo: List[_BatchClaim]) -> List[_BatchClaim]:
+        """The CDI half of the commit barrier: wait out the batch's
+        spec-write task; a member whose write failed is marked failed
+        and returned for rollback. Must run before any unwind deletes
+        spec files (a delete racing a pending write would lose)."""
+        failed = []
+        for b in todo:
+            fut = b.cdi_future
+            if fut is None:
+                continue
+            b.cdi_future = None
+            t0 = time.perf_counter()
+            try:
+                # Shared future: the first member's wait covers the
+                # batch, the rest read the cached result.
+                errors = fut.result()
+            except Exception as e:  # noqa: BLE001 — whole task died
+                errors = {b.uid: str(e)}
+            b.timings["cdi_wait"] = (b.timings.get("cdi_wait", 0.0)
+                                     + time.perf_counter() - t0)
+            err = errors.get(b.uid)
+            if err is not None:
+                if b.error is None:
+                    b.error = f"prepare devices: {err}"
+                failed.append(b)
+        return failed
+
+    def _rollback_survivors_locked(self, todo: List[_BatchClaim],
+                                   members: List[_BatchClaim],
+                                   deferred: Dict[str, str],
+                                   err_msg: str) -> None:
+        """Terminal commit could not be made durable (append failure,
+        sync failure, or a member's spec write failed after the sync):
+        unwind `members` (side effects reversed, specs deleted,
+        checkpoint entries erased) and persist the rollback through the
+        synced slot path, which supersedes whatever the journal record
+        announced. Caller holds _lock and has awaited the CDI futures
+        of every member being unwound."""
+        for b in members:
+            if b.error is None:
+                b.error = err_msg
+            err = self._unwind_claim(b.uid)
+            if err is not None:
+                deferred[b.uid] = err
+        try:
+            self._ckpt_mgr.store(self._checkpoint)
+        except Exception as e2:  # noqa: BLE001 — rollback store failed
+            # as well: degrade every not-yet-deferred member to a
+            # deferred PrepareStarted record so a later unprepare — or
+            # the next driver start — can finish the unwind. Never
+            # silently dropped.
+            for b in todo:
+                if b.uid in deferred:
+                    continue
+                if b.error is None:
+                    b.error = f"checkpoint store: {e2}"
+                self._checkpoint.claims[b.uid] = PreparedClaim(
+                    uid=b.uid, state=PREPARE_STARTED,
+                    name=b.claim["metadata"].get("name", ""),
+                    namespace=b.claim["metadata"].get(
+                        "namespace", ""),
+                    devices=b.records)
+                deferred[b.uid] = str(e2)
+            try:
+                self._ckpt_mgr.store(self._checkpoint)
+            # Deliberate R7 waiver: every member was already degraded
+            # to a deferred PrepareStarted record just above (the
+            # compensation), and this is the RETRY of the rollback
+            # store itself failing — nothing is left to unwind; the
+            # durable intent record (if hazardous) still names the
+            # members' chips for the next start's recovery.
+            # dralint: ignore[R7]
+            except Exception:  # noqa: BLE001
+                log.warning("failed-batch record store failed",
+                            exc_info=True)
+
+    def _rollback_after_sync_failure(self, todo: List[_BatchClaim],
+                                     survivors: List[_BatchClaim],
+                                     deferred: Dict[str, str],
+                                     e: Exception) -> None:
+        """Terminal group sync failed: the journal record's durability
+        is unknown. Await the spec writes (the unwind deletes specs),
+        then unwind the survivors and persist the erasure through the
+        synced slot path, which out-ranks the unsynced record."""
+        self._await_cdi(todo)
+        with self._lock:
+            self._rollback_survivors_locked(
+                todo, survivors, deferred, f"checkpoint store: {e}")
 
     def _apply_batch(self, todo: List[_BatchClaim]) -> None:
         """Run every member's side-effect application; failures land in
@@ -482,7 +637,7 @@ class DeviceState:
                     stack.enter_context(self._hazard_lock)
                 for idx in sorted({r["chip_index"] for r in b.records}):
                     stack.enter_context(self._chip_locks[idx])
-                self._apply_devices(b.claim, b.config_results, b.timings)
+                self._apply_devices(b)
         except Exception as e:  # noqa: BLE001 — report as claim error
             b.error = f"prepare devices: {e}"
 
@@ -588,15 +743,14 @@ class DeviceState:
                 })
         return records
 
-    def _apply_devices(self, claim: Dict,
-                       config_results: List["_ConfigResult"],
-                       timings: Optional[Dict[str, float]] = None) -> None:
+    def _apply_devices(self, b: _BatchClaim) -> None:
         """The side-effect half of prepare: sharing setup, passthrough
-        rebinds, exclusivity guards, and the claim CDI spec write. The
-        caller persisted the records for all of this before any of it
-        runs (crash/failure rollback)."""
-        if timings is None:
-            timings = {}
+        rebinds, exclusivity guards, and the claim CDI spec write —
+        SUBMITTED async as the final step (b.cdi_future; the commit
+        barrier awaits it), so the tmp-write + rename overlap the
+        terminal checkpoint work. The caller persisted the records for
+        all of this before any of it runs (crash/failure rollback)."""
+        claim, config_results, timings = b.claim, b.config_results, b.timings
         uid = claim["metadata"]["uid"]
 
         chip_indices: set = set()
@@ -666,11 +820,59 @@ class DeviceState:
             claim_env["TPU_HBM_LIMIT_BYTES"] = str(subslice_hbm_total)
 
         claim_env.update(visible_chips_env(sorted(chip_indices)))
+        # CPU half on THIS thread (json + the cdi.claim_write fault
+        # site, so a config/ENOSPC-simulating failure takes the plain
+        # apply-error rollback); only the pure-I/O half (tmp write +
+        # rename, GIL-released syscalls) goes to the writer pool. The
+        # async path is bypassed while a drmc vfs recorder is installed:
+        # the crash enumerator needs one deterministic durable-op
+        # sequence, and the sync write exercises the same crash images
+        # (the spec rename is never dir-synced either way).
         t0 = time.perf_counter()
-        self._cdi.create_claim_spec_file(
+        path, text = self._cdi.serialize_claim_spec(
             uid, claim_env, mounts=claim_mounts or None,
             device_nodes=claim_device_nodes or None)
+        if self._cdi_pool is not None and vfs.installed() is None:
+            # Deferred to the batch's single writer task (submitted at
+            # the end of the apply phase): the write+rename syscalls
+            # (GIL-released) overlap the terminal append + group sync,
+            # and the commit barrier (_await_cdi) collects them before
+            # any result externalizes.
+            b.cdi_spec = (path, text)
+        else:
+            self._cdi.write_claim_spec(path, text)
         timings["cdi_write"] = time.perf_counter() - t0
+
+    def _submit_spec_writes(self, todo: List[_BatchClaim]) -> None:
+        """ONE writer task for every member's pending spec: a single
+        pool wakeup + a sequential loop of GIL-releasing syscalls.
+        Sub-ms per-member tasks measured ~7x slower than this (executor
+        wakeup thrash). Members that failed apply never write a spec."""
+        pending = [(b.uid, b.cdi_spec, b.timings) for b in todo
+                   if b.cdi_spec is not None and b.error is None]
+        for b in todo:
+            b.cdi_spec = None
+        if not pending:
+            return
+        fut = self._cdi_pool.submit(self._write_claim_specs, pending)
+        for b in todo:
+            if b.error is None:
+                b.cdi_future = fut
+
+    def _write_claim_specs(self, pending) -> Dict[str, str]:
+        """The batch's spec I/O on the writer pool: uid -> error for
+        any member whose write failed (isolation); the timings dicts
+        are member-private, ordered against readers by the future."""
+        errors: Dict[str, str] = {}
+        for uid, (path, text), timings in pending:
+            t0 = time.perf_counter()
+            try:
+                self._cdi.write_claim_spec(path, text)
+            except Exception as e:  # noqa: BLE001 — isolate the member
+                errors[uid] = str(e)
+            timings["cdi_io"] = (timings.get("cdi_io", 0.0)
+                                 + time.perf_counter() - t0)
+        return errors
 
     def _group_chip_indices(self, chip: Chip) -> List[int]:
         """Indices of every chip sharing `chip`'s IOMMU group (including
@@ -696,13 +898,15 @@ class DeviceState:
         group, so (a) a passthrough prepare conflicts with ANY other claim
         holding a group chip, and (b) a normal prepare conflicts with a
         PASSTHROUGH claim holding a group chip (the rebind destroyed its
-        /dev/accelN). Runs during a batch's apply phase, when checkpoint
-        mutation is quiescent (mutations happen only in the pure and
-        terminal phases, under self._lock); concurrent prepare/unprepare
-        CALLERS must be serialized externally — in production the
-        driver's node-global flock does this. The iteration snapshot
-        below keeps a misbehaving concurrent caller from crashing the
-        guard mid-iteration, though its answer could then be stale.
+        /dev/accelN). Runs during a batch's apply phase. The pipelined
+        server overlaps RPCs on disjoint claims, so checkpoint mutation
+        is no longer globally quiescent here — safety holds because
+        every member's PrepareStarted record lands (under self._lock)
+        BEFORE any apply begins: of two racing conflicting claims, at
+        least one's guard observes the other's record and refuses (both
+        may refuse — kubelet retries break the tie; they can never both
+        succeed). The iteration snapshot below keeps a concurrent
+        terminal-phase mutation from crashing the guard mid-iteration.
         (Sibling handling analog: device_state.go:526-552.)"""
         group_indices = set(self._group_chip_indices(chip))
         for uid, prepared in list(self._checkpoint.claims.items()):
@@ -846,11 +1050,15 @@ class DeviceState:
         seed 5), or the retry would no-op while the on-disk entries
         survive to resurrect at the next restart."""
         results: Dict[str, Optional[str]] = {}
+        token: Optional[int] = None
+        removed: List[Tuple[str, PreparedClaim]] = []
+        to_unwind: List[Tuple[str, PreparedClaim]] = []
+        seen: set = set()
         with self._lock:
-            removed: List[Tuple[str, PreparedClaim]] = []
             for claim_uid in claim_uids:
-                if claim_uid in results:
+                if claim_uid in seen:
                     continue  # duplicate uid in one RPC
+                seen.add(claim_uid)
                 prepared = self._checkpoint.claims.get(claim_uid)
                 if prepared is None:
                     # Unknown claim: still scrub any orphan CDI spec — a
@@ -859,18 +1067,33 @@ class DeviceState:
                     self._cdi.delete_claim_spec_file(claim_uid)
                     results[claim_uid] = None
                     continue
-                try:
-                    self._unprepare_devices(claim_uid, prepared)
-                except Exception as e:  # noqa: BLE001
-                    results[claim_uid] = f"unprepare devices: {e}"
-                    continue
+                to_unwind.append((claim_uid, prepared))
+        # Device unwind OUTSIDE the global lock: _unprepare_devices
+        # serializes on the hazard/chip locks, and a concurrent batch's
+        # apply phase can hold those for a slow sharing round trip
+        # (coordinator Deployment, seconds) — waiting for them under
+        # _lock would convoy every pipelined RPC's pure phase (and its
+        # SharedFlock hold) behind one slow apply. The checkpoint entry
+        # stays in place until the terminal phase below, so exclusivity
+        # guards keep refusing conflicting prepares mid-unwind, and
+        # same-uid RPCs are already ordered by the pipeline.
+        unwound: List[Tuple[str, PreparedClaim]] = []
+        for claim_uid, prepared in to_unwind:
+            try:
+                self._unprepare_devices(claim_uid, prepared)
+            except Exception as e:  # noqa: BLE001 — isolate the claim
+                results[claim_uid] = f"unprepare devices: {e}"
+                continue
+            unwound.append((claim_uid, prepared))
+        with self._lock:
+            for claim_uid, prepared in unwound:
                 self._cdi.delete_claim_spec_file(claim_uid)
-                del self._checkpoint.claims[claim_uid]
-                removed.append((claim_uid, prepared))
+                if self._checkpoint.claims.pop(claim_uid, None) is not None:
+                    removed.append((claim_uid, prepared))
                 results[claim_uid] = None
             if removed:
                 try:
-                    self._ckpt_mgr.store_batch(
+                    token = self._ckpt_mgr.journal_commit(
                         self._checkpoint,
                         absent=[uid for uid, _ in removed])
                 except Exception as e:  # noqa: BLE001 — reinsert ALL
@@ -880,9 +1103,48 @@ class DeviceState:
                         self._checkpoint.claims[claim_uid] = prepared
                         results[claim_uid] = \
                             f"unprepare checkpoint store: {e}"
+        if token is not None:
+            try:
+                # The durable half, outside the lock: concurrent RPCs
+                # coalesce on one fdatasync (group commit).
+                self._ckpt_mgr.journal_barrier(token)
+            except Exception as e:  # noqa: BLE001 — the removal record
+                # may or may not be durable; reinsert and persist.
+                self._reinsert_unprepared(removed, results, e)
         return results
 
+    def _reinsert_unprepared(self, removed: List[Tuple[str, PreparedClaim]],
+                             results: Dict[str, Optional[str]],
+                             e: Exception) -> None:
+        """Unprepare group sync failed: reinsert every removed entry
+        (memory must not run ahead of disk) and persist the reinsertion
+        through the synced slot path, which supersedes the unsynced
+        removal record. If even that store fails, memory keeps the
+        entries and the kubelet retry re-runs the idempotent unwind —
+        whichever image a later crash leaves, the retry converges."""
+        with self._lock:
+            for claim_uid, prepared in removed:
+                self._checkpoint.claims[claim_uid] = prepared
+                results[claim_uid] = f"unprepare checkpoint store: {e}"
+            try:
+                self._ckpt_mgr.store(self._checkpoint)
+            # The reinsertion above IS the compensation; the slot store
+            # is best-effort durability for it (see docstring).
+            # dralint: ignore[R7]
+            except Exception:  # noqa: BLE001
+                log.warning("unprepare rollback store failed",
+                            exc_info=True)
+
     def _unprepare_devices(self, claim_uid: str, prepared: PreparedClaim) -> None:
+        """Reverse a claim's chip-level side effects UNDER the same
+        hazard/chip locks the apply phase takes (same global order:
+        hazard first, then ascending chip index). The pipelined server
+        overlaps RPCs on disjoint CLAIMS, but two claims can touch the
+        same CHIP (time-slice siblings, a chip re-allocated while its
+        old claim's unprepare is in flight) — without these locks an
+        unprepare's reset could interleave with a concurrent prepare's
+        configure on the same chip, which the pre-pipeline exclusive
+        flock used to prevent."""
         chips: Dict[int, Chip] = {}
         strategies = set()
         passthrough_chips = []
@@ -899,17 +1161,27 @@ class DeviceState:
             if cfg.get("kind") == apitypes.PASSTHROUGH_CONFIG_KIND:
                 passthrough_chips.append(chip)
         chip_list = [chips[i] for i in sorted(chips)]
-        if apitypes.MultiprocessStrategy in strategies and self._mp_manager:
-            self._mp_manager.stop(claim_uid, chip_list)
-        if apitypes.TimeSlicingStrategy in strategies and self._ts_manager:
-            self._ts_manager.reset(chip_list)
-        for chip in passthrough_chips:
-            if self._pt_manager is not None:
-                # Return the chip to the accel driver before clearing the
-                # exclusive marker; unconfigure is idempotent, so a crashed
-                # half-prepared claim unwinds cleanly too.
-                self._pt_manager.unconfigure(chip)
-            self._backend.set_exclusive_mode(chip.index, False)
+        with ExitStack() as stack:
+            if passthrough_chips:
+                # IOMMU-group rebinds span beyond the claim's own chips
+                # — serialize on the hazard lock like the apply phase.
+                stack.enter_context(self._hazard_lock)
+            for idx in sorted(chips):
+                stack.enter_context(self._chip_locks[idx])
+            if apitypes.MultiprocessStrategy in strategies \
+                    and self._mp_manager:
+                self._mp_manager.stop(claim_uid, chip_list)
+            if apitypes.TimeSlicingStrategy in strategies \
+                    and self._ts_manager:
+                self._ts_manager.reset(chip_list)
+            for chip in passthrough_chips:
+                if self._pt_manager is not None:
+                    # Return the chip to the accel driver before
+                    # clearing the exclusive marker; unconfigure is
+                    # idempotent, so a crashed half-prepared claim
+                    # unwinds cleanly too.
+                    self._pt_manager.unconfigure(chip)
+                self._backend.set_exclusive_mode(chip.index, False)
 
     # ------------------------------------------------------------------
     # Health / inventory

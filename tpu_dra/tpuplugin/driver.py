@@ -16,11 +16,12 @@ from typing import Dict, List, Optional, Tuple
 
 from tpu_dra.infra import featuregates
 from tpu_dra.infra.faults import FAULTS
-from tpu_dra.infra.flock import Flock
+from tpu_dra.infra.flock import Flock, SharedFlock
 from tpu_dra.infra.metrics import DefaultRegistry
 from tpu_dra.infra.workqueue import WorkQueue, default_prep_unprep_rate_limiter
 from tpu_dra.k8s import ApiClient, RESOURCECLAIMS
 from tpu_dra.k8s.client import NotFoundError
+from tpu_dra.kubeletplugin.pipeline import RpcPipeline
 from tpu_dra.kubeletplugin.server import (
     Claim, DRAPluginServer, DriverCallbacks, PrepareResult, publish_resources,
 )
@@ -42,6 +43,26 @@ prepare_batch_size = DefaultRegistry.histogram(
     "the batch is the group-commit unit)",
     buckets=(1, 2, 4, 8, 16, 32, 64))
 
+# Wire-breakdown components (SURVEY §14): the server-side share of
+# prepare_breakdown_rpc_wire_ms, split so a wire regression names its
+# stage — request decode (claim-list build), pipeline queueing
+# (admission window + per-claim-set ordering), response encode.
+_WIRE_BUCKETS = (0.00005, 0.0001, 0.00025, 0.0005, 0.001, 0.0025,
+                 0.005, 0.01, 0.05)
+wire_decode_seconds = DefaultRegistry.histogram(
+    "tpu_dra_prepare_wire_decode_seconds",
+    "server-side request-decode stage per prepare RPC",
+    buckets=_WIRE_BUCKETS)
+wire_queue_seconds = DefaultRegistry.histogram(
+    "tpu_dra_prepare_wire_queue_seconds",
+    "pipeline queue stage per prepare RPC: in-flight-window admission "
+    "plus per-claim-set ordering waits",
+    buckets=_WIRE_BUCKETS)
+wire_encode_seconds = DefaultRegistry.histogram(
+    "tpu_dra_prepare_wire_encode_seconds",
+    "server-side response-encode stage per prepare RPC",
+    buckets=_WIRE_BUCKETS)
+
 
 class TpuDriver(DriverCallbacks):
     def __init__(self, *, state: DeviceState, client: ApiClient,
@@ -53,13 +74,33 @@ class TpuDriver(DriverCallbacks):
         self._client = client
         self._driver_name = driver_name
         self._node_name = node_name
-        self._pu_lock = Flock(flock_path or f"{plugin_dir}/pu.lock")
+        # Shared ownership over the node-global flock: the flock fences
+        # OTHER processes (rolling upgrade); concurrent RPC threads of
+        # this process share it so the pipeline can overlap them.
+        self._pu_lock = SharedFlock(Flock(flock_path
+                                          or f"{plugin_dir}/pu.lock"))
+        # Pipelined admission: bounded in-flight window + per-claim-set
+        # keyed ordering (two RPCs touching the same claim never
+        # reorder; disjoint RPCs overlap — decode/fetch of RPC N+1 runs
+        # while RPC N commits).
+        self._pipeline = RpcPipeline()
+        # Server-side wire attribution of the LAST prepare RPC
+        # ({decode,queue,encode,handler} ms) — the bench's wire-split
+        # source, paired with last_prepare_ms.
+        self.last_wire_breakdown: Dict[str, float] = {}
+        # Per-HANDLER-THREAD queue share: prepare_claims and the
+        # server's record_wire callback run on the same gRPC handler
+        # thread, and concurrent RPCs are real under the pipeline — a
+        # shared field would pair RPC A's decode with RPC B's queue.
+        self._wire_tls = threading.local()
         # Claim-fetch fan-out pool: a batch's ResourceClaims are fetched
         # concurrently so the API-server round-trip is paid once per RPC
         # wall-clock, not once per claim. Sized past any realistic
         # per-pod claim count; larger batches just wave through in turns.
+        self._fetch_workers = 8
         self._fetch_pool = ThreadPoolExecutor(
-            max_workers=8, thread_name_prefix="tpu-dra-claim-fetch")
+            max_workers=self._fetch_workers,
+            thread_name_prefix="tpu-dra-claim-fetch")
         # Wall ms of the last prepare_claims batch (flock + claim fetch
         # + DeviceState.prepare_batch): with the client-observed latency
         # this attributes the gRPC wire share of claim-to-ready (bench).
@@ -115,18 +156,24 @@ class TpuDriver(DriverCallbacks):
     # -- DRA callbacks ------------------------------------------------------
 
     def prepare_claims(self, claims: List[Claim]) -> Dict[str, PrepareResult]:
-        """nodePrepareResource analog (driver.go:166-193), batched: the
-        RPC is the unit of work. ONE flock acquisition covers the whole
-        batch (the per-claim loop re-acquired it N times), the
-        ResourceClaim fetches fan out concurrently, and DeviceState
-        group-commits the batch. Per-claim errors (404, UID mismatch,
-        prepare failure) isolate to that claim's result."""
+        """nodePrepareResource analog (driver.go:166-193), pipelined:
+        the RPC is the unit of work, and concurrent RPCs overlap. The
+        stages per RPC: admission (bounded in-flight window) ->
+        concurrent ResourceClaim fetch fan-out (overlaps freely — reads
+        the API server, not driver state) -> per-claim-set ordering
+        (two RPCs touching the same claim never reorder) -> shared
+        flock -> DeviceState group commit, whose journal fdatasync
+        coalesces across whichever RPCs reach it together. Per-claim
+        errors (404, UID mismatch, prepare failure) isolate to that
+        claim's result."""
         t0 = time.monotonic()
         prepare_batch_size.observe(len(claims))
         results: Dict[str, PrepareResult] = {}
         try:
-            self._pu_lock.acquire(timeout=10.0)
+            ticket = self._pipeline.admit(c.uid for c in claims)
         except TimeoutError as e:
+            # Window never freed (wedged in-flight RPCs): fail fast so
+            # kubelet retries instead of piling blocked handlers.
             return {c.uid: PrepareResult(error=str(e)) for c in claims}
         try:
             objs = []
@@ -135,8 +182,17 @@ class TpuDriver(DriverCallbacks):
                     results[claim.uid] = PrepareResult(error=err)
                 else:
                     objs.append(obj)
-            if objs:
-                results.update(self._state.prepare_batch(objs))
+            try:
+                self._pipeline.order(ticket)
+                self._pu_lock.acquire(timeout=10.0)
+            except TimeoutError as e:
+                return {c.uid: PrepareResult(error=str(e))
+                        for c in claims}
+            try:
+                if objs:
+                    results.update(self._state.prepare_batch(objs))
+            finally:
+                self._pu_lock.release()
             elapsed = time.monotonic() - t0
             # Batch members complete together, so the honest per-claim
             # number is the amortized share (see the metric help text).
@@ -144,28 +200,62 @@ class TpuDriver(DriverCallbacks):
             for _ in claims:
                 claim_prepare_seconds.observe(per_claim)
             self.last_prepare_ms = elapsed * 1e3
+            self._wire_tls.queue_s = ticket.queue_s
+            wire_queue_seconds.observe(ticket.queue_s)
             return results
         finally:
-            self._pu_lock.release()
+            self._pipeline.done(ticket)
 
     def unprepare_claims(self, claims: List[Claim]) -> Dict[str, str]:
-        """One flock + one group-committed unprepare per RPC."""
+        """Same pipeline as prepare (shared claim-uid ordering — an
+        unprepare never overtakes the prepare it follows), one
+        group-committed unprepare per RPC."""
         try:
-            self._pu_lock.acquire(timeout=10.0)
+            ticket = self._pipeline.admit(c.uid for c in claims)
         except TimeoutError as e:
             return {c.uid: str(e) for c in claims}
         try:
-            errors = self._state.unprepare_batch([c.uid for c in claims])
-            return {c.uid: errors.get(c.uid) or "" for c in claims}
+            try:
+                self._pipeline.order(ticket)
+                self._pu_lock.acquire(timeout=10.0)
+            except TimeoutError as e:
+                return {c.uid: str(e) for c in claims}
+            try:
+                errors = self._state.unprepare_batch(
+                    [c.uid for c in claims])
+                return {c.uid: errors.get(c.uid) or "" for c in claims}
+            finally:
+                self._pu_lock.release()
         finally:
-            self._pu_lock.release()
+            self._pipeline.done(ticket)
+
+    def record_wire(self, stage_s: Dict[str, float]) -> None:
+        """Per-RPC wire attribution from the gRPC handler (server.py):
+        decode/encode/handler seconds, merged with the pipeline queue
+        share measured here. Kept as last-RPC ms for the bench."""
+        wire_decode_seconds.observe(stage_s.get("decode", 0.0))
+        wire_encode_seconds.observe(stage_s.get("encode", 0.0))
+        queue_s = getattr(self._wire_tls, "queue_s", 0.0)
+        self._wire_tls.queue_s = 0.0  # consumed: don't smear onto a
+        # later RPC on this thread that timed out before measuring.
+        self.last_wire_breakdown = {
+            "decode": stage_s.get("decode", 0.0) * 1e3,
+            "queue": queue_s * 1e3,
+            "encode": stage_s.get("encode", 0.0) * 1e3,
+            "handler": stage_s.get("handler", 0.0) * 1e3,
+        }
 
     def _fetch_claims(self, claims: List[Claim]
                       ) -> List[Tuple[Claim, Tuple[Optional[Dict],
                                                    Optional[str]]]]:
         """Concurrent ResourceClaim fan-out: [(claim, (obj|None,
         err|None))], duplicates collapsed to their first occurrence.
-        Single-claim batches fetch inline — pool dispatch buys nothing."""
+        Single-claim batches fetch inline — pool dispatch buys nothing.
+        Larger batches fan out as ONE CHUNK PER WORKER, not one task
+        per claim: each task is a sequential loop over its chunk, so a
+        64-claim batch costs 8 pool wakeups instead of 64 (sub-ms
+        per-claim tasks thrash the executor instead of overlapping)
+        while the API round-trips still run 8 wide."""
         unique: List[Claim] = []
         seen = set()
         for claim in claims:
@@ -174,9 +264,19 @@ class TpuDriver(DriverCallbacks):
                 unique.append(claim)
         if len(unique) == 1:
             return [(unique[0], self._fetch_one(unique[0]))]
-        futures = [(c, self._fetch_pool.submit(self._fetch_one, c))
-                   for c in unique]
-        return [(c, f.result()) for c, f in futures]
+        n_chunks = min(self._fetch_workers, len(unique))
+        chunks = [unique[i::n_chunks] for i in range(n_chunks)]
+
+        def fetch_chunk(chunk):
+            return [self._fetch_one(c) for c in chunk]
+
+        futures = [self._fetch_pool.submit(fetch_chunk, ch)
+                   for ch in chunks]
+        by_uid = {}
+        for ch, f in zip(chunks, futures):
+            for claim, res in zip(ch, f.result()):
+                by_uid[claim.uid] = res
+        return [(c, by_uid[c.uid]) for c in unique]
 
     def _fetch_one(self, claim: Claim
                    ) -> Tuple[Optional[Dict], Optional[str]]:
