@@ -633,13 +633,16 @@ def _start_bind_watcher(cluster, stop):
     return bound_q, watcher
 
 
-def bench_sched_churn(n_nodes: int = 100, n_pods: int = 500,
-                      chips_per_node: int = 4, window: int = None):
-    """Control-plane churn at scale (ISSUE 3): N fake nodes publishing
-    ResourceSlices, M pod lifecycles (create -> template claim ->
-    allocate -> bind -> delete -> claim GC) through the EVENT-DRIVEN
-    scheduler (informer/workqueue + incremental allocation index +
-    compile-cached CEL). Reports:
+def bench_sched_churn(n_nodes: int = None, n_pods: int = None,
+                      chips_per_node: int = 4, window: int = None,
+                      workers: int = None):
+    """Control-plane churn at scale (ISSUE 3, parallelized in ISSUE 8):
+    N fake nodes publishing ResourceSlices, M pod lifecycles (create ->
+    template claim -> allocate -> bind -> delete -> claim GC) through
+    the EVENT-DRIVEN scheduler (informer/workqueue pool + sharded
+    allocation index + snapshot scans + compile-cached CEL). Node/pod
+    counts default from TPU_DRA_BENCH_SCHED_NODES/PODS (overnight
+    5k-node runs set the env instead of editing call sites). Reports:
 
     - sched_pod_to_allocated_p50_ms: pod create -> bound+allocated wall
       (measured from the pod watch stream, `window` lifecycles in
@@ -656,11 +659,16 @@ def bench_sched_churn(n_nodes: int = 100, n_pods: int = 500,
 
     from tpu_dra.infra.metrics import (
         CEL_CACHE_HITS, CEL_CACHE_MISSES, CEL_COMPILES, SCHED_FULL_RELISTS,
+        SCHED_SHARD_RESYNCS, SCHED_SNAPSHOT_CONFLICTS,
     )
     from tpu_dra.k8s import FakeCluster, PODS, RESOURCECLAIMS
     from tpu_dra.simcluster.scheduler import Scheduler
     from tpu_dra.testing import DEFAULT_SCHED_SELECTOR, seed_sched_inventory
 
+    n_nodes = n_nodes if n_nodes is not None else int(
+        os.environ.get("TPU_DRA_BENCH_SCHED_NODES", "100"))
+    n_pods = n_pods if n_pods is not None else int(
+        os.environ.get("TPU_DRA_BENCH_SCHED_PODS", "500"))
     cluster = FakeCluster()
     # Two selector expressions so the CEL cache sees a conjunction per
     # allocation; both must compile exactly once across the whole churn.
@@ -676,13 +684,16 @@ def bench_sched_churn(n_nodes: int = 100, n_pods: int = 500,
     window = min(window or 64, max(1, capacity // 2), n_pods)
 
     relists0 = SCHED_FULL_RELISTS.value()
+    conflicts0 = SCHED_SNAPSHOT_CONFLICTS.value()
+    resyncs0 = SCHED_SHARD_RESYNCS.value()
     compiles0 = CEL_COMPILES.value()
     hits0, misses0 = CEL_CACHE_HITS.value(), CEL_CACHE_MISSES.value()
 
     # Sweep pushed far beyond the bench horizon: the claim-GC drain
     # check below must prove the EVENT path works, not be masked by the
     # periodic safety net firing inside the wait window.
-    sched = Scheduler(cluster, resync_interval=2.0, gc_sweep_interval=3600.0)
+    sched = Scheduler(cluster, resync_interval=2.0, gc_sweep_interval=3600.0,
+                      workers=workers)
     sched.start()
     stop = threading.Event()
     bound_q, _watcher = _start_bind_watcher(cluster, stop)
@@ -741,6 +752,11 @@ def bench_sched_churn(n_nodes: int = 100, n_pods: int = 500,
         "sched_churn_pods": n_pods,
         "sched_churn_chips_per_node": chips_per_node,
         "sched_churn_window": window,
+        "sched_workers": sched._workers,
+        "sched_index_shards": sched._index.n_shards,
+        "sched_snapshot_conflicts": int(
+            SCHED_SNAPSHOT_CONFLICTS.value() - conflicts0),
+        "sched_shard_resyncs": int(SCHED_SHARD_RESYNCS.value() - resyncs0),
         "sched_cel_compiles": compiles,
         "sched_cel_distinct_exprs": distinct,
         "sched_cel_cache_hit_pct": round(
@@ -1132,6 +1148,32 @@ def main():
         out.update(bench_sched_churn())
     except Exception as e:  # noqa: BLE001 — churn phase is best-effort
         out["sched_churn_error"] = str(e)
+    try:
+        # Scaled churn (ISSUE 8): its own isolated section, keys
+        # prefixed sched_scaled_* — a failure here must not blank the
+        # standard scheduler keys above (PR 7's r05 lesson) and vice
+        # versa. Two passes: the default pool (sched_scaled_*) and a
+        # single-worker pass (sched_scaled_w1_*). On GIL-bound CPython
+        # with the in-process fake apiserver the single-worker pass is
+        # the throughput ceiling (SURVEY §15); the pool pass pins the
+        # no-regression bound at full parallelism.
+        sn = int(os.environ.get("TPU_DRA_BENCH_SCHED_SCALED_NODES", "1000"))
+        sp = int(os.environ.get("TPU_DRA_BENCH_SCHED_SCALED_PODS", "5000"))
+        scaled = bench_sched_churn(n_nodes=sn, n_pods=sp)
+        out.update({k.replace("sched_", "sched_scaled_", 1): v
+                    for k, v in scaled.items()})
+        w1 = bench_sched_churn(n_nodes=sn, n_pods=sp, workers=1)
+        out.update({
+            "sched_scaled_w1_throughput_pods_per_s":
+                w1["sched_throughput_pods_per_s"],
+            "sched_scaled_w1_pod_to_allocated_p50_ms":
+                w1["sched_pod_to_allocated_p50_ms"],
+            "sched_scaled_w1_pod_to_allocated_p95_ms":
+                w1["sched_pod_to_allocated_p95_ms"],
+            "sched_scaled_w1_full_relists": w1["sched_full_relists"],
+        })
+    except Exception as e:  # noqa: BLE001 — scaled phase is best-effort
+        out["sched_scaled_churn_error"] = str(e)
     try:
         out.update(bench_topology())
     except Exception as e:  # noqa: BLE001 — topology phase is best-effort

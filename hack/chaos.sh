@@ -8,10 +8,13 @@
 #    each) through tpu_dra.simcluster.chaos — claim convergence, no
 #    orphaned CDI specs, no leaked checkpoints, ResourceSlice vs
 #    healthy-chip consistency — plus the dropped-watch + API-flake
-#    informer recovery scenario, the scheduler-churn walk, and the
-#    topology walk (TopologyAwareScheduling on: every multi-chip
-#    allocation an ICI-contiguous cuboid, topology free-set == the
-#    allocation index after quiesce). Violations exit non-zero.
+#    informer recovery scenario, the scheduler-churn walk (workers=4:
+#    the multi-worker pool, sharded index and optimistic snapshot
+#    commits run under every schedule, incl. the sched.shard_apply /
+#    sched.snapshot_commit fault sites), and the topology walk
+#    (TopologyAwareScheduling on: every multi-chip allocation an
+#    ICI-contiguous cuboid, topology free-set == the allocation index
+#    after quiesce). Violations exit non-zero.
 # 2. The @slow chaos soak tests (excluded from tier-1 by -m 'not slow').
 set -euo pipefail
 REPO_ROOT="$(cd "$(dirname "$0")/.." && pwd)"

@@ -6,10 +6,12 @@
 # Runs `python -m tpu_dra.analysis.drmc` over the gate scenarios:
 #
 # 1. Interleaving explorer — DPOR-lite systematic exploration of the
-#    scheduler-churn (WorkQueue + AllocationIndex) and batch-prepare
-#    (concurrent DeviceState batches) scenarios, asserting the chaos
-#    invariants (no double allocation, index == truth, checkpoint/CDI
-#    consistency, acyclic lock witness) at EVERY terminal state. The
+#    scheduler-churn (MULTI-WORKER WorkQueue pool + sharded
+#    AllocationIndex, with a per-key serialization probe) and
+#    batch-prepare (concurrent DeviceState batches) scenarios,
+#    asserting the chaos invariants (no double allocation, index ==
+#    truth, checkpoint/CDI consistency, acyclic lock witness) at EVERY
+#    terminal state. The
 #    gate requires >= 200 distinct interleavings total (--min-schedules)
 #    so a silently shrunken scenario cannot go green by exploring
 #    nothing.

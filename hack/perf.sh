@@ -26,6 +26,17 @@
 #    distinct selector sources (compile cache), claim GC must drain, and
 #    the pod-to-allocated p50 must not regress >50% against the newest
 #    BENCH_r*.json round that recorded it.
+# 4. SCALED churn gates (ISSUE 8, parallel scheduler core; SURVEY §15)
+#    at SCHED_SCALED_NODES x SCHED_SCALED_PODS (defaults 1000x5000):
+#    against the r05 single-worker scheduler measured at the SAME size
+#    in this environment (SCHED_SCALED_BASELINE_PPS/P50_MS), the
+#    single-worker pass must deliver >= 2x throughput and <= 2x p50
+#    (the core's speed: snapshot scans, busy-node skip, candidate
+#    caching, nudge-set fix, cheap fake-apiserver copies), the
+#    default-pool pass must not regress below 1x (GIL-bound CPython
+#    gains nothing from extra sim workers — the pool is the
+#    concurrency substrate, chaos-verified at workers=4), and full
+#    relists must be 0 in both.
 set -euo pipefail
 REPO_ROOT="$(cd "$(dirname "$0")/.." && pwd)"
 CYCLES="${1:-${PERF_CYCLES:-30}}"
@@ -178,6 +189,59 @@ if prev is not None and out["sched_pod_to_allocated_p50_ms"] > prev[1] * 1.5:
     sys.exit(f"REGRESSION: sched_pod_to_allocated_p50_ms "
              f"{out['sched_pod_to_allocated_p50_ms']} > 1.5x {prev[1]} "
              f"({prev[0]})")
+EOF
+
+echo ">> scaled scheduler churn gates (${SCHED_SCALED_NODES:-1000} nodes x ${SCHED_SCALED_PODS:-5000} pods, vs r05 single-worker baseline)"
+# Baseline: the r05 scheduler (commit 2137df2, single worker) measured
+# at 1000x5000 on THIS container (2026-08-03, git worktree at HEAD):
+# 313.1 pods/s, p50 191.0ms, p95 288.2ms. Re-measure and override via
+# env when gating in a different environment.
+JAX_PLATFORMS=cpu TPU_DRA_TPUINFO_BACKEND=fake \
+  SCHED_SCALED_NODES="${SCHED_SCALED_NODES:-1000}" \
+  SCHED_SCALED_PODS="${SCHED_SCALED_PODS:-5000}" \
+  SCHED_SCALED_BASELINE_PPS="${SCHED_SCALED_BASELINE_PPS:-313.1}" \
+  SCHED_SCALED_BASELINE_P50_MS="${SCHED_SCALED_BASELINE_P50_MS:-191.0}" \
+  python - <<'EOF'
+import json
+import os
+import sys
+
+import bench
+
+nodes = int(os.environ["SCHED_SCALED_NODES"])
+pods = int(os.environ["SCHED_SCALED_PODS"])
+base_pps = float(os.environ["SCHED_SCALED_BASELINE_PPS"])
+base_p50 = float(os.environ["SCHED_SCALED_BASELINE_P50_MS"])
+
+w1 = bench.bench_sched_churn(n_nodes=nodes, n_pods=pods, workers=1)
+print(json.dumps({f"w1_{k}": v for k, v in w1.items()
+                  if k.startswith("sched_")}))
+if w1["sched_full_relists"] != 0:
+    sys.exit(f"REGRESSION: {w1['sched_full_relists']} full relists in the "
+             "scaled single-worker churn")
+if w1["sched_throughput_pods_per_s"] < 2.0 * base_pps:
+    sys.exit(f"REGRESSION: scaled single-worker throughput "
+             f"{w1['sched_throughput_pods_per_s']} pods/s < 2x r05 "
+             f"baseline {base_pps} (ISSUE 8 gate)")
+if w1["sched_pod_to_allocated_p50_ms"] > 2.0 * base_p50:
+    sys.exit(f"REGRESSION: scaled single-worker p50 "
+             f"{w1['sched_pod_to_allocated_p50_ms']}ms > 2x r05 baseline "
+             f"{base_p50}ms (ISSUE 8 gate)")
+
+pool = bench.bench_sched_churn(n_nodes=nodes, n_pods=pods)  # default pool
+print(json.dumps({f"pool_{k}": v for k, v in pool.items()
+                  if k.startswith("sched_")}))
+if pool["sched_full_relists"] != 0:
+    sys.exit(f"REGRESSION: {pool['sched_full_relists']} full relists in "
+             "the scaled pool churn")
+if pool["sched_workers"] < 2:
+    sys.exit("REGRESSION: the scaled pool pass ran single-worker — the "
+             "multi-worker default was lost")
+if pool["sched_throughput_pods_per_s"] < base_pps:
+    sys.exit(f"REGRESSION: scaled pool throughput "
+             f"{pool['sched_throughput_pods_per_s']} pods/s regressed "
+             f"below the r05 single-worker baseline {base_pps} — the "
+             "worker pool must never cost more than it buys")
 EOF
 
 echo ">> topology gates (4x4x4 torus churn, TopologyAwareScheduling on)"

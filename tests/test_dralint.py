@@ -206,6 +206,18 @@ class TestR3ZeroCopyViews:
         """, "R3")
         assert out == []
 
+    def test_json_deepcopy_launders_the_view(self):
+        # The JSON-shaped fast path (k8s.client.json_deepcopy) is the
+        # second sanctioned escape hatch (SURVEY §15).
+        out = lint("""
+            class S:
+                def ok(self):
+                    pod = self.inf.lister.get("x", "ns")
+                    upd = json_deepcopy(pod)
+                    upd["spec"]["nodeName"] = "n1"
+        """, "R3")
+        assert out == []
+
     def test_reads_are_fine(self):
         out = lint("""
             class S:

@@ -11,7 +11,9 @@ import pytest
 
 import bench
 from tpu_dra.infra.faults import FAULTS, EveryNth
-from tpu_dra.infra.metrics import SCHED_FULL_RELISTS
+from tpu_dra.infra.metrics import (
+    SCHED_FULL_RELISTS, SCHED_SNAPSHOT_CONFLICTS,
+)
 from tpu_dra.k8s import FakeCluster, PODS, RESOURCECLAIMS
 from tpu_dra.simcluster.chaos import SchedulerChaosHarness, chip_conflicts
 from tpu_dra.simcluster.scheduler import AllocationIndex, Scheduler
@@ -75,6 +77,269 @@ class TestAllocationIndex:
         idx.apply(truth[0])
         assert idx.diff_against(truth) == []
         assert idx.diff_against([]) != []  # index holds a stale claim
+
+
+class TestShardedIndex:
+    """The ISSUE 8 sharded AllocationIndex: pool routing, optimistic
+    snapshot commits, reservations, and shard-scoped resync."""
+
+    DRIVER = "tpu.dev"
+
+    def _claim(self, name, devices, pool="n0", rv=None):
+        md = {"name": name, "namespace": "default"}
+        if rv is not None:
+            md["resourceVersion"] = str(rv)
+        return {"metadata": md,
+                "status": {"allocation": {"devices": {"results": [
+                    {"driver": self.DRIVER, "pool": pool, "device": d}
+                    for d in devices]}}}}
+
+    def _two_pools_two_shards(self, idx):
+        """Two pool names routing to different shards."""
+        a = "n0"
+        for i in range(1, 64):
+            b = f"n{i}"
+            if idx.shard_of(b) != idx.shard_of(a):
+                return a, b
+        raise AssertionError("no second shard found")
+
+    def test_routing_is_stable_and_per_shard_diff_detects(self):
+        idx = AllocationIndex(n_shards=4)
+        a, b = self._two_pools_two_shards(idx)
+        ca = self._claim("ca", ["chip-0"], pool=a)
+        cb = self._claim("cb", ["chip-0"], pool=b)
+        idx.apply(ca)
+        idx.apply(cb)
+        assert idx.diff_against([ca, cb]) == []
+        # Dropping one claim from truth flags exactly its shard.
+        diffs = idx.diff_against([ca])
+        assert len(diffs) == 1 and f"shard {idx.shard_of(b)}" in diffs[0]
+
+    def test_snapshot_commit_reserves_all_or_nothing(self):
+        idx = AllocationIndex(n_shards=2)
+        view = idx.snapshot("n0")
+        assert not view.is_taken(self.DRIVER, "chip-0")
+        staged = [("default/ca", ((self.DRIVER, "n0", "chip-0"),
+                                  (self.DRIVER, "n0", "chip-1")))]
+        assert idx.try_commit("n0", staged)
+        # Reserved devices are taken for every later snapshot/scan...
+        assert idx.is_taken(self.DRIVER, "n0", "chip-0")
+        assert idx.snapshot("n0").is_taken(self.DRIVER, "chip-1")
+        # ...and a conflicting commit is refused atomically.
+        c0 = SCHED_SNAPSHOT_CONFLICTS.value()
+        assert not idx.try_commit("n0", [
+            ("default/cb", ((self.DRIVER, "n0", "chip-2"),)),
+            ("default/cc", ((self.DRIVER, "n0", "chip-1"),))])
+        assert SCHED_SNAPSHOT_CONFLICTS.value() == c0 + 1
+        assert not idx.is_taken(self.DRIVER, "n0", "chip-2"), \
+            "losing commit leaked a partial reservation"
+        # Release returns the devices to the free set.
+        idx.release("n0", ["default/ca"])
+        assert not idx.is_taken(self.DRIVER, "n0", "chip-0")
+
+    def test_commit_respects_partition_semantics(self):
+        idx = AllocationIndex(n_shards=2)
+        idx.apply(self._claim("ca", ["chip-0-ss-1c-0"]))
+        # The sibling subslice coexists; the whole chip does not.
+        assert idx.try_commit("n0", [
+            ("default/cb", ((self.DRIVER, "n0", "chip-0-ss-1c-1"),))])
+        assert not idx.try_commit("n0", [
+            ("default/cc", ((self.DRIVER, "n0", "chip-0"),))])
+
+    def test_commit_refused_while_shard_dirty_or_resyncing(self):
+        idx = AllocationIndex(n_shards=2)
+        sid = idx.shard_of("n0")
+        staged = [("default/ca", ((self.DRIVER, "n0", "chip-0"),))]
+        idx.mark_shard_dirty(sid, "test")
+        assert not idx.try_commit("n0", staged)
+        idx.begin_resync(sid)  # clears dirty, sets resyncing
+        assert not idx.try_commit("n0", staged)
+        assert idx.resync_shard(sid, [])
+        assert idx.try_commit("n0", staged)
+
+    def test_resync_shard_rebuilds_only_its_shard(self):
+        idx = AllocationIndex(n_shards=4)
+        a, b = self._two_pools_two_shards(idx)
+        idx.apply(self._claim("ca", ["chip-0"], pool=a))
+        idx.apply(self._claim("cb", ["chip-0"], pool=b))
+        # Rebuild a's shard from a listing that no longer has ca.
+        idx.begin_resync(idx.shard_of(a))
+        assert idx.resync_shard(idx.shard_of(a), [])
+        assert not idx.is_taken(self.DRIVER, a, "chip-0")
+        assert idx.is_taken(self.DRIVER, b, "chip-0"), \
+            "sibling shard state lost to another shard's resync"
+
+    def test_resync_preserves_reservations(self):
+        idx = AllocationIndex(n_shards=2)
+        assert idx.try_commit("n0", [
+            ("default/ca", ((self.DRIVER, "n0", "chip-0"),))])
+        sid = idx.shard_of("n0")
+        idx.begin_resync(sid)
+        assert idx.resync_shard(sid, [])
+        assert idx.is_taken(self.DRIVER, "n0", "chip-0"), \
+            "in-flight reservation dropped by resync"
+
+    def test_shard_swap_refused_when_mutations_raced(self):
+        idx = AllocationIndex(n_shards=2)
+        sid = idx.shard_of("n0")
+        gen = idx.mutation_count(sid)
+        idx.apply(self._claim("ca", ["chip-0"], rv=5))
+        assert not idx.resync_shard(sid, [], only_if_mutations=gen), \
+            "stale resync snapshot silently clobbered a newer mutation"
+
+    def test_commit_refuses_same_key_reservation_overwrite(self):
+        """Two workers racing one shared unallocated claim (different
+        pods, so per-key serialization does not order them): the second
+        commit must CONFLICT — overwriting the live reservation would
+        strand the first pick's refcounts when both release."""
+        idx = AllocationIndex(n_shards=2)
+        assert idx.try_commit("n0", [
+            ("default/ca", ((self.DRIVER, "n0", "chip-0"),))])
+        assert not idx.try_commit("n0", [
+            ("default/ca", ((self.DRIVER, "n0", "chip-1"),))])
+        idx.release("n0", ["default/ca"])
+        assert not idx.is_taken(self.DRIVER, "n0", "chip-0"), \
+            "reservation refcount stranded after release"
+        assert not idx.is_taken(self.DRIVER, "n0", "chip-1")
+
+    def test_commit_refuses_stale_copy_of_allocated_claim(self):
+        """A commit staged from a stale claim copy (already allocated
+        to other devices by a sibling worker) must conflict, not
+        reserve a second set of devices for the same claim."""
+        idx = AllocationIndex(n_shards=2)
+        idx.apply(self._claim("ca", ["chip-0"]))
+        assert not idx.try_commit("n0", [
+            ("default/ca", ((self.DRIVER, "n0", "chip-1"),))])
+
+    def test_allocated_count_no_double_count_in_write_window(self):
+        """Between _after_claim_write's index apply and the caller's
+        release the same entries are in _by_claim AND _reserved —
+        allocated_count must count them once or the busy-node skip
+        passes over free capacity."""
+        idx = AllocationIndex(n_shards=2)
+        assert idx.try_commit("n0", [
+            ("default/ca", ((self.DRIVER, "n0", "chip-0"),))])
+        idx.apply(self._claim("ca", ["chip-0"], rv=3))
+        assert idx.allocated_count("n0") == 1, "reservation double-counted"
+        idx.release("n0", ["default/ca"])
+        assert idx.allocated_count("n0") == 1
+
+    def test_cross_pool_move_purges_old_shard(self):
+        """A claim deallocated out-of-band and re-allocated on another
+        pool must not orphan its old entries in the old pool's shard —
+        and a stale replay carrying the OLD pool must neither resurrect
+        them nor repoint the routing."""
+        idx = AllocationIndex(n_shards=4)
+        a, b = self._two_pools_two_shards(idx)
+        idx.apply(self._claim("ca", ["chip-0"], pool=a, rv=5))
+        # The dealloc watch event is in flight but the re-allocation's
+        # mutation-cache apply (rv 7, pool b) lands first.
+        moved = self._claim("ca", ["chip-0"], pool=b, rv=7)
+        idx.apply(moved)
+        assert not idx.is_taken(self.DRIVER, a, "chip-0"), \
+            "old pool's shard kept the moved claim's entries"
+        assert idx.is_taken(self.DRIVER, b, "chip-0")
+        assert idx.diff_against([moved]) == []
+        # The late dealloc (entry-less, rv 6) routes via the new home
+        # and is stale-dropped; a replayed old ADDED (pool a, rv 4) is
+        # stale-dropped in a's shard without repointing the home.
+        idx.apply(self._claim("ca", [], pool=a, rv=6))
+        idx.apply(self._claim("ca", ["chip-0"], pool=a, rv=4))
+        assert idx.diff_against([moved]) == []
+        assert idx.entries_for("default/ca") == (
+            (self.DRIVER, b, "chip-0"),)
+        # Delete converges both shards regardless of event/home skew.
+        idx.remove(moved, force=True)
+        assert idx.diff_against([]) == []
+
+    def test_delayed_delete_replay_cannot_evict_recreated_claim(self):
+        """Template claims reuse deterministic names, so delete +
+        recreate reuses the claim key. A delayed DELETED watch replay
+        carrying the OLD incarnation's body (old pool, old RV) routes
+        its home-shard purge to the recreated claim's NEW shard — which
+        must refuse it as stale rather than evict the live allocation
+        (the index would report the devices free: double allocation)."""
+        idx = AllocationIndex(n_shards=4)
+        a, b = self._two_pools_two_shards(idx)
+        idx.apply(self._claim("ca", ["chip-0"], pool=a, rv=5))
+        # Worker GC: the scheduler mirrors its own delete (rv 20).
+        idx.remove(self._claim("ca", ["chip-0"], pool=a, rv=20),
+                   force=True)
+        # Pod recreated; the new incarnation allocates on pool b.
+        live = self._claim("ca", ["chip-1"], pool=b, rv=21)
+        idx.apply(live)
+        # The old incarnation's DELETED event arrives late on the
+        # informer thread.
+        idx.remove(self._claim("ca", ["chip-0"], pool=a, rv=20))
+        assert idx.entries_for("default/ca") == (
+            (self.DRIVER, b, "chip-1"),)
+        assert idx.diff_against([live]) == []
+
+    def test_resync_prunes_homes_of_claims_deleted_while_divergent(self):
+        """A claim deleted during a shard's divergence window (the
+        dropped DELETE is why the resync runs) never re-enters the
+        eviction FIFO — the rebuild must prune its routing home, or
+        _homes grows one entry per such claim forever."""
+        idx = AllocationIndex(n_shards=4)
+        a, b = self._two_pools_two_shards(idx)
+        ca = self._claim("ca", ["chip-0"], pool=a, rv=5)
+        cb = self._claim("cb", ["chip-0"], pool=b, rv=6)
+        idx.apply(ca)
+        idx.apply(cb)
+        sid = idx.shard_of(a)
+        # ca was deleted out-of-band; the listing no longer has it.
+        assert idx.resync_shard(sid, [cb])
+        assert "default/ca" not in idx._homes
+        assert "default/cb" in idx._homes  # other shard: untouched
+        assert idx.diff_against([cb]) == []
+
+    def test_claim_level_conflict_signals_stale_copy(self):
+        """try_commit distinguishes claim-level conflicts (None: the
+        caller's claim copy is stale, rescans are futile) from
+        device-level ones (False: a fresh snapshot can win)."""
+        idx = AllocationIndex(n_shards=2)
+        assert idx.try_commit("n0", [
+            ("default/ca", ((self.DRIVER, "n0", "chip-0"),))])
+        shared = idx.try_commit("n0", [
+            ("default/ca", ((self.DRIVER, "n0", "chip-1"),))])
+        assert shared is None
+        taken = idx.try_commit("n0", [
+            ("default/cb", ((self.DRIVER, "n0", "chip-0"),))])
+        assert taken is False
+
+    def test_old_shard_eviction_keeps_moved_claims_routing(self, monkeypatch):
+        """Watermark eviction in a claim's OLD shard (post cross-pool
+        move) must not drop the live claim's routing home — or later
+        entry-less deallocs/deletes become unroutable and the new shard
+        keeps a phantom entry no dirty flag ever triggers a resync for."""
+        from tpu_dra.simcluster import scheduler as sched_mod
+
+        monkeypatch.setattr(sched_mod._IndexShard, "RV_RETENTION", 4)
+        idx = AllocationIndex(n_shards=4)
+        a, b = self._two_pools_two_shards(idx)
+        idx.apply(self._claim("ca", ["chip-0"], pool=a, rv=5))
+        moved = self._claim("ca", ["chip-0"], pool=b, rv=7)
+        idx.apply(moved)  # ca now lives in b's shard; a's FIFO holds it
+        # Churn OTHER claims through a's shard past the retention
+        # horizon, evicting ca from a's FIFO.
+        for i in range(8):
+            c = self._claim(f"f{i}", ["chip-9"], pool=a, rv=10 + 2 * i)
+            idx.apply(c)
+            idx.remove(self._claim(f"f{i}", [], pool=a, rv=11 + 2 * i))
+        # The late entry-less dealloc must still route to b's shard.
+        idx.apply(self._claim("ca", [], pool=b, rv=9))
+        assert idx.diff_against([]) == []
+        assert not idx.dirty
+
+    def test_allocated_count_includes_reservations(self):
+        idx = AllocationIndex(n_shards=2)
+        idx.apply(self._claim("ca", ["chip-0", "chip-1"]))
+        assert idx.allocated_count("n0") == 2
+        idx.try_commit("n0", [
+            ("default/cb", ((self.DRIVER, "n0", "chip-2"),))])
+        assert idx.allocated_count("n0") == 3
+        idx.release("n0", ["default/cb"])
+        assert idx.allocated_count("n0") == 2
 
 
 class TestEventDrivenScheduler:
@@ -163,6 +428,79 @@ class TestEventDrivenScheduler:
         s.reconcile_once()
         s.reconcile_once()
         assert SCHED_FULL_RELISTS.value() - r0 == 2
+
+
+class TestMultiWorkerScheduler:
+    """The worker pool end-to-end: churn at workers=4 with the chaos
+    invariants, and the optimistic-commit conflict/requeue path."""
+
+    def test_pool_churn_no_double_allocation(self):
+        c = make_cluster(nodes=4, chips=2)
+        s = Scheduler(c, resync_interval=0.2, gc_sweep_interval=3600.0,
+                      workers=4)
+        s.start()
+        try:
+            for i in range(8):  # exactly capacity: all must place
+                make_pod(c, f"mw{i}")
+            assert c.wait_for(
+                lambda: all(
+                    c.get(PODS, f"mw{i}", "default")["spec"].get("nodeName")
+                    for i in range(8)),
+                timeout=15), "pool churn did not converge"
+            claims = c.list(RESOURCECLAIMS, namespace="default")
+            assert chip_conflicts(claims) == []
+            assert s.verify_index() == []
+        finally:
+            s.stop()
+
+    def test_commit_conflict_requeues_and_converges(self):
+        """An armed sched.snapshot_commit fault refuses the first
+        commits; the pod must retry against fresh snapshots and still
+        place, with the conflict counter advancing."""
+        from tpu_dra.infra.metrics import SCHED_SNAPSHOT_CONFLICTS as SC
+        c = make_cluster(nodes=2, chips=2)
+        s = Scheduler(c, resync_interval=0.1, gc_sweep_interval=3600.0,
+                      workers=2)
+        c0 = SC.value()
+        s.start()
+        try:
+            with FAULTS.armed("sched.snapshot_commit", EveryNth(2)):
+                for i in range(3):
+                    make_pod(c, f"cf{i}")
+                assert c.wait_for(
+                    lambda: all(
+                        c.get(PODS, f"cf{i}", "default")["spec"].get(
+                            "nodeName") for i in range(3)),
+                    timeout=15), "conflicts did not resolve via requeue"
+            assert SC.value() > c0, "fault never exercised the conflict path"
+            assert s.verify_index() == []
+            assert chip_conflicts(
+                c.list(RESOURCECLAIMS, namespace="default")) == []
+        finally:
+            s.stop()
+
+    def test_shard_apply_fault_triggers_shard_scoped_resync(self):
+        from tpu_dra.infra.metrics import SCHED_SHARD_RESYNCS as SR
+        c = make_cluster(nodes=2, chips=2)
+        s = Scheduler(c, resync_interval=0.1, gc_sweep_interval=3600.0,
+                      workers=2)
+        r0 = SR.value()
+        s.start()
+        try:
+            with FAULTS.armed("sched.shard_apply", EveryNth(3)):
+                for i in range(4):
+                    make_pod(c, f"sa{i}")
+                assert c.wait_for(
+                    lambda: all(
+                        c.get(PODS, f"sa{i}", "default")["spec"].get(
+                            "nodeName") for i in range(4)),
+                    timeout=15), "churn did not converge under shard faults"
+            assert c.wait_for(lambda: not s._index.dirty, timeout=5)
+            assert SR.value() > r0, \
+                "shard faults never routed through the shard resync"
+            assert s.verify_index() == []
+        finally:
+            s.stop()
 
 
 class TestSchedulerChaos:

@@ -8,9 +8,10 @@ at every terminal state: no device double-allocation
 (simcluster.chaos.chip_conflicts), allocation index == truth
 (AllocationIndex.diff_against), checkpoint/CDI consistency, and an
 acyclic lock-order graph (the witness runs under every schedule).
-``sched-churn`` drives the WorkQueue + AllocationIndex pair ROADMAP
-item 1's multi-worker refactor will stress; ``batch-prepare`` drives
-concurrent DeviceState prepare/unprepare/health batches. ``racy-index``
+``sched-churn`` drives the MULTI-WORKER WorkQueue pool + sharded
+AllocationIndex pair the parallel scheduler core (SURVEY §15) is built
+on, with an explicit per-key serialization probe; ``batch-prepare``
+drives concurrent DeviceState prepare/unprepare/health batches. ``racy-index``
 is the deliberately-buggy fixture — an unserialized check-then-act on
 the index — whose violating schedule the tests record and replay.
 
@@ -71,12 +72,16 @@ def _mk_claim(name: str, devices: List[str], rv: int,
 # ---------------------------------------------------------------------------
 
 class SchedChurnScenario:
-    """A single-worker queue processing keyed bind/unbind reconciles
-    against an AllocationIndex, while two producers enqueue (same-key
-    dedupe included) and a stopper shuts the queue down mid-stream.
-    Which pods end up bound is schedule-dependent BY DESIGN (an unbind
-    racing its bind is real churn); the invariants are the safety
-    properties that must hold under every ordering."""
+    """A MULTI-WORKER queue pool (two controlled consumers) processing
+    keyed bind/unbind reconciles against a sharded AllocationIndex,
+    while two producers enqueue (same-key dedupe included) and a
+    stopper shuts the queue down mid-stream. Which pods end up bound is
+    schedule-dependent BY DESIGN (an unbind racing its bind is real
+    churn); the invariants are the safety properties that must hold
+    under every ordering — including the pool's per-key serialization
+    contract: two items sharing a key must NEVER be mid-callback on two
+    workers at once (the deferral path in WorkQueue._get), witnessed by
+    an explicit overlap probe rather than trusted."""
 
     name = "sched-churn"
 
@@ -89,9 +94,31 @@ class SchedChurnScenario:
         truth_lock = threading.Lock()   # witnessed: created under install
         rvs = itertools.count(1)
         devices = ["chip-0", "chip-1", "chip-2"]
+        # Per-key overlap probe: counts callbacks mid-flight per key.
+        # Kept under its own witnessed lock; any count > 1 is a
+        # violation of the pool's client-go parallelism contract.
+        active: Dict[str, int] = {}
+        overlaps: List[str] = []
+        probe_lock = threading.Lock()
+
+        def keyed(key: str, body):
+            def cb(_obj) -> None:
+                with probe_lock:
+                    n = active.get(key, 0) + 1
+                    active[key] = n
+                    if n > 1:
+                        overlaps.append(
+                            f"key {key}: {n} callbacks mid-flight — "
+                            "per-key serialization broken")
+                try:
+                    body()
+                finally:
+                    with probe_lock:
+                        active[key] -= 1
+            return cb
 
         def bind(key: str):
-            def cb(_obj) -> None:
+            def body() -> None:
                 # Serialized check-then-act: the pick, the index apply
                 # and the truth record commit atomically under the
                 # truth lock — the discipline racy-index drops.
@@ -104,23 +131,23 @@ class SchedChurnScenario:
                     claim = _mk_claim(key, [free[0]], next(rvs))
                     index.apply(claim)
                     truth[key] = claim
-            return cb
+            return keyed(key, body)
 
         def unbind(key: str):
-            def cb(_obj) -> None:
+            def body() -> None:
                 with truth_lock:
                     claim = truth.pop(key, None)
                     if claim is not None:
                         index.remove(claim, force=True)
-            return cb
-
-        def worker() -> None:
-            queue.run()
+            return keyed(key, body)
 
         def producer1() -> None:
             queue.enqueue(None, bind("pod-a"), key="pod-a")
             queue.enqueue(None, bind("pod-b"), key="pod-b", dedupe=True)
-            # Same-key storm: must absorb into the queued pod-b item.
+            # Same-key storm: absorbs into the queued pod-b item while
+            # it has not been handed to a worker; once it HAS, this
+            # enqueues a second pod-b item — which the pool must then
+            # defer, never run concurrently with the first.
             queue.enqueue(None, bind("pod-b"), key="pod-b", dedupe=True)
 
         def producer2() -> None:
@@ -130,24 +157,32 @@ class SchedChurnScenario:
         def stopper() -> None:
             queue.shutdown()
 
-        sched.spawn("worker", worker)
+        sched.spawn("worker0", queue.run)
+        sched.spawn("worker1", queue.run)
         sched.spawn("producer1", producer1)
         sched.spawn("producer2", producer2)
         sched.spawn("stopper", stopper)
-        return {"queue": queue, "index": index, "truth": truth}
+        return {"queue": queue, "index": index, "truth": truth,
+                "overlaps": overlaps}
 
     def check(self, ctx) -> List[str]:
         from tpu_dra.simcluster.chaos import chip_conflicts
 
         queue, index, truth = ctx["queue"], ctx["index"], ctx["truth"]
         # Quiesce: a shutdown racing the producers legitimately strands
-        # queued items; drain them the way a restarted worker would.
+        # queued AND deferred items; drain both the way a restarted
+        # worker would (single-threaded here, so serialization holds).
         import heapq
-        while queue._heap:
-            _, _, item = heapq.heappop(queue._heap)
-            item.callback(item.obj)
+        while queue._heap or queue._deferred:
+            while queue._heap:
+                _, _, item = heapq.heappop(queue._heap)
+                item.callback(item.obj)
+            for key in sorted(queue._deferred):
+                for item in queue._deferred.pop(key):
+                    item.callback(item.obj)
+        violations = list(ctx["overlaps"])
         claims = [truth[k] for k in sorted(truth)]
-        violations = list(index.diff_against(claims))
+        violations.extend(index.diff_against(claims))
         violations.extend(chip_conflicts(claims))
         return violations
 

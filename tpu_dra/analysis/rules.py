@@ -265,7 +265,9 @@ class _TaintWalker:
     """Statement-order taint tracking within one function: names bound
     to informer-cache views (lister reads, index lookups, watch-event
     payloads in ``copy_events=False`` modules) must not be mutated.
-    ``copy.deepcopy`` launders a view into a private object."""
+    ``copy.deepcopy`` — or the JSON-shaped fast path
+    ``k8s.client.json_deepcopy`` — launders a view into a private
+    object."""
 
     def __init__(self, module: Module, zero_copy_events: bool):
         self.module = module
@@ -284,8 +286,9 @@ class _TaintWalker:
             return base in tainted if base else False
         if isinstance(node, ast.Call):
             chain = attr_chain(node.func)
-            if chain[-2:] == ["copy", "deepcopy"]:
-                return False  # the sanctioned escape hatch
+            if (chain[-2:] == ["copy", "deepcopy"]
+                    or chain[-1:] == ["json_deepcopy"]):
+                return False  # the sanctioned escape hatches
             if chain and chain[-1] in _PROPAGATORS and len(chain) == 1:
                 return any(self._tainted_expr(a, tainted)
                            for a in node.args)

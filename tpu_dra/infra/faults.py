@@ -156,6 +156,17 @@ SITES: Dict[str, str] = {
         "incremental allocated-device index apply/remove fails; "
         "threatens: index vs cluster-truth divergence, device "
         "double-allocation if an allocation proceeded on a dirty index",
+    "sched.shard_apply":
+        "per-shard allocation-index mutation fails after routing (the "
+        "shard is left unchanged and marked dirty); threatens: per-shard "
+        "index==truth divergence — the shard-scoped resync must recover "
+        "without blocking scans on sibling shards",
+    "sched.snapshot_commit":
+        "optimistic snapshot commit refused (models the shard moving "
+        "underneath a lock-free candidate scan); threatens: device "
+        "double-allocation if a worker committed a stale pick anyway — "
+        "the conflict must surface as a bounded re-scan/requeue, never "
+        "a partial reservation",
     "cddaemon.spawn":
         "slice-daemon child fails to spawn; threatens: readiness "
         "mirroring, CD convergence",

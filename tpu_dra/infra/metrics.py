@@ -195,6 +195,14 @@ METRICS_CATALOG: Dict[str, str] = {
     "tpu_dra_sched_watch_events": "infra/metrics.py",
     "tpu_dra_sched_pods_bound": "infra/metrics.py",
     "tpu_dra_sched_claims_gced": "infra/metrics.py",
+    # infra/metrics.py — parallel scheduler core (SURVEY §15): worker
+    # pool size, optimistic snapshot-commit conflicts, shard-scoped
+    # resyncs, and the shared workqueue depth/busy gauges
+    "tpu_dra_sched_workers": "infra/metrics.py",
+    "tpu_dra_sched_snapshot_conflicts_total": "infra/metrics.py",
+    "tpu_dra_sched_shard_resyncs_total": "infra/metrics.py",
+    "tpu_dra_workqueue_depth": "infra/metrics.py",
+    "tpu_dra_workqueue_busy_workers": "infra/metrics.py",
     "tpu_dra_topo_allocations": "infra/metrics.py",
     "tpu_dra_topo_score_seconds": "infra/metrics.py",
     "tpu_dra_topo_free_cuboid_chips": "infra/metrics.py",
@@ -298,6 +306,31 @@ SCHED_CLAIMS_GCED = DefaultRegistry.counter(
     "tpu_dra_sched_claims_gced",
     "template-owned ResourceClaims garbage-collected after pod death, "
     "labeled by path (event|sweep)")
+
+# -- parallel scheduler core (multi-worker pool + sharded index +
+# snapshot scans, SURVEY §15) ------------------------------------------------
+
+SCHED_WORKERS = DefaultRegistry.gauge(
+    "tpu_dra_sched_workers",
+    "reconcile worker threads the scheduler's WorkQueue pool runs")
+SCHED_SNAPSHOT_CONFLICTS = DefaultRegistry.counter(
+    "tpu_dra_sched_snapshot_conflicts_total",
+    "optimistic snapshot commits refused because the shard moved "
+    "underneath the scan (another worker took a picked device, or the "
+    "sched.snapshot_commit fault fired); each conflict re-scans against "
+    "a fresh snapshot, bounded before backoff-requeue")
+SCHED_SHARD_RESYNCS = DefaultRegistry.counter(
+    "tpu_dra_sched_shard_resyncs_total",
+    "allocation-index shards rebuilt by the guarded resync fallback "
+    "(per-shard dirty flags: one divergent shard resyncs alone without "
+    "blocking scans on the others)")
+WORKQUEUE_DEPTH = DefaultRegistry.gauge(
+    "tpu_dra_workqueue_depth",
+    "items queued (delay heap + per-key deferred) in a named WorkQueue, "
+    "labeled by queue")
+WORKQUEUE_BUSY = DefaultRegistry.gauge(
+    "tpu_dra_workqueue_busy_workers",
+    "pool workers currently processing an item, labeled by queue")
 
 # -- ICI topology subsystem (tpu_dra.topology + the scheduler's
 # topology-scored pick path, SURVEY §11) ------------------------------------

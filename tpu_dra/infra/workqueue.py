@@ -11,6 +11,16 @@ doesn't thundering-herd the API server.
 The implementation is a threaded delay queue rather than a port of
 client-go; semantics (AddRateLimited / Forget / NumRequeues / supersede)
 are preserved.
+
+**Worker pools** (SURVEY §15): ``start_workers(n)`` runs N consumer
+threads against one queue with client-go's parallelism contract — two
+items sharing a key are NEVER processed concurrently. A ready item
+whose key is mid-process on another worker is *deferred* (parked in a
+per-key side list, still absorbing ``dedupe=True`` enqueues — it has
+not run yet, so the state-based reconcile contract holds) and
+re-queued the instant the in-flight item completes. Keyless items are
+never serialized. One worker (``run()``) degenerates to the original
+single-consumer behavior exactly.
 """
 
 from __future__ import annotations
@@ -200,7 +210,8 @@ class WorkQueue:
     """
 
     def __init__(self, rate_limiter: Optional[RateLimiter] = None,
-                 log: Optional[Callable[[str], None]] = None):
+                 log: Optional[Callable[[str], None]] = None,
+                 name: str = ""):
         self._rl = rate_limiter or default_controller_rate_limiter()
         self._heap: list = []  # (ready_at, seq, WorkItem)
         self._seq = itertools.count()
@@ -212,11 +223,25 @@ class WorkQueue:
         # Lock is sufficient.
         self._cond = threading.Condition(threading.Lock())
         self._active_ops: Dict[str, WorkItem] = {}
-        # key -> number of items still queued (in the heap, not yet
-        # popped); backs dedupe=True below.
+        # key -> number of items still queued (in the heap or deferred,
+        # not yet handed to a worker); backs dedupe=True below.
         self._queued_keys: Dict[str, int] = {}
+        # Per-key serialization state for worker pools: keys a worker is
+        # processing right now, and ready items deferred because their
+        # key was in flight (re-queued on release).
+        self._processing: Dict[str, WorkItem] = {}
+        self._deferred: Dict[str, list] = {}
+        self._busy = 0
         self._shutdown = False
         self._log = log or (lambda msg: None)
+        # Named queues export depth/busy-worker gauges (unnamed queues —
+        # short-lived test fixtures — stay out of the registry's labels).
+        self._name = name
+        self._depth_gauge = self._busy_gauge = None
+        if name:
+            from tpu_dra.infra.metrics import WORKQUEUE_BUSY, WORKQUEUE_DEPTH
+            self._depth_gauge = WORKQUEUE_DEPTH
+            self._busy_gauge = WORKQUEUE_BUSY
 
     # -- producers ----------------------------------------------------------
 
@@ -247,6 +272,7 @@ class WorkQueue:
                 item.counted = True
                 self._queued_keys[key] = self._queued_keys.get(key, 0) + 1
             self._push_locked(item, after=after)
+            self._observe_locked()
             self._notify()
 
     def _push_locked(self, item: WorkItem,
@@ -291,6 +317,21 @@ class WorkQueue:
         t.start()
         return t
 
+    def start_workers(self, n: int,
+                      stop_event: Optional[threading.Event] = None
+                      ) -> list:
+        """The worker pool: N consumer threads over this queue with
+        per-key serialization (module docstring). Returns the threads;
+        join them after shutdown()/stop_event for a clean stop."""
+        threads = []
+        for i in range(n):
+            t = threading.Thread(target=self.run, args=(stop_event,),
+                                 daemon=True,
+                                 name=f"workqueue-{self._name or 'pool'}-{i}")
+            t.start()
+            threads.append(t)
+        return threads
+
     def shutdown(self) -> None:
         with self._cond:
             self._shutdown = True
@@ -301,24 +342,53 @@ class WorkQueue:
             while True:
                 if self._shutdown or (stop_event is not None and stop_event.is_set()):
                     return None
-                if self._heap:
-                    ready_at, _, item = self._heap[0]
-                    now = time.monotonic()
-                    if ready_at <= now:
-                        heapq.heappop(self._heap)
-                        self._yield_op("queue.get", item.key)
-                        if item.key and item.counted:
-                            item.counted = False  # a retry re-push stays
+                now = time.monotonic()
+                handed = None
+                while self._heap and self._heap[0][0] <= now:
+                    _, _, item = heapq.heappop(self._heap)
+                    if item.key and item.key in self._processing:
+                        # Per-key serialization: this key is mid-process
+                        # on another worker. Defer — the item keeps its
+                        # dedupe registration (it has not run, so it
+                        # still absorbs same-key enqueues) and is
+                        # re-queued when the in-flight item completes.
+                        self._deferred.setdefault(item.key, []).append(item)
+                        continue
+                    handed = item
+                    break
+                if handed is not None:
+                    self._yield_op("queue.get", handed.key)
+                    if handed.key:
+                        self._processing[handed.key] = handed
+                        if handed.counted:
+                            handed.counted = False  # a retry re-push stays
                             #   uncounted: dedupe must not absorb into it
-                            n = self._queued_keys.get(item.key, 0) - 1
+                            n = self._queued_keys.get(handed.key, 0) - 1
                             if n > 0:
-                                self._queued_keys[item.key] = n
+                                self._queued_keys[handed.key] = n
                             else:
-                                self._queued_keys.pop(item.key, None)
-                        return item
-                    self._wait(min(ready_at - now, 0.5))
+                                self._queued_keys.pop(handed.key, None)
+                    self._busy += 1
+                    self._observe_locked()
+                    return handed
+                if self._heap:
+                    self._wait(min(self._heap[0][0] - now, 0.5))
                 else:
                     self._wait(0.5)
+
+    def _release_key_locked(self, item: WorkItem) -> None:
+        """End of this item's processing: free its key and re-queue any
+        ready items that were deferred behind it (one notify per item so
+        idle pool workers pick them up immediately)."""
+        self._busy -= 1
+        if item.key:
+            if self._processing.get(item.key) is item:
+                del self._processing[item.key]
+            for deferred in self._deferred.pop(item.key, ()):
+                heapq.heappush(self._heap,
+                               (time.monotonic(), next(self._seq), deferred))
+                self._notify()
+        self._observe_locked()
 
     def _process(self, item: WorkItem) -> None:
         attempts = self._rl.num_requeues(item.item_id)
@@ -327,6 +397,7 @@ class WorkQueue:
         except Exception as e:  # noqa: BLE001 — retryable by contract
             self._log(f"reconcile: {e} (attempt {attempts})")
             with self._cond:
+                self._release_key_locked(item)
                 current = self._active_ops.get(item.key)
                 if item.key and current is not item:
                     # Superseded — a newer item under this key is either
@@ -343,12 +414,23 @@ class WorkQueue:
                     self._notify()
             return
         with self._cond:
+            self._release_key_locked(item)
             if item.key and self._active_ops.get(item.key) is item:
                 del self._active_ops[item.key]
             self._rl.forget(item.item_id)
+
+    def _observe_locked(self) -> None:
+        if self._depth_gauge is not None:
+            labels = {"queue": self._name}
+            self._depth_gauge.set(
+                len(self._heap) + sum(len(v) for v in
+                                      self._deferred.values()),
+                labels=labels)
+            self._busy_gauge.set(self._busy, labels=labels)
 
     # -- introspection ------------------------------------------------------
 
     def __len__(self) -> int:
         with self._cond:
-            return len(self._heap)
+            return (len(self._heap)
+                    + sum(len(v) for v in self._deferred.values()))

@@ -109,6 +109,21 @@ def label_selector_matches(selector: Optional[str], labels: Dict[str, str]) -> b
     return True
 
 
+def json_deepcopy(obj):
+    """Deep copy for JSON-shaped API objects (dict/list containers,
+    immutable scalars). copy.deepcopy's generic machinery (memo table,
+    reduce protocol) dominated the fake apiserver at churn scale — this
+    specialized walk is the same isolation at a fraction of the cost.
+    Non-JSON containers (a tuple a test tucked into an object) are
+    returned as-is: the API-object contract treats them as values."""
+    cls = obj.__class__
+    if cls is dict:
+        return {k: json_deepcopy(v) for k, v in obj.items()}
+    if cls is list:
+        return [json_deepcopy(v) for v in obj]
+    return obj
+
+
 class ApiClient:
     """Abstract client surface shared by HttpApiClient and FakeCluster."""
 

@@ -9,16 +9,15 @@ tested against realistic apiserver semantics without a cluster.
 
 from __future__ import annotations
 
-import copy
 import itertools
+import os
 import queue
 import threading
-import uuid
 from typing import Dict, Generator, List, Optional, Tuple
 
 from tpu_dra.k8s.client import (
     AlreadyExistsError, ApiClient, ConflictError, GVR, NotFoundError,
-    label_selector_matches,
+    json_deepcopy, label_selector_matches,
 )
 from tpu_dra.k8s.resources import now_rfc3339
 
@@ -42,6 +41,12 @@ class FakeCluster(ApiClient):
 
     def __init__(self):
         self._lock = threading.RLock()
+        # uid source: a per-cluster random tag + counter. uuid.uuid4 was
+        # one getrandom syscall per created object — a large slice of
+        # fake-apiserver wall at churn scale for randomness nothing
+        # needs; uniqueness per cluster instance is the whole contract.
+        self._uid_tag = os.urandom(4).hex()
+        self._uid_seq = itertools.count(1)
         # (gvr.key, namespace or "") -> name -> object
         self._store: Dict[Tuple[str, str], Dict[str, Dict]] = {}
         self._rv = itertools.count(1)
@@ -77,7 +82,7 @@ class FakeCluster(ApiClient):
         # consumers). The previous per-watcher deepcopy made every emit
         # O(watchers) full copies, which dominated the fake apiserver at
         # churn scale (5 informers x thousands of lifecycle events).
-        snapshot = copy.deepcopy(obj)
+        snapshot = json_deepcopy(obj)
         rv = int(obj.get("metadata", {}).get("resourceVersion", "0") or 0)
         self._events.append((rv, gvr.key, ns, event_type, snapshot))
         if len(self._events) > self.EVENT_LOG_CAP:
@@ -108,7 +113,7 @@ class FakeCluster(ApiClient):
             objs = self._store.get(self._ns_key(gvr, namespace), {})
             if name not in objs:
                 raise NotFoundError(f"{gvr.plural}/{name}")
-            return copy.deepcopy(objs[name])
+            return json_deepcopy(objs[name])
 
     def list(self, gvr, namespace=None, label_selector=None):
         with self._lock:
@@ -121,35 +126,37 @@ class FakeCluster(ApiClient):
                 for obj in bucket.values():
                     labels = obj.get("metadata", {}).get("labels", {}) or {}
                     if label_selector_matches(label_selector, labels):
-                        out.append(copy.deepcopy(obj))
+                        out.append(json_deepcopy(obj))
             out.sort(key=lambda o: (o["metadata"].get("namespace", ""),
                                     o["metadata"]["name"]))
             return out
 
     def create(self, gvr, obj, namespace=None):
         with self._lock:
-            obj = copy.deepcopy(obj)
+            obj = json_deepcopy(obj)
             obj = self._run_reactors("create", gvr, obj)
             meta = obj.setdefault("metadata", {})
             # generateName support (ResourceClaims from templates use it).
             if "name" not in meta and meta.get("generateName"):
-                meta["name"] = meta["generateName"] + uuid.uuid4().hex[:6]
+                meta["name"] = (meta["generateName"]
+                                + f"{next(self._uid_seq):06x}")
             key = self._ns_key(gvr, namespace, obj)
             if gvr.namespaced:
                 meta.setdefault("namespace", key[1])
             bucket = self._store.setdefault(key, {})
             if meta["name"] in bucket:
                 raise AlreadyExistsError(f"{gvr.plural}/{meta['name']}")
-            meta.setdefault("uid", str(uuid.uuid4()))
+            meta.setdefault(
+                "uid", f"uid-{self._uid_tag}-{next(self._uid_seq)}")
             meta.setdefault("creationTimestamp", now_rfc3339())
             self._bump(obj)
             bucket[meta["name"]] = obj
             self._emit(gvr, key[1], "ADDED", obj)
-            return copy.deepcopy(obj)
+            return json_deepcopy(obj)
 
     def _update_impl(self, gvr, obj, namespace, subresource: Optional[str]):
         with self._lock:
-            obj = copy.deepcopy(obj)
+            obj = json_deepcopy(obj)
             obj = self._run_reactors("update", gvr, obj)
             meta = obj.get("metadata", {})
             key = self._ns_key(gvr, namespace, obj)
@@ -163,13 +170,13 @@ class FakeCluster(ApiClient):
                 raise ConflictError(
                     f"{gvr.plural}/{name}: resourceVersion mismatch")
             if subresource == "status":
-                merged = copy.deepcopy(current)
-                merged["status"] = copy.deepcopy(obj.get("status"))
+                merged = json_deepcopy(current)
+                merged["status"] = json_deepcopy(obj.get("status"))
             else:
                 merged = obj
                 # status subresource: spec-updates do not touch status
                 if "status" in current and gvr.key in _STATUS_SUBRESOURCE:
-                    merged["status"] = copy.deepcopy(current["status"])
+                    merged["status"] = json_deepcopy(current["status"])
                 # preserve immutable server-side fields
                 merged["metadata"]["uid"] = current["metadata"].get("uid")
                 merged["metadata"].setdefault(
@@ -192,7 +199,7 @@ class FakeCluster(ApiClient):
                 # recovers from without a full resync.
                 self._bump(merged)
                 self._emit(gvr, key[1], "DELETED", merged)
-            return copy.deepcopy(merged)
+            return json_deepcopy(merged)
 
     def update(self, gvr, obj, namespace=None):
         return self._update_impl(gvr, obj, namespace, None)
@@ -261,7 +268,7 @@ class FakeCluster(ApiClient):
                         labels = obj.get("metadata", {}).get("labels", {}) or {}
                         if not label_selector_matches(label_selector, labels):
                             continue
-                        w.events.put((event_type, copy.deepcopy(obj)))
+                        w.events.put((event_type, json_deepcopy(obj)))
             if not gone:
                 self._watchers.append(w)
         if gone:
@@ -310,8 +317,8 @@ _STATUS_SUBRESOURCE = {
 def _merge_patch(target: Dict, patch: Dict) -> Dict:
     """RFC 7386 JSON merge-patch."""
     if not isinstance(patch, dict):
-        return copy.deepcopy(patch)
-    out = copy.deepcopy(target) if isinstance(target, dict) else {}
+        return json_deepcopy(patch)
+    out = json_deepcopy(target) if isinstance(target, dict) else {}
     for k, v in patch.items():
         if v is None:
             out.pop(k, None)

@@ -563,10 +563,15 @@ def run_matrix(seeds: List[int], n_events: int = 40) -> Dict:
 
 # Sites the scheduler walk may arm: API flakes and watch-stream drops hit
 # the informer plane; sched.watch_event / sched.index_apply hit the
-# scheduler's own event handling and incremental allocation index, so the
-# guarded full-resync fallback is chaos-tested on the production path.
+# scheduler's own event handling and incremental allocation index;
+# sched.shard_apply dirties ONE shard of the sharded index (the
+# shard-scoped resync path), and sched.snapshot_commit refuses
+# optimistic commits (the multi-worker conflict/requeue path) — so the
+# guarded resync fallback AND the parallel core's commit discipline are
+# chaos-tested on the production path.
 SCHED_CHAOS_SITES = ("k8s.api.request", "k8s.watch.drop",
-                     "sched.watch_event", "sched.index_apply")
+                     "sched.watch_event", "sched.index_apply",
+                     "sched.shard_apply", "sched.snapshot_commit")
 
 
 def chip_conflicts(claims: List[Dict]) -> List[str]:
@@ -619,7 +624,8 @@ class SchedulerChaosHarness:
 
     QUIESCE_TIMEOUT = 30.0
 
-    def __init__(self, seed: int, *, nodes: int = 4, chips_per_node: int = 2):
+    def __init__(self, seed: int, *, nodes: int = 4, chips_per_node: int = 2,
+                 workers: int = 4):
         from tpu_dra.simcluster.scheduler import Scheduler
 
         # Witness the scheduler's lock population (informer RLocks,
@@ -641,8 +647,11 @@ class SchedulerChaosHarness:
                 self.cluster, max_attempts=4, base_delay=0.001,
                 max_delay=0.01, rng=random.Random(seed ^ 0xD15C))
             self._seed_inventory()
+            # workers=4: the walk exercises the multi-worker pool — the
+            # per-key serialization and optimistic snapshot-commit
+            # disciplines are chaos invariants, not just bench wins.
             self.sched = Scheduler(self.client, resync_interval=0.05,
-                                   gc_sweep_interval=0.2)
+                                   gc_sweep_interval=0.2, workers=workers)
             self.sched.start()
             for inf in self.sched._informers.values():
                 inf.RELIST_BACKOFF_BASE = 0.01  # keep the chaos tier fast

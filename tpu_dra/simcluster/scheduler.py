@@ -29,6 +29,20 @@ Two drive modes (SURVEY §10):
   rebuilds a transient index per pass. Every pass counts as a full
   relist.
 
+**Parallel scheduler core** (SURVEY §15): event mode runs a
+multi-worker WorkQueue pool (per-key serialization: two items sharing
+a key — ``pod/<ns/name>``, ``gc/<ns/name>``, ``resync`` — never run
+concurrently), the ``AllocationIndex`` is sharded by node pool
+(per-shard locks, RV high-water marks and dirty flags), and candidate
+scans read an immutable per-attempt :class:`PoolView` snapshot instead
+of hitting the index lock per device. Allocation commits optimistically:
+``try_commit`` reserves the picked devices all-or-nothing under the one
+shard lock (a conflict — another worker took a device, or the
+``sched.snapshot_commit`` fault — re-scans against a fresh snapshot,
+bounded before backoff-requeue; ``tpu_dra_sched_snapshot_conflicts_total``
+counts them), the claim statuses are written, and the reservation is
+released once the real allocation is applied mutation-cache style.
+
 CEL selector evaluation is compile-cached (simcluster.cel): expressions
 parse once per distinct source string; allocation evaluates the cached
 AST per candidate device. Per-DeviceClass selector sources are
@@ -37,10 +51,13 @@ additionally cached keyed by the class's resourceVersion.
 
 from __future__ import annotations
 
-import copy
+import itertools
 import logging
+import os
+import sys
 import threading
 import time
+import zlib
 from collections import deque
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
@@ -48,7 +65,8 @@ from tpu_dra.infra import featuregates
 from tpu_dra.infra.faults import FAULTS, FaultInjected
 from tpu_dra.infra.metrics import (
     SCHED_CLAIMS_GCED, SCHED_FULL_RELISTS, SCHED_PODS_BOUND,
-    SCHED_WATCH_EVENTS, TOPO_ALLOCS, TOPO_FREE_CUBOID, TOPO_SCORE_SECONDS,
+    SCHED_SHARD_RESYNCS, SCHED_SNAPSHOT_CONFLICTS, SCHED_WATCH_EVENTS,
+    SCHED_WORKERS, TOPO_ALLOCS, TOPO_FREE_CUBOID, TOPO_SCORE_SECONDS,
     Timer,
 )
 from tpu_dra.infra.workqueue import (
@@ -56,6 +74,7 @@ from tpu_dra.infra.workqueue import (
 )
 from tpu_dra.k8s.client import (
     AlreadyExistsError, ApiClient, ConflictError, NotFoundError,
+    json_deepcopy,
 )
 from tpu_dra.k8s.informer import Informer
 from tpu_dra.k8s.resources import (
@@ -66,6 +85,34 @@ from tpu_dra.simcluster import cel
 from tpu_dra import topology
 
 log = logging.getLogger("simcluster.scheduler")
+
+# sys.setswitchinterval is interpreter-global: refcount the raise so
+# overlapping Scheduler lifecycles in one process can neither revert
+# the interval under a still-running sibling nor leak the raised value
+# past the last stop() (see Scheduler.start for the why).
+_switch_lock = threading.Lock()
+_switch_refs = 0
+_switch_saved = 0.0
+
+
+def _raise_switch_interval() -> None:
+    global _switch_refs, _switch_saved
+    with _switch_lock:
+        _switch_refs += 1
+        if _switch_refs == 1:
+            _switch_saved = sys.getswitchinterval()
+            sys.setswitchinterval(max(
+                _switch_saved,
+                float(os.environ.get(
+                    "TPU_DRA_SCHED_SWITCH_INTERVAL", "0.02"))))
+
+
+def _restore_switch_interval() -> None:
+    global _switch_refs
+    with _switch_lock:
+        _switch_refs -= 1
+        if _switch_refs == 0:
+            sys.setswitchinterval(_switch_saved)
 
 _Entry = Tuple[str, str, str]  # (driver, pool, device)
 
@@ -105,27 +152,54 @@ def claim_entries(claim: Dict) -> Tuple[_Entry, ...]:
         for r in (alloc.get("devices") or {}).get("results") or [])
 
 
-class AllocationIndex:
-    """Incremental allocated-device index, maintained from ResourceClaim
-    add/update/delete events instead of re-listing all claims per
-    scheduling attempt.
+def _taken_in(taken, driver: str, pool: str, name: str) -> bool:
+    """The partition-aware membership test every allocated-set reader
+    shares (live shard maps, reservation maps, snapshots, overlays):
+    the exact entry, or — for a subslice — its parent chip's whole-chip
+    marker. `taken` is any container of _Entry keys."""
+    if (driver, pool, name) in taken:
+        return True
+    parent = _parent_of(name)
+    if parent != name and (driver, pool, f"{parent}-ss*") in taken:
+        return True  # parent chip wholly claimed
+    return False
 
-    Holds only extracted string tuples (never references to cache
-    objects), refcounted so that two subslice claims on one chip keep
-    the parent-chip block marker alive until BOTH release. ``apply`` is
-    idempotent per claim key (replace semantics), which makes informer
-    relists — which re-dispatch adds for every object — safe to feed
-    straight in.
 
-    ``dirty`` flags a known divergence (a dropped watch event, a failed
-    apply): allocation must not proceed until ``resync`` rebuilds from a
-    full claim listing (the guarded fallback).
-    """
+class PoolView:
+    """Immutable allocated-set snapshot for ONE pool, built per
+    scheduling attempt (``AllocationIndex.snapshot``): candidate scans
+    read it lock-free instead of taking the shard lock per device. The
+    scan's picks are validated by the optimistic ``try_commit`` — the
+    view may go stale the instant it is built; stale picks surface as
+    commit conflicts, never as double allocations."""
+
+    __slots__ = ("pool", "taken", "mutations")
+
+    def __init__(self, pool: str, taken: frozenset, mutations: int):
+        self.pool = pool
+        self.taken = taken
+        self.mutations = mutations  # shard generation at snapshot time
+
+    def is_taken(self, driver: str, name: str,
+                 overlay: Optional[Set[_Entry]] = None) -> bool:
+        if _taken_in(self.taken, driver, self.pool, name):
+            return True
+        return bool(overlay) and _taken_in(overlay, driver, self.pool, name)
+
+
+class _IndexShard:
+    """One pool-hash shard of the AllocationIndex: its own lock, claim
+    map, refcounted taken set (keyed pool → entry → count), per-claim
+    RV high-water marks, mutation generation, reservation overlay and
+    dirty flag. All ``*_locked`` methods run under ``self._lock``."""
+
+    RV_RETENTION = 4096  # evicted-claim watermarks kept (FIFO)
 
     def __init__(self):
         self._lock = threading.Lock()
         self._by_claim: Dict[str, Tuple[_Entry, ...]] = {}
-        self._taken: Dict[_Entry, int] = {}
+        self._taken: Dict[str, Dict[_Entry, int]] = {}  # pool -> counts
+        self._nreal: Dict[str, int] = {}  # pool -> live device results
         # Per-claim resourceVersion high-water mark: the scheduler
         # applies its OWN writes synchronously (mutation-cache style),
         # so the watch event for an EARLIER state of the same claim can
@@ -143,41 +217,71 @@ class AllocationIndex:
         # Bumped on every EFFECTIVE mutation: lets a resync detect that
         # an informer-thread apply/remove landed between its lister
         # snapshot and its swap (which would otherwise be silently
-        # resurrected by the wholesale replace).
+        # resurrected by the wholesale replace), and stamps PoolView
+        # snapshots.
         self._mutations = 0
+        # In-flight optimistic commits: claim key -> (pool, entries).
+        # Reservations hold picked devices between try_commit and the
+        # post-write apply; they are NOT part of _by_claim (a stale
+        # watch replay must not be able to evict one) and resyncs
+        # preserve them (cluster truth does not know them yet).
+        self._reserved: Dict[str, Tuple[str, Tuple[_Entry, ...]]] = {}
+        self._reserved_taken: Dict[str, Dict[_Entry, int]] = {}
         self.dirty = False
         self.dirty_reason = ""
+        # True between begin_resync clearing the dirty flag and the
+        # rebuilt state swapping in: the shard is KNOWN-divergent but no
+        # longer flagged, so optimistic commits must keep refusing it
+        # (a missed-allocation divergence makes the index vouch for a
+        # taken device as free — try_commit's live re-validation checks
+        # the index itself, which is exactly what cannot be trusted
+        # here). Scans stay lock-free and unblocked; only the commit
+        # step conflicts, bounded by the caller's requeue discipline.
+        self.resyncing = False
 
-    RV_RETENTION = 4096  # evicted-claim watermarks kept (FIFO)
+    # -- refcounted taken bookkeeping (callers hold self._lock) -------------
 
-    # -- mutation -----------------------------------------------------------
-
-    def _add(self, expanded: List[_Entry]) -> None:
+    def _bump_locked(self, table: Dict[str, Dict[_Entry, int]],
+                     expanded: List[_Entry], delta: int) -> None:
         for e in expanded:
-            self._taken[e] = self._taken.get(e, 0) + 1
-
-    def _sub(self, expanded: List[_Entry]) -> None:
-        for e in expanded:
-            n = self._taken.get(e, 0) - 1
+            counts = table.setdefault(e[1], {})
+            n = counts.get(e, 0) + delta
             if n > 0:
-                self._taken[e] = n
+                counts[e] = n
             else:
-                self._taken.pop(e, None)
+                counts.pop(e, None)
+                if not counts:
+                    table.pop(e[1], None)
 
-    def _note_removed_locked(self, key: str) -> None:
+    def _set_entries_locked(self, key: str,
+                            old: Optional[Tuple[_Entry, ...]],
+                            new: Tuple[_Entry, ...]) -> None:
+        self._mutations += 1
+        if old:
+            self._bump_locked(self._taken, _expand(old), -1)
+            for e in old:
+                self._nreal[e[1]] = self._nreal.get(e[1], 1) - 1
+        if new:
+            self._bump_locked(self._taken, _expand(new), +1)
+            for e in new:
+                self._nreal[e[1]] = self._nreal.get(e[1], 0) + 1
+            self._by_claim[key] = new
+        elif old is not None:
+            self._by_claim.pop(key, None)
+
+    def _note_removed_locked(self, key: str) -> List[str]:
+        """Returns watermark keys evicted past the retention horizon
+        (the caller drops their routing homes outside this lock)."""
+        evicted: List[str] = []
         self._removed.append(key)
         while len(self._removed) > self.RV_RETENTION:
             old = self._removed.popleft()
             if old not in self._by_claim:  # not re-created since
                 self._rv.pop(old, None)
+                evicted.append(old)
+        return evicted
 
-    # ONE resourceVersion parse for both halves of the mutation-cache
-    # discipline: the informer's STALE guard and this index's watermark
-    # must agree on ordering or one layer accepts what the other rejects.
-    _rv_int = staticmethod(Informer._rv_int)
-
-    def _stale_locked(self, key: str, claim: Dict) -> bool:
-        rv = self._rv_int(claim)
+    def _stale_locked(self, key: str, rv: Optional[int]) -> bool:
         if rv is None:
             return False
         if rv < self._rv.get(key, 0):
@@ -185,30 +289,153 @@ class AllocationIndex:
         self._rv[key] = rv
         return False
 
+    def mark_dirty(self, reason: str) -> None:
+        with self._lock:
+            self.dirty = True
+            self.dirty_reason = reason
+
+
+class AllocationIndex:
+    """Incremental allocated-device index, maintained from ResourceClaim
+    add/update/delete events instead of re-listing all claims per
+    scheduling attempt — **sharded by node pool** (SURVEY §15): entries
+    route to ``crc32(pool) % n_shards``, each shard with its own lock,
+    RV high-water marks, mutation generation and dirty flag, so a
+    resync on one shard never blocks scans or applies on another.
+
+    Holds only extracted string tuples (never references to cache
+    objects), refcounted so that two subslice claims on one chip keep
+    the parent-chip block marker alive until BOTH release. ``apply`` is
+    idempotent per claim key (replace semantics), which makes informer
+    relists — which re-dispatch adds for every object — safe to feed
+    straight in.
+
+    A claim's entries all live on one pool (allocation is per-node), so
+    one claim maps to one shard; ``_homes`` remembers the routing for
+    entry-less applies/removes (deallocations, deletes) whose pool is
+    no longer derivable from the claim body. ``dirty`` (per shard)
+    flags a known divergence (a dropped watch event, a failed apply):
+    allocation must not proceed until the dirty shards are rebuilt from
+    a full claim listing (the guarded fallback)."""
+
+    def __init__(self, n_shards: int = 8):
+        self._n_shards = max(1, int(n_shards))
+        self._shards = [_IndexShard() for _ in range(self._n_shards)]
+        # claim key -> pool, for routing entry-less mutations.
+        # Deliberately UNLOCKED: every access is a single CPython dict
+        # op (get/set/pop/C-level copy/update), each atomic under the
+        # GIL, and no invariant spans two of them — a lock here sat on
+        # the hot path of every apply/remove from every worker AND the
+        # informer thread, and measured as a top convoy point.
+        self._homes: Dict[str, str] = {}
+
+    # ONE resourceVersion parse for both halves of the mutation-cache
+    # discipline: the informer's STALE guard and this index's watermark
+    # must agree on ordering or one layer accepts what the other rejects.
+    _rv_int = staticmethod(Informer._rv_int)
+
+    @property
+    def n_shards(self) -> int:
+        return self._n_shards
+
+    def shard_of(self, pool: str) -> int:
+        return zlib.crc32(pool.encode()) % self._n_shards
+
+    # -- routing -------------------------------------------------------------
+
+    def _drop_homes(self, keys: List[str], shard_id: int) -> None:
+        """Drop routing for keys whose watermark was evicted from
+        ``shard_id`` — but only while the recorded home still routes
+        THERE. After a cross-pool move the claim lives in another
+        shard; churn in the old shard must not delete the live claim's
+        routing, or later entry-less deallocs/deletes become
+        unroutable and leave phantom entries no resync ever flags."""
+        for key in keys:
+            pool = self._homes.get(key)
+            if pool is not None and self.shard_of(pool) == shard_id:
+                self._homes.pop(key, None)
+
+    # -- mutation -----------------------------------------------------------
+
+    def _checked_shard(self, key: str, pool: str) -> _IndexShard:
+        """Consult the per-shard fault seam; a fired fault marks the
+        target shard dirty (it is about to diverge from the event the
+        caller drops) and raises for the caller's resync path."""
+        shard = self._shards[self.shard_of(pool)]
+        try:
+            FAULTS.check("sched.shard_apply", claim=key, pool=pool)
+        except FaultInjected:
+            shard.mark_dirty("shard apply fault")
+            raise
+        return shard
+
     def apply(self, claim: Dict) -> None:
         """Add/replace one claim's allocation. Consults the
-        ``sched.index_apply`` fault site — a raised fault leaves the
-        index UNCHANGED (the caller marks it dirty and resyncs).
-        Applies carrying an older resourceVersion than already indexed
-        are ignored (see _rv above)."""
+        ``sched.index_apply`` (pre-routing) and ``sched.shard_apply``
+        (post-routing) fault sites — a raised fault leaves the shard
+        UNCHANGED (the caller resyncs; shard_apply marks the shard
+        dirty itself). Applies carrying an older resourceVersion than
+        already indexed are ignored (see _IndexShard._rv).
+
+        A claim whose allocation MOVED pools (deallocated out-of-band,
+        re-allocated elsewhere) routes to the new pool's shard; the
+        previous home's shard is purged of the leftover entries — but
+        only AFTER the new shard accepted the apply, so a stale replay
+        carrying the old pool can neither repoint the routing nor evict
+        the live state."""
         key = claim_key(claim)
         FAULTS.check("sched.index_apply", claim=key)
         entries = claim_entries(claim)
-        with self._lock:
-            if self._stale_locked(key, claim):
+        prev = self._homes.get(key)
+        pool = entries[0][1] if entries else prev
+        if pool is None:
+            return  # never allocated: no entries, no watermark to guard
+        shard = self._checked_shard(key, pool)
+        rv = self._rv_int(claim)
+        evicted: List[str] = []
+        with shard._lock:
+            if shard._stale_locked(key, rv):
                 return
-            old = self._by_claim.get(key)
-            if old == entries:
-                return
-            self._mutations += 1
-            if old:
-                self._sub(_expand(old))
-            if entries:
-                self._add(_expand(entries))
-                self._by_claim[key] = entries
-            elif old is not None:
-                self._by_claim.pop(key, None)
-                self._note_removed_locked(key)
+            old = shard._by_claim.get(key)
+            if old != entries:
+                shard._set_entries_locked(key, old, entries)
+                if not entries and old is not None:
+                    evicted = shard._note_removed_locked(key)
+        # Accepted: commit the routing, then clean a cross-pool move's
+        # leftovers out of the previous home's shard (same shard was
+        # handled by the replace above). The purged key cannot drop its
+        # own just-committed home: that home routes to the new shard,
+        # which _drop_homes's shard check excludes.
+        if entries:
+            self._homes[key] = pool
+            if prev is not None and self.shard_of(prev) != self.shard_of(pool):
+                self._drop_homes(self._purge_shard(prev, key, rv),
+                                 self.shard_of(prev))
+        self._drop_homes(evicted, self.shard_of(pool))
+
+    def _purge_shard(self, pool: str, key: str, rv: Optional[int],
+                     force: bool = False) -> List[str]:
+        """Drop `key`'s entries from `pool`'s shard (cross-pool move
+        cleanup), guarded by that shard's OWN watermark: template claims
+        reuse deterministic names, so a delayed DELETED replay from a
+        deleted-and-recreated claim's prior incarnation routes here via
+        its old body and must not evict the recreated claim's live
+        allocation. ``force`` mirrors remove()'s own-delete semantics.
+        Returns watermark keys evicted past retention."""
+        shard = self._shards[self.shard_of(pool)]
+        with shard._lock:
+            if force:
+                if rv:
+                    shard._rv[key] = max(shard._rv.get(key, 0), rv)
+            elif shard._stale_locked(key, rv):
+                return []
+            shard._mutations += 1  # watermark advance alone must also
+            #   invalidate an in-flight resync snapshot
+            old = shard._by_claim.get(key)
+            if old is None:
+                return []
+            shard._set_entries_locked(key, old, ())
+            return shard._note_removed_locked(key)
 
     def remove(self, claim: Dict, force: bool = False) -> None:
         """Drop a claim's allocation. ``force=True`` is for the
@@ -218,114 +445,329 @@ class AllocationIndex:
         deleted object's RV; single-writer discipline makes that safe."""
         key = claim_key(claim)
         FAULTS.check("sched.index_apply", claim=key)
-        with self._lock:
+        entries = claim_entries(claim)
+        prev = self._homes.get(key)
+        pool = entries[0][1] if entries else prev
+        if pool is None:
+            return
+        shard = self._checked_shard(key, pool)
+        rv = self._rv_int(claim)
+        with shard._lock:
             if force:
-                rv = self._rv_int(claim)
                 if rv:
-                    self._rv[key] = max(self._rv.get(key, 0), rv)
-            elif self._stale_locked(key, claim):
+                    shard._rv[key] = max(shard._rv.get(key, 0), rv)
+            elif shard._stale_locked(key, rv):
                 return
-            self._mutations += 1  # watermark advance alone must also
+            shard._mutations += 1  # watermark advance alone must also
             #   invalidate an in-flight resync snapshot
-            old = self._by_claim.pop(key, None)
-            if old:
-                self._sub(_expand(old))
-            self._note_removed_locked(key)
+            old = shard._by_claim.get(key)
+            if old is not None:
+                shard._set_entries_locked(key, old, ())
+            evicted = shard._note_removed_locked(key)
+        # A deleted claim is gone everywhere: if the event's entries and
+        # the recorded home disagree on the shard (a cross-pool move
+        # whose cleanup raced this delete), purge the home's shard too.
+        if prev is not None and self.shard_of(prev) != self.shard_of(pool):
+            self._drop_homes(self._purge_shard(prev, key, rv, force),
+                             self.shard_of(prev))
+        self._drop_homes(evicted, self.shard_of(pool))
 
-    def begin_resync(self) -> None:
-        """Clear the dirty flag BEFORE the caller takes its claim
-        snapshot: a concurrent _mark_dirty whose dropped event postdates
-        the snapshot then re-dirties the index and its queued resync
+    # -- optimistic snapshot commit (SURVEY §15) -----------------------------
+
+    def snapshot(self, pool: str) -> PoolView:
+        """Immutable allocated-set view of `pool` (live entries plus
+        in-flight reservations) for one lock-free candidate scan."""
+        shard = self._shards[self.shard_of(pool)]
+        with shard._lock:
+            taken = frozenset(shard._taken.get(pool, ())) | frozenset(
+                shard._reserved_taken.get(pool, ()))
+            return PoolView(pool, taken, shard._mutations)
+
+    def try_commit(self, pool: str,
+                   staged: List[Tuple[str, Tuple[_Entry, ...]]]
+                   ) -> Optional[bool]:
+        """Atomically reserve every staged (claim key, entries) pick on
+        `pool`, all-or-nothing, re-validating each device against the
+        LIVE shard state (the snapshot the picks came from may have
+        gone stale). False = device-level conflict: a device is taken
+        or reserved by another claim, the shard is dirty/mid-rebuild,
+        or the ``sched.snapshot_commit`` fault fired — a re-scan
+        against a fresh snapshot can win. None = CLAIM-level conflict
+        (also falsy): a staged key another worker already committed
+        DIFFERENT entries for, or holds an in-flight reservation on
+        (two pods sharing one unallocated claim) — overwriting the
+        live reservation would strand its devices' refcounts, and
+        re-scanning cannot help because the caller's claim COPY is
+        stale; only a re-fetch resolves it. Entries the shard already
+        holds for the same key (an idempotent retry after a partial
+        write) pass vacuously and are not re-reserved."""
+        if FAULTS.fires("sched.snapshot_commit"):
+            SCHED_SNAPSHOT_CONFLICTS.inc()
+            return False
+        shard = self._shards[self.shard_of(pool)]
+        with shard._lock:
+            if shard.dirty or shard.resyncing:
+                # Known-divergent shard: the live re-validation below
+                # would check the very state that cannot be trusted.
+                # Refuse; the requeued attempt lands after the rebuild.
+                SCHED_SNAPSHOT_CONFLICTS.inc()
+                return False
+            pending: Set[_Entry] = set()
+            to_reserve: List[Tuple[str, Tuple[_Entry, ...]]] = []
+            taken = shard._taken.get(pool, {})
+            reserved = shard._reserved_taken.get(pool, {})
+            for key, entries in staged:
+                cur = shard._by_claim.get(key)
+                if cur == entries:
+                    continue  # already committed (idempotent retry)
+                if cur is not None or key in shard._reserved:
+                    # The claim is allocated to other devices, or a
+                    # sibling worker's reservation is in flight: the
+                    # caller's copy was stale.
+                    SCHED_SNAPSHOT_CONFLICTS.inc()
+                    return None
+                for driver, _pool, name in entries:
+                    if (_taken_in(taken, driver, pool, name)
+                            or _taken_in(reserved, driver, pool, name)
+                            or _taken_in(pending, driver, pool, name)):
+                        SCHED_SNAPSHOT_CONFLICTS.inc()
+                        return False
+                pending.update(_expand(entries))
+                to_reserve.append((key, entries))
+            for key, entries in to_reserve:
+                shard._reserved[key] = (pool, entries)
+                shard._bump_locked(shard._reserved_taken,
+                                   _expand(entries), +1)
+        return True
+
+    def release(self, pool: str, keys: Iterable[str]) -> None:
+        """Drop the reservations `try_commit` took for `keys` — after
+        the real allocations were applied (the entries now live in
+        ``_by_claim``), or after the claim write failed (the devices
+        return to the free set)."""
+        shard = self._shards[self.shard_of(pool)]
+        with shard._lock:
+            for key in keys:
+                held = shard._reserved.pop(key, None)
+                if held is not None:
+                    shard._bump_locked(shard._reserved_taken,
+                                       _expand(held[1]), -1)
+
+    def allocated_count(self, pool: str) -> int:
+        """Live device results on `pool` (committed + reserved) — the
+        busy-node skip: a candidate whose count already matches its
+        published device count cannot fit anything, no scan needed."""
+        shard = self._shards[self.shard_of(pool)]
+        with shard._lock:
+            n = shard._nreal.get(pool, 0)
+            for key, (held_pool, entries) in shard._reserved.items():
+                # A key already applied to _by_claim (the window between
+                # _after_claim_write and the caller's release) is in
+                # _nreal — counting its reservation too would double it
+                # and make the busy-node skip pass over free capacity.
+                if held_pool == pool and key not in shard._by_claim:
+                    n += len(entries)
+            return n
+
+    # -- dirty flags + resync ------------------------------------------------
+
+    @property
+    def dirty(self) -> bool:
+        return any(s.dirty for s in self._shards)
+
+    @property
+    def dirty_reason(self) -> str:
+        for s in self._shards:
+            if s.dirty and s.dirty_reason:
+                return s.dirty_reason
+        return ""
+
+    def mark_all_dirty(self, reason: str) -> None:
+        """A divergence that cannot be attributed to one shard (a
+        dropped watch event for an unknown claim): every shard must
+        resync before allocation proceeds."""
+        for s in self._shards:
+            s.mark_dirty(reason)
+
+    def mark_shard_dirty(self, shard_id: int, reason: str) -> None:
+        self._shards[shard_id].mark_dirty(reason)
+
+    def dirty_shards(self) -> List[int]:
+        return [i for i, s in enumerate(self._shards) if s.dirty]
+
+    def begin_resync(self, shard_id: Optional[int] = None) -> None:
+        """Clear the dirty flag(s) BEFORE the caller takes its claim
+        snapshot: a concurrent mark_dirty whose dropped event postdates
+        the snapshot then re-dirties the shard and its queued resync
         re-runs — clearing after the swap would clobber that mark and
-        leave the index divergent forever."""
-        with self._lock:
-            self.dirty = False
-            self.dirty_reason = ""
+        leave the shard divergent forever."""
+        shards = (self._shards if shard_id is None
+                  else [self._shards[shard_id]])
+        for shard in shards:
+            with shard._lock:
+                shard.dirty = False
+                shard.dirty_reason = ""
+                # Commits stay refused until the rebuilt state swaps in
+                # (cleared by _swap_shard; re-marking dirty also covers
+                # the swap-refused tail — see _full_resync).
+                shard.resyncing = True
 
-    def mutation_count(self) -> int:
-        with self._lock:
-            return self._mutations
+    def mutation_count(self, shard_id: Optional[int] = None) -> int:
+        if shard_id is not None:
+            shard = self._shards[shard_id]
+            with shard._lock:
+                return shard._mutations
+        total = 0
+        for shard in self._shards:
+            with shard._lock:
+                total += shard._mutations
+        return total
 
-    def resync(self, claims: Iterable[Dict],
-               only_if_mutations: Optional[int] = None) -> bool:
-        """Rebuild from a full claim listing (call begin_resync first).
-        Deliberately does NOT consult the fault site: this IS the
-        recovery path — an armed apply fault must not be able to starve
-        it. Does NOT touch the dirty flag (see begin_resync).
-
-        only_if_mutations: the mutation_count() the caller read BEFORE
-        taking its claim snapshot; the swap is refused (returns False)
-        when a concurrent apply/remove landed in between — wholesale
-        replacement would silently resurrect what that mutation
-        changed (e.g. an out-of-band claim delete)."""
+    def _shard_state_from(self, claims: Iterable[Dict],
+                          shard_id: Optional[int]):
+        """Fresh (by_claim, taken, nreal, rvs, homes) rebuilt from a
+        claim listing — restricted to `shard_id` when given. Watermarks
+        for entry-less claims route via the recorded home (a stale
+        allocated event for them would route by its entries' pool, so
+        the watermark must live in that same shard)."""
         by_claim: Dict[str, Tuple[_Entry, ...]] = {}
-        taken: Dict[_Entry, int] = {}
+        taken: Dict[str, Dict[_Entry, int]] = {}
+        nreal: Dict[str, int] = {}
         rvs: Dict[str, int] = {}
+        homes: Dict[str, str] = {}
+        old_homes = dict(self._homes)  # C-level copy: atomic under GIL
         for claim in claims:
             key = claim_key(claim)
+            entries = claim_entries(claim)
+            pool = entries[0][1] if entries else old_homes.get(key)
+            if pool is None:
+                continue  # never allocated: nothing to rebuild
+            if shard_id is not None and self.shard_of(pool) != shard_id:
+                continue
+            homes[key] = pool
             rv = self._rv_int(claim)
             if rv:
                 rvs[key] = rv
-            entries = claim_entries(claim)
             if not entries:
                 continue
             by_claim[key] = entries
+            for e in entries:
+                nreal[e[1]] = nreal.get(e[1], 0) + 1
             for e in _expand(entries):
-                taken[e] = taken.get(e, 0) + 1
-        with self._lock:
+                counts = taken.setdefault(e[1], {})
+                counts[e] = counts.get(e, 0) + 1
+        return by_claim, taken, nreal, rvs, homes
+
+    def _swap_shard(self, shard_id: int, state,
+                    only_if_mutations: Optional[int]) -> bool:
+        shard = self._shards[shard_id]
+        by_claim, taken, nreal, rvs, homes = state
+        with shard._lock:
             if (only_if_mutations is not None
-                    and self._mutations != only_if_mutations):
+                    and shard._mutations != only_if_mutations):
                 return False
-            self._by_claim = by_claim
-            self._taken = taken
-            self._rv = rvs
-            self._removed.clear()
+            shard._by_claim = by_claim
+            shard._taken = taken
+            shard._nreal = nreal
+            shard._rv = rvs
+            shard._removed.clear()
+            # The swap is itself a mutation: a CONCURRENT resync of the
+            # same shard holding an older listing must see its
+            # only_if_mutations guard trip rather than silently clobber
+            # this fresher state.
+            shard._mutations += 1
+            shard.resyncing = False
+        # Routing hygiene: the rebuild is the authoritative home set for
+        # this shard. A key routing HERE but absent from the listing was
+        # deleted during the divergence window — it never re-enters the
+        # eviction FIFO (cleared above), so without this prune its
+        # _homes entry leaks for the scheduler's lifetime. Re-read the
+        # value at pop time: a concurrent apply may have just repointed
+        # the key's routing to another shard (same discipline as
+        # _drop_homes).
+        for key, pool in list(self._homes.items()):
+            if key in homes or self.shard_of(pool) != shard_id:
+                continue
+            if self._homes.get(key) is pool:
+                self._homes.pop(key, None)
+        self._homes.update(homes)
         return True
+
+    def resync(self, claims: Iterable[Dict]) -> bool:
+        """Rebuild EVERY shard from a full claim listing (sync mode /
+        tests; call begin_resync first). Deliberately does NOT consult
+        the fault sites: this IS the recovery path — an armed apply
+        fault must not be able to starve it. Does NOT touch the dirty
+        flags (see begin_resync). Reservations are preserved — cluster
+        truth does not know in-flight commits yet."""
+        listing = list(claims)
+        for sid in range(len(self._shards)):
+            self._swap_shard(sid, self._shard_state_from(listing, sid),
+                             None)
+        return True
+
+    def resync_shard(self, shard_id: int, claims: Iterable[Dict],
+                     only_if_mutations: Optional[int] = None) -> bool:
+        """Rebuild ONE shard from a full claim listing (the guarded
+        fallback's unit: sibling shards keep applying and scanning).
+
+        only_if_mutations: the shard's mutation_count() read BEFORE the
+        caller took its claim snapshot; the swap is refused (returns
+        False) when a concurrent apply/remove landed in between —
+        wholesale replacement would silently resurrect what that
+        mutation changed (e.g. an out-of-band claim delete)."""
+        return self._swap_shard(
+            shard_id,
+            self._shard_state_from(claims, shard_id), only_if_mutations)
 
     # -- queries ------------------------------------------------------------
 
     def is_taken(self, driver: str, pool: str, name: str,
                  overlay: Optional[Set[_Entry]] = None) -> bool:
-        key = (driver, pool, name)
-        parent = _parent_of(name)
-        marker = (driver, pool, f"{parent}-ss*") if parent != name else None
-        with self._lock:
-            if key in self._taken:
+        shard = self._shards[self.shard_of(pool)]
+        with shard._lock:
+            if _taken_in(shard._taken.get(pool, ()), driver, pool, name):
                 return True
-            if marker and marker in self._taken:
-                return True  # parent chip wholly claimed
-        if overlay:
-            if key in overlay:
+            if _taken_in(shard._reserved_taken.get(pool, ()),
+                         driver, pool, name):
                 return True
-            if marker and marker in overlay:
-                return True
-        return False
+        return bool(overlay) and _taken_in(overlay, driver, pool, name)
 
     def entries_for(self, key: str) -> Tuple[_Entry, ...]:
-        with self._lock:
-            return self._by_claim.get(key, ())
+        pool = self._homes.get(key)
+        if pool is None:
+            return ()
+        shard = self._shards[self.shard_of(pool)]
+        with shard._lock:
+            return shard._by_claim.get(key, ())
 
     def owners_of_pool(self, pool: str) -> Set[str]:
         """Claim keys holding any device on `pool` (diagnostics)."""
-        with self._lock:
-            return {k for k, entries in self._by_claim.items()
+        shard = self._shards[self.shard_of(pool)]
+        with shard._lock:
+            return {k for k, entries in shard._by_claim.items()
                     if any(e[1] == pool for e in entries)}
 
     def diff_against(self, claims: Iterable[Dict]) -> List[str]:
         """Divergences between the live index and a ground-truth claim
-        listing (chaos invariant: after quiesce, empty)."""
-        want: Dict[str, Tuple[_Entry, ...]] = {}
+        listing (chaos invariant: after quiesce, empty) — checked PER
+        SHARD (a claim indexed in the wrong shard is a divergence even
+        if the global union looks right) and globally."""
+        want_by_shard: Dict[int, Dict[str, Tuple[_Entry, ...]]] = {}
         for claim in claims:
             entries = claim_entries(claim)
             if entries:
-                want[claim_key(claim)] = entries
-        with self._lock:
-            have = dict(self._by_claim)
+                sid = self.shard_of(entries[0][1])
+                want_by_shard.setdefault(sid, {})[claim_key(claim)] = entries
         out = []
-        for key in sorted(set(want) | set(have)):
-            if want.get(key) != have.get(key):
-                out.append(f"index[{key}]={have.get(key)} != "
-                           f"truth {want.get(key)}")
+        for sid, shard in enumerate(self._shards):
+            with shard._lock:
+                have = dict(shard._by_claim)
+            want = want_by_shard.get(sid, {})
+            for key in sorted(set(want) | set(have)):
+                if want.get(key) != have.get(key):
+                    out.append(f"shard {sid}: index[{key}]="
+                               f"{have.get(key)} != truth {want.get(key)}")
         return out
 
 
@@ -339,25 +781,50 @@ class Scheduler:
     the legacy constructor signature); ``resync_interval`` is the
     event-mode safety-net cadence at which still-pending pods are
     re-nudged; ``gc_sweep_interval`` paces the low-frequency orphan-claim
-    sweep backing the event-driven GC."""
+    sweep backing the event-driven GC; ``workers`` sizes the event-mode
+    reconcile pool (default ``TPU_DRA_SCHED_WORKERS`` or 4 — per-key
+    serialization keeps same-pod/same-gc items exclusive, the snapshot
+    commit step keeps cross-worker picks conflict-free)."""
 
     SYNC_TIMEOUT = 10.0
+    # Fresh-snapshot re-scans after an optimistic commit conflict before
+    # the pod item falls back to a backoff requeue.
+    COMMIT_RETRIES = 4
+    # Distinct nodeSelector keys cached in _cand_cache before stale-rev
+    # entries are swept (per-pod-unique selectors would otherwise grow
+    # the cache for the scheduler's lifetime).
+    CAND_CACHE_MAX = 1024
 
     def __init__(self, client: ApiClient, interval: float = 0.15, *,
                  resync_interval: float = 2.0,
-                 gc_sweep_interval: float = 10.0):
+                 gc_sweep_interval: float = 10.0,
+                 workers: Optional[int] = None,
+                 index_shards: Optional[int] = None):
         self._client = client
         self._interval = interval
         self._resync_interval = resync_interval
         self._gc_sweep_interval = gc_sweep_interval
+        self._workers = (workers if workers is not None else
+                         int(os.environ.get("TPU_DRA_SCHED_WORKERS", "4")))
+        self._index_shards = (index_shards if index_shards is not None else
+                              int(os.environ.get(
+                                  "TPU_DRA_SCHED_INDEX_SHARDS", "8")))
         self._stop = threading.Event()
+        self._raised_switch = False
         self._thread: Optional[threading.Thread] = None
         self._queue: Optional[WorkQueue] = None
-        self._worker: Optional[threading.Thread] = None
+        self._pool: List[threading.Thread] = []
         self._sweeper: Optional[threading.Thread] = None
         self._informers: Dict[str, Informer] = {}
-        self._index = AllocationIndex()
+        self._index = AllocationIndex(n_shards=self._index_shards)
         self._pending: Set[str] = set()
+        # Subset of _pending that FAILED to place for lack of capacity:
+        # the capacity-event fast path re-drives only these. Queued or
+        # in-flight pods run against current state anyway, and
+        # re-enqueueing the whole pending set per capacity event was
+        # the control plane's top write amplifier at churn scale (every
+        # claim delete fanned out O(window) queue ops).
+        self._waiting: Set[str] = set()
         # Pods fully placed by us: their own bind-event echo must not
         # re-enqueue a full reconcile pass (entries leave on pod delete,
         # so the set is bounded by live placed pods).
@@ -366,13 +833,31 @@ class Scheduler:
         # DeviceClass name -> (resourceVersion, selector sources): spares
         # re-extracting selector lists per allocation; the compiled
         # programs themselves are cached process-wide in simcluster.cel.
+        # Shared by the pool workers: values are immutable tuples and
+        # CPython dict item assignment is atomic, so concurrent writers
+        # can at worst recompute the same value (benign).
         self._class_cache: Dict[str, Tuple[str, List[str]]] = {}
         # Node -> (slice (name, rv) fingerprint, NodeTopology|None): the
         # per-node fabric view extracted from published ResourceSlices,
-        # rebuilt only when a slice's resourceVersion moves. Worker-thread
-        # only (same single-writer discipline as _class_cache).
+        # rebuilt only when a slice's resourceVersion moves. Same
+        # immutable-value sharing discipline as _class_cache.
         self._topo_cache: Dict[
             str, Tuple[tuple, Optional[topology.NodeTopology]]] = {}
+        # Candidate-node cache: nodeSelector -> (node revision, sorted
+        # names). Invalidated wholesale by bumping _nodes_rev from node
+        # watch events — per-pod scans stop re-listing + re-sorting the
+        # whole node inventory. The cached lists are shared read-only.
+        self._cand_cache: Dict[tuple, Tuple[int, List[str]]] = {}
+        self._nodes_rev = 0
+        # Node -> (slice revision, published device count): the
+        # busy-node skip's denominator (see _schedule).
+        self._devcount_cache: Dict[str, Tuple[int, int]] = {}
+        self._slices_rev = 0
+        # Revision source for both caches: next() is atomic, so two
+        # racing capacity events always land DISTINCT revisions — a
+        # plain += 1 could lose one bump to a read-modify-write race
+        # and leave a cache validated against the surviving value.
+        self._rev_seq = itertools.count(1)
         self._started = False
 
     # -- lifecycle ----------------------------------------------------------
@@ -389,19 +874,23 @@ class Scheduler:
         # so nothing would ever dispatch deletes for claims that died
         # while the scheduler was stopped — a retained index would keep
         # their devices phantom-allocated forever.
-        self._index = AllocationIndex()
+        self._index = AllocationIndex(n_shards=self._index_shards)
         with self._plock:
             self._pending.clear()
+            self._waiting.clear()
             self._done.clear()
         self._class_cache.clear()
         self._topo_cache.clear()
+        self._cand_cache.clear()
+        self._devcount_cache.clear()
         self._queue = WorkQueue(
             # No global token bucket: event enqueues are explicit-delay
             # (after=0) and failures back off per item; a bucket would
             # throttle churn-scale nudge fan-in for no protection (the
             # "apiserver" here is in-process or the fake).
             rate_limiter=ExponentialFailureRateLimiter(0.005, 2.0),
-            log=lambda msg: log.debug("workqueue: %s", msg))
+            log=lambda msg: log.debug("workqueue: %s", msg),
+            name="sched")
 
         inf = {}
         for name, gvr in (("pods", PODS), ("claims", RESOURCECLAIMS),
@@ -428,10 +917,22 @@ class Scheduler:
 
         self._informers = inf
         self._started = True
-        self._worker = threading.Thread(
-            target=self._queue.run, args=(self._stop,), daemon=True,
-            name="sim-scheduler-worker")
-        self._worker.start()
+        # CPython GIL tuning for the lock-heavy event control plane:
+        # the 5ms default switch interval preempts lock HOLDERS
+        # mid-critical-section, convoying every waiter behind them
+        # (measured: workers=4 churn throughput collapsed ~5x under
+        # it). 20ms lets critical sections complete between forced
+        # switches. Process-global by nature, so raise/restore is
+        # refcounted module-wide: overlapping scheduler lifecycles
+        # (tests, chaos harnesses) must not revert it under each other
+        # or leak it past the last stop().
+        _raise_switch_interval()
+        self._raised_switch = True
+        # The reconcile pool: N queue consumers with per-key
+        # serialization (infra.workqueue); cross-worker allocation
+        # safety comes from the snapshot commit step, not from here.
+        self._pool = self._queue.start_workers(self._workers, self._stop)
+        SCHED_WORKERS.set(self._workers)
         for i in inf.values():
             i.start()
         for i in inf.values():
@@ -439,7 +940,7 @@ class Scheduler:
         # The initial claim listing flowed through _on_claim adds during
         # informer sync, so the index is already built; the nudge below
         # only covers pods whose add events raced the pending-set wiring.
-        self._nudge_pending_pods()
+        self._nudge_all_pending()
         self._sweeper = threading.Thread(target=self._sweep_loop,
                                          daemon=True,
                                          name="sim-scheduler-sweep")
@@ -451,9 +952,13 @@ class Scheduler:
             i.stop()
         if self._queue is not None:
             self._queue.shutdown()
-        for t in (self._worker, self._sweeper, self._thread):
+        for t in self._pool + [self._sweeper, self._thread]:
             if t is not None:
                 t.join(timeout=5)
+        self._pool = []
+        if self._raised_switch:
+            self._raised_switch = False
+            _restore_switch_interval()
         self._started = False
 
     def _poll_run(self) -> None:
@@ -523,8 +1028,8 @@ class Scheduler:
             return
         try:
             self._index.apply(new)
-        except FaultInjected:
-            self._mark_dirty("index apply failed")
+        except FaultInjected as e:
+            self._mark_dirty_from(e, "index apply failed")
             return
         if old is not None and claim_entries(old) and not claim_entries(new):
             self._nudge_pending_pods()  # deallocation freed devices
@@ -534,8 +1039,8 @@ class Scheduler:
             return
         try:
             self._index.remove(claim)
-        except FaultInjected:
-            self._mark_dirty("index remove failed")
+        except FaultInjected as e:
+            self._mark_dirty_from(e, "index remove failed")
             return
         # A deleted claim may free devices — and if its owner pod is
         # still alive (out-of-band deletion), that pod needs re-driving
@@ -548,6 +1053,14 @@ class Scheduler:
         self._nudge_pending_pods()
 
     def _on_capacity(self, resource: str) -> None:
+        # Cache invalidation happens even for DROPPED events: the drop
+        # models the scheduler mishandling the event downstream, but a
+        # candidate/devcount cache left stale here would outlive the
+        # guarded resync that recovers everything else.
+        if resource == "nodes":
+            self._nodes_rev = next(self._rev_seq)
+        else:
+            self._slices_rev = next(self._rev_seq)
         if self._drop_event(resource):
             return
         self._nudge_pending_pods()
@@ -568,6 +1081,7 @@ class Scheduler:
     def _enqueue_pod(self, key: str) -> None:
         with self._plock:
             self._pending.add(key)
+            self._waiting.discard(key)  # the enqueue below covers it
             self._done.discard(key)
         self._queue.enqueue(key, self._process_pod, key=f"pod/{key}",
                             after=0, dedupe=True)
@@ -575,24 +1089,55 @@ class Scheduler:
     def _forget_pod(self, key: str, done: bool = False) -> None:
         with self._plock:
             self._pending.discard(key)
+            self._waiting.discard(key)
             if done:
                 self._done.add(key)
             else:
                 self._done.discard(key)
 
     def _nudge_pending_pods(self) -> None:
-        """Re-drive every still-pending pod (capacity may have freed).
-        dedupe=True collapses event-storm fan-in to one queued item per
-        pod."""
+        """Capacity-event fast path: re-drive the pods a previous
+        attempt could NOT place (see _waiting). dedupe=True collapses
+        event-storm fan-in to one queued item per pod. A free landing
+        while a pod's failing attempt is still mid-flight can slip past
+        this (the pod joins _waiting only after the attempt returns) —
+        the periodic sweep re-drives the whole pending set to close
+        that window."""
+        with self._plock:
+            if not self._waiting:
+                return
+            keys = sorted(self._waiting)
+            self._waiting.clear()
+        for key in keys:
+            self._queue.enqueue(key, self._process_pod, key=f"pod/{key}",
+                                after=0, dedupe=True)
+
+    def _nudge_all_pending(self) -> None:
+        """The sweep's safety net: re-drive EVERY still-pending pod
+        (the pre-§15 nudge semantics, now off the event fast path)."""
         with self._plock:
             keys = sorted(self._pending)
         for key in keys:
             self._queue.enqueue(key, self._process_pod, key=f"pod/{key}",
                                 after=0, dedupe=True)
 
-    def _mark_dirty(self, reason: str) -> None:
-        self._index.dirty = True
-        self._index.dirty_reason = reason
+    def _mark_dirty(self, reason: str, *, attributed: bool = False) -> None:
+        """attributed=True: the divergence already marked its OWN shard
+        dirty (the sched.shard_apply seam does so before raising), so
+        only the resync item needs queueing. Otherwise the divergence
+        cannot be pinned to one shard — a dropped watch event for a
+        claim whose pool we never saw — and every shard must rebuild."""
+        if not attributed:
+            self._index.mark_all_dirty(reason)
+        self._enqueue_resync(reason)
+
+    def _mark_dirty_from(self, e: FaultInjected, reason: str) -> None:
+        """The FaultInjected catch sites' shared attribution rule:
+        sched.shard_apply self-marks its shard (see _checked_shard);
+        anything else cannot be pinned to one shard."""
+        self._mark_dirty(reason, attributed=e.site == "sched.shard_apply")
+
+    def _enqueue_resync(self, reason: str) -> None:
         if self._queue is not None:
             self._queue.enqueue(reason, lambda _: self._full_resync(),
                                 key="resync", after=0, dedupe=True)
@@ -602,31 +1147,52 @@ class Scheduler:
         self._mark_dirty(reason)
 
     def _full_resync(self) -> None:
-        """The guarded fallback: rebuild the allocation index and the
-        pending-pod set from the informer caches (which self-heal via
-        relist even when the SCHEDULER mishandled events) and re-drive
-        everything pending. Counted — the bench asserts steady state
-        never comes here."""
-        if not self._index.dirty:
+        """The guarded fallback, per shard: rebuild every DIRTY shard of
+        the allocation index from the informer caches (which self-heal
+        via relist even when the SCHEDULER mishandled events) and
+        re-drive everything pending. Clean shards are untouched — their
+        scans and commits flow throughout the rebuild. Counted — the
+        bench asserts steady state never comes here."""
+        dirty = self._index.dirty_shards()
+        if not dirty:
             return
         SCHED_FULL_RELISTS.inc()
         reason = self._index.dirty_reason
         # Clear-dirty BEFORE the snapshot: a drop landing after the
-        # listing re-dirties the index and its own queued resync re-runs.
-        self._index.begin_resync()
+        # listing re-dirties the shard and its own queued resync
+        # re-runs. `resyncing` stays set until the swap, so optimistic
+        # commits keep refusing the shards meanwhile.
+        for sid in dirty:
+            self._index.begin_resync(sid)
+        # ONE claim listing per retry round, shared by every dirty
+        # shard (an unattributed divergence dirties all of them — at
+        # fleet scale per-shard listings multiplied the recovery cost
+        # by the shard count). The per-shard only_if_mutations guard
+        # still reads each shard's generation before the listing.
+        failed = list(dirty)
         for _ in range(8):
-            gen = self._index.mutation_count()
-            if self._index.resync(self._list_claims(),
-                                  only_if_mutations=gen):
+            gens = {sid: self._index.mutation_count(sid) for sid in failed}
+            listing = self._list_claims()
+            failed = [sid for sid in failed
+                      if not self._index.resync_shard(
+                          sid, listing, only_if_mutations=gens[sid])]
+            if not failed:
                 break
-        else:
-            # Concurrent mutations kept invalidating the snapshot
+        SCHED_SHARD_RESYNCS.inc(len(dirty) - len(failed))
+        if failed:
+            # Concurrent mutations kept invalidating the snapshots
             # (effective handler-side changes are rare, so this is an
-            # extreme tail): retry through the queue rather than spin.
-            self._mark_dirty("resync raced concurrent index mutations")
+            # extreme tail): re-mark just those shards and retry through
+            # the queue rather than spin.
+            for sid in failed:
+                self._index.mark_shard_dirty(
+                    sid, "resync raced concurrent index mutations")
+            self._enqueue_resync("resync raced concurrent index mutations")
             return
         with self._plock:
             self._pending.clear()
+            self._waiting.clear()  # subset of _pending; a stale key here
+            #   would spuriously re-drive a placed pod on capacity events
             self._done.clear()  # conservatively re-verify placed pods
         for pod in self._list_pods():
             if pod["metadata"].get("deletionTimestamp"):
@@ -634,12 +1200,12 @@ class Scheduler:
             phase = (pod.get("status") or {}).get("phase", "Pending")
             if phase in ("", "Pending"):
                 self._enqueue_pod(self._pod_key(pod))
-        log.info("full resync completed (%s)", reason)
+        log.info("resync of shards %s completed (%s)", dirty, reason)
 
     def _sweep_loop(self) -> None:
         next_gc = time.monotonic() + self._gc_sweep_interval
         while not self._stop.wait(self._resync_interval):
-            self._nudge_pending_pods()
+            self._nudge_all_pending()
             if time.monotonic() >= next_gc:
                 next_gc = time.monotonic() + self._gc_sweep_interval
                 self._queue.enqueue(
@@ -768,21 +1334,27 @@ class Scheduler:
         # already seen — so a full resync can never race a real mutation.
         try:
             self._index.remove(claim, force=True)
-        except FaultInjected:
-            self._mark_dirty("index remove failed (own delete)")
+        except FaultInjected as e:
+            self._mark_dirty_from(e, "index remove failed (own delete)")
         SCHED_CLAIMS_GCED.inc(labels={"path": path})
         log.info("GC claim %s/%s via %s (owner pod gone)", ns, name, path)
 
     # -- per-pod reconcile (worker thread) ------------------------------------
 
     def _process_pod(self, key: str) -> None:
-        # Never allocate over a known-divergent index: resync first
-        # (same worker thread, so this is naturally serialized with all
-        # other allocation).
-        if self._index.dirty:
+        # A known-divergent shard must rebuild before its commits flow;
+        # the inline call keeps single-worker tests converging without
+        # waiting for the queued resync item — but ONLY single-worker:
+        # on a pool, every worker inlining it would race concurrent
+        # full listings and swap-thrash each other's only_if_mutations
+        # guards (the keyed+deduped "resync" queue item, enqueued by
+        # every dirty path, already serializes recovery). Scheduling
+        # proceeds regardless: clean shards commit normally, and a
+        # still-dirty (or mid-rebuild) shard refuses try_commit — so a
+        # pod whose pool is divergent degrades to a bounded
+        # conflict/requeue, it never allocates against untrusted state.
+        if self._workers <= 1 and self._index.dirty:
             self._full_resync()
-            if self._index.dirty:  # resync raced mutations; retry later
-                raise _Unscheduled("index dirty, resync pending")
         ns, name = key.split("/", 1)
         pod = self._get_pod(ns, name)
         if pod is None or pod["metadata"].get("deletionTimestamp"):
@@ -799,8 +1371,13 @@ class Scheduler:
             raise _Unscheduled(str(e)) from e  # workqueue retries w/ backoff
         if done:
             self._forget_pod(key, done=True)
-        # else: stays pending; capacity events / the periodic nudge
-        # re-drive it — no busy retry for genuinely unschedulable pods.
+        else:
+            # Stays pending; capacity events (via _waiting) / the
+            # periodic sweep re-drive it — no busy retry for genuinely
+            # unschedulable pods.
+            with self._plock:
+                if key in self._pending:
+                    self._waiting.add(key)
 
     # -- resourceclaim controller analog --------------------------------------
 
@@ -851,7 +1428,7 @@ class Scheduler:
             known[entry["name"]] = claim_name
             changed = True
         if changed:
-            upd = copy.deepcopy(pod)
+            upd = json_deepcopy(pod)
             upd.setdefault("status", {})["resourceClaimStatuses"] = [
                 {"name": k, "resourceClaimName": v}
                 for k, v in sorted(known.items())]
@@ -869,13 +1446,25 @@ class Scheduler:
         claims = self._pod_claims(pod, ns)
         if claims is None:
             raise _Unscheduled("claim object missing")  # retried
+        needs_alloc = any(
+            not (c.get("status") or {}).get("allocation") for c in claims)
         node_name = pod["spec"].get("nodeName")
         candidates = ([node_name] if node_name
                       else self._candidate_nodes(pod))
         for node in candidates:
+            if (needs_alloc and not node_name
+                    and self._index.allocated_count(node)
+                    >= self._published_device_count(node)):
+                # Busy-node skip: every published device on this node is
+                # consumed (each allocated result takes at least one
+                # distinct published device, so count >= published means
+                # full) — no snapshot scan or CEL evaluation needed. At
+                # fleet scale the sorted candidate walk otherwise burns
+                # its time re-scanning the same leading busy nodes.
+                continue
             if self._try_allocate_all(claims, node):
                 if not node_name:
-                    upd = copy.deepcopy(pod)
+                    upd = json_deepcopy(pod)
                     upd["spec"]["nodeName"] = node
                     updated = self._client.update(PODS, upd, ns)
                     if self._started:
@@ -905,11 +1494,39 @@ class Scheduler:
 
     def _candidate_nodes(self, pod: Dict) -> List[str]:
         selector = pod["spec"].get("nodeSelector") or {}
-        names = []
-        for node in self._iter_nodes():
-            labels = node["metadata"].get("labels") or {}
-            if all(labels.get(k) == v for k, v in selector.items()):
-                names.append(node["metadata"]["name"])
+        ck = tuple(sorted(selector.items()))
+        names: Optional[List[str]] = None
+        # The selector->names cache spares re-listing + re-sorting the
+        # whole node inventory per scheduling attempt (O(n log n) at 5k
+        # nodes). `rev` is read BEFORE the listing: an event landing
+        # mid-listing stores the entry under the pre-event revision, so
+        # the next lookup recomputes rather than trusting a torn view.
+        # Event mode only — sync mode has no events to bump revisions.
+        rev = self._nodes_rev
+        if self._started:
+            cached = self._cand_cache.get(ck)
+            if cached is not None and cached[0] == rev:
+                names = cached[1]
+        if names is None:
+            names = []
+            for node in self._iter_nodes():
+                labels = node["metadata"].get("labels") or {}
+                if all(labels.get(k) == v for k, v in selector.items()):
+                    names.append(node["metadata"]["name"])
+            if self._started:
+                if len(self._cand_cache) >= self.CAND_CACHE_MAX:
+                    # Sweep superseded-revision entries (dead weight —
+                    # lookups miss on the rev check); if every entry is
+                    # current the workload really has this many live
+                    # selectors, so start over rather than grow without
+                    # bound. list() snapshots atomically under the GIL
+                    # (sibling workers insert concurrently).
+                    for k, v in list(self._cand_cache.items()):
+                        if v[0] != rev:
+                            self._cand_cache.pop(k, None)
+                    if len(self._cand_cache) >= self.CAND_CACHE_MAX:
+                        self._cand_cache.clear()
+                self._cand_cache[ck] = (rev, names)
         if (len(names) > 1
                 and featuregates.enabled(
                     featuregates.TopologyAwareScheduling)):
@@ -942,34 +1559,94 @@ class Scheduler:
         self._topo_cache[node] = (key, topo)
         return topo
 
+    def _published_device_count(self, node: str) -> int:
+        """Total devices this node's ResourceSlices publish — the
+        busy-node skip's denominator. Cached against the slice revision
+        in event mode (sync mode recomputes: nothing bumps the revision
+        there)."""
+        rev = self._slices_rev
+        if self._started:
+            cached = self._devcount_cache.get(node)
+            if cached is not None and cached[0] == rev:
+                return cached[1]
+        count = sum(len((sl.get("spec") or {}).get("devices") or ())
+                    for sl in self._slices_for_node(node))
+        if self._started:
+            self._devcount_cache[node] = (rev, count)
+        return count
+
     def _try_allocate_all(self, claims: List[Dict], node: str) -> bool:
         """Allocate every unallocated claim on `node`; all-or-nothing per
         pod (claims already allocated elsewhere pin the pod implicitly:
         a shared pre-allocated claim simply must exist on this node).
-        Device availability comes from the incremental index plus a
-        staging overlay for this pod's own picks."""
-        overlay: Set[_Entry] = set()
-        staged: List[Tuple[Dict, Dict]] = []
-        for claim in claims:
-            alloc = (claim.get("status") or {}).get("allocation")
-            if alloc:
-                # Shared claim already allocated: usable only if it landed
-                # on this node's pool.
-                pools = {r.get("pool") for r in
-                         (alloc.get("devices") or {}).get("results") or []}
-                if pools and node not in pools:
+
+        Snapshot discipline (SURVEY §15): availability is read from one
+        immutable PoolView built per attempt — no index lock is held
+        across the scan — plus a staging overlay for this pod's own
+        picks. The picks then commit optimistically: ``try_commit``
+        re-validates every device against the live shard and reserves
+        them all-or-nothing. A conflict (another worker took a device
+        first, the shard is mid-resync, or the sched.snapshot_commit
+        fault fired) re-scans against a fresh snapshot — which now sees
+        the winner's reservation — up to COMMIT_RETRIES times before
+        the pod item falls back to a backoff requeue."""
+        for _attempt in range(self.COMMIT_RETRIES):
+            view = self._index.snapshot(node)
+            overlay: Set[_Entry] = set()
+            staged: List[Tuple[Dict, Dict, str, Tuple[_Entry, ...]]] = []
+            for claim in claims:
+                alloc = (claim.get("status") or {}).get("allocation")
+                if alloc:
+                    # Shared claim already allocated: usable only if it
+                    # landed on this node's pool.
+                    pools = {r.get("pool") for r in
+                             (alloc.get("devices") or {}).get("results")
+                             or []}
+                    if pools and node not in pools:
+                        return False
+                    continue
+                allocation = self._allocate(claim, node, view, overlay)
+                if allocation is None:
                     return False
-                continue
-            allocation = self._allocate(claim, node, overlay)
-            if allocation is None:
-                return False
-            staged.append((claim, allocation))
-        for claim, allocation in staged:
-            upd = copy.deepcopy(claim)
-            upd.setdefault("status", {})["allocation"] = allocation
-            updated = self._client.update_status(
-                RESOURCECLAIMS, upd, upd["metadata"].get("namespace"))
-            self._after_claim_write(updated)
+                entries = tuple(
+                    (r["driver"], r["pool"], r["device"])
+                    for r in allocation["devices"]["results"])
+                staged.append((claim, allocation, claim_key(claim),
+                               entries))
+            if not staged:
+                return True  # nothing to place: already allocated
+            committed = self._index.try_commit(
+                node, [(k, e) for _c, _a, k, e in staged])
+            if committed:
+                break
+            if committed is None:
+                # Claim-level conflict: a sibling worker allocated or
+                # reserved one of these very claims, so the local claim
+                # bodies are stale — every retry would stage the same
+                # outdated copy and conflict deterministically (the
+                # fresh snapshot changes the DEVICE picks, not the
+                # claim). Skip the guaranteed-futile rescans; the
+                # backoff requeue's claim re-fetch resolves it.
+                raise _Unscheduled(
+                    f"claim copies went stale under commit on {node}")
+            # Device conflict: the shard moved underneath the snapshot.
+            # Loop — the fresh view includes whatever won.
+        else:
+            raise _Unscheduled(
+                f"snapshot commit kept conflicting on {node}")
+        try:
+            for claim, allocation, _k, _e in staged:
+                upd = json_deepcopy(claim)
+                upd.setdefault("status", {})["allocation"] = allocation
+                updated = self._client.update_status(
+                    RESOURCECLAIMS, upd, upd["metadata"].get("namespace"))
+                self._after_claim_write(updated)
+        finally:
+            # Reservations end when the real allocations are indexed
+            # (success: _after_claim_write applied them) or when the
+            # write failed (the devices return to the free set and the
+            # requeued attempt re-picks).
+            self._index.release(node, [k for _c, _a, k, _e in staged])
         return True
 
     def _after_claim_write(self, obj: Dict) -> None:
@@ -983,10 +1660,10 @@ class Scheduler:
             self._informers["claims"].update_cache(obj)
         try:
             self._index.apply(obj)
-        except FaultInjected:
-            self._mark_dirty("index apply failed (own write)")
+        except FaultInjected as e:
+            self._mark_dirty_from(e, "index apply failed (own write)")
 
-    def _allocate(self, claim: Dict, node: str,
+    def _allocate(self, claim: Dict, node: str, view: PoolView,
                   overlay: Set[_Entry]) -> Optional[Dict]:
         devices = (claim.get("spec") or {}).get("devices") or {}
         results = []
@@ -1006,7 +1683,7 @@ class Scheduler:
             progs = cel.compile_many(sources)
             if progs is None:
                 return None  # a broken selector selects nothing
-            picked = self._pick_devices(node, progs, count, overlay)
+            picked = self._pick_devices(node, progs, count, view, overlay)
             if picked is None:
                 return None
             for driver, dev in picked:
@@ -1040,12 +1717,14 @@ class Scheduler:
         return sources
 
     def _pick_devices(self, node: str, progs: List["cel.Program"],
-                      count: int, overlay: Set[_Entry]
+                      count: int, view: PoolView, overlay: Set[_Entry]
                       ) -> Optional[List[Tuple[str, str]]]:
         """Devices on `node` matching EVERY compiled CEL program, as
         (driver, name) pairs. CEL is evaluated for real against the
         published attributes (simcluster.cel): a wrong attribute name or
         type mismatch selects nothing instead of everything.
+        Availability reads the caller's immutable PoolView — the scan
+        holds no index lock; stale reads surface as commit conflicts.
 
         Iteration is deterministic — slices and devices are scanned in
         name order — so first-fit picks and topology scores reproduce
@@ -1075,8 +1754,7 @@ class Scheduler:
                               key=lambda d: d["name"]):
                 if not all(p.matches(dev, driver) for p in progs):
                     continue
-                if self._index.is_taken(driver, node, dev["name"],
-                                        overlay=overlay):
+                if view.is_taken(driver, dev["name"], overlay=overlay):
                     continue
                 available.append((driver, dev["name"]))
                 if not topo_path and len(available) == count:
