@@ -16,19 +16,30 @@
 #    ICI-contiguous cuboid, topology free-set == the allocation index
 #    after quiesce). Violations exit non-zero.
 # 2. The @slow chaos soak tests (excluded from tier-1 by -m 'not slow').
+# 3. Witness cross-validation: the acquisition-order edges the whole
+#    matrix + soak observed must be a subset of draracer's static
+#    lock-order graph (SURVEY §16.4).
 set -euo pipefail
 REPO_ROOT="$(cd "$(dirname "$0")/.." && pwd)"
 SEEDS="${1:-${CHAOS_SEEDS:-25}}"
 EVENTS="${2:-${CHAOS_EVENTS:-60}}"
+WITNESS_EDGES="$REPO_ROOT/.lockwitness-edges.chaos.json"
+rm -f "$WITNESS_EDGES"
 
 echo ">> chaos matrix: ${SEEDS} seeded schedules x ${EVENTS} events"
 JAX_PLATFORMS=cpu TPU_DRA_TPUINFO_BACKEND=fake \
+TPU_DRA_LOCK_WITNESS_EXPORT="$WITNESS_EDGES" \
   python -m tpu_dra.simcluster.chaos \
     --seeds "$SEEDS" --seed-start "${CHAOS_SEED_START:-0}" \
     --events "$EVENTS"
 
 echo ">> chaos soak (slow-marked pytest tier, lock witness on)"
 JAX_PLATFORMS=cpu TPU_DRA_LOCK_WITNESS=1 \
+TPU_DRA_LOCK_WITNESS_EXPORT="$WITNESS_EDGES" \
   python -m pytest "$REPO_ROOT/tests/test_chaos.py" \
   -m slow -q -p no:cacheprovider
+
+echo ">> lock-order witness cross-validation (observed ⊆ static)"
+python -m tpu_dra.analysis --root "$REPO_ROOT" \
+  --check-witness "$WITNESS_EDGES"
 echo ">> chaos tier green"

@@ -10,26 +10,42 @@
 #    R3 zero-copy informer reads are read-only, R4 fault-site registry
 #    coverage, R5 metric catalog, R6 feature-gate names, R7 prepare-
 #    pipeline except paths unwind, R8 no success externalization before
-#    the terminal store. Any unsuppressed finding fails. Whole-tree
-#    runs are incremental (per-file result cache, .dralint-cache.json);
-#    DRALINT_NO_CACHE=1 forces a cold run.
+#    the terminal store — plus the draracer interprocedural pass
+#    (SURVEY §16): R9 whole-tree *_locked reachability over the call
+#    graph, R10 guarded-by inference, R11 static lock-order graph
+#    acyclicity. Any unsuppressed finding fails, and so does any
+#    suppression comment WITHOUT a justification string
+#    (--require-justified): the waiver count can never grow silently.
+#    Whole-tree runs are incremental (per-file result cache,
+#    .dralint-cache.json); DRALINT_NO_CACHE=1 forces a cold run.
 # 3. The fault-site coverage report (informational): guard + arm
 #    locations per registered site.
 # 4. drmc — the deterministic model checker gate (hack/drmc.sh):
 #    interleaving exploration + crash-point enumeration over the
-#    scheduler-churn and batch-prepare scenarios.
+#    scheduler-churn and batch-prepare scenarios — run with the lock
+#    witness EXPORTING its observed acquisition-order edges.
+# 5. observed ⊆ static: every runtime edge the drmc run observed must
+#    be in R11's static lock-order graph. An unexplained edge means
+#    the call graph under-approximates — the gate fails so the model
+#    gets fixed rather than quietly trusted.
 set -euo pipefail
 REPO_ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+WITNESS_EDGES="$REPO_ROOT/.lockwitness-edges.lint.json"
 
 echo ">> compileall"
 python -m compileall -q \
   "$REPO_ROOT/tpu_dra" "$REPO_ROOT/tests" "$REPO_ROOT/bench.py" \
   "$REPO_ROOT/hack"
 
-echo ">> dralint (R1-R8) + fault-site coverage"
+echo ">> dralint (R1-R11) + fault-site coverage"
 python -m tpu_dra.analysis --root "$REPO_ROOT" --sites-report \
-  ${DRALINT_NO_CACHE:+--no-cache}
+  --require-justified ${DRALINT_NO_CACHE:+--no-cache}
 
-"$REPO_ROOT/hack/drmc.sh"
+rm -f "$WITNESS_EDGES"
+TPU_DRA_LOCK_WITNESS_EXPORT="$WITNESS_EDGES" "$REPO_ROOT/hack/drmc.sh"
+
+echo ">> lock-order witness cross-validation (observed ⊆ static)"
+python -m tpu_dra.analysis --root "$REPO_ROOT" \
+  --check-witness "$WITNESS_EDGES" ${DRALINT_NO_CACHE:+--no-cache}
 
 echo ">> lint tier green"

@@ -17,15 +17,23 @@
 #    scales with iterations, not wall-clock — with the LOCK-ORDER WITNESS
 #    installed (TPU_DRA_LOCK_WITNESS=1): conftest fails the session on an
 #    acquisition-order cycle.
+# 5. Witness cross-validation: every acquisition-order edge OBSERVED
+#    across the deep drmc exploration and all N witnessed suite runs
+#    must be in draracer's static lock-order graph (observed ⊆ static,
+#    SURVEY §16.4) — an unexplained edge means the static call graph
+#    under-approximates and fails the tier.
 set -euo pipefail
 REPO_ROOT="$(cd "$(dirname "$0")/.." && pwd)"
 N="${1:-3}"
+WITNESS_EDGES="$REPO_ROOT/.lockwitness-edges.race.json"
+rm -f "$WITNESS_EDGES"
 
 echo ">> lint gate (dralint)"
 "$REPO_ROOT/hack/lint.sh"
 
 echo ">> drmc deep exploration"
-"$REPO_ROOT/hack/drmc.sh" 600 --skip-crash
+TPU_DRA_LOCK_WITNESS_EXPORT="$WITNESS_EDGES" \
+  "$REPO_ROOT/hack/drmc.sh" 600 --skip-crash
 
 echo ">> TSan build + drive"
 make -C "$REPO_ROOT/native" tsan -s
@@ -38,8 +46,14 @@ echo ">> ${N}x repeat of the threaded Python suites (lock witness on)"
 for i in $(seq 1 "$N"); do
   echo "-- iteration $i/$N"
   TPU_DRA_LOCK_WITNESS=1 \
+  TPU_DRA_LOCK_WITNESS_EXPORT="$WITNESS_EDGES" \
   python -m pytest "$REPO_ROOT/tests/test_cd_integration.py" \
     "$REPO_ROOT/tests/test_stress_failover.py" \
     "$REPO_ROOT/tests/test_multiprocess_e2e.py" -q -p no:cacheprovider
 done
+
+echo ">> lock-order witness cross-validation (observed ⊆ static)"
+python -m tpu_dra.analysis --root "$REPO_ROOT" \
+  --check-witness "$WITNESS_EDGES"
+
 echo ">> race tier green"

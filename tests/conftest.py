@@ -31,6 +31,9 @@ def pytest_sessionfinish(session, exitstatus):
     if not _WITNESS_SESSION:
         return
     from tpu_dra.infra import lockwitness
+    # Session-level installs never hit uninstall's refcount-zero export:
+    # flush the observed edge set here for the observed⊆static gate.
+    lockwitness.export_edges()
     cycles = lockwitness.WITNESS.cycles()
     if cycles:
         print("\n!! lock-order witness violations:")

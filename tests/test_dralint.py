@@ -636,8 +636,13 @@ class TestR8SuccessOrdering:
 # ---------------------------------------------------------------------------
 
 class TestResultCache:
-    BAD = ("import time\n"
+    # A real created lock: draracer (R9-R11) runs in the same pass, so
+    # the fixture must be clean for every rule except the R2 it seeds.
+    BAD = ("import threading\n"
+           "import time\n"
            "class M:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
            "    def f(self):\n"
            "        with self._lock:\n"
            "            time.sleep(1)\n")
@@ -690,6 +695,54 @@ class TestResultCache:
         r2 = analysis.run([mod], root=root, use_cache=True)
         assert r2.findings == []
 
+    def test_touch_hits_content_hash_tier(self, tmp_path):
+        """A touch (or content-equal rewrite) changes the stat key but
+        not the bytes: the hash tier must reuse the entry — no reparse
+        — and refresh the stat key for the next run (ISSUE 9)."""
+        import json
+        import os
+        root = self._tree(tmp_path)
+        mod = root / "mod.py"
+        mod.write_text(self.BAD)
+        analysis.run([mod], root=root, use_cache=True)
+        os.utime(mod, ns=(12345, 12345))  # touch: same bytes, new stat
+        import tpu_dra.analysis.core as core
+
+        real_parse = core.parse_module
+        calls = []
+
+        def counting_parse(path, rootp, source=None):
+            calls.append(path)
+            return real_parse(path, rootp, source=source)
+
+        core.parse_module = counting_parse
+        try:
+            r2 = analysis.run([mod], root=root, use_cache=True)
+        finally:
+            core.parse_module = real_parse
+        assert calls == []
+        assert r2.cache_hits == 1
+        assert [f.rule for f in r2.findings] == ["R2"]
+        # The stat key was refreshed in place: the entry now carries
+        # the touched mtime, so the NEXT run hits the cheap tier.
+        doc = json.loads((root / ".dralint-cache.json").read_text())
+        entry = doc["files"]["mod.py"]
+        assert entry["mtime_ns"] == mod.stat().st_mtime_ns
+
+    def test_content_change_misses_hash_tier(self, tmp_path):
+        """Same size, different bytes: the stat tier misses and the
+        hash tier must NOT vouch for the stale entry (ISSUE 9)."""
+        root = self._tree(tmp_path)
+        mod = root / "mod.py"
+        mod.write_text(self.BAD)
+        analysis.run([mod], root=root, use_cache=True)
+        fixed = self.BAD.replace("time.sleep(1)", "t = (1, 2, 3)")
+        assert len(fixed) == len(self.BAD)  # same size: hash must decide
+        mod.write_text(fixed)
+        r2 = analysis.run([mod], root=root, use_cache=True)
+        assert r2.findings == []
+        assert r2.cache_hits == 0
+
     def test_rules_version_change_invalidates(self, tmp_path):
         import json
         root = self._tree(tmp_path)
@@ -728,6 +781,30 @@ class TestResultCache:
             msgs = [f.message for f in rep.findings]
             assert any("tpu_dra_orphan_total" in m for m in msgs), msgs
             assert any("tpu_dra_live_total" in m for m in msgs), msgs
+
+    def test_json_payload_trends_suppressions(self, tmp_path):
+        """--json must carry the per-rule finding/suppression counts
+        the human formatter surfaces, plus the unjustified-suppression
+        list the lint.sh gate trips on (ISSUE 9)."""
+        root = self._tree(tmp_path)
+        bare = root / "bare.py"
+        bare.write_text(self.BAD.replace(
+            "time.sleep(1)", "time.sleep(1)  # dralint: ignore[R2]"))
+        just = root / "just.py"
+        just.write_text(self.BAD.replace(
+            "time.sleep(1)",
+            "time.sleep(1)  # dralint: ignore[R2] — fixture reason"))
+        report = analysis.run([bare, just], root=root, use_cache=False)
+        doc = report.to_dict()
+        assert doc["findings_by_rule"] == {}
+        assert doc["suppressed_by_rule"] == {"R2": 2}
+        unj = doc["suppressed_unjustified"]
+        assert [u["path"] for u in unj] == ["bare.py"]
+        # The same verdict replays from a fully cached run.
+        analysis.run([bare, just], root=root, use_cache=True)
+        warm = analysis.run([bare, just], root=root, use_cache=True)
+        assert warm.cache_hits == 2
+        assert warm.to_dict()["suppressed_unjustified"] == unj
 
     def test_whole_tree_cached_run_matches_cold(self, tmp_path):
         """The real tree: a cache-backed rerun reproduces the cold

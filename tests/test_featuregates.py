@@ -85,3 +85,41 @@ class TestLockToDefault:
         with pytest.raises(ValueError, match="already registered"):
             g.add(TimeSlicingSettings, VersionedSpecs((
                 ("0.2.0", FeatureSpec(default=True)),)))
+
+
+class TestConcurrency:
+    def test_known_is_safe_against_concurrent_add(self):
+        """draracer R10 (ISSUE 9): known() iterated the features dict
+        unlocked. CPython's GIL happens to make sorted(dict) atomic
+        today, so the mutation-during-iteration RuntimeError is masked
+        — this pins the thread-safety contract (and would catch a
+        regression under free-threaded builds or a refactor that
+        iterates in Python code)."""
+        import threading
+
+        from tpu_dra.infra.featuregates import FeatureSpec
+
+        gate = FeatureGate(features={})
+        stop = threading.Event()
+        errors = []
+
+        def reader():
+            while not stop.is_set():
+                try:
+                    gate.known()
+                except RuntimeError as exc:  # pragma: no cover — the bug
+                    errors.append(exc)
+                    return
+
+        t = threading.Thread(target=reader)
+        t.start()
+        try:
+            for i in range(3000):
+                gate.add(f"G{i}", VersionedSpecs(
+                    (("0.1.0",
+                      FeatureSpec(default=False, pre_release="Alpha")),)))
+        finally:
+            stop.set()
+            t.join(timeout=10)
+        assert not t.is_alive()
+        assert errors == []

@@ -260,3 +260,54 @@ class TestInstall:
         finally:
             lw.uninstall()
             lw.WITNESS = saved
+
+
+class TestEdgeExport:
+    """export_edges/load_edges: the observed⊆static gate's transport
+    (ISSUE 9). Merge semantics let the chaos matrix, the soak and a
+    drmc run accumulate into one file."""
+
+    def _observe(self, witness):
+        a, b = _lock("m.py:1"), _lock("m.py:2")
+        _in_thread(_nested(a, b))
+
+    def test_export_and_load_roundtrip(self, witness, tmp_path):
+        self._observe(witness)
+        out = tmp_path / "edges.json"
+        assert lw.export_edges(str(out)) == str(out)
+        assert lw.load_edges(str(out)) == [("m.py:1", "m.py:2")]
+
+    def test_export_merges_across_runs(self, witness, tmp_path):
+        out = tmp_path / "edges.json"
+        self._observe(witness)
+        lw.export_edges(str(out))
+        witness.reset()
+        c, d = _lock("m.py:3"), _lock("m.py:4")
+        _in_thread(_nested(c, d))
+        lw.export_edges(str(out))
+        assert lw.load_edges(str(out)) == [
+            ("m.py:1", "m.py:2"), ("m.py:3", "m.py:4")]
+
+    def test_export_noop_without_destination(self, witness, monkeypatch):
+        monkeypatch.delenv(lw.EXPORT_ENV, raising=False)
+        self._observe(witness)
+        assert lw.export_edges() is None
+
+    def test_env_destination_and_uninstall_flush(self, witness, tmp_path,
+                                                 monkeypatch):
+        out = tmp_path / "edges.json"
+        monkeypatch.setenv(lw.EXPORT_ENV, str(out))
+        self._observe(witness)
+        was_installed = lw.installed()
+        lw.install(reset=False)
+        lw.uninstall()  # refcount zero (unless a session install holds)
+        if was_installed:
+            lw.export_edges()  # session installs flush via conftest
+        assert lw.load_edges(str(out)) == [("m.py:1", "m.py:2")]
+
+    def test_garbled_existing_file_is_replaced(self, witness, tmp_path):
+        out = tmp_path / "edges.json"
+        out.write_text("{not json")
+        self._observe(witness)
+        lw.export_edges(str(out))
+        assert lw.load_edges(str(out)) == [("m.py:1", "m.py:2")]

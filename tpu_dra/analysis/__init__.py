@@ -11,12 +11,14 @@ deterministic model checker — lives in ``tpu_dra.analysis.drmc``
 """
 
 from tpu_dra.analysis import rules as _rules  # noqa: F401 — registers R1-R8
+from tpu_dra.analysis import raceanalysis as _race  # noqa: F401 — R9-R11
 from tpu_dra.analysis.core import (
     Finding, Module, ProjectContext, Report, Rule, all_rules, find_root,
-    lint_source, render, run,
+    lint_source, lint_sources, render, run,
 )
 
 __all__ = [
     "Finding", "Module", "ProjectContext", "Report", "Rule",
-    "all_rules", "find_root", "lint_source", "render", "run",
+    "all_rules", "find_root", "lint_source", "lint_sources", "render",
+    "run",
 ]

@@ -2,7 +2,13 @@
 
 Exit status 0 = zero unsuppressed findings (the hack/lint.sh gate);
 1 = findings. ``--sites-report`` prints the fault-site coverage table
-(guard + arm locations per registered site) instead of linting.
+(guard + arm locations per registered site); ``--locks-report`` the
+draracer guarded-by table (one row per class attribute the R10
+inference considered); ``--check-witness FILE`` additionally asserts a
+runtime-exported lock-order edge set (infra.lockwitness.export_edges)
+is a subset of the static graph; ``--require-justified`` fails when
+any suppression comment lacks a justification string — together the
+hack/lint.sh / race.sh / chaos.sh gates.
 """
 
 from __future__ import annotations
@@ -11,7 +17,7 @@ import argparse
 import sys
 from pathlib import Path
 
-from tpu_dra.analysis import core, rules
+from tpu_dra.analysis import core, raceanalysis, rules
 
 
 def main(argv=None) -> int:
@@ -34,6 +40,18 @@ def main(argv=None) -> int:
                     help="also print the fault-site coverage table "
                          "(guard + arm locations per registered site), "
                          "from the same scan")
+    ap.add_argument("--locks-report", action="store_true",
+                    help="also print the draracer guarded-by table "
+                         "(per class attribute: inferred/annotated "
+                         "guard + guarded/unguarded access counts)")
+    ap.add_argument("--check-witness", metavar="FILE", default=None,
+                    help="assert the runtime lock-order edge set "
+                         "exported to FILE is a subset of the static "
+                         "lock-order graph (observed ⊆ static); an "
+                         "unexplained runtime edge exits 1")
+    ap.add_argument("--require-justified", action="store_true",
+                    help="fail when any suppressed finding's ignore "
+                         "comment carries no justification string")
     args = ap.parse_args(argv)
 
     root = args.root or core.find_root(
@@ -53,27 +71,69 @@ def main(argv=None) -> int:
 
     rule_ids = ({r.strip() for r in args.rules.split(",") if r.strip()}
                 or None)
-    if args.sites_report and rule_ids is not None:
-        rule_ids.add("R4")  # the table is R4's collection; always run it
+    if rule_ids is not None:
+        if args.sites_report:
+            rule_ids.add("R4")  # the table is R4's collection
+        if args.locks_report or args.check_witness:
+            rule_ids.add("R9")  # draracer's collection (R9-R11)
     active = core.all_rules()
     report = core.run(paths, root=root, rules=active, rule_ids=rule_ids,
                       use_cache=not args.no_cache)
     print(core.render(report, as_json=args.as_json,
                       show_suppressed=args.show_suppressed))
+    # Under --json, stdout is the machine-readable document — the
+    # report tables and gate diagnostics go to stderr instead.
+    out = sys.stderr if args.as_json else sys.stdout
+    status = 0 if report.ok else 1
     if args.sites_report:
         # Reuses the lint pass's R4 collection and parsed registries —
         # one tree scan, one registry parse total.
         r4 = next(r for r in active
                   if isinstance(r, rules.FaultSiteRegistry))
         ctx = report.ctx
-        print(f"{'site':34} {'guards':>7} {'arms':>5}")
+        print(f"{'site':34} {'guards':>7} {'arms':>5}", file=out)
         for site, guards, arms in rules.site_coverage(r4, ctx):
-            print(f"{site:34} {len(guards):7d} {len(arms):5d}")
+            print(f"{site:34} {len(guards):7d} {len(arms):5d}", file=out)
             for loc in guards:
-                print(f"    guard {loc}")
+                print(f"    guard {loc}", file=out)
             for loc in arms:
-                print(f"    arm   {loc}")
-    return 0 if report.ok else 1
+                print(f"    arm   {loc}", file=out)
+    race = next(r for r in active
+                if isinstance(r, raceanalysis.RaceAnalysis))
+    if args.locks_report:
+        # Same pattern: the lint pass's R10 inference, re-rendered.
+        rows = raceanalysis.locks_report(race)
+        print(f"{'class.attr':58} {'guard':16} {'how':>10} "
+              f"{'grd':>4} {'ungrd':>5}", file=out)
+        for row in rows:
+            name = f"{row['class']}.{row['attr']}"
+            print(f"{name:58} {str(row['guard']):16} {row['how']:>10} "
+                  f"{row['guarded']:4d} {row['unguarded']:5d}", file=out)
+    if args.check_witness:
+        from tpu_dra.infra import lockwitness
+        try:
+            observed = lockwitness.load_edges(args.check_witness)
+        except (OSError, ValueError) as exc:
+            # A missing/garbled export turning the gate green would be
+            # the exact silent under-approximation the gate exists to
+            # catch: fail loudly instead.
+            print(f"dralint: cannot read witness export "
+                  f"{args.check_witness}: {exc}", file=sys.stderr)
+            return 2
+        problems = raceanalysis.check_witness(race, observed)
+        for p in problems:
+            print(f"witness: {p}", file=out)
+        print(f"witness: {len(observed)} observed edge(s), "
+              f"{len(race.static_edges)} static, "
+              f"{len(problems)} unexplained", file=out)
+        if problems:
+            status = max(status, 1)
+    if args.require_justified and report.unjustified:
+        for f in report.unjustified:
+            print(f"{f.format()} (suppressed WITHOUT justification — "
+                  "add a reason string to the ignore comment)", file=out)
+        status = max(status, 1)
+    return status
 
 
 if __name__ == "__main__":

@@ -30,10 +30,30 @@ import re
 import tokenize
 from dataclasses import dataclass, field
 from pathlib import Path
-from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set
+from typing import (
+    Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple,
+)
+
+from tpu_dra.infra.metrics import DefaultRegistry as _METRICS
+
+# Lint observability (METRICS_CATALOG, R5-checked like every other
+# metric): findings emitted and per-file cache hits, so CI can trend
+# both the invariant debt and the incremental cache's effectiveness.
+_LINT_FINDINGS = _METRICS.counter(
+    "tpu_dra_lint_findings_total",
+    "dralint findings emitted across runs in this process")
+_LINT_CACHE_HITS = _METRICS.counter(
+    "tpu_dra_lint_cache_hits_total",
+    "dralint per-file result-cache hits (stat or content-hash tier)")
 
 _SUPPRESS_RE = re.compile(
-    r"#\s*dralint:\s*ignore(?:\[(?P<rules>[^\]]*)\])?")
+    r"#\s*dralint:\s*ignore(?:\[(?P<rules>[^\]]*)\])?(?P<rest>[^#]*)")
+
+# A suppression is JUSTIFIED when the comment carries prose beyond the
+# ignore tag (``# dralint: ignore[R7] — rollback is the caller's``).
+# hack/lint.sh gates unjustified suppressions to zero, so the waiver
+# count can never grow without a visible reason in the diff.
+_JUSTIFY_MIN_CHARS = 3
 
 
 @dataclass(frozen=True)
@@ -62,6 +82,8 @@ class Module:
     tree: ast.AST
     # line -> None (suppress all rules) or the set of suppressed rule ids
     suppressions: Dict[int, Optional[Set[str]]] = field(default_factory=dict)
+    # line -> the ignore comment carries a justification string
+    justified: Dict[int, bool] = field(default_factory=dict)
 
     @property
     def is_test(self) -> bool:
@@ -76,6 +98,10 @@ class Module:
         """A finding at `line` is waived by an ignore comment on the
         same line or the line directly above it."""
         return _lookup_suppressed(self.suppressions, rule, line)
+
+    def suppression_justified(self, rule: str, line: int) -> bool:
+        return _lookup_justified(self.suppressions, self.justified,
+                                 rule, line)
 
 
 _MISSING = object()
@@ -96,8 +122,26 @@ def _lookup_suppressed(lines: Dict[int, Optional[Set[str]]],
     return False
 
 
-def _parse_suppressions(source: str) -> Dict[int, Optional[Set[str]]]:
+def _lookup_justified(lines: Dict[int, Optional[Set[str]]],
+                      justified: Dict[int, bool],
+                      rule: str, line: int) -> bool:
+    """Whether the comment that suppresses (rule, line) carries a
+    justification string — resolved against the same line-or-above
+    comment `_lookup_suppressed` matched."""
+    for ln in (line, line - 1):
+        rules = lines.get(ln, _MISSING)
+        if rules is _MISSING:
+            continue
+        if rules is None or rule in rules:
+            return justified.get(ln, False)
+    return False
+
+
+def _parse_suppressions(
+        source: str) -> Tuple[Dict[int, Optional[Set[str]]],
+                              Dict[int, bool]]:
     out: Dict[int, Optional[Set[str]]] = {}
+    just: Dict[int, bool] = {}
     try:
         tokens = tokenize.generate_tokens(io.StringIO(source).readline)
         for tok in tokens:
@@ -106,23 +150,29 @@ def _parse_suppressions(source: str) -> Dict[int, Optional[Set[str]]]:
             m = _SUPPRESS_RE.search(tok.string)
             if not m:
                 continue
+            rest = (m.group("rest") or "").strip(" \t-—:.,")
+            has_reason = len(rest) >= _JUSTIFY_MIN_CHARS
+            ln = tok.start[0]
+            just[ln] = just.get(ln, False) or has_reason
             raw = m.group("rules")
             if raw is None:
-                out[tok.start[0]] = None
+                out[ln] = None
             else:
                 rules = {r.strip() for r in raw.split(",") if r.strip()}
-                prev = out.get(tok.start[0], _MISSING)
+                prev = out.get(ln, _MISSING)
                 if prev is None:
                     continue  # bare ignore on the same line already wins
                 merged = rules if prev is _MISSING else (prev | rules)
-                out[tok.start[0]] = merged
+                out[ln] = merged
     except (tokenize.TokenError, IndentationError):
         pass  # unparseable comments: no suppressions, findings stand
-    return out
+    return out, just
 
 
-def parse_module(path: Path, root: Path) -> Optional[Module]:
-    source = path.read_text(encoding="utf-8")
+def parse_module(path: Path, root: Path,
+                 source: Optional[str] = None) -> Optional[Module]:
+    if source is None:
+        source = path.read_text(encoding="utf-8")
     try:
         tree = ast.parse(source, filename=str(path))
     except SyntaxError:
@@ -131,8 +181,9 @@ def parse_module(path: Path, root: Path) -> Optional[Module]:
         rel = str(path.relative_to(root))
     except ValueError:
         rel = str(path)
+    suppressions, justified = _parse_suppressions(source)
     return Module(path=path, relpath=rel, source=source, tree=tree,
-                  suppressions=_parse_suppressions(source))
+                  suppressions=suppressions, justified=justified)
 
 
 # ---------------------------------------------------------------------------
@@ -224,6 +275,15 @@ class Rule:
 
     rule_id: str = ""
     title: str = ""
+    # Rule ids this rule emits. Most rules emit exactly their own id;
+    # a combined pass (raceanalysis R9-R11) declares the full set so
+    # --rules filtering keeps working (core also post-filters findings
+    # by id, so asking for R10 from a combined rule yields only R10).
+    provides: frozenset = frozenset()
+
+    @classmethod
+    def provided_ids(cls) -> frozenset:
+        return cls.provides or frozenset({cls.rule_id})
 
     def scan(self, module: Module, ctx: ProjectContext) -> Iterator[Finding]:
         return iter(())
@@ -260,6 +320,10 @@ class Report:
     findings: List[Finding] = field(default_factory=list)
     suppressed: List[Finding] = field(default_factory=list)
     files: int = 0
+    cache_hits: int = 0
+    # Suppressed findings whose ignore comment has no justification
+    # string — the lint.sh --require-justified gate.
+    unjustified: List[Finding] = field(default_factory=list)
     # The context the run was performed against (registries + scanned
     # set) — lets callers (e.g. --sites-report) reuse the parse.
     ctx: Optional["ProjectContext"] = None
@@ -268,10 +332,24 @@ class Report:
     def ok(self) -> bool:
         return not self.findings
 
+    @staticmethod
+    def _by_rule(findings: List[Finding]) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for f in findings:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return dict(sorted(out.items()))
+
     def to_dict(self) -> Dict:
+        # Per-rule counts ride along so CI can trend suppressions the
+        # same way the human formatter surfaces them (ISSUE 9).
         return {"files": self.files,
+                "cache_hits": self.cache_hits,
                 "findings": [f.to_dict() for f in self.findings],
-                "suppressed": [f.to_dict() for f in self.suppressed]}
+                "suppressed": [f.to_dict() for f in self.suppressed],
+                "findings_by_rule": self._by_rule(self.findings),
+                "suppressed_by_rule": self._by_rule(self.suppressed),
+                "suppressed_unjustified":
+                    [f.to_dict() for f in self.unjustified]}
 
 
 def iter_python_files(paths: Sequence[Path]) -> Iterator[Path]:
@@ -315,10 +393,10 @@ def find_root(start: Path) -> Path:
 # cross-file FACTS each rule contributed (Rule.module_facts), which are
 # replayed through absorb_facts so finalize sees the whole tree.
 
-CACHE_VERSION = 1
+CACHE_VERSION = 2
 CACHE_FILENAME = ".dralint-cache.json"
 
-_RULES_SOURCES = ("core.py", "rules.py")
+_RULES_SOURCES = ("core.py", "rules.py", "raceanalysis.py")
 _REGISTRY_SOURCES = ("infra/faults.py", "infra/metrics.py",
                      "infra/featuregates.py")
 
@@ -360,21 +438,29 @@ def _load_cache(path: Path, keys: Dict[str, str]) -> Dict:
 class _CachedSuppressions:
     """Module.suppressed() semantics over a cached suppression map —
     finalize findings anchored in an unparsed file still honor its
-    waiver comments."""
+    waiver comments (and their justification strings)."""
 
     def __init__(self, doc: Dict):
+        lines = doc.get("lines", doc) or {}
         self._lines: Dict[int, Optional[Set[str]]] = {}
-        for line, rules in (doc or {}).items():
+        for line, rules in lines.items():
             self._lines[int(line)] = (None if rules is None
                                       else set(rules))
+        self._just: Dict[int, bool] = {
+            int(line): bool(v)
+            for line, v in (doc.get("just") or {}).items()}
 
     def suppressed(self, rule: str, line: int) -> bool:
         return _lookup_suppressed(self._lines, rule, line)
 
+    def suppression_justified(self, rule: str, line: int) -> bool:
+        return _lookup_justified(self._lines, self._just, rule, line)
 
-def _suppressions_doc(mod: Module) -> Dict[str, Optional[List[str]]]:
-    return {str(ln): (None if rules is None else sorted(rules))
-            for ln, rules in mod.suppressions.items()}
+
+def _suppressions_doc(mod: Module) -> Dict:
+    return {"lines": {str(ln): (None if rules is None else sorted(rules))
+                      for ln, rules in mod.suppressions.items()},
+            "just": {str(ln): v for ln, v in mod.justified.items()}}
 
 
 def _rel(path: Path, root: Path) -> str:
@@ -388,12 +474,14 @@ def run(paths: Sequence[Path], root: Optional[Path] = None,
         rules: Optional[Iterable[Rule]] = None,
         rule_ids: Optional[Set[str]] = None,
         use_cache: bool = False) -> Report:
+    import hashlib
+
     paths = [Path(p) for p in paths]
     root = Path(root) if root else find_root(paths[0] if paths else Path("."))
     ctx = ProjectContext.load(root)
     active = list(rules) if rules is not None else all_rules()
     if rule_ids:
-        active = [r for r in active if r.rule_id in rule_ids]
+        active = [r for r in active if r.provided_ids() & rule_ids]
     # The cache stores full-rule-set results; a rule-filtered run must
     # not read partial entries as authoritative nor poison future runs.
     # (Callers passing explicit `rules` with use_cache=True — the CLI —
@@ -407,34 +495,58 @@ def run(paths: Sequence[Path], root: Optional[Path] = None,
     modules: List[Module] = []
     cached: Dict[str, Dict] = {}     # relpath -> valid cache entry
     stats: Dict[str, Dict] = {}      # relpath -> fresh stat for new entry
+    refreshed: Dict[str, Dict] = {}  # content-hash hits with new stat keys
     for f in iter_python_files(paths):
         rel = _rel(f, root)
-        try:
-            st = f.stat()
-        except OSError:
-            continue
+        # A stat/read failure raises: silently skipping an unreadable
+        # file would drop its findings AND its contribution to the
+        # R9-R11 call graph — "lint tier green" must never mean "lint
+        # could not see the tree".
+        st = f.stat()
         entry = cache["files"].get(rel) if use_cache else None
         if (entry is not None and entry.get("mtime_ns") == st.st_mtime_ns
                 and entry.get("size") == st.st_size):
             cached[rel] = entry
             continue
-        mod = parse_module(f, root)
+        data = f.read_bytes()
+        sha = hashlib.sha1(data).hexdigest() if use_cache else ""
+        if (entry is not None and entry.get("sha1")
+                and entry["sha1"] == sha):
+            # Content-hash fallback tier: a touch or a content-equal
+            # rewrite changed the stat key but not the bytes — reuse
+            # the entry and refresh its stat key so the next run hits
+            # on the cheap tier again.
+            entry = {**entry, "mtime_ns": st.st_mtime_ns,
+                     "size": st.st_size}
+            cached[rel] = entry
+            refreshed[rel] = entry
+            continue
+        mod = parse_module(f, root, source=data.decode("utf-8"))
         if mod is not None:
             modules.append(mod)
-            stats[rel] = {"mtime_ns": st.st_mtime_ns, "size": st.st_size}
+            stats[rel] = {"mtime_ns": st.st_mtime_ns,
+                          "size": st.st_size, "sha1": sha}
     report.files = len(modules) + len(cached)
+    report.cache_hits = len(cached)
     ctx.scanned = {m.relpath for m in modules} | set(cached)
 
+    by_rel: Dict[str, object] = {}
     for rel in sorted(cached):
         entry = cached[rel]
+        replayed = _CachedSuppressions(entry.get("suppressions") or {})
+        by_rel[rel] = replayed
         for rule in active:
             facts = (entry.get("facts") or {}).get(rule.rule_id)
             if facts is not None:
                 rule.absorb_facts(rel, facts, ctx)
         report.findings.extend(Finding(**d) for d in entry["findings"])
-        report.suppressed.extend(Finding(**d) for d in entry["suppressed"])
+        for d in entry["suppressed"]:
+            f = Finding(**d)
+            report.suppressed.append(f)
+            if not replayed.suppression_justified(f.rule, f.line):
+                report.unjustified.append(f)
 
-    new_entries: Dict[str, Dict] = {}
+    new_entries: Dict[str, Dict] = dict(refreshed)
     for mod in modules:
         mod_findings: List[Finding] = []
         mod_suppressed: List[Finding] = []
@@ -450,6 +562,9 @@ def run(paths: Sequence[Path], root: Optional[Path] = None,
                 facts[rule.rule_id] = rule_facts
         report.findings.extend(mod_findings)
         report.suppressed.extend(mod_suppressed)
+        for f in mod_suppressed:
+            if not mod.suppression_justified(f.rule, f.line):
+                report.unjustified.append(f)
         if use_cache and mod.relpath in stats:
             new_entries[mod.relpath] = {
                 **stats[mod.relpath],
@@ -459,19 +574,30 @@ def run(paths: Sequence[Path], root: Optional[Path] = None,
                 "facts": facts,
             }
 
-    by_rel: Dict[str, object] = {m.relpath: m for m in modules}
-    for rel, entry in cached.items():
-        by_rel[rel] = _CachedSuppressions(entry.get("suppressions") or {})
+    for m in modules:
+        by_rel[m.relpath] = m
     for rule in active:
         for finding in rule.finalize(ctx):
             mod = by_rel.get(finding.path)
             if mod is not None and mod.suppressed(finding.rule, finding.line):
                 report.suppressed.append(finding)
+                if not mod.suppression_justified(finding.rule,
+                                                 finding.line):
+                    report.unjustified.append(finding)
             else:
                 report.findings.append(finding)
+    if rule_ids:
+        report.findings = [f for f in report.findings
+                           if f.rule in rule_ids]
+        report.suppressed = [f for f in report.suppressed
+                             if f.rule in rule_ids]
+        report.unjustified = [f for f in report.unjustified
+                              if f.rule in rule_ids]
     report.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     report.suppressed.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
 
+    _LINT_FINDINGS.inc(len(report.findings))
+    _LINT_CACHE_HITS.inc(report.cache_hits)
     if use_cache:
         # Merge, never replace wholesale: a single-file lint must not
         # evict the rest of the tree's entries. Vanished files linger
@@ -486,32 +612,53 @@ def run(paths: Sequence[Path], root: Optional[Path] = None,
     return report
 
 
+def lint_sources(sources: Dict[str, str],
+                 ctx: Optional[ProjectContext] = None,
+                 rule_ids: Optional[Set[str]] = None) -> List[Finding]:
+    """Lint a {relpath: source} set as one small tree (the CROSS-MODULE
+    test seam the interprocedural rules need): returns UNSUPPRESSED
+    findings, using a synthetic context unless one is given. Relpaths
+    become module identities — ``pkg/mod_a.py`` is importable from a
+    sibling fixture as ``from pkg.mod_a import f``."""
+    ctx = ctx or ProjectContext(root=Path("."))
+    mods: List[Module] = []
+    for relpath, source in sources.items():
+        suppressions, justified = _parse_suppressions(source)
+        mods.append(Module(path=Path(relpath), relpath=relpath,
+                           source=source, tree=ast.parse(source),
+                           suppressions=suppressions,
+                           justified=justified))
+    # The test seam acts as a full-project run: orphan rules see the
+    # registries as in-view so fixtures can exercise both directions.
+    ctx.scanned = ({m.relpath for m in mods}
+                   | {ctx.fault_sites_path, ctx.metric_catalog_path}
+                   | ctx.scanned)
+    active = all_rules()
+    if rule_ids:
+        active = [r for r in active if r.provided_ids() & rule_ids]
+    by_rel = {m.relpath: m for m in mods}
+    out: List[Finding] = []
+    for rule in active:
+        for mod in mods:
+            for finding in rule.scan(mod, ctx):
+                if not mod.suppressed(finding.rule, finding.line):
+                    out.append(finding)
+        for finding in rule.finalize(ctx):
+            mod = by_rel.get(finding.path)
+            if mod is None or not mod.suppressed(finding.rule,
+                                                 finding.line):
+                out.append(finding)
+    if rule_ids:
+        out = [f for f in out if f.rule in rule_ids]
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out
+
+
 def lint_source(source: str, relpath: str = "fixture.py",
                 ctx: Optional[ProjectContext] = None,
                 rule_ids: Optional[Set[str]] = None) -> List[Finding]:
-    """Lint a source string (the test seam): returns UNSUPPRESSED
-    findings, using a synthetic context unless one is given."""
-    ctx = ctx or ProjectContext(root=Path("."))
-    tree = ast.parse(source)
-    mod = Module(path=Path(relpath), relpath=relpath, source=source,
-                 tree=tree, suppressions=_parse_suppressions(source))
-    # The test seam acts as a full-project run: orphan rules see the
-    # registries as in-view so fixtures can exercise both directions.
-    ctx.scanned = ({mod.relpath, ctx.fault_sites_path,
-                    ctx.metric_catalog_path} | ctx.scanned)
-    out: List[Finding] = []
-    for rule in all_rules():
-        if rule_ids and rule.rule_id not in rule_ids:
-            continue
-        for finding in rule.scan(mod, ctx):
-            if not mod.suppressed(finding.rule, finding.line):
-                out.append(finding)
-        for finding in rule.finalize(ctx):
-            if (finding.path != mod.relpath
-                    or not mod.suppressed(finding.rule, finding.line)):
-                out.append(finding)
-    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
-    return out
+    """Single-module lint seam (the original fixture entry point)."""
+    return lint_sources({relpath: source}, ctx=ctx, rule_ids=rule_ids)
 
 
 def render(report: Report, as_json: bool = False,

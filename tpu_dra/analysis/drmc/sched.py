@@ -278,7 +278,7 @@ class CooperativeScheduler:
         cond._lock.release()
         self._park(task, Op("cond.wait", key, inst))
         task.notified = False
-        cond._lock.acquire()
+        cond._lock.acquire()  # dralint: ignore[R11] — the controlled scheduler IS the instrument: it re-enters a parked waiter's Condition lock by design; the witness models the inner lock itself
         return True
 
     def controlled_notify(self, cond, all_waiters: bool) -> bool:

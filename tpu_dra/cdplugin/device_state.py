@@ -152,7 +152,7 @@ class DeviceState:
         # below is NOT under the lock.
         with self._label_lock:
             with self._lock:
-                self._assert_channels_free(uid, channel_ids)
+                self._assert_channels_free_locked(uid, channel_ids)
                 # Record intent before side effects (crash consistency).
                 self._checkpoint.claims[uid] = PreparedClaim(
                     uid=uid, state=PREPARE_STARTED,
@@ -213,12 +213,14 @@ class DeviceState:
         self._first_attempt.pop(uid, None)
         return self._complete(uid)
 
-    def _assert_channels_free(self, claim_uid: str,
-                              channel_ids: List[int]) -> None:
+    def _assert_channels_free_locked(self, claim_uid: str,
+                                     channel_ids: List[int]) -> None:
         """Channel exclusivity (device_state.go:625-664): a channel held by
         a *different* claim that completed prepare must first be
         unprepared — orders prepare-after-unprepare correctly when kubelet
-        races a new pod against a terminating one."""
+        races a new pod against a terminating one. Iterates checkpoint
+        claims, so the caller must hold ``self._lock`` (draracer R10
+        caught the undeclared requirement)."""
         for other_uid, other in self._checkpoint.claims.items():
             if other_uid == claim_uid or other.state != PREPARE_COMPLETED:
                 continue

@@ -250,7 +250,7 @@ class FaultRegistry:
         # Disarmed fast path: a plain dict emptiness/membership test,
         # no lock (dict reads are atomic under the GIL; a racing arm()
         # is observed on the next guard hit, which is all chaos needs).
-        if site not in self._armed:
+        if site not in self._armed:  # dralint: ignore[R10] — deliberate lock-free fast path: GIL-atomic membership test, a racing arm() lands on the next guard hit
             return None
         with self._lock:
             armed = self._armed.get(site)

@@ -92,7 +92,10 @@ class FeatureGate:
         self._overrides: Dict[str, bool] = {}
 
     def known(self) -> Iterable[str]:
-        return sorted(self._features)
+        # Under the lock: sorted() iterates the dict, and a concurrent
+        # add() mid-iteration raises (draracer R10 caught this).
+        with self._lock:
+            return sorted(self._features)
 
     def add(self, name: str, spec: VersionedSpecs) -> None:
         with self._lock:

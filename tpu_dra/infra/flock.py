@@ -25,6 +25,7 @@ class FlockTimeout(TimeoutError):
 
 class Flock:
     def __init__(self, path: str, poll_interval: float = 0.1):
+        # GUARDED_BY: none — immutable after construction
         self._path = path
         self._poll = poll_interval
         self._fd: Optional[int] = None

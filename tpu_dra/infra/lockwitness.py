@@ -30,6 +30,8 @@ too (tests/conftest.py fails the session on cycles).
 
 from __future__ import annotations
 
+import json
+import os
 import sys
 import threading
 import time
@@ -271,6 +273,79 @@ WITNESS = LockWitness()
 
 
 # ---------------------------------------------------------------------------
+# Edge export (the draracer observed⊆static cross-validation seam)
+# ---------------------------------------------------------------------------
+# A chaos matrix, a drmc exploration, or a witnessed pytest session
+# dumps the edge set it OBSERVED; ``python -m tpu_dra.analysis
+# --check-witness FILE`` then asserts every observed edge is in the
+# static lock-order graph (raceanalysis R11). Exports MERGE: several
+# processes (the 25-seed matrix, then the soak, then drmc) accumulate
+# into one file, so the gate checks the union of everything that ran.
+# ``TPU_DRA_LOCK_WITNESS_EXPORT=<path>`` makes the export automatic at
+# the final uninstall() of each generation (chaos/drmc harness close)
+# and at witnessed-session exit (tests/conftest.py).
+
+EXPORT_ENV = "TPU_DRA_LOCK_WITNESS_EXPORT"
+
+# (path, frozenset(edges)) of the last auto-export: drmc installs and
+# uninstalls around EVERY explored schedule, and a read-merge-rewrite
+# per schedule would spend deadline-bounded exploration time on
+# redundant IO — the refcount-zero flush skips when nothing changed.
+_last_export: Optional[Tuple[str, frozenset]] = None
+
+
+def export_edges(path: Optional[str] = None,
+                 only_if_changed: bool = False) -> Optional[str]:
+    """Merge the witness's observed edge set into the JSON file at
+    `path` (default: $TPU_DRA_LOCK_WITNESS_EXPORT; no-op returning None
+    when neither names a destination). Best-effort: an unwritable
+    export path must not take down the harness that observed the
+    edges — the gate reading the file is where absence gets loud."""
+    global _last_export
+    path = path or os.environ.get(EXPORT_ENV)
+    if not path:
+        return None
+    edges = {(s, d) for (s, d) in WITNESS.edges()}
+    own = frozenset(edges)  # pre-merge: the signature is OUR edges only
+    if only_if_changed and _last_export == (path, own):
+        return path
+    try:
+        with open(path, encoding="utf-8") as fh:
+            doc = json.load(fh)
+        for e in doc.get("edges", ()):
+            if isinstance(e, list) and len(e) == 2:
+                edges.add((e[0], e[1]))
+    except (OSError, ValueError):
+        pass
+    # Tmp + rename: a failed write (ENOSPC) must leave the previous
+    # accumulation intact — truncating it in place would let the NEXT
+    # exporter silently restart the merge from its own edges alone and
+    # hand the observed⊆static gate a shrunken observed set.
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump({"edges": sorted(list(e) for e in edges)}, fh,
+                      indent=0)
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+    _last_export = (path, own)
+    return path
+
+
+def load_edges(path: str) -> List[Tuple[str, str]]:
+    """The exported edge set, as (src, dst) creation-site pairs."""
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    return [(e[0], e[1]) for e in doc.get("edges", ())
+            if isinstance(e, list) and len(e) == 2]
+
+
+# ---------------------------------------------------------------------------
 # Yield-point hook (drmc's controlled-scheduler seam)
 # ---------------------------------------------------------------------------
 # The witness's instrumentation points double as the deterministic model
@@ -437,6 +512,15 @@ def uninstall() -> None:
         if _install_count == 0:
             threading.Lock = _real_lock
             threading.RLock = _real_rlock
+            last_out = True
+        else:
+            last_out = False
+    if last_out:
+        # The generation's graph is complete: flush it for the
+        # observed⊆static gate (no-op unless the env names a file;
+        # skipped when the merged edge set already matches the last
+        # flush — drmc uninstalls once per explored schedule).
+        export_edges(only_if_changed=True)
 
 
 def installed() -> bool:

@@ -210,6 +210,11 @@ METRICS_CATALOG: Dict[str, str] = {
     # by hack/drmc.sh gates; labeled by scenario)
     "tpu_dra_drmc_schedules_total": "infra/metrics.py",
     "tpu_dra_drmc_crashpoints_total": "infra/metrics.py",
+    # analysis/core.py — dralint/draracer lint-tier observability:
+    # finding volume and per-file result-cache effectiveness (stat tier
+    # + the content-hash fallback tier), trended by CI
+    "tpu_dra_lint_findings_total": "analysis/core.py",
+    "tpu_dra_lint_cache_hits_total": "analysis/core.py",
 }
 
 

@@ -582,7 +582,7 @@ class DeviceState:
             # store itself failing — nothing is left to unwind; the
             # durable intent record (if hazardous) still names the
             # members' chips for the next start's recovery.
-            # dralint: ignore[R7]
+            # dralint: ignore[R7] — the rollback store IS the unwind; retrying it has nothing left to compensate
             except Exception:  # noqa: BLE001
                 log.warning("failed-batch record store failed",
                             exc_info=True)
@@ -1130,7 +1130,7 @@ class DeviceState:
                 self._ckpt_mgr.store(self._checkpoint)
             # The reinsertion above IS the compensation; the slot store
             # is best-effort durability for it (see docstring).
-            # dralint: ignore[R7]
+            # dralint: ignore[R7] — reinsertion above is the compensation; this store is best-effort durability for it
             except Exception:  # noqa: BLE001
                 log.warning("unprepare rollback store failed",
                             exc_info=True)
