@@ -244,6 +244,70 @@ if pool["sched_throughput_pods_per_s"] < base_pps:
              "worker pool must never cost more than it buys")
 EOF
 
+echo ">> data-plane gates (topology-allocated mesh psum + placement A/B)"
+# ISSUE 10 gates: the psum must run on EVERY chip the driver allocated
+# on the fake multi-host backend (coverage N/N with psum_devices > 1,
+# nonzero bandwidth), every workload must attribute a number on the
+# allocated mesh, and the placement-quality A/B must show the delta the
+# topology scorer claims: contiguous >= fragmented on modeled ICI
+# bandwidth — STRICTLY greater when the modeled topologies differ —
+# and byte-identical across runs (hop-count model, no randomness).
+JAX_PLATFORMS=cpu TPU_DRA_TPUINFO_BACKEND=fake python - <<'EOF'
+import json
+import sys
+
+import bench
+
+out = bench.bench_mesh_dataplane()
+print(json.dumps(out))
+for err_key in ("psum_mesh_error", "psum_mesh_psum_error", "psum_ab_error"):
+    if out.get(err_key):
+        sys.exit(f"REGRESSION: data-plane phase error: "
+                 f"{err_key}={out[err_key]}")
+if out.get("psum_mesh_devices", 0) <= 1:
+    sys.exit(f"REGRESSION: psum ran on {out.get('psum_mesh_devices')} "
+             "devices — the multi-process mesh wiring degraded to "
+             "single-device (the r01-r05 gap ISSUE 10 closes)")
+used, allocated = out["psum_mesh_coverage"].split("/")
+if used != allocated:
+    sys.exit(f"REGRESSION: psum coverage {out['psum_mesh_coverage']} — "
+             "the collective did not cover every allocated chip")
+if not out.get("psum_mesh_algo_gbps", 0) > 0:
+    sys.exit("REGRESSION: psum on the allocated mesh reports no "
+             f"bandwidth ({out.get('psum_mesh_algo_gbps')})")
+# The authoritative workload list is the meshbuild registry itself — a
+# newly registered workload is gated here automatically.
+from tpu_dra.workloads.meshbuild import WORKLOADS
+
+for wl in list(WORKLOADS)[1:]:
+    if out.get(f"mesh_workload_{wl}_error"):
+        sys.exit(f"REGRESSION: workload {wl} failed on the allocated "
+                 f"mesh: {out[f'mesh_workload_{wl}_error']}")
+    if not any(k.startswith(f"mesh_workload_{wl}_") for k in out):
+        sys.exit(f"REGRESSION: workload {wl} reported nothing on the "
+                 "allocated mesh")
+contig = out["psum_ab_contiguous_gbps"]
+frag = out["psum_ab_fragmented_gbps"]
+if contig < frag:
+    sys.exit(f"REGRESSION: contiguous allocation models {contig} GB/s "
+             f"< fragmented {frag} — the topology scorer's contiguity "
+             "preference buys nothing")
+if (out["psum_ab_contiguous_hop_mean"] != out["psum_ab_fragmented_hop_mean"]
+        and not contig > frag):
+    sys.exit(f"REGRESSION: modeled topologies differ (hop means "
+             f"{out['psum_ab_contiguous_hop_mean']} vs "
+             f"{out['psum_ab_fragmented_hop_mean']}) but contiguous "
+             f"{contig} is not strictly above fragmented {frag}")
+
+# Determinism: the gated A/B numbers are pure functions of the two
+# coordinate sets — two fresh modeled-only rounds must agree exactly.
+a = bench._ab_placement_section(measure=False)
+b = bench._ab_placement_section(measure=False)
+if "psum_ab_error" in a or a != b:
+    sys.exit(f"REGRESSION: modeled A/B is not deterministic across "
+             f"runs:\n{a}\n{b}")
+EOF
+
 echo ">> topology gates (4x4x4 torus churn, TopologyAwareScheduling on)"
 JAX_PLATFORMS=cpu TPU_DRA_TPUINFO_BACKEND=fake python - <<'EOF'
 import glob

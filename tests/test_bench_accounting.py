@@ -41,6 +41,27 @@ class TestPsumCoverage:
         assert "99" in r["coverage_error"]
         assert r["n_devices"] == 1.0
 
+    def test_single_device_emits_skip_reason(self):
+        """ISSUE 10: a degenerate single-device psum must carry an
+        explicit skip reason next to its honest 0.0 — never a bare
+        zero beside a healthy-looking coverage."""
+        import jax
+        real = jax.devices()[:1]
+        probe = {"devices": real, "platform": real[0].platform}
+        r = bench.bench_psum(probe, visible_chips="0", allocated_chips=1)
+        assert "skip_reason" in r
+        assert "no ICI collective" in r["skip_reason"]
+
+    def test_coverage_denominator_is_allocated_not_resolved(self):
+        """allocated-vs-used: the claim allocated 4 chips, one resolved
+        — coverage must read 1/4, not 1/1."""
+        import jax
+        real = jax.devices()[:1]
+        probe = {"devices": real, "platform": real[0].platform}
+        r = bench.bench_psum(probe, visible_chips="0",
+                             allocated_chips=4)
+        assert r["coverage"] == "1/4"
+
 
 class TestMfuAccounting:
     def test_embedding_gather_excluded_from_6n(self):
@@ -57,6 +78,49 @@ class TestMfuAccounting:
         phase must no-op there (it reports {} -> no keys in the line)."""
         probe = {**bench.probe_jax(), "platform": "cpu", "generation": None}
         assert bench.bench_long_context(probe) == {}
+
+
+class TestMeshDataplaneIsolation:
+    """Per-section error isolation for the data-plane phase (the PR 7/8
+    bench pattern): one failing workload or section must not blank its
+    siblings' keys."""
+
+    def test_failing_workload_does_not_blank_siblings(self, monkeypatch):
+        from tpu_dra.workloads import meshbuild
+
+        def boom(plan, devices, **kw):
+            raise RuntimeError("injected workload failure")
+
+        monkeypatch.setitem(meshbuild.WORKLOADS, "moe", boom)
+        out = bench._mesh_dataplane_collect(n_workers=1,
+                                            chips_per_worker=4)
+        assert "injected workload failure" in out["mesh_workload_moe_error"]
+        # Siblings and the psum/A/B sections survive.
+        assert out["psum_mesh_coverage"] == "4/4"
+        assert out["psum_mesh_devices"] == 4
+        assert out["psum_mesh_algo_gbps"] > 0
+        assert "mesh_workload_pipeline_wall_ms" in out
+        assert "psum_ab_contiguous_gbps" in out
+
+    def test_ab_failure_isolated_to_its_key(self, monkeypatch):
+        import tpu_dra.testing as testing_mod
+
+        def boom(*a, **kw):
+            raise RuntimeError("injected A/B harness failure")
+
+        monkeypatch.setattr(testing_mod, "MeshSliceHarness", boom)
+        out = bench._ab_placement_section(measure=False)
+        assert "injected A/B harness failure" in out["psum_ab_error"]
+        assert "psum_ab_contiguous_gbps" not in out
+
+    def test_modeled_ab_is_deterministic(self):
+        """The gated A/B numbers are pure functions of the coordinate
+        sets: two fresh provisioning rounds must agree exactly."""
+        a = bench._ab_placement_section(measure=False)
+        b = bench._ab_placement_section(measure=False)
+        assert "psum_ab_error" not in a, a
+        assert a == b
+        assert a["psum_ab_contiguous_gbps"] > a["psum_ab_fragmented_gbps"]
 
 
 class TestClaimToReadyConfigs:

@@ -294,6 +294,32 @@ class TestChannelPrepare:
                 == "10.0.0.1:8476")
         assert env_b["MEGASCALE_SLICE_ID"] == "1"
 
+    def test_cd_topology_env_exported(self, harness):
+        """SURVEY §17 env handoff: the controller-stamped slice-
+        alignment verdict (status.topology) surfaces in the workload
+        env as TPU_CD_SLICES / TPU_CD_SLICE_ALIGNED; a CD without the
+        stamp exports neither key (old env exactly preserved)."""
+        cluster = harness["cluster"]
+        cd = make_cd(cluster)
+        register_node(cluster, cd, "node-a", "10.0.0.1", ready=True)
+        mgr = ComputeDomainManager(
+            cluster, node_name="node-a",
+            driver_plugin_dir=str(harness["tmp"] / "topo"))
+        cd_fresh = cluster.get(COMPUTEDOMAINS, "cd-1", NS)
+        env = mgr.workload_env(cd_fresh, [0], "Single")
+        assert "TPU_CD_SLICES" not in env
+        assert "TPU_CD_SLICE_ALIGNED" not in env
+        cd_fresh.setdefault("status", {})["topology"] = {
+            "slices": 2, "sliceAligned": False}
+        env = mgr.workload_env(cd_fresh, [0], "Single")
+        assert env["TPU_CD_SLICES"] == "2"
+        assert env["TPU_CD_SLICE_ALIGNED"] == "false"
+        cd_fresh["status"]["topology"] = {"slices": 1,
+                                          "sliceAligned": True}
+        env = mgr.workload_env(cd_fresh, [0], "Single")
+        assert env["TPU_CD_SLICES"] == "1"
+        assert env["TPU_CD_SLICE_ALIGNED"] == "true"
+
     def test_idempotent(self, harness):
         cluster = harness["cluster"]
         cd = make_cd(cluster)

@@ -1,0 +1,342 @@
+"""Allocation → mesh contract tests (SURVEY §17).
+
+Three properties the data-plane handoff must keep:
+
+- **determinism** — the rank→coordinate mapping is a pure function of
+  the allocation, so every process of a multi-process mesh computes the
+  same device order with no coordination round;
+- **refusal** — rank/topology mismatches (missing coords, duplicate
+  coords, out-of-bounds coords, disagreeing worker views) raise
+  MeshBuildError loudly instead of building a silently wrong mesh;
+- **honest cost** — a fragmented allocation still builds (the workload
+  can run) but reports a strictly higher modeled hop cost than the
+  contiguous cuboid of the same chip count, which is what the bench
+  A/B and perf gates ride on.
+"""
+
+import jax
+import pytest
+
+from tpu_dra.infra.faults import FAULTS, Always, FaultInjected
+from tpu_dra.native.tpuinfo import default_fake_chips
+from tpu_dra.topology import meshexport as me
+from tpu_dra.workloads import meshbuild as mb
+
+
+def cuboid_coords(dims):
+    return [(x, y, z) for z in range(dims[2]) for y in range(dims[1])
+            for x in range(dims[0])]
+
+
+def plan_of(coords, slice_dims, generation="v5p", worker=0):
+    return me.plan_from_coords(
+        {(worker, i): c for i, c in enumerate(coords)}, slice_dims,
+        generation)
+
+
+@pytest.fixture(scope="module")
+def devices():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    return devs[:8]
+
+
+class TestSnakeOrder:
+    def test_full_cuboid_all_neighbor_hops(self):
+        """Boustrophedon over a full cuboid: every consecutive pair —
+        plane transitions and the ring-closing step included — is one
+        ICI hop."""
+        for dims in ((2, 2, 2), (4, 4, 1), (2, 4, 2)):
+            plan = plan_of(cuboid_coords(dims), dims)
+            assert plan.contiguous
+            assert set(plan.hops) == {1}, (dims, plan.hops)
+            assert plan.hop_mean == 1.0
+
+    def test_deterministic_across_permutations(self):
+        """Same coordinate SET in any arrival permutation ⇒ same rank
+        order (every worker computes the same mesh independently)."""
+        import random
+        coords = cuboid_coords((2, 2, 2))
+        base = plan_of(coords, (2, 2, 2))
+        for seed in range(5):
+            shuffled = list(coords)
+            random.Random(seed).shuffle(shuffled)
+            p = me.plan_from_coords(
+                {(0, i): c for i, c in enumerate(shuffled)}, (2, 2, 2),
+                "v5p")
+            assert p.coords == base.coords
+            assert p.modeled_ici_gbps == base.modeled_ici_gbps
+
+    def test_same_allocation_same_plan(self):
+        a = plan_of(cuboid_coords((2, 2, 1)), (4, 4, 4))
+        b = plan_of(cuboid_coords((2, 2, 1)), (4, 4, 4))
+        assert a == b
+
+
+class TestRefusal:
+    def test_duplicate_coords_refused(self):
+        with pytest.raises(me.MeshBuildError, match="share coordinate"):
+            me.plan_from_coords({(0, 0): (0, 0, 0), (0, 1): (0, 0, 0)},
+                                (2, 2, 2), "v5p")
+
+    def test_out_of_bounds_refused(self):
+        with pytest.raises(me.MeshBuildError, match="outside declared"):
+            plan_of([(0, 0, 0), (5, 0, 0)], (2, 2, 2))
+
+    def test_empty_refused(self):
+        with pytest.raises(me.MeshBuildError, match="empty allocation"):
+            me.plan_from_coords({}, (2, 2, 2), "v5p")
+
+    def test_visible_chip_without_coord_refused(self):
+        env = {"TPU_VISIBLE_CHIPS": "0,1",
+               "TPU_CHIP_COORDS": "0:0.0.0",
+               "TPU_SLICE_TOPOLOGY": "2x1x1",
+               "TPU_GENERATION": "v5p"}
+        with pytest.raises(me.MeshBuildError, match="no exported coord"):
+            me.plan_from_env(env)
+
+    def test_no_coords_env_refused(self):
+        with pytest.raises(me.MeshBuildError, match="no TPU_CHIP_COORDS"):
+            me.plan_from_env({"TPU_VISIBLE_CHIPS": "0"})
+
+    def test_noncontiguous_worker_ids_refused(self):
+        envs = [
+            {"TPU_WORKER_ID": "0", "TPU_CHIP_COORDS": "0:0.0.0",
+             "TPU_VISIBLE_CHIPS": "0"},
+            {"TPU_WORKER_ID": "2", "TPU_CHIP_COORDS": "0:1.0.0",
+             "TPU_VISIBLE_CHIPS": "0"},
+        ]
+        with pytest.raises(me.MeshBuildError, match="not the contiguous"):
+            me.plan_from_worker_envs(envs)
+
+    def test_peer_list_size_mismatch_refused(self):
+        envs = [{"TPU_WORKER_ID": "0",
+                 "TPU_WORKER_HOSTNAMES": "a,b,c",
+                 "TPU_CHIP_COORDS": "0:0.0.0", "TPU_VISIBLE_CHIPS": "0"},
+                {"TPU_WORKER_ID": "1",
+                 "TPU_WORKER_HOSTNAMES": "a,b,c",
+                 "TPU_CHIP_COORDS": "0:1.0.0", "TPU_VISIBLE_CHIPS": "0"}]
+        with pytest.raises(me.MeshBuildError, match="peer list names 3"):
+            me.plan_from_worker_envs(envs)
+
+    def test_conflicting_topologies_refused(self):
+        envs = [{"TPU_WORKER_ID": "0", "TPU_SLICE_TOPOLOGY": "2x2x2",
+                 "TPU_CHIP_COORDS": "0:0.0.0", "TPU_VISIBLE_CHIPS": "0"},
+                {"TPU_WORKER_ID": "1", "TPU_SLICE_TOPOLOGY": "4x4x4",
+                 "TPU_CHIP_COORDS": "0:1.0.0", "TPU_VISIBLE_CHIPS": "0"}]
+        with pytest.raises(me.MeshBuildError, match="conflicting slice"):
+            me.plan_from_worker_envs(envs)
+
+    def test_overlapping_worker_coords_refused(self):
+        envs = [{"TPU_WORKER_ID": "0", "TPU_CHIP_COORDS": "0:0.0.0",
+                 "TPU_VISIBLE_CHIPS": "0"},
+                {"TPU_WORKER_ID": "1", "TPU_CHIP_COORDS": "0:0.0.0",
+                 "TPU_VISIBLE_CHIPS": "0"}]
+        with pytest.raises(me.MeshBuildError, match="share coordinate"):
+            me.plan_from_worker_envs(envs)
+
+    def test_device_count_mismatch_refused(self, devices):
+        plan = plan_of(cuboid_coords((2, 2, 2)), (2, 2, 2))
+        with pytest.raises(me.MeshBuildError, match="8 devices but"):
+            mb.mesh_from_plan(plan, devices[:4])
+
+    def test_malformed_coords_env_refused(self):
+        with pytest.raises(me.MeshBuildError, match="malformed"):
+            me.parse_chip_coords("0:0.0")
+
+    def test_malformed_visible_chips_refused(self):
+        """A torn TPU_VISIBLE_CHIPS token must refuse, not silently
+        drop the chip and mesh over a subset of the allocation."""
+        env = {"TPU_VISIBLE_CHIPS": "0,1x,2",
+               "TPU_CHIP_COORDS": "0:0.0.0,1:1.0.0,2:2.0.0",
+               "TPU_SLICE_TOPOLOGY": "4x1x1",
+               "TPU_GENERATION": "v5p"}
+        with pytest.raises(me.MeshBuildError,
+                           match="malformed TPU_VISIBLE_CHIPS"):
+            me.plan_from_env(env)
+
+    def test_mesh_build_fault_site_fires(self):
+        with FAULTS.armed("mesh.build", Always()):
+            with pytest.raises(FaultInjected):
+                plan_of(cuboid_coords((2, 2, 1)), (2, 2, 1))
+
+    def test_workload_launch_fault_site_fires(self, devices):
+        plan = plan_of(cuboid_coords((2, 2, 2)), (2, 2, 2))
+        with FAULTS.armed("workload.launch", Always()):
+            with pytest.raises(FaultInjected):
+                mb.launch_workload("allreduce", plan, devices)
+
+    def test_unknown_workload_refused(self, devices):
+        plan = plan_of(cuboid_coords((2, 2, 2)), (2, 2, 2))
+        with pytest.raises(me.MeshBuildError, match="unknown workload"):
+            mb.launch_workload("nope", plan, devices)
+
+
+class TestFragmentedCost:
+    def test_fragmented_builds_with_higher_hop_cost(self):
+        """A scattered allocation still constructs (the workload can
+        run) but models strictly worse ICI bandwidth than the cuboid —
+        the delta the placement A/B gates on."""
+        contig = plan_of(cuboid_coords((2, 2, 2)), (4, 4, 4))
+        frag = plan_of([(x, y, z) for z in (0, 2) for y in (0, 2)
+                        for x in (0, 2)], (4, 4, 4))
+        assert contig.contiguous and not frag.contiguous
+        assert frag.hop_mean > contig.hop_mean
+        assert frag.modeled_ici_gbps < contig.modeled_ici_gbps
+        assert contig.n_devices == frag.n_devices == 8
+
+    def test_undeclared_dims_non_origin_block_normalizes(self):
+        """A coords-but-no-declared-topology env whose block does not
+        touch the slice corner must still plan (normalized to its own
+        origin), not crash: rank indices keep naming the same chips."""
+        env = {"TPU_VISIBLE_CHIPS": "0,1",
+               "TPU_CHIP_COORDS": "0:2.1.0,1:3.1.0",
+               "TPU_GENERATION": "v5p"}
+        plan = me.plan_from_env(env)
+        assert plan.n_devices == 2
+        assert plan.contiguous
+        assert plan.coords == ((0, 0, 0), (1, 0, 0))
+        assert plan.chip_keys == ((0, 0), (0, 1))
+
+    def test_conflicting_generations_refused(self):
+        envs = [{"TPU_WORKER_ID": "0", "TPU_GENERATION": "v5e",
+                 "TPU_CHIP_COORDS": "0:0.0.0", "TPU_VISIBLE_CHIPS": "0"},
+                {"TPU_WORKER_ID": "1", "TPU_GENERATION": "v5p",
+                 "TPU_CHIP_COORDS": "0:1.0.0", "TPU_VISIBLE_CHIPS": "0"}]
+        with pytest.raises(me.MeshBuildError,
+                           match="conflicting generations"):
+            me.plan_from_worker_envs(envs)
+
+    def test_wraparound_counts_in_hop_model(self):
+        """On a wrapping torus dim, opposite edges are 1 hop — the ring
+        distance, not the Manhattan one."""
+        mesh = me.slice_mesh_for((4, 1, 1), "v5p")
+        assert mesh.wrap[0]
+        assert mesh.distance((0, 0, 0), (3, 0, 0)) == 1
+
+
+class TestExportRoundTrip:
+    def test_chip_export_parses_back(self):
+        chips = default_fake_chips(4, "v5p", slice_id="rt")
+        env = me.export_topology_env(chips)
+        parsed = me.parse_chip_coords(env["TPU_CHIP_COORDS"])
+        assert parsed == {c.index: c.coords for c in chips}
+        assert env["TPU_SLICE_TOPOLOGY"] == chips[0].slice_topology
+        assert env["TPU_GENERATION"] == "v5p"
+
+    def test_coordless_inventory_exports_nothing(self):
+        """Multi-chip inventory with all-(0,0,0) coords and no declared
+        topology published no fabric info: the claim env must stay
+        exactly as before (no topology block to mislead a mesh build)."""
+
+        class C:
+            coords = (0, 0, 0)
+            slice_topology = ""
+            generation = "v5e"
+            worker_index = 0
+            slice_id = ""
+
+            def __init__(self, i):
+                self.index = i
+
+        assert me.export_topology_env([C(0), C(1)]) == {}
+        # The single-chip case is just as ambiguous: (0,0,0) with no
+        # declared topology could be a zero-filled sysfs default, so
+        # nothing may be fabricated for it either.
+        assert me.export_topology_env([C(0)]) == {}
+
+
+class TestPlanFromAllocation:
+    def _slice(self, node, n_chips):
+        return {"metadata": {"name": f"{node}-tpu.dev"},
+                "spec": {"driver": "tpu.dev", "nodeName": node,
+                         "devices": [{"name": f"chip-{i}", "attributes": {
+                             "type": {"string": "chip"},
+                             "generation": {"string": "v5p"},
+                             "coordX": {"int": i % 4},
+                             "coordY": {"int": (i // 4) % 4},
+                             "coordZ": {"int": i // 16},
+                             "sliceTopology": {"string": "4x4x1"}}}
+                             for i in range(n_chips)]}}
+
+    def test_double_digit_chips_key_by_real_index(self):
+        """chip-10 must rank after chip-2 and key as chip index 10:
+        lexicographic device order would scramble rank→coordinate on
+        any node with 10+ chips."""
+        claim = {"metadata": {"name": "c"}, "status": {"allocation": {
+            "devices": {"results": [
+                {"pool": "n0", "device": "chip-10"},
+                {"pool": "n0", "device": "chip-2"}]}}}}
+        plan = me.plan_from_allocation(claim, [self._slice("n0", 16)])
+        assert set(plan.chip_keys) == {(0, 2), (0, 10)}
+        # coords follow the published attributes of the REAL indices:
+        # chip-2 at (2,0,0), chip-10 at (2,2,0).
+        assert set(plan.coords) == {(2, 0, 0), (2, 2, 0)}
+
+
+class TestHarnessPlan:
+    def test_multi_worker_harness_yields_contiguous_plan(self):
+        """End to end without JAX: real prepare pipeline -> CDI env ->
+        merged multi-worker plan covering every allocated chip."""
+        from tpu_dra.testing import MeshSliceHarness
+
+        h = MeshSliceHarness(n_workers=2, chips_per_worker=4)
+        try:
+            envs = h.worker_envs()
+            plan = me.plan_from_worker_envs(envs)
+        finally:
+            h.close()
+        assert plan.n_devices == 8
+        assert plan.n_workers == 2
+        assert plan.contiguous
+        assert plan.hop_mean == 1.0
+        assert plan.modeled_ici_gbps > 0
+        # Both workers' chips participate (global coords disjoint).
+        assert {k[0] for k in plan.chip_keys} == {0, 1}
+
+    def test_three_worker_harness(self):
+        """Fake multi-host provisioning sized beyond 2 nodes (ISSUE 10):
+        3 workers x 4 chips = 12-chip v5p slice, still one dense mesh."""
+        from tpu_dra.testing import MeshSliceHarness
+
+        h = MeshSliceHarness(n_workers=3, chips_per_worker=4)
+        try:
+            plan = me.plan_from_worker_envs(h.worker_envs())
+        finally:
+            h.close()
+        assert plan.n_devices == 12
+        assert plan.n_workers == 3
+        assert plan.contiguous
+
+
+class TestMeshConstruction:
+    def test_device_order_follows_coords(self, devices):
+        """mesh_from_plan permutes devices into snake-rank order: the
+        device at rank r is the one supplied at the arrival index the
+        plan's order names."""
+        plan = plan_of(cuboid_coords((2, 2, 2)), (2, 2, 2))
+        mesh = mb.mesh_from_plan(plan, devices)
+        got = list(mesh.devices.flat)
+        want = [devices[i] for i in plan.order]
+        assert got == want
+
+    def test_2d_mesh_shape(self, devices):
+        plan = plan_of(cuboid_coords((2, 2, 2)), (2, 2, 2))
+        mesh = mb.mesh_from_plan(plan, devices,
+                                 axis_names=("data", "model"),
+                                 shape=(4, 2))
+        assert mesh.shape == {"data": 4, "model": 2}
+
+    def test_bad_shape_refused(self, devices):
+        plan = plan_of(cuboid_coords((2, 2, 2)), (2, 2, 2))
+        with pytest.raises(me.MeshBuildError, match="holds 6 devices"):
+            mb.mesh_from_plan(plan, devices, axis_names=("a", "b"),
+                              shape=(3, 2))
+
+    def test_launch_allreduce_on_plan(self, devices):
+        plan = plan_of(cuboid_coords((2, 2, 2)), (2, 2, 2))
+        r = mb.launch_workload("allreduce", plan, devices,
+                               nbytes_per_device=1 << 14, iters=1)
+        assert r["n_devices"] == 8
+        assert r["algo_gbps"] > 0

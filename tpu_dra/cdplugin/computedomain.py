@@ -269,6 +269,16 @@ class ComputeDomainManager:
         if coordinator is not None:
             env["TPU_COORDINATOR_ADDRESS"] = (
                 f"{coordinator.get('ipAddress', '')}:{COORDINATOR_PORT}")
+        # Allocation -> mesh handoff (SURVEY §17): surface the
+        # controller-stamped slice-alignment verdict (status.topology,
+        # cdcontroller) so a workload's mesh builder can tell a
+        # slice-aligned domain (ICI end to end) from one stitched
+        # across slices (DCN hops) without an API-server round trip.
+        topo = (cd.get("status") or {}).get("topology") or {}
+        if topo:
+            env["TPU_CD_SLICES"] = str(topo.get("slices", 1))
+            env["TPU_CD_SLICE_ALIGNED"] = (
+                "true" if topo.get("sliceAligned") else "false")
         if len(slice_ids) > 1:
             # Heterogeneous domain: slices talk over DCN (megascale-style).
             env["MEGASCALE_NUM_SLICES"] = str(len(slice_ids))

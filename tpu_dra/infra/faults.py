@@ -170,6 +170,18 @@ SITES: Dict[str, str] = {
     "cddaemon.spawn":
         "slice-daemon child fails to spawn; threatens: readiness "
         "mirroring, CD convergence",
+    "mesh.build":
+        "allocation -> mesh plan construction fails (torn topology env, "
+        "stale coordinate export, refused rank mapping); threatens: the "
+        "data-plane handoff — a workload must see a loud refusal and "
+        "retry against fresh claim state, never a silently mis-ordered "
+        "mesh whose collectives ride long ICI paths",
+    "workload.launch":
+        "workload launch on a built mesh fails (admission refusal, "
+        "compile/dispatch error at the data-plane seam); threatens: "
+        "per-workload bench attribution — one failing launch must "
+        "isolate to its own workload record, not blank sibling "
+        "workloads or unwind the mesh",
     "health.chip_event":
         "synthetic chip health event (payload-injecting site); "
         "threatens: ResourceSlice vs healthy-chip consistency",

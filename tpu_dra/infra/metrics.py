@@ -206,6 +206,13 @@ METRICS_CATALOG: Dict[str, str] = {
     "tpu_dra_topo_allocations": "infra/metrics.py",
     "tpu_dra_topo_score_seconds": "infra/metrics.py",
     "tpu_dra_topo_free_cuboid_chips": "infra/metrics.py",
+    # infra/metrics.py — allocation -> mesh data-plane handoff (SURVEY
+    # §17): plan builds by outcome (ok/fragmented/refused), measured
+    # psum bandwidth on allocated meshes, and the contiguous-vs-
+    # fragmented placement A/B delta the perf tier gates on
+    "tpu_dra_mesh_builds_total": "infra/metrics.py",
+    "tpu_dra_psum_bandwidth_gbps": "infra/metrics.py",
+    "tpu_dra_psum_ab_delta_gbps": "infra/metrics.py",
     # infra/metrics.py — drmc model-checker exploration stats (consumed
     # by hack/drmc.sh gates; labeled by scenario)
     "tpu_dra_drmc_schedules_total": "infra/metrics.py",
@@ -357,6 +364,30 @@ TOPO_FREE_CUBOID = DefaultRegistry.histogram(
     "largest free cuboid (chips) remaining on the node after each "
     "topology-scored placement — the fragmentation observable",
     buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256))
+
+# -- allocation -> mesh data-plane handoff (topology/meshexport +
+# workloads/meshbuild, SURVEY §17) -------------------------------------------
+
+MESH_BUILDS = DefaultRegistry.counter(
+    "tpu_dra_mesh_builds_total",
+    "allocation -> MeshPlan constructions, labeled by outcome: ok "
+    "(contiguous cuboid, all-neighbor ring), fragmented (plan still "
+    "builds but the modeled hop cost is above the cuboid floor), "
+    "refused (rank/topology mismatch, duplicate or out-of-bounds "
+    "coordinates — the loud-refusal contract)")
+PSUM_BW = DefaultRegistry.histogram(
+    "tpu_dra_psum_bandwidth_gbps",
+    "measured all-reduce algorithm bandwidth (GB/s) per collective run "
+    "on a driver-allocated mesh (the bench's psum phase and any "
+    "launch_workload('allreduce') caller)",
+    buckets=(0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 200.0,
+             400.0, 800.0))
+PSUM_AB_DELTA = DefaultRegistry.gauge(
+    "tpu_dra_psum_ab_delta_gbps",
+    "modeled ICI bandwidth delta (contiguous cuboid minus deliberately "
+    "fragmented placement of the same chip count) from the last "
+    "placement-quality A/B — the bandwidth the topology scorer's "
+    "contiguity preference buys, deterministic on the fake backend")
 
 # -- drmc deterministic model checker (tpu_dra/analysis/drmc, SURVEY
 # §13): exploration volume counters the hack/drmc.sh gate asserts on —

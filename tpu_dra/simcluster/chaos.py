@@ -624,6 +624,10 @@ class SchedulerChaosHarness:
 
     QUIESCE_TIMEOUT = 30.0
 
+    # Sites the walk's re-arm op may pick; subclasses extend (the
+    # topology walk adds the data-plane handoff sites).
+    REARM_SITES = SCHED_CHAOS_SITES
+
     def __init__(self, seed: int, *, nodes: int = 4, chips_per_node: int = 2,
                  workers: int = 4):
         from tpu_dra.simcluster.scheduler import Scheduler
@@ -687,7 +691,7 @@ class SchedulerChaosHarness:
 
     def _op_rearm(self) -> None:
         self._harvest_faults()
-        site = self.rng.choice(SCHED_CHAOS_SITES)
+        site = self.rng.choice(self.REARM_SITES)
         if self.rng.random() < 0.3:
             FAULTS.disarm(site)
             return
@@ -716,10 +720,13 @@ class SchedulerChaosHarness:
 
     # -- run + invariants ---------------------------------------------------
 
+    def _ops(self):
+        """(op, weight) pairs of the walk; subclasses extend."""
+        return [(self._op_create_pod, 4), (self._op_delete_pod, 2),
+                (self._op_rearm, 2), (self._op_force_resync, 1)]
+
     def run(self, n_events: int = 60) -> ChaosReport:
-        ops = [(self._op_create_pod, 4), (self._op_delete_pod, 2),
-               (self._op_rearm, 2), (self._op_force_resync, 1)]
-        weighted = [op for op, w in ops for _ in range(w)]
+        weighted = [op for op, w in self._ops() for _ in range(w)]
         try:
             for _ in range(n_events):
                 self.report.events += 1
@@ -824,6 +831,15 @@ def run_sched_schedule(seed: int, n_events: int = 60) -> ChaosReport:
 # single-chip-heavy load with a multi-chip tail).
 TOPO_CLAIM_SIZES = (1, 1, 2, 2, 4)
 
+# Data-plane handoff sites (SURVEY §17) the topology walk additionally
+# arms: mesh.build fires inside meshexport.plan_from_* (the allocation
+# -> MeshPlan constructor the workload's mesh builder runs), and
+# workload.launch inside the launch-admission seam. The walk's mesh
+# probe keeps exercising both against live allocations, so the refusal
+# paths are chaos-tested, and quiesce asserts that with faults disarmed
+# every allocated multi-chip claim still yields a contiguous plan.
+MESH_CHAOS_SITES = ("mesh.build", "workload.launch")
+
 
 class TopologyChaosHarness(SchedulerChaosHarness):
     """The scheduler walk with the TopologyAwareScheduling gate ON over
@@ -840,7 +856,16 @@ class TopologyChaosHarness(SchedulerChaosHarness):
     satisfiable: the topology path deliberately REFUSES non-contiguous
     placements, and a walk pinned at 100% utilization could wedge a
     final multi-chip pod behind fragmentation no future free will clear
-    (no deletes happen after the walk)."""
+    (no deletes happen after the walk).
+
+    7. (data-plane handoff, SURVEY §17) every allocated multi-chip claim
+       on the coordinate-publishing inventory yields a MeshPlan —
+       contiguous, with a positive modeled ICI bandwidth — once faults
+       are disarmed; during the walk the probe op keeps building plans
+       with mesh.build/workload.launch armed, so refusals surface as
+       FaultInjected (counted), never as a wrong mesh."""
+
+    REARM_SITES = SCHED_CHAOS_SITES + MESH_CHAOS_SITES
 
     def __init__(self, seed: int, *, nodes: int = 4,
                  chips_per_node: int = 16):
@@ -942,6 +967,70 @@ class TopologyChaosHarness(SchedulerChaosHarness):
             log.info("topology chaos: pruned provably-unplaceable pod %s "
                      "(%d chips, fragmentation wedge)", name, n)
 
+    def _ops(self):
+        return super()._ops() + [(self._op_mesh_probe, 2)]
+
+    def _allocated_multichip_claims(self) -> List[Dict]:
+        return [c for c in self.cluster.list(RESOURCECLAIMS,
+                                             namespace="default")
+                if len((((c.get("status") or {}).get("allocation") or {})
+                        .get("devices") or {}).get("results") or []) >= 2]
+
+    def _op_mesh_probe(self) -> None:
+        """Build a MeshPlan from one live allocation + admit a launch,
+        with whatever faults the walk armed: the data-plane handoff's
+        production guards (mesh.build, workload.launch) fire here, and
+        an injected fault must surface as FaultInjected — a refusal the
+        workload retries — never as a silently mis-ordered mesh."""
+        from tpu_dra.infra.faults import FaultInjected
+        from tpu_dra.topology import meshexport
+
+        claims = self._allocated_multichip_claims()
+        if not claims:
+            return
+        claim = self.rng.choice(sorted(
+            claims, key=lambda c: c["metadata"]["name"]))
+        slices = self.cluster.list(RESOURCESLICES)
+        try:
+            plan = meshexport.plan_from_allocation(claim, slices)
+            meshexport.admit_launch("allreduce")
+        except FaultInjected:
+            return  # counted via FAULTS.take_counts at harvest
+        except meshexport.MeshBuildError as e:
+            # A racing deallocation can momentarily list a claim whose
+            # slices moved; quiesce re-checks with the world stopped,
+            # so only repeated failure there is a violation.
+            log.info("topology chaos: mid-walk mesh probe refused: %s", e)
+            return
+        if not plan.contiguous:
+            self.report.violations.append(
+                f"mesh probe: claim {claim['metadata']['name']} built a "
+                f"non-contiguous plan on the gate-on inventory "
+                f"(coords {list(plan.coords)})")
+
+    def _verify_mesh_handoff(self) -> List[str]:
+        """Quiesce invariant 7: faults disarmed, every allocated
+        multi-chip claim must yield a contiguous MeshPlan with positive
+        modeled ICI bandwidth."""
+        from tpu_dra.topology import meshexport
+
+        out: List[str] = []
+        slices = self.cluster.list(RESOURCESLICES)
+        for claim in self._allocated_multichip_claims():
+            name = claim["metadata"]["name"]
+            try:
+                plan = meshexport.plan_from_allocation(claim, slices)
+            except Exception as e:  # noqa: BLE001 — any failure is a finding
+                out.append(f"mesh handoff: claim {name} yields no plan: {e}")
+                continue
+            if not plan.contiguous:
+                out.append(f"mesh handoff: claim {name} plan is not a "
+                           f"contiguous cuboid (coords {list(plan.coords)})")
+            if plan.modeled_ici_gbps <= 0:
+                out.append(f"mesh handoff: claim {name} modeled ICI "
+                           f"bandwidth is {plan.modeled_ici_gbps}")
+        return out
+
     def _converged(self) -> List[str]:
         self._prune_wedged()
         return super()._converged()
@@ -949,6 +1038,7 @@ class TopologyChaosHarness(SchedulerChaosHarness):
     def quiesce_and_verify(self) -> None:
         super().quiesce_and_verify()
         self.report.violations.extend(self.sched.verify_topology())
+        self.report.violations.extend(self._verify_mesh_handoff())
 
     def close(self) -> None:
         try:

@@ -33,6 +33,16 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
+def read_claim_env(cdi, claim_uid: str) -> dict:
+    """The workload container's env view of a prepared claim, parsed
+    from the WRITTEN CDI spec (the same file kubelet's runtime
+    consumes). One decoding for every harness, so a CDI-env encoding
+    change cannot silently diverge between them."""
+    spec = cdi.read_spec(cdi.claim_spec_path(claim_uid))
+    return dict(e.split("=", 1)
+                for e in spec["devices"][0]["containerEdits"]["env"])
+
+
 class FakeNode:
     """One 'node': a CD kubelet plugin plus (once labeled) a cd daemon."""
 
@@ -349,15 +359,29 @@ def provision_two_node_cd(namespace: str = "cdtest",
                           node_names=("node-a", "node-b"),
                           retry_timeout: float = 30.0,
                           join_timeout: float = 60.0) -> dict:
-    """Provision a 2-node ComputeDomain through the full CD stack —
+    """The historical 2-node entry point (bench.bench_cd_convergence,
+    __graft_entry__._cd_psum_probe); provision_multi_node_cd is the
+    general N-node harness."""
+    return provision_multi_node_cd(namespace=namespace,
+                                   node_names=node_names,
+                                   retry_timeout=retry_timeout,
+                                   join_timeout=join_timeout)
+
+
+def provision_multi_node_cd(n_nodes: int = 2, namespace: str = "cdtest",
+                            node_names=None,
+                            retry_timeout: float = 30.0,
+                            join_timeout: float = 60.0) -> dict:
+    """Provision an N-node ComputeDomain through the full CD stack —
     controller + CD kubelet plugins + real C++ slice daemons converging
     over the fake API server — and prepare one workload channel claim per
     node (SURVEY §3.3). The single source of the harness for
     bench.bench_cd_convergence (convergence timing) and
-    __graft_entry__._cd_psum_probe (claim-env -> mesh -> collective).
+    __graft_entry__._cd_psum_probe (claim-env -> mesh -> collective);
+    sized beyond 2 nodes for the data-plane tier (SURVEY §17).
 
     Returns {"ok", "error"/"skipped", "elapsed_s", "envs"} where
-    elapsed_s is CD-creation -> both claims prepared, and envs maps node
+    elapsed_s is CD-creation -> all claims prepared, and envs maps node
     name -> the prepared claim's CDI env (the workload container's view:
     TPU_WORKER_ID, TPU_WORKER_HOSTNAMES, coordinator/megascale vars).
     """
@@ -370,6 +394,8 @@ def provision_two_node_cd(namespace: str = "cdtest",
     from tpu_dra.k8s import COMPUTEDOMAINS, FakeCluster, RESOURCECLAIMS
     from tpu_dra.kubeletplugin.server import Claim
 
+    if node_names is None:
+        node_names = tuple(f"node-{i:02d}" for i in range(n_nodes))
     if not os.path.exists(DAEMON_BIN):
         return {"ok": False, "skipped": "native daemon not built"}
 
@@ -422,10 +448,7 @@ def provision_two_node_cd(namespace: str = "cdtest",
             c = Claim(uid=uid, name=claim["metadata"]["name"],
                       namespace=namespace)
             results[node.name] = node.driver.prepare_claims([c])[c.uid]
-            spec = node.cdi.read_spec(node.cdi.claim_spec_path(uid))
-            envs[node.name] = dict(
-                e.split("=", 1)
-                for e in spec["devices"][0]["containerEdits"]["env"])
+            envs[node.name] = read_claim_env(node.cdi, uid)
 
         threads = [threading.Thread(target=kubelet, args=(n,))
                    for n in nodes]
@@ -544,6 +567,130 @@ def seed_sched_inventory(client, *, nodes: int, chips_per_node: int,
                          "workerIndex": {"int": c.worker_index}}}
                          for c in chips]}})
     return names
+
+
+# ---------------------------------------------------------------------------
+# Fake multi-host slice provisioning (data-plane tier, SURVEY §17)
+# ---------------------------------------------------------------------------
+
+class MeshSliceHarness:
+    """A fake multi-host TPU slice provisioned through the REAL
+    tpuplugin prepare pipeline, for the data-plane bench/tests: each of
+    `n_workers` "hosts" runs its own DeviceState + CDIHandler +
+    CheckpointManager over a FakeBackend holding that worker's block of
+    the GLOBAL slice coordinate space (default_fake_chips with
+    worker_index/total_workers), claims are prepared through
+    ``DeviceState.prepare_batch`` (the same pipeline kubelet drives),
+    and each claim's env is read back from the WRITTEN CDI spec — the
+    workload container's view, including the exported topology block
+    (TPU_CHIP_COORDS / TPU_SLICE_TOPOLOGY) — merged with the
+    cddaemon-shaped worker identity the CD channel claim would add
+    (TPU_WORKER_ID, TPU_WORKER_HOSTNAMES, coordinator address).
+
+    This is the no-native-toolchain path to a >2-host mesh env set;
+    provision_multi_node_cd is the full-stack (real C++ slice daemon)
+    counterpart. Sized by argument, not hardware: the JAX side maps the
+    merged plan onto however many host-platform devices exist.
+    """
+
+    def __init__(self, n_workers: int = 2, chips_per_worker: int = 4,
+                 generation: str = "v5p", slice_id: str = "mesh"):
+        import shutil as _shutil
+        import tempfile as _tempfile
+
+        from tpu_dra.cdi.handler import CDIHandler as _CDIHandler
+        from tpu_dra.cddaemon.dnsnames import stable_name
+        from tpu_dra.cdplugin.computedomain import COORDINATOR_PORT
+        from tpu_dra.native.tpuinfo import FakeBackend, default_fake_chips
+        from tpu_dra.tpuplugin.device_state import DeviceState as _DS
+        from tpu_dra.tpuplugin.sharing import TimeSlicingManager
+
+        self.n_workers = n_workers
+        self.chips_per_worker = chips_per_worker
+        self.generation = generation
+        self.tmp = _tempfile.mkdtemp(prefix="tpu-dra-meshslice-")
+        self._rmtree = _shutil.rmtree
+        self._claim_seq = 0
+        self._prepared = []  # (worker, uid) for close-time unprepare
+        peers = ",".join(stable_name(i) for i in range(n_workers))
+        self._identity = [{
+            "TPU_WORKER_ID": str(w),
+            "TPU_WORKER_HOSTNAMES": peers,
+            "TPU_PROCESS_COUNT": str(n_workers),
+            "TPU_COORDINATOR_ADDRESS": f"127.0.0.1:{COORDINATOR_PORT}",
+        } for w in range(n_workers)]
+        self.states = []
+        self.backends = []
+        try:
+            for w in range(n_workers):
+                backend = FakeBackend(default_fake_chips(
+                    chips_per_worker, generation, slice_id=slice_id,
+                    worker_index=w, total_workers=n_workers))
+                wdir = os.path.join(self.tmp, f"w{w}")
+                state = _DS(
+                    backend=backend,
+                    cdi=_CDIHandler(os.path.join(wdir, "cdi")),
+                    checkpoints=CheckpointManager(os.path.join(wdir, "p")),
+                    driver_name=apitypes.TPU_DRIVER_NAME,
+                    node_name=f"mesh-{w}",
+                    ts_manager=TimeSlicingManager(backend))
+                self.backends.append(backend)
+                self.states.append(state)
+        except BaseException:
+            self.close()
+            raise
+
+    def prepare_claim(self, worker: int, chip_indices=None,
+                      devices=None) -> Dict[str, str]:
+        """Prepare one allocated claim on `worker` (all its chips by
+        default; `devices` overrides with explicit device names) and
+        return the claim's CDI-spec env merged with the worker's
+        identity vars — exactly what that worker's workload container
+        would see."""
+        state = self.states[worker]
+        if devices is None:
+            indices = (chip_indices if chip_indices is not None
+                       else [c.index for c in self.backends[worker].chips()])
+            devices = [f"chip-{i}" for i in indices]
+        uid = f"mesh-claim-{worker}-{self._claim_seq}"
+        self._claim_seq += 1
+        claim = {
+            "apiVersion": "resource.k8s.io/v1", "kind": "ResourceClaim",
+            "metadata": {"name": uid, "namespace": "default", "uid": uid},
+            "spec": {"devices": {"requests": [{"name": "tpu"}]}},
+            "status": {"allocation": {"devices": {"results": [
+                {"request": "tpu", "driver": apitypes.TPU_DRIVER_NAME,
+                 "pool": f"mesh-{worker}", "device": d}
+                for d in devices], "config": []}}},
+        }
+        result = state.prepare_batch([claim])[uid]
+        if result.error:
+            raise RuntimeError(
+                f"mesh harness prepare failed on worker {worker}: "
+                f"{result.error}")
+        self._prepared.append((worker, uid))
+        env = read_claim_env(state._cdi, uid)
+        env.update(self._identity[worker])
+        return env
+
+    def worker_envs(self):
+        """One all-chips claim per worker; the env list a multi-process
+        mesh build consumes (meshexport.plan_from_worker_envs)."""
+        return [self.prepare_claim(w) for w in range(self.n_workers)]
+
+    def close(self) -> None:
+        for worker, uid in self._prepared:
+            try:
+                self.states[worker].unprepare_batch([uid])
+            except Exception:  # noqa: BLE001 — teardown is best-effort
+                pass
+        self._prepared.clear()
+        for state in self.states:
+            try:
+                state.close()
+            except Exception:  # noqa: BLE001 — teardown is best-effort
+                pass
+        self._rmtree(self.tmp, ignore_errors=True)
 
 
 def make_sched_pod(client, name: str, namespace: str = "default",

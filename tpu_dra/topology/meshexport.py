@@ -1,0 +1,485 @@
+"""Allocation → mesh contract: coordinate export, rank order, ICI cost.
+
+The control-plane half of the data-plane loop (SURVEY §17). The driver
+allocates torus-contiguous chip sets (topology/placement); a workload
+container then has to lay a ``jax.sharding.Mesh`` over exactly those
+chips in an order that keeps neighboring ranks on neighboring ICI links.
+This module owns everything about that contract that does NOT need JAX:
+
+- **coordinate export** (``export_topology_env``): the per-claim CDI env
+  the tpuplugin emits next to ``TPU_VISIBLE_CHIPS`` — per-chip torus
+  coordinates, the declared slice topology, slice/worker identity — so
+  the workload's mesh builder consumes the same allocation result the
+  scheduler scored, not a rediscovered one.
+- **rank→coordinate mapping** (``snake_order``): the deterministic
+  device order every process of a multi-process mesh must agree on.
+  Boustrophedon over the allocation's bounding box: consecutive ranks
+  of a contiguous cuboid are ICI neighbors (1 hop), and the order is a
+  pure function of the coordinate set — same allocation ⇒ same order in
+  every worker, no coordination round needed.
+- **ICI cost model** (``ring_hops`` / ``modeled_ring_allreduce_gbps``):
+  hop-count-weighted link bandwidth for the fake multi-host backend.
+  On real hardware the measured collective is the truth; on the fake
+  backend the model makes placement quality *measurable and
+  deterministic* — the contiguous-vs-fragmented bench A/B gates on it.
+- **MeshPlan** (``plan_from_coords`` and its adapters): the validated,
+  ordered result handed to ``workloads.meshbuild``. Construction
+  REFUSES lies (rank/topology mismatch, duplicate or out-of-bounds
+  coords) — a wrong mesh silently degrades every collective, so the
+  error surface is loud and early, mirroring ``mesh.validate_chips``.
+
+Ownership rules: this module holds no allocation state and never
+mutates its inputs; plans are frozen snapshots of one claim's
+allocation result. The exported env is written once at prepare time
+into the claim's CDI spec — consumers treat it as immutable, and a
+re-prepare rewrites the whole spec. Fault sites ``mesh.build`` and
+``workload.launch`` guard the two seams where the data plane first
+trusts control-plane output.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from tpu_dra.infra.faults import FAULTS
+from tpu_dra.infra.metrics import MESH_BUILDS
+from tpu_dra.topology.mesh import (
+    Coord, Mesh, TORUS_GENERATIONS, format_topology, parse_topology,
+)
+from tpu_dra.topology.placement import is_contiguous_block
+
+# Modeled per-link, per-direction ICI bandwidth in GB/s by generation.
+# Only the RATIOS matter to anything gated (the A/B compares placements
+# of the same generation); absolute values are public-order-of-magnitude
+# so modeled numbers read plausibly next to measured ones.
+ICI_LINK_GBPS: Dict[str, float] = {
+    "v4": 50.0,
+    "v5p": 100.0,
+    "v5e": 50.0,
+    "v6e": 100.0,
+}
+
+# Env keys of the exported contract (also consumed by workloads.meshbuild).
+ENV_CHIP_COORDS = "TPU_CHIP_COORDS"
+ENV_SLICE_TOPOLOGY = "TPU_SLICE_TOPOLOGY"
+ENV_GENERATION = "TPU_GENERATION"
+ENV_SLICE_ID = "TPU_SLICE_ID"
+ENV_WORKER_INDEX = "TPU_WORKER_INDEX"
+
+
+class MeshBuildError(ValueError):
+    """The allocation result cannot back a trustworthy mesh (rank or
+    topology mismatch, duplicate/out-of-bounds coordinates, missing
+    coordinate export). Refusal, not degradation: a silently-wrong
+    device order turns every ICI-adjacent collective into a slow one."""
+
+
+# ---------------------------------------------------------------------------
+# Coordinate export (prepare-time env, next to TPU_VISIBLE_CHIPS)
+# ---------------------------------------------------------------------------
+
+def format_chip_coords(coords_by_index: Dict[int, Coord]) -> str:
+    """{0: (0,0,0), 1: (1,0,0)} -> '0:0.0.0,1:1.0.0' (index-sorted)."""
+    return ",".join(f"{i}:{c[0]}.{c[1]}.{c[2]}"
+                    for i, c in sorted(coords_by_index.items()))
+
+
+def parse_chip_coords(text: str) -> Dict[int, Coord]:
+    """Inverse of format_chip_coords; raises MeshBuildError on malformed
+    entries (a torn env var must not silently drop chips)."""
+    out: Dict[int, Coord] = {}
+    if not text:
+        return out
+    for part in text.split(","):
+        try:
+            idx_s, coord_s = part.split(":")
+            x, y, z = coord_s.split(".")
+            idx, c = int(idx_s), (int(x), int(y), int(z))
+        except ValueError as e:
+            raise MeshBuildError(
+                f"malformed {ENV_CHIP_COORDS} entry {part!r}") from e
+        if idx in out:
+            raise MeshBuildError(
+                f"duplicate chip index {idx} in {ENV_CHIP_COORDS}")
+        out[idx] = c
+    return out
+
+
+def export_topology_env(chips: Iterable) -> Dict[str, str]:
+    """The claim-env topology block for an allocated chip set, or {}
+    when the inventory published no fabric information (every chip at
+    the default (0,0,0) with no declared topology — the coordinate-less
+    real-sysfs case validate_chips documents). Emitted by the tpuplugin
+    at prepare time into the claim's CDI spec."""
+    members = list(chips)
+    if not members:
+        return {}
+    if (all(c.coords == (0, 0, 0) for c in members)
+            and not any(getattr(c, "slice_topology", "") for c in members)):
+        # No topology published: nothing to export. Unlike
+        # validate_chips (where a single chip AT (0,0,0) is a valid
+        # fabric), an export here cannot distinguish "really at the
+        # origin" from "zero-filled sysfs default" without a declared
+        # topology — exporting a fabricated coordinate would feed the
+        # mesh builder a guess, so coordless claims of ANY size keep
+        # their exact old env and plan_from_env refuses loudly instead.
+        return {}
+    declared = ""
+    for chip in members:
+        topo = getattr(chip, "slice_topology", "")
+        if topo:
+            declared = topo
+            break
+    env = {
+        ENV_CHIP_COORDS: format_chip_coords(
+            {c.index: c.coords for c in members}),
+        ENV_GENERATION: members[0].generation,
+        ENV_WORKER_INDEX: str(members[0].worker_index),
+    }
+    if declared:
+        env[ENV_SLICE_TOPOLOGY] = declared
+    slice_id = getattr(members[0], "slice_id", "")
+    if slice_id:
+        env[ENV_SLICE_ID] = slice_id
+    return env
+
+
+# ---------------------------------------------------------------------------
+# Rank → coordinate mapping
+# ---------------------------------------------------------------------------
+
+def snake_order(coords: Iterable[Coord]) -> List[Coord]:
+    """Deterministic boustrophedon order over the coordinate set's
+    bounding box: z-planes ascending, y-rows serpentine within a plane
+    (direction flips per plane), x serpentine within a row (direction
+    flips per traversed row, continuing across planes). For a full
+    cuboid every consecutive pair — including the plane transitions —
+    is exactly one ICI hop apart, so ring collectives over this order
+    ride neighbor links. A pure function of the set: every process
+    computes the same order from the same allocation."""
+    pts = sorted(set(coords))
+    if not pts:
+        return []
+    lo = tuple(min(c[i] for c in pts) for i in range(3))
+    hi = tuple(max(c[i] for c in pts) for i in range(3))
+    dx, dy = hi[0] - lo[0] + 1, hi[1] - lo[1] + 1
+
+    def key(c: Coord):
+        x, y, z = c[0] - lo[0], c[1] - lo[1], c[2] - lo[2]
+        yy = y if z % 2 == 0 else dy - 1 - y
+        row = z * dy + yy
+        xx = x if row % 2 == 0 else dx - 1 - x
+        return (z, yy, xx)
+
+    return sorted(pts, key=key)
+
+
+def ring_hops(ordered: Sequence[Coord], slice_mesh: Mesh) -> List[int]:
+    """Per-step ICI hop distances of the ring over `ordered` (wrapping
+    back to the first coord), measured on the FULL slice mesh so torus
+    closure counts where the slice wraps."""
+    n = len(ordered)
+    if n < 2:
+        return []
+    return [slice_mesh.distance(ordered[i], ordered[(i + 1) % n])
+            for i in range(n)]
+
+
+def modeled_ring_allreduce_gbps(ordered: Sequence[Coord], slice_mesh: Mesh,
+                                generation: str) -> float:
+    """Hop-count-weighted ring all-reduce bandwidth model: each of the
+    2(n-1) ring steps moves payload/n bytes over that step's hop count
+    serially, so algo bandwidth = link * n / (2(n-1) * mean_hop).
+    Deterministic — the bench A/B's contiguous-vs-fragmented delta is a
+    pure function of the two coordinate sets."""
+    hops = ring_hops(ordered, slice_mesh)
+    if not hops:
+        return 0.0
+    n = len(ordered)
+    mean_hop = sum(hops) / len(hops)
+    link = ICI_LINK_GBPS.get(generation, 50.0)
+    return link * n / (2.0 * (n - 1) * mean_hop)
+
+
+def slice_mesh_for(dims: Tuple[int, int, int], generation: str) -> Mesh:
+    """The full-slice Mesh for declared dims: torus closure on every dim
+    a torus generation meaningfully spans (same rule as mesh.for_slice,
+    but from declared dims rather than a chip count)."""
+    torus = generation in TORUS_GENERATIONS
+    return Mesh(dims=dims, wrap=tuple(torus and d > 2 for d in dims))
+
+
+# ---------------------------------------------------------------------------
+# MeshPlan: the validated, ordered allocation → mesh handoff
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class MeshPlan:
+    """One claim-set's allocation, ordered and costed for mesh
+    construction. ``coords``/``chip_keys`` are in RANK order (snake);
+    ``order[r]`` is the arrival-order index of rank r, so a caller
+    holding per-chip resources in arrival order permutes them with it.
+    Frozen: plans are snapshots, never mutated."""
+
+    generation: str
+    slice_dims: Tuple[int, int, int]
+    coords: Tuple[Coord, ...]
+    chip_keys: Tuple[Tuple[int, int], ...]   # (worker_index, chip_index)
+    order: Tuple[int, ...]
+    contiguous: bool
+    hops: Tuple[int, ...]
+    hop_mean: float
+    hop_max: int
+    modeled_ici_gbps: float
+    n_workers: int = 1
+
+    @property
+    def n_devices(self) -> int:
+        return len(self.coords)
+
+
+def plan_from_coords(coords_by_key: Dict[Tuple[int, int], Coord],
+                     slice_dims: Optional[Tuple[int, int, int]],
+                     generation: str,
+                     n_workers: int = 1) -> MeshPlan:
+    """Validate + order one allocation into a MeshPlan.
+
+    `coords_by_key` maps (worker_index, chip_index) -> global slice
+    coordinate. Refuses duplicate coordinates (two chips cannot share a
+    fabric position), coordinates outside the declared slice topology,
+    and empty allocations. Without declared dims the bounding box
+    serves (a fabric the inventory never declared can still be laid
+    out, just never validated against a larger slice)."""
+    FAULTS.check("mesh.build")
+    if not coords_by_key:
+        MESH_BUILDS.inc(labels={"outcome": "refused"})
+        raise MeshBuildError("empty allocation: no chips to lay out")
+    arrival = sorted(coords_by_key.items())
+    seen: Dict[Coord, Tuple[int, int]] = {}
+    for key, c in arrival:
+        if any(v < 0 for v in c):
+            MESH_BUILDS.inc(labels={"outcome": "refused"})
+            raise MeshBuildError(f"negative coordinate {c} for chip {key}")
+        if c in seen:
+            MESH_BUILDS.inc(labels={"outcome": "refused"})
+            raise MeshBuildError(
+                f"chips {seen[c]} and {key} share coordinate {c}")
+        seen[c] = key
+    if slice_dims is None:
+        lo = tuple(min(c[i] for c in seen) for i in range(3))
+        hi = tuple(max(c[i] for c in seen) for i in range(3))
+        if lo != (0, 0, 0):
+            # Normalize an undeclared fabric to its own origin so the
+            # hop model sees the same block wherever it sits — the
+            # arrival list shifts WITH it, so rank indices keep naming
+            # the same chips.
+            arrival = [(k, (c[0] - lo[0], c[1] - lo[1], c[2] - lo[2]))
+                       for k, c in arrival]
+            seen = {c: k for k, c in arrival}
+            hi = tuple(hi[i] - lo[i] for i in range(3))
+        slice_dims = (hi[0] + 1, hi[1] + 1, hi[2] + 1)
+    else:
+        for c in seen:
+            if any(c[i] >= slice_dims[i] for i in range(3)):
+                MESH_BUILDS.inc(labels={"outcome": "refused"})
+                raise MeshBuildError(
+                    f"coordinate {c} outside declared slice topology "
+                    f"{format_topology(slice_dims)}")
+    mesh = slice_mesh_for(slice_dims, generation)
+    ordered = snake_order(seen)
+    index_of = {c: i for i, (_k, c) in enumerate(arrival)}
+    order = tuple(index_of[c] for c in ordered)
+    chip_keys = tuple(arrival[i][0] for i in order)
+    hops = tuple(ring_hops(ordered, mesh))
+    contiguous = is_contiguous_block(ordered, mesh)
+    plan = MeshPlan(
+        generation=generation,
+        slice_dims=slice_dims,
+        coords=tuple(ordered),
+        chip_keys=chip_keys,
+        order=order,
+        contiguous=contiguous,
+        hops=hops,
+        hop_mean=(sum(hops) / len(hops)) if hops else 0.0,
+        hop_max=max(hops) if hops else 0,
+        modeled_ici_gbps=modeled_ring_allreduce_gbps(ordered, mesh,
+                                                     generation),
+        n_workers=n_workers,
+    )
+    MESH_BUILDS.inc(labels={
+        "outcome": "ok" if contiguous else "fragmented"})
+    return plan
+
+
+def _env_chip_coords(env: Dict[str, str], worker: int
+                     ) -> Dict[Tuple[int, int], Coord]:
+    """Validated {(worker, chip_index): coord} from one claim env: the
+    refusal contract shared by the single- and multi-worker plan paths.
+    Refuses a missing coordinate export (coordinate-less node) and a
+    visible chip with no exported coordinate — each is a rank/topology
+    mismatch, not a chip to guess about."""
+    coords = parse_chip_coords(env.get(ENV_CHIP_COORDS, ""))
+    if not coords:
+        raise MeshBuildError(
+            f"worker {worker} claim env exports no {ENV_CHIP_COORDS}: "
+            "the inventory published no topology (coordinate-less node)")
+    visible = []
+    for tok in (t.strip() for t in
+                env.get("TPU_VISIBLE_CHIPS", "").split(",") if t.strip()):
+        if not tok.isdigit():
+            # A torn env var must not silently drop chips: a filtered
+            # token would build a mesh over a subset of the allocation.
+            raise MeshBuildError(
+                f"worker {worker} has a malformed TPU_VISIBLE_CHIPS "
+                f"entry {tok!r}")
+        visible.append(int(tok))
+    missing = [i for i in visible if i not in coords]
+    if missing:
+        raise MeshBuildError(
+            f"worker {worker} visible chips {missing} have no exported "
+            "coordinate (claim env topology mismatch)")
+    return {(worker, i): coords[i] for i in (visible or sorted(coords))}
+
+
+def plan_from_env(env: Dict[str, str]) -> MeshPlan:
+    """MeshPlan from ONE worker's claim CDI env (the workload
+    container's view): TPU_VISIBLE_CHIPS selects the chips,
+    TPU_CHIP_COORDS places them, TPU_SLICE_TOPOLOGY declares the
+    fabric. Refusals per _env_chip_coords."""
+    worker = int(env.get(ENV_WORKER_INDEX, "0") or 0)
+    dims = parse_topology(env.get(ENV_SLICE_TOPOLOGY, ""))
+    generation = env.get(ENV_GENERATION, "")
+    return plan_from_coords(_env_chip_coords(env, worker), dims, generation)
+
+
+def plan_from_worker_envs(envs: Sequence[Dict[str, str]]) -> MeshPlan:
+    """MeshPlan across a multi-process worker set: each env is one
+    worker's claim CDI env (chip coords are GLOBAL slice coordinates)
+    merged with its cddaemon identity (TPU_WORKER_ID,
+    TPU_WORKER_HOSTNAMES). Refuses non-contiguous worker ids, a peer
+    list whose size disagrees with the env count, conflicting slice
+    topologies, and overlapping coordinates — each is a symptom of
+    workers holding different allocation results, and a mesh built from
+    disagreeing views deadlocks or corrupts at first collective."""
+    if not envs:
+        raise MeshBuildError("no worker envs")
+    ids = []
+    for env in envs:
+        try:
+            ids.append(int(env["TPU_WORKER_ID"]))
+        except (KeyError, ValueError) as e:
+            raise MeshBuildError(
+                "worker env missing a parseable TPU_WORKER_ID") from e
+    if sorted(ids) != list(range(len(envs))):
+        raise MeshBuildError(
+            f"worker ids {sorted(ids)} are not the contiguous range "
+            f"0..{len(envs) - 1} (rank mismatch)")
+    hostnames = {env.get("TPU_WORKER_HOSTNAMES", "") for env in envs}
+    hostnames.discard("")
+    if len(hostnames) > 1:
+        raise MeshBuildError(
+            f"workers disagree on the peer list: {sorted(hostnames)}")
+    if hostnames:
+        n_hosts = len(next(iter(hostnames)).split(","))
+        if n_hosts != len(envs):
+            raise MeshBuildError(
+                f"peer list names {n_hosts} hosts but {len(envs)} "
+                "worker envs were provided (rank/topology mismatch)")
+    dims_seen = {env.get(ENV_SLICE_TOPOLOGY, "") for env in envs}
+    dims_seen.discard("")
+    if len(dims_seen) > 1:
+        raise MeshBuildError(
+            f"workers declare conflicting slice topologies "
+            f"{sorted(dims_seen)}")
+    dims = parse_topology(next(iter(dims_seen))) if dims_seen else None
+    gens_seen = {env.get(ENV_GENERATION, "") for env in envs}
+    gens_seen.discard("")
+    if len(gens_seen) > 1:
+        # One physical slice cannot span generations — disagreement
+        # means divergent allocation views, and picking one would also
+        # pick the wrong ICI_LINK_GBPS for the modeled numbers.
+        raise MeshBuildError(
+            f"workers declare conflicting generations {sorted(gens_seen)}")
+    generation = next(iter(gens_seen)) if gens_seen else ""
+    merged: Dict[Tuple[int, int], Coord] = {}
+    for env in envs:
+        merged.update(_env_chip_coords(env, int(env["TPU_WORKER_ID"])))
+    return plan_from_coords(merged, dims, generation, n_workers=len(envs))
+
+
+def plan_from_allocation(claim: Dict, slices: List[Dict]) -> MeshPlan:
+    """Control-plane adapter: MeshPlan straight from cluster truth (an
+    allocated ResourceClaim + the node's published ResourceSlices),
+    bypassing the CDI env — what the chaos walk and controllers use to
+    ask 'what mesh would this allocation yield?' without a prepare."""
+    from tpu_dra.topology.placement import node_topology_from_slices
+
+    results = (((claim.get("status") or {}).get("allocation") or {})
+               .get("devices") or {}).get("results") or []
+    if not results:
+        raise MeshBuildError("claim has no allocation results")
+    pools = {r.get("pool", "") for r in results}
+    by_node: Dict[str, List[Dict]] = {}
+    for sl in slices:
+        node = (sl.get("spec") or {}).get("nodeName")
+        if node in pools:
+            by_node.setdefault(node, []).append(sl)
+    coords: Dict[Tuple[int, int], Coord] = {}
+    generation = ""
+    dims: Optional[Tuple[int, int, int]] = None
+    for w, pool in enumerate(sorted(pools, key=_natural_name_key)):
+        topo = node_topology_from_slices(by_node.get(pool, []))
+        if topo is None:
+            raise MeshBuildError(
+                f"node {pool} publishes no usable topology")
+        devices = [r.get("device", "") for r in results
+                   if r.get("pool", "") == pool]
+        for i, dev in enumerate(sorted(devices, key=_natural_name_key)):
+            if dev not in topo.coord_of:
+                raise MeshBuildError(
+                    f"allocated device {dev} carries no coordinate on "
+                    f"{pool}")
+            # The real chip index where the name carries one (chip-10
+            # sorts AND keys as 10, matching the arrival-order contract
+            # ordered_devices documents), positional otherwise.
+            _head, _sep, tail = dev.rpartition("-")
+            key = (w, int(tail) if tail.isdigit() else i)
+            if key in coords:
+                raise MeshBuildError(
+                    f"devices on {pool} collide on chip index "
+                    f"{key[1]} ({dev} vs an earlier device)")
+            coords[key] = topo.coord_of[dev]
+        if dims is None:
+            dims = topo.mesh.dims
+        gen = next(((_attr_str(d, "generation") or "")
+                    for sl in by_node.get(pool, [])
+                    for d in (sl.get("spec") or {}).get("devices") or []),
+                   "")
+        generation = generation or gen
+    return plan_from_coords(coords, dims, generation,
+                            n_workers=len(pools))
+
+
+def _attr_str(dev: Dict, name: str) -> Optional[str]:
+    a = (dev.get("attributes") or {}).get(name) or {}
+    return a.get("string")
+
+
+def _natural_name_key(name: str):
+    """Order names with a trailing integer numerically (chip-10 after
+    chip-2, mesh-10 after mesh-2) — lexicographic order would scramble
+    ranks on any node with 10+ chips."""
+    head, sep, tail = name.rpartition("-")
+    if sep and tail.isdigit():
+        return (head, int(tail))
+    return (name, -1)
+
+
+def admit_launch(workload: str) -> None:
+    """Launch-admission seam consulted before a workload runs on a built
+    mesh (``workloads.meshbuild.launch_workload`` and any future
+    launcher). Exists so the ``workload.launch`` failure mode — the
+    launch layer erroring after the mesh is up — is drivable from chaos
+    without importing JAX."""
+    FAULTS.check("workload.launch", workload=workload)

@@ -35,6 +35,7 @@ from tpu_dra.tpuplugin.checkpoint import (
 from tpu_dra.tpuplugin.passthrough import PassthroughManager
 from tpu_dra.tpuplugin.sharing import MultiprocessManager, TimeSlicingManager
 from tpu_dra.topology import mesh as topology_mesh
+from tpu_dra.topology.meshexport import export_topology_env
 
 
 log = logging.getLogger("tpu_dra.tpuplugin")
@@ -754,6 +755,7 @@ class DeviceState:
         uid = claim["metadata"]["uid"]
 
         chip_indices: set = set()
+        claim_chips: Dict[int, Chip] = {}
         subslice_cores: Dict[int, set] = {}
         subslice_hbm_total = 0
         claim_env: Dict[str, str] = {}
@@ -773,6 +775,7 @@ class DeviceState:
             for result in cr.results:
                 dev = self.allocatable[result["device"]]
                 chip_indices.add(dev.chip.index)
+                claim_chips[dev.chip.index] = dev.chip
                 if dev.type == deviceinfo.DEVICE_TYPE_SUBSLICE:
                     ss = dev.subslice
                     subslice_cores.setdefault(dev.chip.index, set()).update(
@@ -820,6 +823,14 @@ class DeviceState:
             claim_env["TPU_HBM_LIMIT_BYTES"] = str(subslice_hbm_total)
 
         claim_env.update(visible_chips_env(sorted(chip_indices)))
+        # Allocation -> mesh handoff (SURVEY §17): export the allocated
+        # chips' torus coordinates + declared slice topology next to
+        # TPU_VISIBLE_CHIPS, so the workload's mesh builder
+        # (workloads.meshbuild) lays ranks over the SAME allocation the
+        # scheduler scored. Empty when the inventory publishes no
+        # topology (coordinate-less nodes keep their exact old env).
+        claim_env.update(export_topology_env(
+            [claim_chips[i] for i in sorted(claim_chips)]))
         # CPU half on THIS thread (json + the cdi.claim_write fault
         # site, so a config/ENOSPC-simulating failure takes the plain
         # apply-error rollback); only the pure-I/O half (tmp write +

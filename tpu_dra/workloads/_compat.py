@@ -1,0 +1,42 @@
+"""JAX API compatibility seam for the workload library.
+
+The workloads target the modern ``jax.shard_map`` entry point (with its
+``check_vma`` flag); older runtimes (< 0.5) only ship
+``jax.experimental.shard_map.shard_map`` whose equivalent flag is
+``check_rep``. One adapter here keeps every workload importable and
+RUNNABLE on both — the data-plane bench gates (hack/perf.sh) execute on
+whatever JAX the container has, so "the collective library needs a
+newer JAX" must never silently read as a driver regression.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` where it exists, else the experimental entry
+    point with ``check_vma`` mapped onto its older ``check_rep`` name
+    (same semantics: per-shard output typing checks, disabled for
+    bodies whose partials carry no varying-axis typing)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check_vma)
+
+
+def pcast_varying(x, axis_name):
+    """Mark `x` device-varying over `axis_name` under whichever
+    varying-axis-typing API this JAX ships: ``jax.lax.pcast`` (0.7+),
+    ``jax.lax.pvary`` (0.5-0.8, deprecated 0.9), or a no-op on
+    pre-typing runtimes (where ``check_rep=False`` bodies never see
+    varying-axis types at all)."""
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is not None:
+        return pcast(x, axis_name, to="varying")
+    pvary = getattr(jax.lax, "pvary", None)
+    if pvary is not None:
+        return pvary(x, axis_name)
+    return x

@@ -22,6 +22,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tpu_dra.workloads._compat import shard_map
+
 
 def device_put_sharded_uniform(nbytes_per_device: int, devices: List
                                ) -> jax.Array:
@@ -136,7 +138,7 @@ def allreduce_bandwidth(nbytes_per_device: int = 64 << 20,
         # concurrently (PJRT CPU) — a last-output fetch alone would let the
         # psums overlap and inflate bandwidth. The 1/n pre-scale keeps the
         # values at ~1.0 across iterations so nothing over/underflows.
-        return jax.shard_map(
+        return shard_map(
             lambda s: jax.lax.psum(s * jnp.asarray(inv_n, s.dtype), "x"),
             mesh=mesh, in_specs=P("x"), out_specs=P("x"))(v)
 

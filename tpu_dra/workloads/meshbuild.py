@@ -1,0 +1,260 @@
+"""Multi-process JAX mesh construction from a driver allocation.
+
+The JAX half of the allocation → mesh contract (SURVEY §17; control-
+plane half: ``tpu_dra.topology.meshexport``). A prepared claim's CDI
+env names the chips, their torus coordinates, and the worker's identity
+(``TPU_WORKER_ID`` / ``TPU_WORKER_HOSTNAMES`` from the cddaemon); the
+:class:`~tpu_dra.topology.meshexport.MeshPlan` built from that env
+fixes a deterministic rank→coordinate order. This module lays actual
+``jax.sharding.Mesh`` axes over JAX devices in THAT order, so every
+workload in ``tpu_dra/workloads`` runs on topology-allocated devices —
+ring steps ride ICI neighbor links — rather than ambient
+``jax.devices()`` in whatever order the runtime enumerated them.
+
+``launch_workload`` is the mesh-parameterized entry point over the
+workload library (allreduce, ringattention, ulysses, moe, pipeline,
+sp_train): small, measured runs returning per-workload bandwidth or
+throughput, used by the bench's data-plane phase and injectable into
+tests. Every launch passes the ``workload.launch`` admission seam and
+every mesh build the ``mesh.build`` one, so both failure modes are
+chaos-drivable.
+
+JAX is imported lazily inside functions: the control plane imports this
+module's siblings without paying for (or requiring) a JAX runtime.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+from tpu_dra.infra.metrics import PSUM_BW
+from tpu_dra.topology.meshexport import (  # noqa: F401  (re-exported API)
+    MeshBuildError, MeshPlan, admit_launch, plan_from_env,
+    plan_from_worker_envs,
+)
+
+
+def ordered_devices(plan: MeshPlan, devices: Sequence) -> List:
+    """Permute `devices` into the plan's rank order. `devices` is the
+    arrival-order device list — one JAX device per allocated chip,
+    aligned with the plan's (worker_index, chip_index)-sorted arrival
+    order (worker-major, chip ascending: the order a multi-process
+    runtime enumerates a slice). Refuses a count mismatch: a mesh over
+    the wrong device count is a rank/topology lie."""
+    if len(devices) != plan.n_devices:
+        raise MeshBuildError(
+            f"allocation plans {plan.n_devices} devices but "
+            f"{len(devices)} JAX devices were supplied")
+    return [devices[i] for i in plan.order]
+
+
+def mesh_from_plan(plan: MeshPlan, devices: Sequence,
+                   axis_names: Sequence[str] = ("x",),
+                   shape: Optional[Sequence[int]] = None):
+    """A ``jax.sharding.Mesh`` whose device order follows the allocated
+    torus coordinates. Default is the 1-D collective mesh; pass
+    `axis_names` + `shape` for N-D layouts (the product must equal the
+    device count — checked, not truncated)."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devs = ordered_devices(plan, devices)
+    if shape is None:
+        shape = (len(devs),) if len(axis_names) == 1 else None
+    if shape is None or len(shape) != len(axis_names):
+        raise MeshBuildError(
+            f"axis_names {tuple(axis_names)} need an explicit shape")
+    n = 1
+    for d in shape:
+        n *= d
+    if n != len(devs):
+        raise MeshBuildError(
+            f"mesh shape {tuple(shape)} holds {n} devices but the "
+            f"allocation has {len(devs)}")
+    return Mesh(np.array(devs).reshape(tuple(shape)), tuple(axis_names))
+
+
+def _sync_scalar(x) -> float:
+    """Fetch one scalar from (possibly nested) output — the only
+    synchronization barrier that holds on every PJRT backend."""
+    import jax
+    leaf = jax.tree.leaves(x)[0]
+    return float(leaf.reshape(-1)[0])
+
+
+def _timed(fn: Callable, *args, iters: int = 2) -> float:
+    """Mean wall seconds per call after one compile+warm call. Every
+    iteration is synchronized by a scalar fetch: the calls share their
+    inputs, so a final-output-only fetch would let independent
+    dispatches overlap on backends that run computations concurrently
+    (PJRT CPU) and inflate the reported rate — the same pitfall
+    allreduce_bandwidth documents and avoids by data-chaining."""
+    _sync_scalar(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        _sync_scalar(fn(*args))
+    return (time.perf_counter() - t0) / iters
+
+
+# ---------------------------------------------------------------------------
+# Per-workload launchers (small, measured; shapes scale with the mesh)
+# ---------------------------------------------------------------------------
+
+def _run_allreduce(plan: MeshPlan, devices: Sequence, **kw) -> Dict:
+    from tpu_dra.workloads.allreduce import allreduce_bandwidth
+
+    r = allreduce_bandwidth(
+        nbytes_per_device=int(kw.get("nbytes_per_device", 1 << 18)),
+        iters=int(kw.get("iters", 4)), warmup=2,
+        devices=ordered_devices(plan, devices))
+    if r["algo_gbps"] > 0:
+        PSUM_BW.observe(r["algo_gbps"])
+    return {"algo_gbps": round(r["algo_gbps"], 3),
+            "bus_gbps": round(r["bus_gbps"], 3),
+            "n_devices": int(r["n_devices"])}
+
+
+def _attention_inputs(n: int, heads: int, s_local: int = 8, b: int = 2,
+                      d: int = 16):
+    import numpy as np
+    import jax.numpy as jnp
+
+    shape = (b, n * s_local, heads, d)
+    return [jnp.asarray(np.random.RandomState(i).standard_normal(shape),
+                        jnp.float32) for i in range(3)], shape
+
+
+def _run_ringattention(plan: MeshPlan, devices: Sequence, **kw) -> Dict:
+    from tpu_dra.workloads.ringattention import make_ring_attention
+
+    mesh = mesh_from_plan(plan, devices, axis_names=("seq",))
+    n = plan.n_devices
+    qkv, shape = _attention_inputs(n, heads=2)
+    fn = make_ring_attention(mesh, axis_name="seq")
+    wall_s = _timed(lambda q, k, v: fn(q, k, v), *qkv,
+                    iters=int(kw.get("iters", 2)))
+    b, s, h, d = shape
+    flops = 4.0 * b * s * s * h * d  # qk^T + att@v, forward
+    return {"wall_ms": round(wall_s * 1e3, 3),
+            "gflops_per_s": round(flops / wall_s / 1e9, 3),
+            "seq": s}
+
+
+def _run_ulysses(plan: MeshPlan, devices: Sequence, **kw) -> Dict:
+    from tpu_dra.workloads.ulysses import make_ulysses_attention
+
+    mesh = mesh_from_plan(plan, devices, axis_names=("seq",))
+    n = plan.n_devices
+    qkv, shape = _attention_inputs(n, heads=n)  # H % axis_size == 0
+    fn = make_ulysses_attention(mesh, axis_name="seq")
+    wall_s = _timed(lambda q, k, v: fn(q, k, v), *qkv,
+                    iters=int(kw.get("iters", 2)))
+    b, s, h, d = shape
+    flops = 4.0 * b * s * s * h * d
+    return {"wall_ms": round(wall_s * 1e3, 3),
+            "gflops_per_s": round(flops / wall_s / 1e9, 3),
+            "seq": s}
+
+
+def _run_moe(plan: MeshPlan, devices: Sequence, **kw) -> Dict:
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_dra.workloads.moe import (
+        init_moe_params, make_expert_parallel_ffn, shard_moe_params,
+    )
+
+    mesh = mesh_from_plan(plan, devices, axis_names=("expert",))
+    n = plan.n_devices
+    d_model, d_ff = 16, 32
+    params = shard_moe_params(
+        init_moe_params(jax.random.PRNGKey(1), d_model, d_ff, n,
+                        dtype=jnp.float32), mesh)
+    x = jnp.asarray(np.random.RandomState(3).standard_normal(
+        (2, 16, d_model)), jnp.float32)
+    fn = make_expert_parallel_ffn(mesh)
+    wall_s = _timed(lambda p, v: fn(p, v)[0], params, x,
+                    iters=int(kw.get("iters", 2)))
+    b, s, _ = x.shape
+    tokens = b * s
+    flops = 2.0 * tokens * d_model * d_ff * 2  # up + down matmuls, fwd
+    return {"wall_ms": round(wall_s * 1e3, 3),
+            "gflops_per_s": round(flops / wall_s / 1e9, 3),
+            "tokens_per_s": round(tokens / wall_s, 1)}
+
+
+def _run_pipeline(plan: MeshPlan, devices: Sequence, **kw) -> Dict:
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_dra.workloads.pipeline import (
+        init_stage_params, make_pipeline_forward, shard_stage_params,
+    )
+
+    mesh = mesh_from_plan(plan, devices, axis_names=("stage",))
+    n = plan.n_devices
+    d = 16
+    weights = shard_stage_params(
+        init_stage_params(jax.random.PRNGKey(2), n, d), mesh)
+    mbs = jnp.asarray(np.random.RandomState(4).standard_normal((6, 2, d)),
+                      jnp.float32)
+    fn = make_pipeline_forward(mesh)
+    wall_s = _timed(lambda w, m: fn(w, m), weights, mbs,
+                    iters=int(kw.get("iters", 2)))
+    return {"wall_ms": round(wall_s * 1e3, 3),
+            "microbatches_per_s": round(mbs.shape[0] / wall_s, 1),
+            "stages": n}
+
+
+def _run_sp_train(plan: MeshPlan, devices: Sequence, **kw) -> Dict:
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_dra.workloads.model import (
+        ModelConfig, TransformerLM, init_params,
+    )
+    from tpu_dra.workloads.sp_train import make_sp_train_step
+
+    mesh = mesh_from_plan(plan, devices, axis_names=("seq",))
+    n = plan.n_devices
+    cfg = ModelConfig(vocab=64, d_model=n * 4, n_heads=n, n_layers=2,
+                      d_ff=64, max_seq=n * 8, dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(11), cfg)
+    tokens = jnp.asarray(
+        np.random.RandomState(12).randint(0, cfg.vocab, (2, cfg.max_seq)),
+        dtype=jnp.int32)
+    step = make_sp_train_step(TransformerLM(cfg), mesh)
+    wall_s = _timed(lambda p, t: step(p, t)[1], params, tokens,
+                    iters=int(kw.get("iters", 2)))
+    tokens_per_step = tokens.shape[0] * (cfg.max_seq - 1)
+    return {"wall_ms": round(wall_s * 1e3, 3),
+            "tokens_per_s": round(tokens_per_step / wall_s, 1),
+            "seq": cfg.max_seq}
+
+
+WORKLOADS: Dict[str, Callable] = {
+    "allreduce": _run_allreduce,
+    "ringattention": _run_ringattention,
+    "ulysses": _run_ulysses,
+    "moe": _run_moe,
+    "pipeline": _run_pipeline,
+    "sp_train": _run_sp_train,
+}
+
+
+def launch_workload(name: str, plan: MeshPlan, devices: Sequence,
+                    **kw) -> Dict:
+    """Run workload `name` on the allocation's mesh and return its
+    metric record ({wall_ms, bandwidth or rate, ...}). Unknown names
+    refuse (a typo must not read as 'workload passed'); the
+    workload.launch admission seam runs first so launch failures are
+    chaos-drivable."""
+    if name not in WORKLOADS:
+        raise MeshBuildError(
+            f"unknown workload {name!r} (known: {sorted(WORKLOADS)})")
+    admit_launch(name)
+    return WORKLOADS[name](plan, devices, **kw)

@@ -25,6 +25,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tpu_dra.workloads._compat import shard_map
+
 
 def init_moe_params(key, d_model: int, d_ff: int, n_experts: int,
                     dtype=jnp.bfloat16) -> Dict:
@@ -123,7 +125,7 @@ def make_expert_parallel_ffn(mesh: Mesh, axis_name: str = "expert",
 
     param_specs = {"router": P(), "w_up": P(axis_name, None, None),
                    "w_down": P(axis_name, None, None)}
-    fn = jax.shard_map(
+    fn = shard_map(
         body, mesh=mesh,
         in_specs=(param_specs, P()),
         out_specs=(P(), P()),

@@ -22,6 +22,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tpu_dra.workloads._compat import shard_map
+
 
 def init_stage_params(key, n_stages: int, d_model: int,
                       dtype=jnp.float32):
@@ -93,7 +95,7 @@ def make_pipeline_forward(mesh: Mesh, axis_name: str = "stage",
         # (every other stage's accumulator is all zeros).
         return jax.lax.psum(outs, axis_name)
 
-    shard = jax.shard_map(
+    shard = shard_map(
         body, mesh=mesh,
         in_specs=(P(axis_name, None, None), P()),
         out_specs=P(),

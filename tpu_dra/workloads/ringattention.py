@@ -27,6 +27,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tpu_dra.workloads._compat import pcast_varying, shard_map
+
 NEG_INF = -1e30
 
 
@@ -128,17 +130,16 @@ def ring_attention(q, k, v, *, axis_name: str, causal: bool = True,
         # agree on varying-axis typing and the real branches' lse is
         # device-varying (zeros_like(qq) already inherits qq's typing).
         return (jnp.zeros_like(qq),
-                jax.lax.pcast(jnp.full((b, h, s_local), NEG_INF,
-                                       jnp.float32),
-                              axis_name, to="varying"))
+                pcast_varying(jnp.full((b, h, s_local), NEG_INF,
+                                       jnp.float32), axis_name))
 
     branches = [future_fn, partial_fn(True), partial_fn(False)]
 
     # pcast to varying: the fresh carries are device-invariant but the
     # loop produces device-varying values; shard_map's typed carries must
-    # agree. (jax.lax.pvary is deprecated as of jax 0.9.)
+    # agree (no-op on pre-typing runtimes — see _compat.pcast_varying).
     def _varying(x):
-        return jax.lax.pcast(x, axis_name, to="varying")
+        return pcast_varying(x, axis_name)
 
     acc_o = _varying(jnp.zeros((b, s_local, h, d), jnp.float32))
     acc_lse = _varying(jnp.full((b, h, s_local), NEG_INF, jnp.float32))
@@ -197,8 +198,8 @@ def make_ring_attention(mesh: Mesh, axis_name: str = "data",
     # check_vma=False: pallas_call results carry no varying-axis typing
     # (their ShapeDtypeStructs would need explicit vma), so the typed-
     # carry check cannot see through the flash per-step partials.
-    fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
-                       out_specs=spec, check_vma=False)
+    fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                   out_specs=spec, check_vma=False)
     return jax.jit(fn, in_shardings=(seq_sharding,) * 3,
                    out_shardings=seq_sharding)
 

@@ -30,6 +30,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tpu_dra.workloads._compat import shard_map
+
 
 def make_sp_train_step(model, mesh: Mesh, lr: float = 1e-3,
                        axis_name: str = "seq"):
@@ -66,7 +68,7 @@ def make_sp_train_step(model, mesh: Mesh, lr: float = 1e-3,
         return (jnp.sum(nll * mask)[None], jnp.sum(mask)[None])
 
     tok_spec = P(None, axis_name)
-    fwd = jax.shard_map(
+    fwd = shard_map(
         body, mesh=mesh,
         in_specs=(P(), tok_spec, tok_spec, tok_spec),
         out_specs=(P(axis_name), P(axis_name)),

@@ -32,6 +32,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tpu_dra.workloads._compat import shard_map
+
 
 def ulysses_attention(q, k, v, *, axis_name: str, causal: bool = True,
                       impl: str = "auto", platform: str = "",
@@ -79,7 +81,7 @@ def make_ulysses_attention(mesh: Mesh, axis_name: str = "seq",
     body = functools.partial(ulysses_attention, axis_name=axis_name,
                              causal=causal, impl=impl, rope=rope,
                              platform="tpu" if on_tpu else "cpu")
-    fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
-                       out_specs=spec, check_vma=False)
+    fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                   out_specs=spec, check_vma=False)
     return jax.jit(fn, in_shardings=(seq_sharding,) * 3,
                    out_shardings=seq_sharding)
