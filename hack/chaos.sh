@@ -11,10 +11,15 @@
 #    informer recovery scenario, the scheduler-churn walk (workers=4:
 #    the multi-worker pool, sharded index and optimistic snapshot
 #    commits run under every schedule, incl. the sched.shard_apply /
-#    sched.snapshot_commit fault sites), and the topology walk
+#    sched.snapshot_commit fault sites), the topology walk
 #    (TopologyAwareScheduling on: every multi-chip allocation an
 #    ICI-contiguous cuboid, topology free-set == the allocation index
-#    after quiesce). Violations exit non-zero.
+#    after quiesce), and the node-death walk (SURVEY §18: node loss +
+#    chip quarantine racing pod churn with sched.evict armed — every
+#    evicted claim ends Allocated-on-live-chips or Pending-with-reason,
+#    never a claim pinned to a dead/quarantined chip; the node walk
+#    additionally asserts quarantine survives crash-restart).
+#    Violations exit non-zero.
 # 2. The @slow chaos soak tests (excluded from tier-1 by -m 'not slow').
 # 3. Witness cross-validation: the acquisition-order edges the whole
 #    matrix + soak observed must be a subset of draracer's static

@@ -7,17 +7,22 @@
 #
 # 1. Interleaving explorer — DPOR-lite systematic exploration of the
 #    scheduler-churn (MULTI-WORKER WorkQueue pool + sharded
-#    AllocationIndex, with a per-key serialization probe) and
-#    batch-prepare (concurrent DeviceState batches) scenarios,
-#    asserting the chaos invariants (no double allocation, index ==
-#    truth, checkpoint/CDI consistency, acyclic lock witness) at EVERY
+#    AllocationIndex, with a per-key serialization probe),
+#    batch-prepare (concurrent DeviceState batches), and evict-churn
+#    (eviction racing the optimistic bind pipeline, SURVEY §18)
+#    scenarios, asserting the chaos invariants (no double allocation,
+#    index == truth, checkpoint/CDI consistency, no claim bound to a
+#    dead device post-eviction, acyclic lock witness) at EVERY
 #    terminal state. The
 #    gate requires >= 200 distinct interleavings total (--min-schedules)
 #    so a silently shrunken scenario cannot go green by exploring
-#    nothing.
-# 2. Crash-point enumerator — 100% of the batch-prepare-crash
-#    scenario's durable ops crashed (clean / all-persisted / torn
-#    variants) with recovery invariants asserted after each restart.
+#    nothing; a SECOND dedicated run holds the evict-churn scenario
+#    ALONE to >= 200 interleavings (the ISSUE 12 acceptance bar).
+# 2. Crash-point enumerator — 100% of the batch-prepare-crash AND
+#    quarantine-crash (chip-quarantine journal ops interleaved with a
+#    claim lifecycle) scenarios' durable ops crashed (clean /
+#    all-persisted / torn variants) with recovery invariants asserted
+#    after each restart.
 #
 # Any invariant violation fails with the schedule trace (or crash
 # point) printed; replay the trace with:
@@ -34,5 +39,10 @@ echo ">> drmc: interleaving exploration + crash-point enumeration"
 JAX_PLATFORMS=cpu python -m tpu_dra.analysis.drmc \
   --budget "$BUDGET" --min-schedules 200 --min-crash-points 30 \
   --deadline 180 "$@"
+
+echo ">> drmc: evict-churn dedicated floor (>= 200 interleavings)"
+JAX_PLATFORMS=cpu python -m tpu_dra.analysis.drmc \
+  --scenario evict-churn --budget 250 --min-schedules 200 \
+  --deadline 120 --skip-crash
 
 echo ">> drmc tier green"

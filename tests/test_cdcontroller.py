@@ -132,10 +132,18 @@ class TestReadiness:
         self._register_nodes(cluster, cd, ready=2)
         assert cluster.wait_for(lambda: (get_cd(cluster).get("status") or {})
                                 .get("status") == "Ready")
-        # Drop below numNodes -> NotReady
+        # Drop below numNodes: a previously-Ready domain DEGRADES (with
+        # the why recorded), it does not read as never-started.
         self._register_nodes(cluster, cd, ready=1, registered=2)
         assert cluster.wait_for(lambda: get_cd(cluster)["status"]["status"]
-                                == "NotReady")
+                                == "Degraded")
+        assert "1/2 members ready" in \
+            get_cd(cluster)["status"]["statusReason"]
+        # Recovery republishes cleanly: Ready again, reason gone.
+        self._register_nodes(cluster, cd, ready=2)
+        assert cluster.wait_for(lambda: get_cd(cluster)["status"]["status"]
+                                == "Ready")
+        assert "statusReason" not in get_cd(cluster)["status"]
 
     def test_numnodes_zero_follows_scheduled(self, harness):
         cluster = harness["cluster"]
@@ -146,11 +154,11 @@ class TestReadiness:
         assert cluster.wait_for(
             lambda: (get_cd(cluster, "cd-z").get("status") or {})
             .get("status") == "Ready")
-        # A registered-but-not-ready node drops the open-ended CD to
-        # NotReady (every registered daemon must be ready).
+        # A registered-but-not-ready node degrades the previously-Ready
+        # open-ended CD (every registered daemon must be ready).
         self._register_nodes(cluster, cd, ready=2, registered=3, name="cd-z")
         assert cluster.wait_for(
-            lambda: get_cd(cluster, "cd-z")["status"]["status"] == "NotReady")
+            lambda: get_cd(cluster, "cd-z")["status"]["status"] == "Degraded")
 
     def test_numnodes_zero_scheduled_lower_bound(self, harness):
         """A daemon pod scheduled but not yet registered (image pull in
@@ -271,7 +279,108 @@ class TestPodDeletion:
             nodes = (get_cd(cluster).get("status") or {}).get("nodes") or []
             return [n["name"] for n in nodes] == ["node-a"]
         assert cluster.wait_for(node_b_gone)
-        assert get_cd(cluster)["status"]["status"] == "NotReady"
+        # Slice loss mid-job: Ready -> Degraded with the member named —
+        # never a CD stuck Ready with a dead member, never an anonymous
+        # NotReady (SURVEY §18).
+        status = get_cd(cluster)["status"]
+        assert status["status"] == "Degraded"
+        assert "node-b" in status["statusReason"]
+
+    def test_member_loss_fault_retries_until_recorded(self, harness):
+        """cd.member_loss firing on the first attempt must not leave the
+        CD Ready with a dead member: the keyed queue item retries."""
+        from tpu_dra.infra.faults import FAULTS, OneShot
+
+        cluster = harness["cluster"]
+        cd = make_cd(cluster, name="cd-f", num_nodes=2, rct_name="rct-f")
+        uid = cd["metadata"]["uid"]
+        fresh = get_cd(cluster, "cd-f")
+        fresh["status"] = {"status": "Ready", "nodes": [
+            {"name": "node-a", "ipAddress": "10.0.0.1", "sliceID": "s0",
+             "index": 0, "status": "Ready"},
+            {"name": "node-b", "ipAddress": "10.0.0.2", "sliceID": "s0",
+             "index": 1, "status": "Ready"},
+        ]}
+        cluster.update_status(COMPUTEDOMAINS, fresh)
+        cluster.create(PODS, {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "daemon-f", "namespace": NS,
+                         "labels": {LABEL: uid}},
+            "status": {"podIP": "10.0.0.2"},
+        })
+        assert cluster.wait_for(lambda: _exists(cluster, PODS, "daemon-f", NS))
+        with FAULTS.armed("cd.member_loss", OneShot()):
+            cluster.delete(PODS, "daemon-f", NS)
+            assert cluster.wait_for(
+                lambda: get_cd(cluster, "cd-f")["status"]["status"]
+                == "Degraded", timeout=10), \
+                "member loss not recorded past the injected fault"
+        nodes = get_cd(cluster, "cd-f")["status"]["nodes"]
+        assert [n["name"] for n in nodes] == ["node-a"]
+
+    def test_growth_settle_is_not_degraded(self):
+        """A Ready open-ended CD gaining an all-ready member re-arms the
+        settle window — that is GROWTH, not loss: the hold must read
+        NotReady (the pre-§18 behavior), never Degraded, and must not
+        bump the regression counter."""
+        import time as _time
+
+        from tpu_dra.cdcontroller.controller import degraded_total
+
+        cluster = FakeCluster()
+        controller = Controller(cluster, namespace=NS, image="img:test",
+                                gc_interval=3600.0,
+                                open_ready_settle_s=0.5)
+        controller.start()
+        try:
+            cd = make_cd(cluster, name="cd-g", num_nodes=0,
+                         rct_name="rct-g")
+            assert cluster.wait_for(lambda: _exists(
+                cluster, DAEMONSETS, daemon_object_name(cd), NS))
+
+            def register(n_ready):
+                fresh = get_cd(cluster, "cd-g")
+                fresh.setdefault("status", {})["nodes"] = [
+                    {"name": f"node-{i}", "ipAddress": f"10.0.0.{i}",
+                     "sliceID": "s0", "index": i, "status": "Ready"}
+                    for i in range(n_ready)]
+                cluster.update_status(COMPUTEDOMAINS, fresh)
+
+            register(2)
+            assert cluster.wait_for(
+                lambda: (get_cd(cluster, "cd-g").get("status") or {})
+                .get("status") == "Ready", timeout=5.0)
+            before = degraded_total.value()
+            # Growth: a third all-ready member joins.
+            register(3)
+            deadline = _time.monotonic() + 0.4
+            while _time.monotonic() < deadline:
+                assert (get_cd(cluster, "cd-g").get("status") or {}).get(
+                    "status") != "Degraded", \
+                    "growth misread as member loss"
+                _time.sleep(0.05)
+            assert cluster.wait_for(
+                lambda: get_cd(cluster, "cd-g")["status"]["status"]
+                == "Ready", timeout=5.0)
+            assert degraded_total.value() == before
+        finally:
+            controller.stop()
+
+    def test_never_ready_cd_stays_not_ready(self, harness):
+        """Degraded is a REGRESSION state: a domain that never reached
+        Ready keeps reading NotReady when members churn."""
+        cluster = harness["cluster"]
+        cd = make_cd(cluster, name="cd-n", num_nodes=2, rct_name="rct-n")
+        assert cluster.wait_for(lambda: _exists(
+            cluster, DAEMONSETS, daemon_object_name(cd), NS))
+        fresh = get_cd(cluster, "cd-n")
+        fresh["status"] = {"status": "NotReady", "nodes": [
+            {"name": "node-a", "ipAddress": "10.0.0.1", "sliceID": "s0",
+             "index": 0, "status": "Ready"}]}
+        cluster.update_status(COMPUTEDOMAINS, fresh)
+        import time as _time
+        _time.sleep(0.3)
+        assert get_cd(cluster, "cd-n")["status"]["status"] == "NotReady"
 
 
 class TestTeardown:

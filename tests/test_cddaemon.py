@@ -487,6 +487,104 @@ class TestDaemonRunner:
         assert nodes == []
 
 
+class TestMemberLossSettle:
+    """Slice-loss handling on the daemon side (SURVEY §18): a dying
+    slice's burst of member removals coalesces into one reconfigure,
+    and a failed member-loss update retries instead of waiting for a
+    nudge from a peer that is never coming back."""
+
+    def _runner(self, tmp_path, monkeypatch):
+        from types import SimpleNamespace
+
+        monkeypatch.setenv("TPU_DRA_TPUINFO_BACKEND", "fake")
+        monkeypatch.setenv("TPU_DRA_FAKE_SLICE_ID", "slice-A")
+        cluster = FakeCluster()
+        cd = make_cd(cluster)
+        ns = flags().parse([
+            "--cd-uid", cd["metadata"]["uid"],
+            "--cd-name", "cd-1", "--cd-namespace", "user-ns",
+            "--node-name", "node-a", "--pod-ip", "10.0.0.1",
+            "--port", str(free_port()),
+            "--work-dir", str(tmp_path / "work"),
+            "--hosts-file", str(tmp_path / "hosts"),
+            "--daemon-binary", "/nonexistent/daemon",
+        ])
+        runner = DaemonRunner(cluster, ns)
+        os.makedirs(str(tmp_path / "work"), exist_ok=True)
+        signals = []
+        runner.process = SimpleNamespace(
+            signal=lambda sig: signals.append(sig),
+            restart=lambda: signals.append("restart"))
+        return runner, signals
+
+    @staticmethod
+    def _members(n):
+        return tuple((f"node-{i}", f"10.0.0.{i}", "slice-A", i)
+                     for i in range(n))
+
+    def test_shrink_burst_coalesces_to_one_reconfigure(
+            self, tmp_path, monkeypatch):
+        import threading
+
+        runner, signals = self._runner(tmp_path, monkeypatch)
+        runner.MEMBER_LOSS_SETTLE_S = 0.15
+        t = threading.Thread(target=runner._update_loop, daemon=True)
+        t.start()
+        try:
+            runner.cd.updates.put_nowait(self._members(4))
+            deadline = time.monotonic() + 5
+            while not signals and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert len(signals) == 1, "initial membership reconfigure"
+            # The burst: 4 -> 3 -> 1 in quick succession (latest-wins
+            # queue + the settle drain must fold it into ONE signal).
+            runner.cd._on_change({"status": {"nodes": [
+                {"name": n, "ipAddress": ip, "sliceID": s, "index": i}
+                for n, ip, s, i in self._members(3)]}})
+            runner.cd._on_change({"status": {"nodes": [
+                {"name": n, "ipAddress": ip, "sliceID": s, "index": i}
+                for n, ip, s, i in self._members(1)]}})
+            deadline = time.monotonic() + 5
+            while len(signals) < 2 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            time.sleep(0.3)  # would catch a second burst signal
+            assert len(signals) == 2, \
+                f"shrink burst must coalesce to one reconfigure: {signals}"
+            hosts = open(str(tmp_path / "hosts")).read()
+            assert stable_name(0) in hosts
+            assert stable_name(3) not in hosts
+        finally:
+            runner._stop.set()
+            t.join(3)
+
+    def test_member_loss_fault_retries(self, tmp_path, monkeypatch):
+        import threading
+
+        from tpu_dra.infra.faults import FAULTS, OneShot
+
+        runner, signals = self._runner(tmp_path, monkeypatch)
+        runner.MEMBER_LOSS_SETTLE_S = 0.05
+        t = threading.Thread(target=runner._update_loop, daemon=True)
+        t.start()
+        try:
+            runner.cd.updates.put_nowait(self._members(3))
+            deadline = time.monotonic() + 5
+            while not signals and time.monotonic() < deadline:
+                time.sleep(0.01)
+            with FAULTS.armed("cd.member_loss", OneShot()):
+                runner.cd.updates.put_nowait(self._members(1))
+                deadline = time.monotonic() + 5
+                while len(signals) < 2 and time.monotonic() < deadline:
+                    time.sleep(0.01)
+            assert len(signals) >= 2, \
+                "member-loss update not retried past the injected fault"
+            hosts = open(str(tmp_path / "hosts")).read()
+            assert stable_name(2) not in hosts
+        finally:
+            runner._stop.set()
+            t.join(3)
+
+
 class TestDriverVersionGate:
     def test_version_parse_and_compare(self):
         from tpu_dra.cddaemon.main import dns_names_supported, parse_driver_version

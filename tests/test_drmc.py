@@ -165,6 +165,15 @@ class TestGateScenarios:
         assert report.distinct >= 25
         assert report.violation is None
 
+    def test_evict_churn_explores_clean(self):
+        """Evict-vs-prepare/commit interleavings (SURVEY §18): every
+        explored ordering ends with index == truth, no double
+        allocation, and no claim bound to the dead device."""
+        report = drmc_explore.explore(
+            drmc_scenarios.EvictChurnScenario(), budget=60)
+        assert report.distinct == 60           # rich frontier
+        assert report.violation is None
+
     def test_metrics_are_bumped(self):
         from tpu_dra.infra.metrics import DRMC_SCHEDULES
         before = DRMC_SCHEDULES.value(labels={"scenario": "counter"})
@@ -364,6 +373,20 @@ class TestCrashMatrices:
         for probe in ("pwrite", "fdatasync", "write_text", "replace",
                       "unlink", "flock"):
             assert probe in kinds, f"no {probe} op enumerated: {kinds}"
+        assert report.violations == [], "\n".join(report.violations)
+
+    def test_quarantine_crash_full_matrix(self):
+        """ISSUE 12 acceptance: 100% crash-point coverage over the
+        quarantine journal ops — graduation, operator clear, and the
+        claim lifecycle sharing the journal — with externalized
+        transitions durable and the faultless replay converging."""
+        report = drmc_crash.enumerate_crashes(
+            drmc_scenarios.QuarantineCrashScenario())
+        assert report.points_run == report.points_enumerated
+        assert report.coverage == 1.0
+        assert report.points_enumerated >= 30
+        kinds = " ".join(report.ops)
+        assert "pwrite" in kinds and "fdatasync" in kinds
         assert report.violations == [], "\n".join(report.violations)
 
     def test_crashpoint_escapes_except_exception(self):

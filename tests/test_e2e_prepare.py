@@ -672,23 +672,46 @@ class TestHealthMonitorLifecycle:
                 release.wait(30)  # ignores the timeout: wedged driver
                 return None
 
+        from tpu_dra.tpuplugin.health import wedged_gauge
+
         mon = DeviceHealthMonitor(WedgedBackend(), lambda e: None)
         mon.start()
         try:
+            assert wedged_gauge.value() == 0.0
             mon.stop()
             assert mon.wedged is True
+            # The wedge is exported (tpu_dra_health_monitor_wedged), not
+            # just a bare attribute: dashboards can now tell a dead
+            # health pipeline from a quiet one.
+            assert wedged_gauge.value() == 1.0
         finally:
             release.set()
             mon._thread.join(2)
+            wedged_gauge.set(0)  # don't leak the trip into other tests
 
     def test_clean_stop_is_not_wedged(self):
-        from tpu_dra.tpuplugin.health import DeviceHealthMonitor
+        from tpu_dra.tpuplugin.health import DeviceHealthMonitor, wedged_gauge
 
         backend = FakeBackend(default_fake_chips(2, "v5e"))
         mon = DeviceHealthMonitor(backend, lambda e: None)
         mon.start()
         mon.stop()
         assert mon.wedged is False
+        assert wedged_gauge.value() == 0.0
+
+    def test_restart_clears_wedged_gauge(self):
+        """A replacement monitor coming up healthy must clear the
+        tripwire — the gauge reports the CURRENT pipeline."""
+        from tpu_dra.tpuplugin.health import DeviceHealthMonitor, wedged_gauge
+
+        wedged_gauge.set(1)  # predecessor tripped it
+        backend = FakeBackend(default_fake_chips(2, "v5e"))
+        mon = DeviceHealthMonitor(backend, lambda e: None)
+        mon.start()
+        try:
+            assert wedged_gauge.value() == 0.0
+        finally:
+            mon.stop()
 
     def test_fault_site_injects_synthetic_event(self):
         """health.chip_event payloads flow through the real monitor loop

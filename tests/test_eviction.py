@@ -1,0 +1,210 @@
+"""Failure-domain eviction (SURVEY §18): claims whose allocated chips
+died are released through the real deallocation pipeline and their pods
+re-driven — Allocated on surviving capacity, or Pending-with-reason
+when nothing fits. Never a claim pinned to a dead chip, never a silent
+hang, never a direct index edit.
+"""
+
+import pytest
+
+from tpu_dra.infra.faults import FAULTS, OneShot
+from tpu_dra.infra.metrics import SCHED_EVICTIONS
+from tpu_dra.k8s import FakeCluster, NODES, PODS, RESOURCECLAIMS
+from tpu_dra.k8s.resources import RESOURCESLICES
+from tpu_dra.simcluster.chaos import chip_conflicts
+from tpu_dra.simcluster.scheduler import Scheduler, claim_entries
+from tpu_dra.testing import make_sched_pod, seed_sched_inventory
+
+
+def make_cluster(nodes=2, chips=2):
+    c = FakeCluster()
+    seed_sched_inventory(c, nodes=nodes, chips_per_node=chips)
+    return c
+
+
+@pytest.fixture
+def sched_cluster():
+    c = make_cluster()
+    s = Scheduler(c, resync_interval=0.1, gc_sweep_interval=3600.0)
+    s.start()
+    yield c, s
+    s.stop()
+
+
+def bound_node(c, pod_name):
+    return c.get(PODS, pod_name, "default")["spec"].get("nodeName")
+
+
+def pod_claim(c, pod_name):
+    for claim in c.list(RESOURCECLAIMS, namespace="default"):
+        owner = (claim["metadata"].get("annotations") or {}).get(
+            "sim/owner-pod")
+        if owner == pod_name:
+            return claim
+    return None
+
+
+def shrink_slice(c, node, dead_devices):
+    """The driver-quarantine republish analog: the node's ResourceSlice
+    loses the dead devices."""
+    for sl in c.list(RESOURCESLICES):
+        if (sl.get("spec") or {}).get("nodeName") != node:
+            continue
+        sl["spec"]["devices"] = [
+            d for d in sl["spec"].get("devices", [])
+            if d["name"] not in dead_devices]
+        c.update(RESOURCESLICES, sl)
+
+
+def kill_node(c, node, *, keep_slice=False):
+    c.delete(NODES, node, None)
+    if keep_slice:
+        return
+    for sl in list(c.list(RESOURCESLICES)):
+        if (sl.get("spec") or {}).get("nodeName") == node:
+            c.delete(RESOURCESLICES, sl["metadata"]["name"], None)
+
+
+def add_node(c, name, chips=2, generation="v5p"):
+    """Re-provision a node + its ResourceSlice (the shape
+    seed_sched_inventory stamps, without re-creating the class/template
+    singletons)."""
+    from tpu_dra.native.tpuinfo import default_fake_chips
+
+    chip_objs = default_fake_chips(chips, generation,
+                                   slice_id=f"ici-{name}")
+    c.create(NODES, {"apiVersion": "v1", "kind": "Node",
+                     "metadata": {"name": name, "labels": {}}})
+    c.create(RESOURCESLICES, {
+        "apiVersion": "resource.k8s.io/v1", "kind": "ResourceSlice",
+        "metadata": {"name": f"{name}-tpu.dev"},
+        "spec": {"driver": "tpu.dev", "nodeName": name,
+                 "pool": {"name": name, "generation": 1},
+                 "devices": [{"name": f"chip-{ch.index}", "attributes": {
+                     "type": {"string": "chip"},
+                     "generation": {"string": generation},
+                     "coordX": {"int": ch.coords[0]},
+                     "coordY": {"int": ch.coords[1]},
+                     "coordZ": {"int": ch.coords[2]},
+                     "sliceTopology": {"string": ch.slice_topology},
+                     "sliceID": {"string": ch.slice_id},
+                     "workerIndex": {"int": ch.worker_index}}}
+                     for ch in chip_objs]}})
+
+
+def sched_condition(c, pod_name):
+    pod = c.get(PODS, pod_name, "default")
+    for cond in (pod.get("status") or {}).get("conditions") or []:
+        if cond.get("type") == "PodScheduled":
+            return cond
+    return None
+
+
+class TestChipLossEviction:
+    def test_quarantined_chip_evicts_and_reallocates(self, sched_cluster):
+        c, s = sched_cluster
+        make_sched_pod(c, "p0")
+        assert c.wait_for(lambda: bound_node(c, "p0"), timeout=5)
+        node = bound_node(c, "p0")
+        dead = {e[2] for e in claim_entries(pod_claim(c, "p0"))}
+        before = SCHED_EVICTIONS.value(labels={"reason": "device_lost"})
+
+        shrink_slice(c, node, dead)
+        # The claim must end Allocated on LIVE devices (same node's
+        # surviving chip or the sibling node), the pod re-bound.
+        def recovered():
+            claim = pod_claim(c, "p0")
+            entries = claim_entries(claim) if claim else ()
+            if not entries:
+                return False
+            published = {d["name"] for sl in c.list(RESOURCESLICES)
+                         if (sl["spec"].get("nodeName")
+                             == entries[0][1])
+                         for d in sl["spec"].get("devices", [])}
+            return (all(e[2] in published for e in entries)
+                    and bound_node(c, "p0") == entries[0][1])
+        assert c.wait_for(recovered, timeout=10), \
+            "claim not re-allocated onto live chips after device loss"
+        assert SCHED_EVICTIONS.value(
+            labels={"reason": "device_lost"}) > before
+        claim = pod_claim(c, "p0")
+        assert "evicted" not in (claim.get("status") or {})
+        assert chip_conflicts(
+            c.list(RESOURCECLAIMS, namespace="default")) == []
+        assert s.verify_index() == []
+
+    def test_evict_fault_retries_to_convergence(self, sched_cluster):
+        c, s = sched_cluster
+        make_sched_pod(c, "p0")
+        assert c.wait_for(lambda: bound_node(c, "p0"), timeout=5)
+        node = bound_node(c, "p0")
+        dead = {e[2] for e in claim_entries(pod_claim(c, "p0"))}
+        with FAULTS.armed("sched.evict", OneShot()):
+            shrink_slice(c, node, dead)
+            assert c.wait_for(
+                lambda: not any(
+                    e[2] in dead
+                    for e in claim_entries(pod_claim(c, "p0") or {})),
+                timeout=10), \
+                "eviction did not retry past the injected fault"
+        assert s.verify_index() == []
+
+
+class TestNodeLossEviction:
+    def test_node_death_reallocates_on_survivor(self, sched_cluster):
+        c, s = sched_cluster
+        make_sched_pod(c, "p0")
+        assert c.wait_for(lambda: bound_node(c, "p0"), timeout=5)
+        node = bound_node(c, "p0")
+        before = SCHED_EVICTIONS.value(labels={"reason": "node_lost"})
+
+        # Node object gone, slice left behind (kubelet died; the slice
+        # GC lags) — the scan must treat the POOL as dead regardless.
+        kill_node(c, node, keep_slice=True)
+        assert c.wait_for(
+            lambda: bound_node(c, "p0") not in (node, None, ""),
+            timeout=10), "pod not re-bound on the surviving node"
+        entries = claim_entries(pod_claim(c, "p0"))
+        assert entries and all(e[1] != node for e in entries)
+        assert SCHED_EVICTIONS.value(
+            labels={"reason": "node_lost"}) > before
+        assert s.verify_index() == []
+
+    def test_no_capacity_pending_with_reason_then_recovery(self):
+        c = make_cluster(nodes=1, chips=2)
+        s = Scheduler(c, resync_interval=0.1, gc_sweep_interval=3600.0)
+        s.start()
+        try:
+            make_sched_pod(c, "p0")
+            assert c.wait_for(lambda: bound_node(c, "p0"), timeout=5)
+            kill_node(c, "n0")
+            # No surviving capacity: the claim ends unallocated with the
+            # eviction recorded, the pod Pending with a reason — the
+            # clean refusal, not a wedge and not a silent hang.
+            assert c.wait_for(
+                lambda: not claim_entries(pod_claim(c, "p0") or {}),
+                timeout=10)
+            claim = pod_claim(c, "p0")
+            assert (claim["status"].get("evicted") or {}).get(
+                "reason") == "node_lost"
+            assert c.wait_for(lambda: not bound_node(c, "p0"), timeout=5)
+            assert c.wait_for(
+                lambda: (sched_condition(c, "p0") or {}).get(
+                    "status") == "False", timeout=10), \
+                "pending pod carries no PodScheduled=False reason"
+            cond = sched_condition(c, "p0")
+            assert cond["reason"] in ("Evicted", "Unschedulable")
+
+            # The node comes back: the pod re-binds and the stale
+            # reason flips — recovery republishes cleanly.
+            add_node(c, "n-new0")
+            assert c.wait_for(
+                lambda: bound_node(c, "p0") == "n-new0", timeout=10)
+            assert c.wait_for(
+                lambda: (sched_condition(c, "p0") or {}).get(
+                    "status") == "True", timeout=5)
+            claim = pod_claim(c, "p0")
+            assert "evicted" not in (claim.get("status") or {})
+            assert s.verify_index() == []
+        finally:
+            s.stop()

@@ -1,0 +1,336 @@
+"""Chip quarantine ladder (SURVEY §18): flap counting, graduation,
+checkpoint-journal persistence across restarts, operator/TTL clears,
+and the recovery-event hold that stops flap ping-pong.
+"""
+
+import os
+import time
+
+import pytest
+
+from tpu_dra.api.types import TPU_DRIVER_NAME
+from tpu_dra.cdi.handler import CDIHandler
+from tpu_dra.infra.faults import FAULTS, Always
+from tpu_dra.native.tpuinfo import FakeBackend, HealthEvent, default_fake_chips
+from tpu_dra.tpuplugin.checkpoint import CheckpointManager
+from tpu_dra.tpuplugin.device_state import (
+    DeviceState, quarantined_chips_gauge,
+)
+from tpu_dra.tpuplugin.health import RECOVERED_KIND
+
+
+def make_state(tmp, *, threshold=2, window=60.0, ttl=0.0, chips=4):
+    backend = FakeBackend(default_fake_chips(chips, "v5p", slice_id="q"))
+    return DeviceState(
+        backend=backend,
+        cdi=CDIHandler(os.path.join(tmp, "cdi"),
+                       driver_root=os.path.join(tmp, "drv")),
+        checkpoints=CheckpointManager(os.path.join(tmp, "plugin")),
+        driver_name=TPU_DRIVER_NAME, node_name="q-node",
+        quarantine_threshold=threshold, quarantine_window_s=window,
+        quarantine_ttl_s=ttl)
+
+
+def flap(state, chip=0):
+    """One full flap: unhealthy then recovered (the transition is what
+    the ladder counts)."""
+    state.mark_unhealthy(chip)
+    state.mark_healthy(chip)
+
+
+def chip_uuid(state, chip=0):
+    return state.backend.get_chip(chip).uuid
+
+
+def published_chip_indices(state):
+    return {int(d["name"].split("-")[1]) for d in state.healthy_devices()
+            if d["attributes"]["type"]["string"] == "chip"}
+
+
+class TestLadder:
+    def test_below_threshold_stays_transient(self, tmp_path):
+        state = make_state(str(tmp_path), threshold=3)
+        try:
+            flap(state, 0)
+            assert state.quarantined_chips() == {}
+            # Transient unhealthy still re-admits on recovery.
+            state.mark_unhealthy(0)
+            assert 0 not in published_chip_indices(state)
+            assert state.mark_healthy(0)
+            assert 0 in published_chip_indices(state)
+        finally:
+            state.close()
+
+    def test_threshold_graduates_to_quarantine(self, tmp_path):
+        state = make_state(str(tmp_path), threshold=2)
+        try:
+            flap(state, 0)
+            state.mark_unhealthy(0)  # second flap: graduates
+            q = state.quarantined_chips()
+            assert chip_uuid(state, 0) in q
+            assert q[chip_uuid(state, 0)]["chip_index"] == 0
+            assert "flaps" in q[chip_uuid(state, 0)]["reason"]
+            assert quarantined_chips_gauge.value() == 1.0
+            assert 0 not in published_chip_indices(state)
+        finally:
+            state.close()
+
+    def test_recovery_does_not_readmit_quarantined(self, tmp_path):
+        """The ping-pong hold: the very recovery events that make a chip
+        a flapper must not re-admit it once quarantined."""
+        state = make_state(str(tmp_path), threshold=2)
+        try:
+            flap(state, 0)
+            state.mark_unhealthy(0)
+            assert state.mark_healthy(0) == []  # no devices re-admitted
+            assert 0 not in published_chip_indices(state)
+            assert chip_uuid(state, 0) in state.quarantined_chips()
+        finally:
+            state.close()
+
+    def test_window_expires_old_flaps(self, tmp_path):
+        state = make_state(str(tmp_path), threshold=2, window=0.05)
+        try:
+            flap(state, 0)
+            time.sleep(0.08)  # first flap ages out of the window
+            state.mark_unhealthy(0)
+            assert state.quarantined_chips() == {}
+        finally:
+            state.close()
+
+    def test_other_chips_unaffected(self, tmp_path):
+        state = make_state(str(tmp_path), threshold=2)
+        try:
+            flap(state, 1)
+            state.mark_unhealthy(1)
+            assert published_chip_indices(state) == {0, 2, 3}
+        finally:
+            state.close()
+
+
+class TestPersistence:
+    def test_quarantine_survives_restart(self, tmp_path):
+        state = make_state(str(tmp_path), threshold=2)
+        flap(state, 0)
+        state.mark_unhealthy(0)
+        uuid = chip_uuid(state, 0)
+        assert uuid in state.quarantined_chips()
+        state.close()  # SIGKILL analog: no terminal store
+
+        state2 = make_state(str(tmp_path), threshold=2)
+        try:
+            assert uuid in state2.quarantined_chips()
+            assert 0 not in published_chip_indices(state2)
+        finally:
+            state2.close()
+
+    def test_clear_survives_restart(self, tmp_path):
+        state = make_state(str(tmp_path), threshold=2)
+        flap(state, 0)
+        state.mark_unhealthy(0)
+        readmitted = state.clear_quarantine(0)
+        assert any("chip-0" in name for name in readmitted)
+        assert state.quarantined_chips() == {}
+        # Fresh start: cleared chips are fully healthy again.
+        assert 0 in published_chip_indices(state)
+        state.close()
+
+        state2 = make_state(str(tmp_path), threshold=2)
+        try:
+            assert state2.quarantined_chips() == {}
+            assert 0 in published_chip_indices(state2)
+        finally:
+            state2.close()
+
+    def test_replaced_chip_record_pruned(self, tmp_path):
+        """A quarantine record whose uuid is no longer on the node (chip
+        physically replaced) must not haunt the replacement hardware."""
+        state = make_state(str(tmp_path), threshold=1)
+        state.mark_unhealthy(0)
+        assert state.quarantined_chips()
+        state.close()
+
+        # A different generation mints different chip uuids — the
+        # "replacement hardware" whose health record must start fresh.
+        backend = FakeBackend(default_fake_chips(4, "v5e", slice_id="q2"))
+        state2 = DeviceState(
+            backend=backend,
+            cdi=CDIHandler(os.path.join(str(tmp_path), "cdi"),
+                           driver_root=os.path.join(str(tmp_path), "drv")),
+            checkpoints=CheckpointManager(
+                os.path.join(str(tmp_path), "plugin")),
+            driver_name=TPU_DRIVER_NAME, node_name="q-node",
+            quarantine_threshold=1)
+        try:
+            assert state2.quarantined_chips() == {}
+        finally:
+            state2.close()
+
+
+class TestClears:
+    def test_ttl_expiry_readmits_at_publish(self, tmp_path):
+        state = make_state(str(tmp_path), threshold=2, ttl=0.05)
+        try:
+            flap(state, 0)
+            state.mark_unhealthy(0)
+            assert 0 not in published_chip_indices(state)
+            time.sleep(0.08)
+            assert 0 in published_chip_indices(state)  # TTL lifted
+            assert state.quarantined_chips() == {}
+        finally:
+            state.close()
+
+    def test_clear_all(self, tmp_path):
+        state = make_state(str(tmp_path), threshold=1)
+        try:
+            state.mark_unhealthy(0)
+            state.mark_unhealthy(1)
+            assert len(state.quarantined_chips()) == 2
+            state.clear_quarantine()
+            assert state.quarantined_chips() == {}
+            assert published_chip_indices(state) == {0, 1, 2, 3}
+        finally:
+            state.close()
+
+    def test_clear_unknown_chip_is_noop(self, tmp_path):
+        state = make_state(str(tmp_path), threshold=1)
+        try:
+            state.mark_unhealthy(0)
+            assert state.clear_quarantine(99) == []
+            assert state.quarantined_chips()
+        finally:
+            state.close()
+
+
+class TestFlapFaultSite:
+    def test_persistence_failure_degrades_and_retries(self, tmp_path):
+        """health.flap firing at graduation must leave the chip
+        transient-unhealthy (still excluded), NOT half-quarantined; the
+        next flap retries and succeeds once the fault clears."""
+        state = make_state(str(tmp_path), threshold=2)
+        try:
+            flap(state, 0)
+            with FAULTS.armed("health.flap", Always()):
+                state.mark_unhealthy(0)  # graduation refused
+            assert state.quarantined_chips() == {}
+            assert 0 not in published_chip_indices(state)  # transient
+            # Transient means recovery still re-admits.
+            assert state.mark_healthy(0)
+            # Fault cleared: the next flap crosses the (still-warm)
+            # window and graduates.
+            state.mark_unhealthy(0)
+            assert chip_uuid(state, 0) in state.quarantined_chips()
+        finally:
+            state.close()
+
+
+class TestReadmitRace:
+    def test_recovery_mid_batch_cannot_double_assign(self, tmp_path):
+        """Regression: mark_healthy re-admitting a chip while a
+        prepare_batch is in flight. _unhealthy_uuids and the checkpoint
+        both mutate under _lock (GUARDED_BY — draracer R10 vouches), so
+        the interleaving can reorder events but never tear state: every
+        batch result is terminal, the chip's devices land in exactly the
+        claims that succeeded (each chip assigned once per live claim
+        set), and the flap ladder still graduates deterministically from
+        the transition count."""
+        import threading
+
+        state = make_state(str(tmp_path), threshold=10**6)  # ladder off
+        stop = threading.Event()
+        errors = []
+
+        def flapper():
+            while not stop.is_set():
+                state.mark_unhealthy(0)
+                state.mark_healthy(0)
+                state.healthy_devices()
+
+        def claim_for(i):
+            return {
+                "apiVersion": "resource.k8s.io/v1",
+                "kind": "ResourceClaim",
+                "metadata": {"name": f"rc-{i}", "namespace": "default",
+                             "uid": f"uid-rc-{i}"},
+                "spec": {"devices": {"requests": [{"name": "tpu"}]}},
+                "status": {"allocation": {"devices": {"results": [
+                    {"request": "tpu", "driver": TPU_DRIVER_NAME,
+                     "pool": "q-node", "device": "chip-0"}],
+                    "config": []}}},
+            }
+
+        def preparer():
+            for i in range(30):
+                obj = claim_for(i)
+                uid = obj["metadata"]["uid"]
+                try:
+                    res = state.prepare_batch([obj])[uid]
+                    if res.error:
+                        errors.append(res.error)
+                        continue
+                    err = state.unprepare_batch([uid])[uid]
+                    if err:
+                        errors.append(err)
+                except Exception as e:  # noqa: BLE001 — the regression
+                    errors.append(f"raised: {e}")
+
+        t1 = threading.Thread(target=flapper)
+        t2 = threading.Thread(target=preparer)
+        t1.start()
+        t2.start()
+        t2.join(60)
+        stop.set()
+        t1.join(5)
+        try:
+            assert errors == []
+            # Every claim unwound: the chip is assigned to nobody, and
+            # the inventory converges with the last health mark.
+            assert state.prepared_claim_uids() == []
+            state.mark_healthy(0)
+            assert published_chip_indices(state) == {0, 1, 2, 3}
+        finally:
+            state.close()
+
+
+class TestDriverIntegration:
+    @pytest.fixture
+    def stack(self, tmp_path):
+        from tpu_dra.k8s import FakeCluster, RESOURCESLICES
+        from tpu_dra.tpuplugin.driver import TpuDriver
+
+        cluster = FakeCluster()
+        state = make_state(str(tmp_path), threshold=2)
+        driver = TpuDriver(
+            state=state, client=cluster, driver_name=TPU_DRIVER_NAME,
+            node_name="q-node",
+            plugin_dir=os.path.join(str(tmp_path), "plugin"),
+            registry_dir=os.path.join(str(tmp_path), "reg"))
+        driver.start()
+        yield {"cluster": cluster, "driver": driver, "state": state,
+               "slices": RESOURCESLICES}
+        driver.shutdown()
+
+    def _published(self, stack):
+        return {d["name"]
+                for s in stack["cluster"].list(stack["slices"])
+                for d in s["spec"].get("devices", [])}
+
+    def test_flap_storm_shrinks_slice_and_recovery_holds(self, stack):
+        driver, state = stack["driver"], stack["state"]
+        cluster = stack["cluster"]
+        baseline = self._published(stack)
+        for _ in range(2):
+            driver._on_unhealthy_event(HealthEvent(
+                chip_index=0, code=110, kind="hbm_fault"))
+            driver._on_unhealthy_event(HealthEvent(
+                chip_index=0, code=0, kind=RECOVERED_KIND))
+        assert chip_uuid(state, 0) in state.quarantined_chips()
+        assert cluster.wait_for(
+            lambda: "chip-0" not in self._published(stack), timeout=5), \
+            "quarantine did not shrink the published ResourceSlice"
+        # The recovery events above must NOT have re-admitted chip-0.
+        assert "chip-0" not in self._published(stack)
+        # Operator clear republishes the full inventory.
+        assert driver.clear_quarantine(0)
+        assert cluster.wait_for(
+            lambda: self._published(stack) == baseline, timeout=5)

@@ -244,6 +244,155 @@ class RacyIndexScenario:
 
 
 # ---------------------------------------------------------------------------
+# evict-churn: eviction racing the optimistic bind pipeline (SURVEY §18)
+# ---------------------------------------------------------------------------
+
+class EvictChurnScenario:
+    """Evict-vs-prepare and evict-vs-commit: binders place claims
+    through the REAL optimistic pipeline (snapshot -> pick ->
+    try_commit reservation -> truth write -> apply -> release) while an
+    evictor kills a device mid-stream and releases its holder the way
+    the scheduler's evict scan does (truth removal mirrored by
+    remove(force=True)), then re-drives the victim through the queue.
+    Which claims end up bound is schedule-dependent BY DESIGN; the
+    safety properties under EVERY ordering:
+
+    - no device double-allocation (a reservation the evictor interleaves
+      with must still be all-or-nothing);
+    - index == truth at quiesce;
+    - no claim bound to the dead device once the eviction has run —
+      a bind racing the eviction must abort via the dead-set check and
+      release its reservation, never commit onto dead hardware."""
+
+    name = "evict-churn"
+
+    def build(self, sched) -> Dict:
+        from tpu_dra.simcluster.scheduler import AllocationIndex
+
+        queue = WorkQueue(rate_limiter=_ZeroLimiter())
+        index = AllocationIndex()
+        truth: Dict[str, Dict] = {}
+        dead: set = set()
+        evicted: List[str] = []
+        truth_lock = threading.Lock()   # witnessed: created under install
+        rvs = itertools.count(1)
+        devices = ["chip-0", "chip-1", "chip-2"]
+
+        def bind(key: str):
+            def body(_obj=None) -> None:
+                for _attempt in range(4):
+                    view = index.snapshot(_POOL)
+                    with truth_lock:
+                        if key in truth:
+                            return
+                        free = [d for d in devices
+                                if d not in dead
+                                and not view.is_taken(_DRIVER, d)]
+                    if not free:
+                        return
+                    entries = ((_DRIVER, _POOL, free[0]),)
+                    if not index.try_commit(_POOL, [(key, entries)]):
+                        continue  # conflict: re-scan a fresh snapshot
+                    claim = _mk_claim(key, [free[0]], next(rvs))
+                    with truth_lock:
+                        if free[0] in dead:
+                            # The device died between the reservation
+                            # and the write: abort — committing would
+                            # bind onto dead hardware.
+                            index.release(_POOL, [key])
+                            return
+                        # Truth write + index apply commit atomically
+                        # (the apiserver-serialized mutation-cache
+                        # discipline, same as sched-churn): the evictor
+                        # must never observe a truth entry whose index
+                        # apply has not landed, or its higher-rv
+                        # dealloc has no routing home to supersede.
+                        truth[key] = claim
+                        index.apply(claim)
+                    index.release(_POOL, [key])
+                    return
+            return body
+
+        def evictor() -> None:
+            # chip-0 dies: release every holder through the real
+            # pipeline — a DEALLOCATED claim write at a HIGHER rv,
+            # mirrored into the index via apply (exactly what
+            # _after_claim_write does) — then re-drive the victims.
+            # NOT remove(force=True): that only advances the watermark
+            # to the victim's OWN rv, so a binder's delayed same-rv
+            # apply would pass the strict staleness check and
+            # resurrect the evicted entry (the real scheduler never
+            # has this problem because eviction IS a new higher-RV
+            # write; the miniature must model the same thing).
+            victims = []
+            with truth_lock:
+                dead.add("chip-0")
+                for k in sorted(truth):
+                    if any(d == "chip-0"
+                           for _dr, _p, d in _entries(truth[k])):
+                        claim = truth.pop(k)
+                        index.apply(_mk_claim(
+                            k, [], next(rvs),
+                            uid=claim["metadata"]["uid"]))
+                        victims.append(k)
+                        evicted.append(k)
+            for k in victims:
+                queue.enqueue(None, bind(k), key=k, dedupe=True)
+
+        def producer1() -> None:
+            queue.enqueue(None, bind("pod-a"), key="pod-a")
+            queue.enqueue(None, bind("pod-b"), key="pod-b", dedupe=True)
+
+        def producer2() -> None:
+            queue.enqueue(None, bind("pod-c"), key="pod-c")
+
+        def stopper() -> None:
+            queue.shutdown()
+
+        sched.spawn("worker0", queue.run)
+        sched.spawn("worker1", queue.run)
+        sched.spawn("producer1", producer1)
+        sched.spawn("producer2", producer2)
+        sched.spawn("evictor", evictor)
+        sched.spawn("stopper", stopper)
+        return {"queue": queue, "index": index, "truth": truth,
+                "dead": dead, "evicted": evicted}
+
+    def check(self, ctx) -> List[str]:
+        import heapq
+
+        from tpu_dra.simcluster.chaos import chip_conflicts
+
+        queue, index, truth = ctx["queue"], ctx["index"], ctx["truth"]
+        # Quiesce drain, as in sched-churn: a shutdown racing the
+        # producers/evictor legitimately strands queued re-binds.
+        while queue._heap or queue._deferred:
+            while queue._heap:
+                _, _, item = heapq.heappop(queue._heap)
+                item.callback(item.obj)
+            for key in sorted(queue._deferred):
+                for item in queue._deferred.pop(key):
+                    item.callback(item.obj)
+        violations: List[str] = []
+        claims = [truth[k] for k in sorted(truth)]
+        violations.extend(index.diff_against(claims))
+        violations.extend(chip_conflicts(claims))
+        dead = ctx["dead"]
+        if dead:  # the evictor ran: nobody may hold the dead device
+            for key in sorted(truth):
+                on_dead = [d for _dr, _p, d in _entries(truth[key])
+                           if d in dead]
+                if on_dead:
+                    violations.append(
+                        f"claim {key} bound to dead device(s) "
+                        f"{on_dead} after eviction")
+        return violations
+
+    def cleanup(self, ctx) -> None:
+        ctx["queue"].shutdown()
+
+
+# ---------------------------------------------------------------------------
 # batch-prepare: concurrent DeviceState batches under controlled scheduling
 # ---------------------------------------------------------------------------
 
@@ -498,16 +647,182 @@ class BatchPrepareCrashScenario:
             shutil.rmtree(tmp, ignore_errors=True)
 
 
+# ---------------------------------------------------------------------------
+# quarantine-crash: the quarantine ledger's journal ops crash-enumerated
+# ---------------------------------------------------------------------------
+
+class QuarantineCrashScenario:
+    """The quarantine ladder's durable ops (SURVEY §18) under the crash
+    enumerator, INTERLEAVED with a real claim lifecycle so quarantine
+    snapshots and claim upsert/remove deltas coexist in one journal:
+    a claim prepares, two chips flap to graduation (journal append +
+    group sync each), an operator clear follows, the claim unprepares —
+    then a crash after EVERY durable op in every variant. Recovery
+    invariants: the rebuilt DeviceState always comes up; an
+    externalized transition (the call RETURNED) is durable — quarantine
+    AND claim alike; a crash can never half-quarantine; and the
+    faultless replay converges to the canonical final state from ANY
+    crash image."""
+
+    name = "quarantine-crash"
+
+    def setup(self) -> Dict:
+        from tpu_dra.cdi.handler import CDIHandler
+        from tpu_dra.native.tpuinfo import FakeBackend, default_fake_chips
+        from tpu_dra.tpuplugin.checkpoint import CheckpointManager
+        from tpu_dra.tpuplugin.device_state import DeviceState
+
+        tmp = tempfile.mkdtemp(prefix="drmc-quar-")
+        backend = FakeBackend(default_fake_chips(4, "v5p",
+                                                 slice_id="drmc"))
+        cdi = CDIHandler(os.path.join(tmp, "cdi"),
+                         driver_root=os.path.join(tmp, "drv"))
+        state = DeviceState(
+            backend=backend, cdi=cdi,
+            checkpoints=CheckpointManager(os.path.join(tmp, "plugin")),
+            driver_name=_DRIVER, node_name=_POOL, async_cdi=False,
+            quarantine_threshold=2, quarantine_window_s=3600.0)
+        uuids = {c.index: c.uuid for c in backend.chips()}
+        return {"tmp": tmp, "state": state, "uuids": uuids,
+                "claims": {"qa": _mk_claim("qa", ["chip-2"], rv=1)},
+                "externalized": {}}
+
+    @staticmethod
+    def _ladder(state, chip: int) -> None:
+        """Two flaps: transition in, recover, transition in — crosses
+        threshold=2 and graduates on the second mark_unhealthy."""
+        state.mark_unhealthy(chip)
+        state.mark_healthy(chip)
+        state.mark_unhealthy(chip)
+
+    def body(self, ctx) -> None:
+        state, uuids = ctx["state"], ctx["uuids"]
+        ext: Dict[str, str] = ctx["externalized"]
+        uid_qa = ctx["claims"]["qa"]["metadata"]["uid"]
+        res = state.prepare_batch([ctx["claims"]["qa"]])
+        ext["claim"] = "failed" if res[uid_qa].error else "completed"
+        self._ladder(state, 0)
+        if uuids[0] in state.quarantined_chips():
+            ext[uuids[0]] = "quarantined"
+        self._ladder(state, 1)
+        if uuids[1] in state.quarantined_chips():
+            ext[uuids[1]] = "quarantined"
+        # Once the operator clear is REQUESTED the record is going away
+        # by intent: a crash may land on either side of its removal, so
+        # the survival invariant relaxes until the call returns (the
+        # same relaxation as batch-prepare-crash's unprepare-requested).
+        ext[uuids[0]] = "clear-requested"
+        state.clear_quarantine(0)
+        ext[uuids[0]] = "cleared"
+        ext["claim"] = "unprepare-requested"
+        errs = state.unprepare_batch([uid_qa])
+        if errs[uid_qa] is None:
+            ext["claim"] = "unprepared"
+
+    def dispose(self, ctx) -> None:
+        ctx["state"].close()
+
+    def recover_and_check(self, ctx) -> List[str]:
+        from tpu_dra.cdi.handler import CDIHandler
+        from tpu_dra.native.tpuinfo import FakeBackend, default_fake_chips
+        from tpu_dra.tpuplugin.checkpoint import CheckpointManager
+        from tpu_dra.tpuplugin.device_state import DeviceState
+
+        tmp, uuids = ctx["tmp"], ctx["uuids"]
+        ext: Dict[str, str] = ctx["externalized"]
+        v: List[str] = []
+        state2 = None
+        try:
+            backend = FakeBackend(default_fake_chips(4, "v5p",
+                                                     slice_id="drmc"))
+            try:
+                state2 = DeviceState(
+                    backend=backend,
+                    cdi=CDIHandler(os.path.join(tmp, "cdi"),
+                                   driver_root=os.path.join(tmp, "drv")),
+                    checkpoints=CheckpointManager(
+                        os.path.join(tmp, "plugin")),
+                    driver_name=_DRIVER, node_name=_POOL,
+                    async_cdi=False,
+                    quarantine_threshold=2, quarantine_window_s=3600.0)
+            except Exception as e:  # noqa: BLE001 — THE invariant
+                return [f"recovery failed to start: {e}"]
+            from tpu_dra.tpuplugin.checkpoint import PREPARE_COMPLETED
+
+            uid_qa = ctx["claims"]["qa"]["metadata"]["uid"]
+            q = set(state2.quarantined_chips())
+            for uuid, status in sorted(ext.items()):
+                if status == "quarantined" and uuid not in q:
+                    v.append(f"externalized quarantine of {uuid} lost")
+                elif status == "cleared" and uuid in q:
+                    v.append(f"externalized clear of {uuid} "
+                             "resurrected as quarantined")
+                # "clear-requested": mid-clear crash — quarantined or
+                # cleared are BOTH legal images; replay converges below.
+            pc = state2.checkpoint_snapshot().claims.get(uid_qa)
+            claim_ext = ext.get("claim")
+            if claim_ext == "completed" and (
+                    pc is None or pc.state != PREPARE_COMPLETED):
+                v.append("externalized prepare lost alongside the "
+                         "quarantine journal ops")
+            elif claim_ext == "unprepared" and pc is not None:
+                v.append("externalized unprepare resurrected")
+            elif claim_ext == "unprepare-requested" and pc is not None \
+                    and pc.state != PREPARE_COMPLETED:
+                v.append(f"in-flight unprepare left {uid_qa} {pc.state}")
+            # Half-quarantine is impossible by construction: a chip is
+            # quarantined iff its ledger record exists; verify the
+            # ledger and the publish exclusion agree.
+            names = {d["name"] for d in state2.healthy_devices()}
+            for uuid in q:
+                leaked = [n for n in names
+                          if state2.allocatable[n].chip.uuid == uuid]
+                if leaked:
+                    v.append(f"quarantined chip {uuid} still "
+                             f"published: {leaked}")
+
+            # Faultless replay: the same lifecycle from ANY crash image
+            # must converge to {chip1 quarantined, chip0 clear, no
+            # claims}.
+            res = state2.prepare_batch([ctx["claims"]["qa"]])
+            if res[uid_qa].error:
+                v.append(f"replay prepare failed: {res[uid_qa].error}")
+            self._ladder(state2, 0)
+            self._ladder(state2, 1)
+            state2.clear_quarantine(0)
+            errs = state2.unprepare_batch([uid_qa])
+            if errs[uid_qa] is not None:
+                v.append(f"replay unprepare failed: {errs[uid_qa]}")
+            final = set(state2.quarantined_chips())
+            if final != {uuids[1]}:
+                v.append(f"replay converged to {sorted(final)}, "
+                         f"expected {{{uuids[1]}}}")
+            if state2.checkpoint_snapshot().claims:
+                v.append("replay left checkpoint claims behind")
+            names = {d["name"] for d in state2.healthy_devices()}
+            if any(state2.allocatable[n].chip.uuid == uuids[1]
+                   for n in names):
+                v.append("replayed quarantine of chip 1 still published")
+            return v
+        finally:
+            if state2 is not None:
+                state2.close()
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
 INTERLEAVING_SCENARIOS = {
     SchedChurnScenario.name: SchedChurnScenario,
     BatchPrepareScenario.name: BatchPrepareScenario,
+    EvictChurnScenario.name: EvictChurnScenario,
     RacyIndexScenario.name: RacyIndexScenario,
 }
 
 # Scenarios the CI gate runs (racy-index is the negative fixture: it is
 # SUPPOSED to violate, so it lives in tests, not the gate).
-GATE_SCENARIOS = (SchedChurnScenario.name, BatchPrepareScenario.name)
+GATE_SCENARIOS = (SchedChurnScenario.name, BatchPrepareScenario.name,
+                  EvictChurnScenario.name)
 
 CRASH_SCENARIOS = {
     BatchPrepareCrashScenario.name: BatchPrepareCrashScenario,
+    QuarantineCrashScenario.name: QuarantineCrashScenario,
 }

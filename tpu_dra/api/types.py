@@ -60,6 +60,12 @@ COMPUTE_DOMAIN_KIND = "ComputeDomain"
 
 COMPUTE_DOMAIN_STATUS_READY = "Ready"
 COMPUTE_DOMAIN_STATUS_NOT_READY = "NotReady"
+# Failure-domain state (SURVEY §18): a CD that WAS Ready and lost a
+# member (node death, daemon crash) — workloads already running on it
+# learn they are degraded (with status.statusReason naming why) instead
+# of the domain silently reading as a never-started NotReady. Recovery
+# (the member set converging ready again) republishes Ready cleanly.
+COMPUTE_DOMAIN_STATUS_DEGRADED = "Degraded"
 ALLOCATION_MODE_SINGLE = "Single"
 ALLOCATION_MODE_ALL = "All"
 

@@ -28,6 +28,7 @@ from tpu_dra.api import types as apitypes
 from tpu_dra.cdcontroller import templates
 from tpu_dra.cdcontroller.cleanup import CleanupManager
 from tpu_dra.infra import featuregates
+from tpu_dra.infra.faults import FAULTS
 from tpu_dra.infra.metrics import DefaultRegistry
 from tpu_dra.topology import domain_topology
 from tpu_dra.infra.workqueue import WorkQueue, default_controller_rate_limiter
@@ -43,6 +44,11 @@ reconciles_total = DefaultRegistry.counter(
     "tpu_dra_cd_reconciles_total", "ComputeDomain reconcile passes")
 teardowns_total = DefaultRegistry.counter(
     "tpu_dra_cd_teardowns_total", "ComputeDomain teardown completions")
+degraded_total = DefaultRegistry.counter(
+    "tpu_dra_cd_degraded_total",
+    "Ready -> Degraded transitions: a previously-Ready ComputeDomain "
+    "lost a member (node death, daemon crash) and says so via "
+    "status.statusReason instead of reading as a never-started NotReady")
 
 UID_INDEX = "uid"
 CD_LABEL_INDEX = "cd-uid"
@@ -316,6 +322,8 @@ class Controller:
         ready = sum(1 for n in nodes
                     if n.get("status") == apitypes.COMPUTE_DOMAIN_STATUS_READY)
         num_nodes = (cd.get("spec") or {}).get("numNodes", 0)
+        expected_members = num_nodes
+        settling = False
         if num_nodes > 0:
             want = (apitypes.COMPUTE_DOMAIN_STATUS_READY
                     if ready >= num_nodes
@@ -330,6 +338,7 @@ class Controller:
             desired = (hits[0].get("status") or {}).get(
                 "desiredNumberScheduled", 0)
             expected = max(len(nodes), desired)
+            expected_members = expected
             want = (apitypes.COMPUTE_DOMAIN_STATUS_READY
                     if ready > 0 and ready >= expected
                     else apitypes.COMPUTE_DOMAIN_STATUS_NOT_READY)
@@ -360,8 +369,35 @@ class Controller:
                 remaining = self._open_settle_s - (now - changed_at)
                 if remaining > 0:
                     want = apitypes.COMPUTE_DOMAIN_STATUS_NOT_READY
+                    settling = True
                     self._queue.enqueue(uid, self._reconcile,
                                         key=f"cd/{uid}", after=remaining)
+        # Failure-domain transition (SURVEY §18): a domain that WAS
+        # Ready and no longer meets its readiness bar has LOST something
+        # — a member node died, a daemon crash-looped — and the
+        # workloads gating on it need to know it is a regression, not a
+        # domain that never came up. Ready -> Degraded, with the why in
+        # status.statusReason; a Degraded domain stays Degraded until it
+        # either recovers (Ready, reason cleared) or is torn down.
+        # EXCEPT the settle hold: there every member IS ready — the
+        # window exists to absorb growth (a joining member), which is
+        # not a loss and must not read (or count) as one.
+        reason = None
+        if not settling and \
+                want == apitypes.COMPUTE_DOMAIN_STATUS_NOT_READY:
+            cur = (cd.get("status") or {}).get("status")
+            if cur in (apitypes.COMPUTE_DOMAIN_STATUS_READY,
+                       apitypes.COMPUTE_DOMAIN_STATUS_DEGRADED):
+                want = apitypes.COMPUTE_DOMAIN_STATUS_DEGRADED
+                # The pod-delete handler may already have recorded a
+                # MORE specific reason (the lost member's name); the
+                # periodic readiness pass must not launder it into the
+                # generic count.
+                reason = ((cd.get("status") or {}).get("statusReason")
+                          if cur == apitypes.COMPUTE_DOMAIN_STATUS_DEGRADED
+                          else None) or (
+                    f"{ready}/{expected_members} members ready "
+                    "(member lost or daemon not ready)")
         # ICI placement observability (gated): how many physical slices
         # the registered member set spans and whether it is slice-aligned
         # (one sliceID, contiguous worker indices). The daemons register
@@ -379,31 +415,48 @@ class Controller:
                     "computedomain %s is Ready but spans %d ICI slices "
                     "(members not slice-aligned): inter-node collectives "
                     "will traverse DCN", uid, topo["slices"])
-        self._set_cd_status(uid, want, topo=topo)
+        self._set_cd_status(uid, want, topo=topo, reason=reason)
 
     def _set_cd_status(self, uid: str, want: str,
-                       topo: Optional[Dict] = None) -> None:
+                       topo: Optional[Dict] = None,
+                       reason: Optional[str] = None) -> None:
         """topo=None means "no topology summary applies" (single-node
         membership, or the gate is off): a previously stamped
         status.topology is REMOVED rather than left stale — the field
-        must describe the current member set or not exist."""
+        must describe the current member set or not exist. The same
+        contract governs `reason` (status.statusReason): recovery to
+        Ready republishes cleanly, with no stale degradation note."""
         cd = self._fresh_cd(uid)
         if cd is None:
             return
         status = cd.setdefault("status", {})
         if (status.get("status") == want
-                and status.get("topology") == topo):
+                and status.get("topology") == topo
+                and status.get("statusReason") == reason):
             return
+        newly_degraded = (
+            want == apitypes.COMPUTE_DOMAIN_STATUS_DEGRADED
+            and status.get("status")
+            == apitypes.COMPUTE_DOMAIN_STATUS_READY)
         status["status"] = want
         if topo is not None:
             status["topology"] = topo
         else:
             status.pop("topology", None)
+        if reason is not None:
+            status["statusReason"] = reason
+        else:
+            status.pop("statusReason", None)
         status.setdefault("nodes", [])
         try:
             updated = self._client.update_status(COMPUTEDOMAINS, cd)
         except (ConflictError, NotFoundError) as e:
             raise RetryableError(f"status update: {e}") from e
+        if newly_degraded:
+            # Counted only once the write LANDED: a conflict retries
+            # the whole item, and counting before the write would
+            # record the same transition per attempt.
+            degraded_total.inc()
         self.cd_informer.update_cache(updated)
         log.info("computedomain %s/%s status -> %s",
                  cd["metadata"].get("namespace"), cd["metadata"]["name"], want)
@@ -431,15 +484,46 @@ class Controller:
         kept = [n for n in nodes if n.get("ipAddress") != pod_ip]
         if len(kept) == len(nodes):
             return
+        # Injection site: the member-loss handling itself fails (status
+        # write refused) — the keyed queue item must retry until the
+        # loss is recorded; a CD must never sit Ready with a dead member
+        # because the handler gave up.
+        FAULTS.check("cd.member_loss", cd=uid, pod_ip=pod_ip)
+        lost = sorted(n.get("name", "?") for n in nodes if n not in kept)
         cd.setdefault("status", {})["nodes"] = kept
         num_nodes = (cd.get("spec") or {}).get("numNodes", 0)
-        if num_nodes and len(kept) < num_nodes:
-            cd["status"]["status"] = apitypes.COMPUTE_DOMAIN_STATUS_NOT_READY
+        short = ((num_nodes and len(kept) < num_nodes)
+                 or (not num_nodes and not kept))
+        newly_degraded = False
+        if short:
+            was = cd["status"].get("status")
+            if was in (apitypes.COMPUTE_DOMAIN_STATUS_READY,
+                       apitypes.COMPUTE_DOMAIN_STATUS_DEGRADED):
+                # Ready -> Degraded with the member named: slice loss
+                # mid-job reads as a regression with a reason, never a
+                # wedged CD still claiming Ready (SURVEY §18).
+                newly_degraded = \
+                    was == apitypes.COMPUTE_DOMAIN_STATUS_READY
+                cd["status"]["status"] = \
+                    apitypes.COMPUTE_DOMAIN_STATUS_DEGRADED
+            else:
+                cd["status"]["status"] = \
+                    apitypes.COMPUTE_DOMAIN_STATUS_NOT_READY
+            cd["status"]["statusReason"] = (
+                f"member node lost: {', '.join(lost)} "
+                f"({len(kept)}/{num_nodes or len(nodes)} members remain)")
         try:
             updated = self._client.update_status(COMPUTEDOMAINS, cd)
         except (ConflictError, NotFoundError) as e:
             raise RetryableError(f"pod-delete status update: {e}") from e
+        if newly_degraded:
+            # After the write, not before: a conflict re-runs the keyed
+            # item and would double-count the same transition.
+            degraded_total.inc()
         self.cd_informer.update_cache(updated)
+        if short:
+            log.warning("computedomain %s degraded: %s", uid,
+                        cd["status"]["statusReason"])
 
     # -- teardown -----------------------------------------------------------
 

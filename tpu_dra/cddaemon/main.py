@@ -25,6 +25,7 @@ import signal
 import socket
 import sys
 import threading
+from typing import Optional
 
 from tpu_dra.cddaemon.computedomain import ComputeDomainManager
 from tpu_dra.cddaemon.dnsnames import (
@@ -32,6 +33,7 @@ from tpu_dra.cddaemon.dnsnames import (
 )
 from tpu_dra.cddaemon.process import ProcessManager
 from tpu_dra.infra import debug, featuregates
+from tpu_dra.infra.faults import FAULTS
 from tpu_dra.infra.flags import (
     Flag, FlagSet, apply_feature_gates, feature_gate_flag, logging_flags,
     setup_logging,
@@ -119,6 +121,17 @@ def discover_slice_id(backend) -> str:
 class DaemonRunner:
     """Wires CD registration, the native process, and the update loop;
     factored as a class so tests can drive it without a real pod."""
+
+    # Member-loss settle: a dying slice produces a BURST of removals
+    # (one CD status write per departing daemon). Reconfiguring the
+    # native daemon per removal means N hosts-file rewrites and — in
+    # legacy IP mode — N full child restarts in quick succession, a
+    # self-inflicted crash loop on every surviving node exactly when
+    # the domain is most fragile. Shrinks therefore wait this long and
+    # drain to the LATEST membership snapshot before reconfiguring:
+    # one burst, one reconfigure. Growth stays immediate (a joining
+    # member should rendezvous at probe latency).
+    MEMBER_LOSS_SETTLE_S = 0.25
 
     def __init__(self, client, ns):
         self.ns = ns
@@ -208,7 +221,16 @@ class DaemonRunner:
     # -- loops --------------------------------------------------------------
 
     def _update_loop(self) -> None:
-        """Membership changes -> peer config refresh (main.go:296-377)."""
+        """Membership changes -> peer config refresh (main.go:296-377).
+
+        Member LOSS (the peer set shrank — a node died, a slice is
+        going away) is handled with a settle window + latest-snapshot
+        drain (MEMBER_LOSS_SETTLE_S) so a dying slice's burst of
+        removals coalesces into ONE reconfigure instead of a restart
+        storm; a failed update re-offers its snapshot to the latest-wins
+        queue so the loop RETRIES instead of waiting for the next
+        membership change that may never come (the dead peer is not
+        coming back to nudge us)."""
         dns_mode = featuregates.enabled(featuregates.SliceDaemonsWithDNSNames)
         if dns_mode and not self.dns_supported:
             # Version gate (device_state.go:666-690 analog): fall back to
@@ -216,6 +238,7 @@ class DaemonRunner:
             log.warning("accel driver predates DNS-stable rendezvous; "
                         "falling back to IP mode")
             dns_mode = False
+        prev_ids: Optional[set] = None
         while not self._stop.is_set():
             try:
                 node_set = self.cd.updates.get(timeout=0.2)
@@ -223,6 +246,18 @@ class DaemonRunner:
                 continue
             try:
                 peers = self.cd.slice_peers(node_set)
+                ids = {i for i, _ip in peers}
+                if prev_ids is not None and prev_ids - ids:
+                    # Injection site: the member-loss reconfigure path
+                    # fails (hosts rewrite EIO, restart refusal) — the
+                    # re-offer below must retry it; surviving daemons
+                    # must not crash-loop or silently keep dead peers.
+                    FAULTS.check("cd.member_loss",
+                                 node=self.ns.node_name,
+                                 lost=sorted(prev_ids - ids))
+                    self._stop.wait(self.MEMBER_LOSS_SETTLE_S)
+                    node_set, peers, ids = self._drain_latest(
+                        node_set, peers, ids)
                 if dns_mode:
                     hosts_changed = update_hosts_file(
                         self.ns.hosts_file, peers)
@@ -235,8 +270,29 @@ class DaemonRunner:
                     ips = [ip for _i, ip in sorted(peers)]
                     if write_nodes_config(self.nodes_path, ips, self.ns.port):
                         self.process.restart()
-            except Exception:  # noqa: BLE001 — keep consuming updates
-                log.exception("membership update failed")
+                prev_ids = ids
+            except Exception:  # noqa: BLE001 — keep consuming updates,
+                # and RETRY this snapshot: put it back unless a newer
+                # one already superseded it (latest-wins), then back off
+                # a tick so a hard failure cannot spin the loop.
+                log.exception("membership update failed; retrying")
+                try:
+                    self.cd.updates.put_nowait(node_set)
+                except queue.Full:
+                    pass  # newer snapshot queued: it wins
+                self._stop.wait(0.1)
+
+    def _drain_latest(self, node_set, peers, ids):
+        """Collapse whatever queued during the settle window to the
+        newest membership snapshot (one burst, one reconfigure)."""
+        while True:
+            try:
+                node_set = self.cd.updates.get_nowait()
+            except queue.Empty:
+                break
+            peers = self.cd.slice_peers(node_set)
+            ids = {i for i, _ip in peers}
+        return node_set, peers, ids
 
     def _readiness_loop(self) -> None:
         """Probe the local daemon and mirror readiness into the per-node CD

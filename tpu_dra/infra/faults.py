@@ -185,6 +185,23 @@ SITES: Dict[str, str] = {
     "health.chip_event":
         "synthetic chip health event (payload-injecting site); "
         "threatens: ResourceSlice vs healthy-chip consistency",
+    "health.flap":
+        "quarantine-ladder graduation fails to persist (journal append "
+        "ENOSPC while a flapping chip crosses the threshold); threatens: "
+        "quarantine durability — the chip must stay transient-unhealthy "
+        "and re-graduate on the next flap, never half-quarantine or "
+        "crash the health callback",
+    "sched.evict":
+        "eviction of a claim whose allocated chips died fails mid-flight "
+        "(deallocation write refused, pod unbind conflict); threatens: "
+        "failure-domain convergence — the evict scan must retry with "
+        "backoff until every claim ends Allocated-on-live-chips or "
+        "Pending-with-reason, never a claim pinned to a dead chip",
+    "cd.member_loss":
+        "ComputeDomain member-loss handling fails (Degraded status write "
+        "conflict, daemon peer-config rewrite error); threatens: a CD "
+        "stuck Ready with a dead member, or a daemon crash-looping on "
+        "dead peers instead of backing off",
 }
 
 

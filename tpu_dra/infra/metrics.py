@@ -177,6 +177,11 @@ METRICS_CATALOG: Dict[str, str] = {
     "tpu_dra_prepare_wire_encode_seconds": "tpuplugin/driver.py",
     # kubeletplugin/pipeline.py — pipelined RPC admission
     "tpu_dra_prepare_inflight_rpcs": "kubeletplugin/pipeline.py",
+    # tpuplugin/health.py + device_state.py — failure-domain recovery
+    # (SURVEY §18): the wedged-monitor tripwire and the chip-quarantine
+    # ladder's exclusion count
+    "tpu_dra_health_monitor_wedged": "tpuplugin/health.py",
+    "tpu_dra_quarantined_chips": "tpuplugin/device_state.py",
     # tpuplugin/checkpoint.py — append-only journal + group commit
     "tpu_dra_journal_appends_total": "tpuplugin/checkpoint.py",
     "tpu_dra_journal_group_syncs_total": "tpuplugin/checkpoint.py",
@@ -184,9 +189,11 @@ METRICS_CATALOG: Dict[str, str] = {
     "tpu_dra_journal_lag_records": "tpuplugin/checkpoint.py",
     # cdplugin/driver.py — ComputeDomain channel prepare
     "tpu_dra_cd_claim_prepare_seconds": "cdplugin/driver.py",
-    # cdcontroller/controller.py — CD reconcile loop
+    # cdcontroller/controller.py — CD reconcile loop + failure-domain
+    # transitions (Ready -> Degraded on member loss, SURVEY §18)
     "tpu_dra_cd_reconciles_total": "cdcontroller/controller.py",
     "tpu_dra_cd_teardowns_total": "cdcontroller/controller.py",
+    "tpu_dra_cd_degraded_total": "cdcontroller/controller.py",
     # infra/metrics.py — shared control-plane instruments (below)
     "tpu_dra_cel_cache_hits": "infra/metrics.py",
     "tpu_dra_cel_cache_misses": "infra/metrics.py",
@@ -201,6 +208,7 @@ METRICS_CATALOG: Dict[str, str] = {
     "tpu_dra_sched_workers": "infra/metrics.py",
     "tpu_dra_sched_snapshot_conflicts_total": "infra/metrics.py",
     "tpu_dra_sched_shard_resyncs_total": "infra/metrics.py",
+    "tpu_dra_sched_evictions_total": "infra/metrics.py",
     "tpu_dra_workqueue_depth": "infra/metrics.py",
     "tpu_dra_workqueue_busy_workers": "infra/metrics.py",
     "tpu_dra_topo_allocations": "infra/metrics.py",
@@ -336,6 +344,13 @@ SCHED_SHARD_RESYNCS = DefaultRegistry.counter(
     "allocation-index shards rebuilt by the guarded resync fallback "
     "(per-shard dirty flags: one divergent shard resyncs alone without "
     "blocking scans on the others)")
+SCHED_EVICTIONS = DefaultRegistry.counter(
+    "tpu_dra_sched_evictions_total",
+    "claims evicted because an allocated device disappeared from the "
+    "published inventory (chip quarantined/yanked, node lost), labeled "
+    "by reason (device_lost|node_lost); every eviction releases through "
+    "the claim deallocation write + mutation-cache pipeline and "
+    "re-drives the owner pod")
 WORKQUEUE_DEPTH = DefaultRegistry.gauge(
     "tpu_dra_workqueue_depth",
     "items queued (delay heap + per-key deferred) in a named WorkQueue, "

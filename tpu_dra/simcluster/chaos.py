@@ -68,11 +68,13 @@ log = logging.getLogger("simcluster.chaos")
 # DeviceState parallel apply), so the group-commit rollback machinery is
 # chaos-tested on the exact production path; the prepare.journal_* sites
 # break the append-only journal's append and bounded-lag compaction the
-# same way (SURVEY §14).
+# same way (SURVEY §14). health.flap breaks the quarantine ladder's
+# graduation persistence (SURVEY §18): the chip must degrade to
+# transient-unhealthy and re-graduate, never half-quarantine.
 CHAOS_SITES = ("k8s.api.request", "cdi.claim_write", "checkpoint.store",
                "checkpoint.corrupt", "prepare.batch_fetch",
                "prepare.batch_apply", "prepare.journal_append",
-               "prepare.journal_compact")
+               "prepare.journal_compact", "health.flap")
 
 TS_CONFIG = [{"source": "FromClaim", "requests": [], "opaque": {
     "driver": TPU_DRIVER_NAME, "parameters": {
@@ -193,7 +195,11 @@ class ChaosHarness:
             backend=self.backend, cdi=self.cdi,
             checkpoints=CheckpointManager(os.path.join(self.tmp, "plugin")),
             driver_name=TPU_DRIVER_NAME, node_name="chaos-node",
-            ts_manager=TimeSlicingManager(self.backend))
+            ts_manager=TimeSlicingManager(self.backend),
+            # The ladder engages under the walk's flap storms (window
+            # far past any schedule's wall clock; threshold low enough
+            # that _op_flap_storm deterministically graduates).
+            quarantine_threshold=3, quarantine_window_s=300.0)
         self.driver = TpuDriver(
             state=self.state, client=self.client,
             driver_name=TPU_DRIVER_NAME, node_name="chaos-node",
@@ -425,11 +431,35 @@ class ChaosHarness:
                                 kind="hbm_fault")
         self.driver._on_unhealthy_event(event)
 
+    def _op_flap_storm(self) -> None:
+        """Drive one chip through the full quarantine ladder: threshold
+        unhealthy/recovered flaps in a burst — with health.flap armed
+        the graduation may be refused (degrading to transient-unhealthy
+        and retrying on the next flap), which is exactly the path under
+        test."""
+        chip = self.rng.randrange(self.n_chips)
+        for _ in range(3):
+            self.driver._on_unhealthy_event(HealthEvent(
+                chip_index=chip, code=self.rng.randint(100, 120),
+                kind="hbm_fault"))
+            self.driver._on_unhealthy_event(HealthEvent(
+                chip_index=chip, code=0, kind=RECOVERED_KIND))
+            self.report.health_events += 2
+
+    def _op_clear_quarantine(self) -> None:
+        """The operator's move: lift one random chip's quarantine."""
+        q = self.state.quarantined_chips()
+        if not q:
+            return
+        uuid = self.rng.choice(sorted(q))
+        self.driver.clear_quarantine(q[uuid].get("chip_index"))
+
     def run(self, n_events: int = 40) -> ChaosReport:
         ops = [(self._op_prepare_new, 4), (self._op_prepare_batch, 2),
                (self._op_retry_pending, 3),
                (self._op_unprepare, 2), (self._op_rearm, 2),
-               (self.crash_restart, 1), (self._op_health, 1)]
+               (self.crash_restart, 1), (self._op_health, 1),
+               (self._op_flap_storm, 1), (self._op_clear_quarantine, 1)]
         weighted = [op for op, w in ops for _ in range(w)]
         try:
             for _ in range(n_events):
@@ -470,8 +500,15 @@ class ChaosHarness:
                 self.prepared[uid] = obj
 
         # 2. Crash consistency: the terminal state must survive an
-        # unclean restart (load_or_init + orphan GC path).
+        # unclean restart (load_or_init + orphan GC path) — INCLUDING
+        # the quarantine ledger (SURVEY §18): a crash must not launder
+        # a flapping chip back into the inventory.
+        q_before = set(self.state.quarantined_chips())
         self.crash_restart()
+        q_after = set(self.state.quarantined_chips())
+        if q_before != q_after:
+            v.append(f"quarantine did not survive restart: before "
+                     f"{sorted(q_before)} after {sorted(q_after)}")
 
         snap = self.state.checkpoint_snapshot()
         want = set(self.prepared)
@@ -1052,6 +1089,314 @@ def run_topo_schedule(seed: int, n_events: int = 60) -> ChaosReport:
     return TopologyChaosHarness(seed).run(n_events)
 
 
+# ---------------------------------------------------------------------------
+# Node-death walk (failure-domain recovery racing pod churn, SURVEY §18)
+# ---------------------------------------------------------------------------
+
+class NodeDeathChaosHarness(TopologyChaosHarness):
+    """The topology walk plus the classic production failure: hardware
+    dies mid-traffic. The walk kills nodes (Node + ResourceSlices gone),
+    quarantines chips (the slice shrinks, the driver-republish analog),
+    revives both, and arms ``sched.evict`` on top of the scheduler
+    sites — while pods churn. The control plane must CONVERGE, not
+    wedge; after quiesce:
+
+    8. no claim is allocated to a dead node or an unpublished (dead /
+       quarantined) device;
+    9. every live pod is either bound with its claim Allocated on live
+       published chips, or — when no placement exists on the surviving
+       topology — Pending WITH a recorded PodScheduled=False reason
+       (strict topology refusal, never a silent shrink or hang);
+    10. a pod that IS placeable on the surviving capacity gets placed
+       (eviction re-drives, the strict-refusal path does not leak pods).
+
+    Pruning is OFF in this walk: provably-unplaceable pods are the
+    invariant (Pending-with-reason), not noise to delete.
+    """
+
+    REARM_SITES = TopologyChaosHarness.REARM_SITES + ("sched.evict",)
+
+    # Claim sizes: single-chip heavy so dead capacity rarely wedges
+    # everything, with a multi-chip tail to exercise strict refusal.
+    CLAIM_SIZES = (1, 1, 1, 2, 4)
+
+    def __init__(self, seed: int, *, nodes: int = 4,
+                 chips_per_node: int = 8):
+        super().__init__(seed, nodes=nodes, chips_per_node=chips_per_node)
+        # name -> saved {"node": obj, "slices": [objs]} for revival.
+        self.dead_nodes: Dict[str, Dict] = {}
+        # node -> {device name: saved device obj} (quarantined chips).
+        self.dead_chips: Dict[str, Dict[str, Dict]] = {}
+
+    # -- capacity bookkeeping ------------------------------------------------
+
+    def _published(self) -> Dict[str, set]:
+        from tpu_dra.k8s import RESOURCESLICES
+        out: Dict[str, set] = {}
+        for sl in self.cluster.list(RESOURCESLICES):
+            node = (sl.get("spec") or {}).get("nodeName")
+            if node:
+                out.setdefault(node, set()).update(
+                    d["name"] for d in sl["spec"].get("devices", []))
+        return out
+
+    def _nodes_alive(self) -> set:
+        from tpu_dra.k8s import NODES
+        return {n["metadata"]["name"] for n in self.cluster.list(NODES)}
+
+    def _op_create_pod(self) -> None:
+        # Budget against LIVE capacity, not the seeded total — a walk
+        # that killed half the fleet must stop admitting at half rate.
+        alive = self._nodes_alive()
+        live = sum(len(devs) for node, devs in self._published().items()
+                   if node in alive)
+        self.chip_budget = (live * 3) // 4
+        n = self.rng.choice(self.CLAIM_SIZES)
+        if sum(self.pod_chips.values()) + n > self.chip_budget:
+            return
+        from tpu_dra.testing import make_sched_pod
+        name = f"nd-{self.seed}-{self._pod_seq}"
+        self._pod_seq += 1
+        make_sched_pod(self.cluster, name,
+                       template="tmpl" if n == 1 else f"tmpl{n}")
+        self.live[name] = None
+        self.pod_chips[name] = n
+        self.report.prepares += 1
+
+    # -- failure-domain ops --------------------------------------------------
+
+    @staticmethod
+    def _strip_meta(obj: Dict) -> Dict:
+        from tpu_dra.k8s.client import json_deepcopy
+        out = json_deepcopy(obj)
+        for key in ("resourceVersion", "uid", "creationTimestamp"):
+            out["metadata"].pop(key, None)
+        return out
+
+    def _op_kill_node(self) -> None:
+        """Node death: the Node object AND its ResourceSlices vanish
+        (kubelet gone, slice GC done). At least half the fleet stays
+        alive so quiesce retains surviving capacity to re-drive onto."""
+        from tpu_dra.k8s import NODES, RESOURCESLICES
+        candidates = sorted(self._nodes_alive())
+        if len(candidates) <= max(1, self.nodes // 2):
+            return
+        name = self.rng.choice(candidates)
+        node_obj = next(n for n in self.cluster.list(NODES)
+                        if n["metadata"]["name"] == name)
+        slices = [sl for sl in self.cluster.list(RESOURCESLICES)
+                  if (sl.get("spec") or {}).get("nodeName") == name]
+        self.dead_nodes[name] = {
+            "node": self._strip_meta(node_obj),
+            "slices": [self._strip_meta(sl) for sl in slices]}
+        for sl in slices:
+            self.cluster.delete(RESOURCESLICES, sl["metadata"]["name"],
+                                None)
+        self.cluster.delete(NODES, name, None)
+        self.report.crashes += 1
+        log.info("node-death chaos: killed node %s", name)
+
+    def _op_revive_node(self) -> None:
+        from tpu_dra.k8s import NODES, RESOURCESLICES
+        if not self.dead_nodes:
+            return
+        name = self.rng.choice(sorted(self.dead_nodes))
+        saved = self.dead_nodes.pop(name)
+        self.cluster.create(NODES, saved["node"])
+        for sl in saved["slices"]:
+            self.cluster.create(RESOURCESLICES, sl)
+        log.info("node-death chaos: revived node %s", name)
+
+    def _op_quarantine_chip(self) -> None:
+        """The driver-quarantine republish analog: one whole chip drops
+        out of its node's published ResourceSlice."""
+        from tpu_dra.k8s import RESOURCESLICES
+        alive = sorted(self._nodes_alive())
+        if not alive:
+            return
+        node = self.rng.choice(alive)
+        for sl in self.cluster.list(RESOURCESLICES):
+            if (sl.get("spec") or {}).get("nodeName") != node:
+                continue
+            devices = sl["spec"].get("devices", [])
+            if len(devices) <= 1:
+                return  # keep the node publishing something
+            dev = self.rng.choice(sorted(d["name"] for d in devices))
+            saved = next(d for d in devices if d["name"] == dev)
+            sl["spec"]["devices"] = [d for d in devices
+                                     if d["name"] != dev]
+            self.cluster.update(RESOURCESLICES, sl)
+            self.dead_chips.setdefault(node, {})[dev] = saved
+            self.report.health_events += 1
+            return
+
+    def _op_restore_chip(self) -> None:
+        from tpu_dra.k8s import RESOURCESLICES
+        nodes = [n for n in sorted(self.dead_chips)
+                 if n in self._nodes_alive() and self.dead_chips[n]]
+        if not nodes:
+            return
+        node = self.rng.choice(nodes)
+        dev = self.rng.choice(sorted(self.dead_chips[node]))
+        saved = self.dead_chips[node].pop(dev)
+        for sl in self.cluster.list(RESOURCESLICES):
+            if (sl.get("spec") or {}).get("nodeName") != node:
+                continue
+            sl["spec"]["devices"] = sorted(
+                sl["spec"].get("devices", []) + [saved],
+                key=lambda d: d["name"])
+            self.cluster.update(RESOURCESLICES, sl)
+            return
+
+    def _ops(self):
+        return super()._ops() + [
+            (self._op_kill_node, 2), (self._op_revive_node, 1),
+            (self._op_quarantine_chip, 2), (self._op_restore_chip, 1)]
+
+    # -- convergence ---------------------------------------------------------
+
+    def _placeable(self, n_chips: int, published: Dict[str, set],
+                   alive: set) -> bool:
+        """Can a contiguous n-chip cuboid be placed on ANY live node's
+        free coordinates (claims of LIVE pods taken; dead pods' claims
+        drain via GC)? The same proof _prune_wedged runs — here it
+        decides whether Pending-with-reason is legitimate."""
+        from tpu_dra import topology
+        from tpu_dra.k8s import RESOURCESLICES
+        from tpu_dra.simcluster.scheduler import (
+            _parent_of, claim_entries,
+        )
+
+        pods = {p["metadata"]["name"]
+                for p in self.cluster.list(PODS, namespace="default")}
+        taken: Dict[str, set] = {}
+        for claim in self.cluster.list(RESOURCECLAIMS,
+                                       namespace="default"):
+            owner = (claim["metadata"].get("annotations") or {}).get(
+                "sim/owner-pod")
+            if owner and owner not in pods:
+                continue  # GC will free these
+            for _drv, pool, dev in claim_entries(claim):
+                taken.setdefault(pool, set()).add(_parent_of(dev))
+        for sl in self.cluster.list(RESOURCESLICES):
+            node = (sl.get("spec") or {}).get("nodeName")
+            if node not in alive:
+                continue
+            topo = topology.node_topology_from_slices([sl])
+            if topo is None:
+                continue
+            free = {c for dev, c in topo.coord_of.items()
+                    if dev not in taken.get(node, set())}
+            if topology.best_placement(topo.mesh, free, n_chips) \
+                    is not None:
+                return True
+        return False
+
+    def _converged(self) -> List[str]:
+        from tpu_dra.simcluster.scheduler import claim_entries
+
+        problems = []
+        pods = {p["metadata"]["name"]: p
+                for p in self.cluster.list(PODS, namespace="default")}
+        claims = self.cluster.list(RESOURCECLAIMS, namespace="default")
+        published = self._published()
+        alive = self._nodes_alive()
+        by_owner = {}
+        for claim in claims:
+            owner = (claim["metadata"].get("annotations") or {}).get(
+                "sim/owner-pod")
+            if owner:
+                by_owner[owner] = claim
+        for name in sorted(self.live):
+            pod = pods.get(name)
+            if pod is None:
+                problems.append(f"live pod {name} missing from cluster")
+                continue
+            claim = by_owner.get(name)
+            node = pod["spec"].get("nodeName")
+            entries = claim_entries(claim) if claim else ()
+            if node:
+                if not entries:
+                    problems.append(f"bound pod {name} claim unallocated")
+                    continue
+                if {e[1] for e in entries} != {node}:
+                    problems.append(
+                        f"pod {name} bound to {node} but claim on "
+                        f"{sorted({e[1] for e in entries})}")
+                if node not in alive:
+                    problems.append(f"pod {name} bound to DEAD node "
+                                    f"{node} (eviction missing)")
+                dead = [e[2] for e in entries
+                        if e[2] not in published.get(node, set())]
+                if dead:
+                    problems.append(
+                        f"claim of pod {name} allocated to dead/"
+                        f"quarantined devices {dead} on {node}")
+            else:
+                if entries:
+                    # Mid-eviction or mid-bind: not converged yet.
+                    problems.append(f"unbound pod {name} still holds an "
+                                    "allocation")
+                    continue
+                if self._placeable(self.pod_chips.get(name, 1),
+                                   published, alive):
+                    problems.append(f"pod {name} placeable on surviving "
+                                    "capacity but still pending")
+                    continue
+                cond = next(
+                    (c for c in (pod.get("status") or {}).get(
+                        "conditions") or []
+                     if c.get("type") == "PodScheduled"), None)
+                if not (cond and cond.get("status") == "False"
+                        and cond.get("reason")):
+                    problems.append(f"pod {name} pending WITHOUT a "
+                                    "recorded reason")
+        alive_pods = set(self.live)
+        for claim in claims:
+            owner = (claim["metadata"].get("annotations") or {}).get(
+                "sim/owner-pod")
+            if owner and owner not in alive_pods:
+                problems.append(f"claim {claim['metadata']['name']} "
+                                f"leaked after pod {owner} death")
+        if self.sched._index.dirty:
+            problems.append("index dirty (resync pending)")
+        else:
+            problems.extend(self.sched.verify_index())
+        return problems
+
+    def quiesce_and_verify(self) -> None:
+        # The base quiesce polls _converged (ours) then asserts
+        # chip_conflicts/index/witness + topology/mesh invariants; on
+        # top, the failure-domain hard invariant: NO allocated claim —
+        # any claim, owned or not — references a dead node or an
+        # unpublished device.
+        super().quiesce_and_verify()
+        from tpu_dra.simcluster.scheduler import claim_entries
+        published = self._published()
+        alive = self._nodes_alive()
+        for claim in self.cluster.list(RESOURCECLAIMS,
+                                       namespace="default"):
+            for _drv, pool, dev in claim_entries(claim):
+                if pool not in alive:
+                    self.report.violations.append(
+                        f"claim {claim['metadata']['name']} allocated "
+                        f"on dead node {pool} at quiesce")
+                elif dev not in published.get(pool, set()):
+                    self.report.violations.append(
+                        f"claim {claim['metadata']['name']} bound to "
+                        f"unpublished device {dev} on {pool} at quiesce")
+
+
+def run_nodedeath_schedule(seed: int, n_events: int = 60) -> ChaosReport:
+    """One seeded node-death-racing-churn walk to quiesce."""
+    return NodeDeathChaosHarness(seed).run(n_events)
+
+
+def run_nodedeath_matrix(seeds: List[int], n_events: int = 60) -> Dict:
+    return _pod_matrix_summary(
+        [run_nodedeath_schedule(seed, n_events) for seed in seeds])
+
+
 def run_topo_matrix(seeds: List[int], n_events: int = 60) -> Dict:
     return _pod_matrix_summary(
         [run_topo_schedule(seed, n_events) for seed in seeds])
@@ -1196,11 +1541,18 @@ def main(argv=None) -> int:
     # Topology walk over the same seed matrix: contiguity + free-set
     # invariants with the TopologyAwareScheduling gate on.
     summary["topology"] = run_topo_matrix(seeds, n_events=args.events)
+    # Node-death walk over the same seed matrix (SURVEY §18): node loss
+    # and chip quarantine racing pod churn — eviction must converge
+    # (Allocated-on-live-chips or Pending-with-reason, no claim pinned
+    # to dead hardware, no double allocation).
+    summary["node_death"] = run_nodedeath_matrix(seeds,
+                                                 n_events=args.events)
     print(json.dumps(summary, indent=2))
     return 1 if (summary["violations"]
                  or summary["watch_flake_violations"]
                  or summary["scheduler"]["violations"]
-                 or summary["topology"]["violations"]) else 0
+                 or summary["topology"]["violations"]
+                 or summary["node_death"]["violations"]) else 0
 
 
 if __name__ == "__main__":

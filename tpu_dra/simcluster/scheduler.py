@@ -64,10 +64,10 @@ from typing import Dict, Iterable, List, Optional, Set, Tuple
 from tpu_dra.infra import featuregates
 from tpu_dra.infra.faults import FAULTS, FaultInjected
 from tpu_dra.infra.metrics import (
-    SCHED_CLAIMS_GCED, SCHED_FULL_RELISTS, SCHED_PODS_BOUND,
-    SCHED_SHARD_RESYNCS, SCHED_SNAPSHOT_CONFLICTS, SCHED_WATCH_EVENTS,
-    SCHED_WORKERS, TOPO_ALLOCS, TOPO_FREE_CUBOID, TOPO_SCORE_SECONDS,
-    Timer,
+    SCHED_CLAIMS_GCED, SCHED_EVICTIONS, SCHED_FULL_RELISTS,
+    SCHED_PODS_BOUND, SCHED_SHARD_RESYNCS, SCHED_SNAPSHOT_CONFLICTS,
+    SCHED_WATCH_EVENTS, SCHED_WORKERS, TOPO_ALLOCS, TOPO_FREE_CUBOID,
+    TOPO_SCORE_SECONDS, Timer,
 )
 from tpu_dra.infra.workqueue import (
     ExponentialFailureRateLimiter, WorkQueue,
@@ -722,6 +722,18 @@ class AllocationIndex:
 
     # -- queries ------------------------------------------------------------
 
+    def allocated_claims(self) -> List[Tuple[str, Tuple[_Entry, ...]]]:
+        """Snapshot of every indexed (claim key, entries) pair, shard by
+        shard — the eviction scan's worklist. Each shard is read under
+        its own lock; the union is NOT a cross-shard atomic snapshot,
+        which the consumer tolerates (a claim mutating mid-scan is
+        re-validated against the live lister before any eviction)."""
+        out: List[Tuple[str, Tuple[_Entry, ...]]] = []
+        for shard in self._shards:
+            with shard._lock:
+                out.extend(shard._by_claim.items())
+        return out
+
     def is_taken(self, driver: str, pool: str, name: str,
                  overlay: Optional[Set[_Entry]] = None) -> bool:
         shard = self._shards[self.shard_of(pool)]
@@ -1064,6 +1076,15 @@ class Scheduler:
         if self._drop_event(resource):
             return
         self._nudge_pending_pods()
+        # Failure-domain reaction (SURVEY §18): the same events that ADD
+        # capacity also take it away — a node delete, or a ResourceSlice
+        # shrinking because the driver quarantined/yanked a chip. The
+        # keyed+deduped evict-scan item sweeps the allocation index for
+        # claims whose devices no longer exist and releases them through
+        # the real deallocation pipeline.
+        if self._queue is not None:
+            self._queue.enqueue(resource, lambda _o: self._evict_scan(),
+                                key="evict", after=0, dedupe=True)
 
     def _on_class(self, dc: Dict) -> None:
         if self._drop_event("deviceclasses"):
@@ -1211,6 +1232,14 @@ class Scheduler:
                 self._queue.enqueue(
                     "sweep", lambda _: self._gc_sweep(),
                     key="gc-sweep", after=0, dedupe=True)
+                # Eviction safety net, same shape as the GC sweep: a
+                # DROPPED capacity event (sched.watch_event) would
+                # otherwise be the last trigger a dead chip's claims
+                # ever get — the periodic sweep guarantees the evict
+                # scan converges regardless.
+                self._queue.enqueue(
+                    "sweep", lambda _: self._evict_scan(),
+                    key="evict", after=0, dedupe=True)
 
     # -- data access (lister-backed when started, client-backed sync) --------
 
@@ -1339,6 +1368,177 @@ class Scheduler:
         SCHED_CLAIMS_GCED.inc(labels={"path": path})
         log.info("GC claim %s/%s via %s (owner pod gone)", ns, name, path)
 
+    # -- failure-domain eviction (worker thread, SURVEY §18) ------------------
+
+    def _evict_scan(self) -> None:
+        """Sweep the allocation index for claims whose allocated devices
+        no longer exist — the node is gone, or the device vanished from
+        the node's published ResourceSlices (chip quarantined/yanked by
+        the driver's health pipeline) — and evict them through the REAL
+        deallocation pipeline: a claim-status write (allocation removed,
+        eviction reason recorded) mirrored via _after_claim_write, then
+        the owner pod unbound and re-driven. The index is never edited
+        directly: the write IS the eviction, exactly like GC's delete.
+
+        Raises on a per-claim failure (sched.evict fault, write
+        conflict): the keyed evict item retries with backoff and
+        re-scans — eviction must converge, not half-apply."""
+        nodes_alive = {n["metadata"]["name"] for n in self._iter_nodes()}
+        published: Dict[str, Set[str]] = {}
+        for key, entries in self._index.allocated_claims():
+            reason = None
+            for _driver, pool, dev in entries:
+                if pool not in nodes_alive:
+                    reason = "node_lost"
+                    break
+                devs = published.get(pool)
+                if devs is None:
+                    devs = {d["name"]
+                            for sl in self._slices_for_node(pool)
+                            for d in (sl.get("spec") or {}).get(
+                                "devices") or []}
+                    published[pool] = devs
+                if dev not in devs:
+                    reason = "device_lost"
+                    break
+            if reason is None:
+                continue
+            # Injection site: the eviction itself fails mid-flight — the
+            # scan item must retry until the claim is released, never
+            # leave it half-evicted or pinned to the dead chip.
+            FAULTS.check("sched.evict", claim=key, reason=reason)
+            self._evict_claim(key, entries, reason)
+        # Healing pass: an eviction is two writes (claim deallocation,
+        # pod unbind) and only the first is found by the index scan
+        # above — if the unbind failed (write conflict) or the pod
+        # re-bound against a claim the scan had not deallocated yet,
+        # the owner is left bound to an evicted, unallocated claim and
+        # NOTHING above would ever revisit it. Every scan therefore
+        # re-enforces the second half: evicted + unallocated + owner
+        # still bound -> unbind and re-drive. Idempotent and O(claims).
+        for claim in self._list_claims():
+            status = claim.get("status") or {}
+            if status.get("allocation") or "evicted" not in status:
+                continue
+            owner = (claim["metadata"].get("annotations") or {}).get(
+                "sim/owner-pod")
+            if not owner:
+                continue
+            ns = claim["metadata"].get("namespace", "default")
+            pod = self._get_pod(ns, owner)
+            if pod is not None and pod["spec"].get("nodeName"):
+                self._release_pod_binding(
+                    f"{ns}/{owner}",
+                    (status["evicted"] or {}).get("reason", "evicted"))
+
+    def _evict_claim(self, key: str,
+                     entries: Tuple[_Entry, ...], reason: str) -> None:
+        ns, name = key.split("/", 1)
+        claim = self._get_claim(ns, name)
+        if claim is None or claim_entries(claim) != entries:
+            return  # stale scan entry: the claim already moved on
+        upd = json_deepcopy(claim)
+        status = upd.setdefault("status", {})
+        status.pop("allocation", None)
+        status["evicted"] = {
+            "reason": reason,
+            "message": f"allocated devices lost ({reason}): "
+                       f"{sorted(e[2] for e in entries)}"}
+        try:
+            updated = self._client.update_status(RESOURCECLAIMS, upd, ns)
+        except (ConflictError, NotFoundError) as e:
+            raise _Unscheduled(f"evict {key}: {e}") from e
+        # Mutation-cache discipline, same as every scheduler write: the
+        # index learns the deallocation from the write, not from a
+        # direct shard edit.
+        self._after_claim_write(updated)
+        SCHED_EVICTIONS.inc(labels={"reason": reason})
+        log.warning("evicted claim %s (%s): devices %s no longer "
+                    "published", key, reason,
+                    sorted(e[2] for e in entries))
+        owner = (claim["metadata"].get("annotations") or {}).get(
+            "sim/owner-pod")
+        if owner:
+            self._release_pod_binding(f"{ns}/{owner}", reason)
+
+    def _release_pod_binding(self, key: str, reason: str) -> None:
+        """Unbind the evicted claim's owner pod and re-drive it: it
+        re-enters the scheduling loop and ends Allocated on surviving
+        capacity, or Pending with the PodScheduled=False reason when
+        nothing fits (strict topology refusal — never a silent
+        shrink)."""
+        ns, name = key.split("/", 1)
+        pod = self._get_pod(ns, name)
+        if pod is None or pod["metadata"].get("deletionTimestamp"):
+            return
+        if pod["spec"].get("nodeName"):
+            upd = json_deepcopy(pod)
+            upd["spec"]["nodeName"] = ""
+            try:
+                updated = self._client.update(PODS, upd, ns)
+            except (ConflictError, NotFoundError) as e:
+                raise _Unscheduled(f"unbind {key}: {e}") from e
+            if self._started:
+                self._informers["pods"].update_cache(updated)
+            self._set_pod_reason(
+                key, "Evicted",
+                f"allocated devices lost ({reason}); rescheduling")
+        self._enqueue_pod(key)
+
+    @staticmethod
+    def _pod_sched_condition(pod: Dict) -> Optional[Dict]:
+        for cond in (pod.get("status") or {}).get("conditions") or []:
+            if cond.get("type") == "PodScheduled":
+                return cond
+        return None
+
+    def _set_pod_reason(self, key: str, reason: str, message: str) -> None:
+        """Record why the pod is not scheduled as a PodScheduled=False
+        condition (Pending-with-reason). Reason/message are only written
+        when they change — the failed-attempt path runs repeatedly and
+        must not amplify writes. Best-effort: a conflict is retried by
+        the next failed attempt."""
+        ns, name = key.split("/", 1)
+        pod = self._get_pod(ns, name)
+        if pod is None or pod["metadata"].get("deletionTimestamp"):
+            return
+        cur = self._pod_sched_condition(pod)
+        if cur is not None and cur.get("status") == "False" \
+                and cur.get("reason") == reason:
+            return
+        upd = json_deepcopy(pod)
+        conds = [c for c in (upd.setdefault("status", {}).get(
+            "conditions") or []) if c.get("type") != "PodScheduled"]
+        conds.append({"type": "PodScheduled", "status": "False",
+                      "reason": reason, "message": message})
+        upd["status"]["conditions"] = conds
+        try:
+            updated = self._client.update_status(PODS, upd, ns)
+        except (ConflictError, NotFoundError):
+            return
+        if self._started:
+            self._informers["pods"].update_cache(updated)
+
+    def _clear_pod_reason(self, pod: Dict) -> None:
+        """The pod bound: flip its PodScheduled condition True (drop the
+        stale Pending/Evicted reason). Skipped when no False condition
+        was ever recorded — the common placement path stays one write."""
+        cur = self._pod_sched_condition(pod)
+        if cur is None or cur.get("status") == "True":
+            return
+        ns = pod["metadata"].get("namespace", "default")
+        upd = json_deepcopy(pod)
+        conds = [c for c in (upd.setdefault("status", {}).get(
+            "conditions") or []) if c.get("type") != "PodScheduled"]
+        conds.append({"type": "PodScheduled", "status": "True"})
+        upd["status"]["conditions"] = conds
+        try:
+            updated = self._client.update_status(PODS, upd, ns)
+        except (ConflictError, NotFoundError):
+            return
+        if self._started:
+            self._informers["pods"].update_cache(updated)
+
     # -- per-pod reconcile (worker thread) ------------------------------------
 
     def _process_pod(self, key: str) -> None:
@@ -1378,6 +1578,14 @@ class Scheduler:
             with self._plock:
                 if key in self._pending:
                     self._waiting.add(key)
+            # Pending-with-reason (SURVEY §18): the refusal is recorded
+            # on the pod, so "waiting for capacity" is observable —
+            # strict topology refusal must read as a reasoned Pending,
+            # never a silent hang. Written only on change.
+            self._set_pod_reason(
+                key, "Unschedulable",
+                "no node can satisfy the pod's claims (insufficient "
+                "free capacity or no contiguous topology cuboid)")
 
     # -- resourceclaim controller analog --------------------------------------
 
@@ -1470,6 +1678,10 @@ class Scheduler:
                     if self._started:
                         self._informers["pods"].update_cache(updated)
                     SCHED_PODS_BOUND.inc()
+                    # A pod that carried a Pending/Evicted reason is now
+                    # placed: flip the condition so "Pending-with-reason"
+                    # only ever describes pods that are actually waiting.
+                    self._clear_pod_reason(updated)
                 return True
         return False
 
@@ -1638,6 +1850,9 @@ class Scheduler:
             for claim, allocation, _k, _e in staged:
                 upd = json_deepcopy(claim)
                 upd.setdefault("status", {})["allocation"] = allocation
+                # Re-allocation supersedes a prior eviction: the marker
+                # must describe the claim's CURRENT state or not exist.
+                upd["status"].pop("evicted", None)
                 updated = self._client.update_status(
                     RESOURCECLAIMS, upd, upd["metadata"].get("namespace"))
                 self._after_claim_write(updated)

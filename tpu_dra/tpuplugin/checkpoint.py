@@ -84,14 +84,26 @@ class PreparedClaim:
 @dataclass
 class Checkpoint:
     claims: Dict[str, PreparedClaim] = field(default_factory=dict)
+    # Chip-quarantine ledger (SURVEY §18): chip uuid -> record dict
+    # ({chip_index, reason, flaps, since, ttl_s}). Quarantine must
+    # survive a driver restart — a flapping chip that crashed the plugin
+    # would otherwise re-enter the inventory on recovery and flap the
+    # scheduler all over again — so it rides the same durable state
+    # machine as the claims: full map in every slot image, delta
+    # snapshots in the journal (journal_commit(quarantine=True)).
+    quarantine: Dict[str, Dict] = field(default_factory=dict)
 
     # -- versioned encodings ------------------------------------------------
 
     def to_v2_doc(self) -> Dict:
-        return {
+        doc = {
             "version": "v2",
             "preparedClaims": {uid: c.to_v2() for uid, c in self.claims.items()},
         }
+        if self.quarantine:
+            doc["quarantine"] = {uid: dict(rec)
+                                 for uid, rec in self.quarantine.items()}
+        return doc
 
     def to_v1_doc(self) -> Dict:
         """Downgrade view: V1 had no state machine — only completed claims
@@ -119,6 +131,8 @@ class Checkpoint:
         elif version == "v2":
             for uid, entry in prepared.items():
                 cp.claims[uid] = PreparedClaim.from_v2(uid, entry)
+            cp.quarantine = {uid: dict(rec) for uid, rec in
+                             (doc.get("quarantine") or {}).items()}
         else:
             raise CheckpointError(f"unknown checkpoint version {version!r}")
         return cp
@@ -401,7 +415,8 @@ class CheckpointManager:
     # legally shred).
 
     def journal_commit(self, cp: Checkpoint, *, present=(), absent=(),
-                       intent: bool = False) -> int:
+                       intent: bool = False,
+                       quarantine: bool = False) -> int:
         """Append one group-commit delta record; returns the sync token
         for journal_barrier. NOT durable until the barrier. Caller must
         hold its data lock (single logical writer — same contract as
@@ -410,7 +425,12 @@ class CheckpointManager:
 
         `present`/`absent` are both the postcondition check (as in
         store_batch) and the delta itself: present uids are serialized
-        from `cp`, absent uids become removal markers."""
+        from `cp`, absent uids become removal markers.
+
+        ``quarantine=True`` additionally snapshots the full quarantine
+        ledger into the record (the map is O(chips-per-node), so a full
+        snapshot per transition is cheaper than delta bookkeeping and
+        makes replay order-insensitive: the highest-seq record wins)."""
         # Same site as the slot path: a journal append IS the hot-path
         # checkpoint store; chaos arms one site to break both schemes.
         FAULTS.check("checkpoint.store", intent=intent)
@@ -424,11 +444,13 @@ class CheckpointManager:
             raise CheckpointError(
                 f"group commit inconsistent: missing={missing} "
                 f"lingering={lingering}")
-        payload = json.dumps(
-            {"intent": bool(intent),
-             "upsert": {uid: cp.claims[uid].to_v2() for uid in present},
-             "remove": sorted(absent)},
-            sort_keys=True, separators=(",", ":"))
+        delta = {"intent": bool(intent),
+                 "upsert": {uid: cp.claims[uid].to_v2() for uid in present},
+                 "remove": sorted(absent)}
+        if quarantine:
+            delta["quarantine"] = {uid: dict(rec)
+                                   for uid, rec in cp.quarantine.items()}
+        payload = json.dumps(delta, sort_keys=True, separators=(",", ":"))
         with self._journal_lock:
             fd = self._ensure_journal_fd()
             self._seq += 1
@@ -652,6 +674,12 @@ class CheckpointManager:
                 cp.claims[uid] = PreparedClaim.from_v2(uid, entry)
             for uid in doc.get("remove") or ():
                 cp.claims.pop(uid, None)
+            if "quarantine" in doc:
+                # Full-snapshot semantics: the record's ledger replaces
+                # the image's (append order = seq order, so the last
+                # replayed snapshot is the newest).
+                cp.quarantine = {uid: dict(rec) for uid, rec in
+                                 (doc.get("quarantine") or {}).items()}
             self._seq = max(self._seq, seq)
         return cp
 

@@ -15,6 +15,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from contextlib import ExitStack
 from dataclasses import dataclass, field
@@ -25,6 +26,7 @@ from tpu_dra.api import types as apitypes
 from tpu_dra.cdi.handler import CDIHandler, visible_chips_env
 from tpu_dra.infra import featuregates, vfs
 from tpu_dra.infra.faults import FAULTS
+from tpu_dra.infra.metrics import DefaultRegistry
 from tpu_dra.kubeletplugin.server import PreparedDevice, PrepareResult
 from tpu_dra.native.tpuinfo import Chip, TpuInfoBackend
 from tpu_dra.tpuplugin import deviceinfo
@@ -39,6 +41,13 @@ from tpu_dra.topology.meshexport import export_topology_env
 
 
 log = logging.getLogger("tpu_dra.tpuplugin")
+
+quarantined_chips_gauge = DefaultRegistry.gauge(
+    "tpu_dra_quarantined_chips",
+    "chips currently quarantined by the flap ladder on this node "
+    "(excluded from every ResourceSlice publish until an operator "
+    "clear or TTL expiry re-admits them; persisted in the checkpoint "
+    "journal so the count survives restarts)")
 
 
 class PrepareError(Exception):
@@ -120,7 +129,10 @@ class DeviceState:
                  mp_manager: Optional[MultiprocessManager] = None,
                  pt_manager: Optional[PassthroughManager] = None,
                  include_subslices: bool = True,
-                 async_cdi: bool = True):
+                 async_cdi: bool = True,
+                 quarantine_threshold: int = 3,
+                 quarantine_window_s: float = 60.0,
+                 quarantine_ttl_s: float = 0.0):
         self._backend = backend
         self._cdi = cdi
         self._ckpt_mgr = checkpoints
@@ -138,7 +150,22 @@ class DeviceState:
         topology_mesh.validate_chips(chips)
         self.allocatable = deviceinfo.enumerate_allocatable(
             chips, include_subslices=include_subslices)
-        self._unhealthy_uuids: set = set()
+        self._unhealthy_uuids: set = set()  # GUARDED_BY: _lock
+        # Quarantine ladder (SURVEY §18): a chip whose unhealthy
+        # TRANSITIONS (flaps — each one requires an intervening
+        # recovery) reach `quarantine_threshold` within
+        # `quarantine_window_s` graduates from transient-unhealthy to
+        # quarantined: excluded from publish until an operator clear or
+        # TTL expiry (`quarantine_ttl_s`; 0 = operator-only), and
+        # persisted in the checkpoint journal so a plugin crash cannot
+        # launder a flapping chip back into the scheduler's inventory.
+        self._q_threshold = max(1, int(quarantine_threshold))
+        self._q_window_s = float(quarantine_window_s)
+        self._q_ttl_s = float(quarantine_ttl_s)
+        # chip uuid -> monotonic timestamps of recent flaps (transient,
+        # deliberately NOT persisted: the quarantine decision is; a
+        # restart resets the window, which only delays re-graduation).
+        self._flap_history: Dict[str, deque] = {}  # GUARDED_BY: _lock
         # Per-phase ms of the last non-idempotent prepare (see prepare()).
         self.last_prepare_breakdown: Dict[str, float] = {}
         # Batch-level phase ms of the last fully-successful prepare_batch
@@ -174,6 +201,18 @@ class DeviceState:
         # (NewDeviceState analog, device_state.go:59-145).
         self._cdi.create_standard_device_spec_file(backend.chips())
         self._checkpoint = self._ckpt_mgr.load_or_init()
+        # Quarantine survives the restart (it was loaded with the
+        # checkpoint); records for uuids no longer on this node (chip
+        # physically replaced) are pruned — the replacement hardware
+        # earns its own health record. The prune is in-memory only: it
+        # persists with the next quarantine transition or compaction.
+        known_uuids = {c.uuid for c in chips}
+        for uuid in list(self._checkpoint.quarantine):
+            if uuid not in known_uuids:
+                log.info("dropping quarantine record for replaced chip "
+                         "uuid %s", uuid)
+                self._checkpoint.quarantine.pop(uuid, None)
+        quarantined_chips_gauge.set(len(self._checkpoint.quarantine))
         # Orphan claim-spec GC: non-hazardous prepares (no side effects
         # beyond the CDI spec) skip the intent store, so a crash between
         # their CDI write and terminal checkpoint store leaves a spec file
@@ -1203,41 +1242,192 @@ class DeviceState:
         device names (UpdateDeviceHealthStatus analog,
         device_state.go:701-715). Takes _lock: the health-monitor thread
         mutates the set while republish reads it — unguarded, a republish
-        mid-event could observe a torn inventory."""
+        mid-event could observe a torn inventory.
+
+        Quarantine ladder: each TRANSITION into unhealthy (the chip was
+        healthy a moment ago — a flap) is counted against the sliding
+        window; crossing the threshold graduates the chip to quarantined
+        and persists the ledger through the journal (group sync outside
+        the lock). A persistence failure (health.flap site) leaves the
+        chip transient-unhealthy — still excluded from publish — and the
+        NEXT flap retries the graduation; the callback never dies."""
+        token: Optional[int] = None
         with self._lock:
             affected = []
+            uuid = None
             for name, dev in self.allocatable.items():
                 if dev.chip.index == chip_index:
-                    self._unhealthy_uuids.add(dev.chip.uuid)
+                    uuid = dev.chip.uuid
                     affected.append(name)
-            return affected
+            if uuid is None:
+                return affected
+            is_flap = uuid not in self._unhealthy_uuids
+            self._unhealthy_uuids.add(uuid)
+            if is_flap and uuid not in self._checkpoint.quarantine:
+                now = time.monotonic()
+                hist = self._flap_history.setdefault(uuid, deque())
+                hist.append(now)
+                while hist and hist[0] < now - self._q_window_s:
+                    hist.popleft()
+                if len(hist) >= self._q_threshold:
+                    token = self._quarantine_locked(
+                        uuid, chip_index,
+                        reason=f"{len(hist)} flaps within "
+                               f"{self._q_window_s:g}s")
+        if token is not None:
+            self._quarantine_barrier(token)
+        return affected
+
+    def _quarantine_locked(self, uuid: str, chip_index: int, *,
+                           reason: str) -> Optional[int]:
+        """Graduate one chip to quarantined under _lock; returns the
+        journal token to barrier outside the lock (None: persistence
+        refused — the chip stays transient-unhealthy and the next flap
+        retries). Never raises."""
+        record = {
+            "chip_index": chip_index,
+            "reason": reason,
+            "flaps": len(self._flap_history.get(uuid, ())),
+            "since": time.time(),
+        }
+        if self._q_ttl_s > 0:
+            record["ttl_s"] = self._q_ttl_s
+        try:
+            # Injection site: the graduation's journal append fails
+            # (ENOSPC) — quarantine must degrade to transient-unhealthy,
+            # not crash the health pipeline or half-persist.
+            FAULTS.check("health.flap", chip_index=chip_index)
+            self._checkpoint.quarantine[uuid] = record
+            token = self._ckpt_mgr.journal_commit(
+                self._checkpoint, quarantine=True)
+        except Exception as e:  # noqa: BLE001 — degrade, retry on flap
+            self._checkpoint.quarantine.pop(uuid, None)
+            log.warning("quarantine of chip %d could not persist (%s); "
+                        "chip stays transient-unhealthy until the next "
+                        "flap retries", chip_index, e)
+            return None
+        self._flap_history.pop(uuid, None)
+        quarantined_chips_gauge.set(len(self._checkpoint.quarantine))
+        log.warning("chip %d QUARANTINED (%s); excluded from publish "
+                    "until operator clear%s", chip_index, reason,
+                    f" or TTL {self._q_ttl_s:g}s" if self._q_ttl_s > 0
+                    else "")
+        return token
+
+    def _quarantine_barrier(self, token: int) -> None:
+        """The durable half of a quarantine transition, outside _lock.
+        A barrier failure keeps the in-memory transition (exclusion is
+        the safe direction; a crash merely re-runs the ladder) and the
+        next group sync or compaction re-covers the record."""
+        try:
+            self._ckpt_mgr.journal_barrier(token)
+        except Exception:  # noqa: BLE001 — safe-direction degradation
+            log.warning("quarantine journal sync failed; record may not "
+                        "be durable until the next group sync",
+                        exc_info=True)
 
     def mark_healthy(self, chip_index: int) -> List[str]:
         """Reverse of mark_unhealthy: a recovery event re-admits the chip's
         devices to the inventory. The reference cannot do this — a yanked
         GPU stays gone until driver restart (driver.go:263-264); the accel
-        health stream's explicit 'recovered' records make re-add safe."""
+        health stream's explicit 'recovered' records make re-add safe.
+
+        A QUARANTINED chip is NOT re-admitted: recovery records are
+        exactly what a flapping chip produces between its faults, and
+        re-admitting on them is the ping-pong the ladder exists to stop.
+        Only clear_quarantine (operator) or TTL expiry re-admits."""
         # Collect first, discard after: the chip's devices (chip +
         # subslices) share one uuid, and discarding inside the loop would
         # report only the first match.
         with self._lock:
             affected = [name for name, dev in self.allocatable.items()
                         if dev.chip.index == chip_index
-                        and dev.chip.uuid in self._unhealthy_uuids]
+                        and dev.chip.uuid in self._unhealthy_uuids
+                        and dev.chip.uuid not in self._checkpoint.quarantine]
             for name in affected:
                 self._unhealthy_uuids.discard(
                     self.allocatable[name].chip.uuid)
             return affected
 
-    def healthy_devices(self) -> List[Dict]:
-        """resourceapi device list excluding unhealthy chips (the republish
-        path drops yanked devices, driver.go:283-293). Takes _lock so a
-        health event landing mid-republish cannot yield a half-updated
-        device set."""
+    def quarantined_chips(self) -> Dict[str, Dict]:
+        """uuid -> quarantine record snapshot (operator introspection)."""
         with self._lock:
-            return [dev.to_resource_api()
-                    for name, dev in sorted(self.allocatable.items())
-                    if dev.chip.uuid not in self._unhealthy_uuids]
+            return {uuid: dict(rec) for uuid, rec in
+                    self._checkpoint.quarantine.items()}
+
+    def clear_quarantine(self, chip_index: Optional[int] = None
+                         ) -> List[str]:
+        """Operator seam: lift the quarantine of `chip_index` (None =
+        every chip), persist the cleared ledger, and return the
+        re-admitted device names so the caller republishes. The chip
+        re-enters the inventory with a fresh flap window."""
+        token: Optional[int] = None
+        with self._lock:
+            cleared = [uuid for uuid, rec in
+                       self._checkpoint.quarantine.items()
+                       if chip_index is None
+                       or rec.get("chip_index") == chip_index]
+            if not cleared:
+                return []
+            affected = self._clear_quarantine_locked(cleared)
+            try:
+                token = self._ckpt_mgr.journal_commit(
+                    self._checkpoint, quarantine=True)
+            except Exception:  # noqa: BLE001 — the clear stands in
+                # memory (the operator asked for it); durability rides
+                # the next transition or compaction.
+                log.warning("quarantine clear could not persist",
+                            exc_info=True)
+        if token is not None:
+            self._quarantine_barrier(token)
+        return affected
+
+    def _clear_quarantine_locked(self, uuids: List[str]) -> List[str]:
+        """Drop quarantine records + give the chips a fresh start
+        (unhealthy mark and flap window cleared). Returns re-admitted
+        device names. Caller holds _lock and persists."""
+        affected = []
+        for uuid in uuids:
+            self._checkpoint.quarantine.pop(uuid, None)
+            self._unhealthy_uuids.discard(uuid)
+            self._flap_history.pop(uuid, None)
+            affected.extend(name for name, dev in self.allocatable.items()
+                            if dev.chip.uuid == uuid)
+        quarantined_chips_gauge.set(len(self._checkpoint.quarantine))
+        return sorted(affected)
+
+    def healthy_devices(self) -> List[Dict]:
+        """resourceapi device list excluding unhealthy AND quarantined
+        chips (the republish path drops yanked devices,
+        driver.go:283-293). Takes _lock so a health event landing
+        mid-republish cannot yield a half-updated device set. Expired
+        quarantine TTLs are lifted here — publish time is when the
+        re-admission becomes visible anyway."""
+        token: Optional[int] = None
+        with self._lock:
+            now = time.time()
+            expired = [uuid for uuid, rec in
+                       self._checkpoint.quarantine.items()
+                       if rec.get("ttl_s")
+                       and now >= rec.get("since", now) + rec["ttl_s"]]
+            if expired:
+                readmitted = self._clear_quarantine_locked(expired)
+                log.info("quarantine TTL expired; re-admitting %s",
+                         readmitted)
+                try:
+                    token = self._ckpt_mgr.journal_commit(
+                        self._checkpoint, quarantine=True)
+                except Exception:  # noqa: BLE001 — next transition
+                    # re-persists; exclusion already lifted in memory.
+                    log.warning("quarantine TTL clear could not persist",
+                                exc_info=True)
+            devices = [dev.to_resource_api()
+                       for name, dev in sorted(self.allocatable.items())
+                       if dev.chip.uuid not in self._unhealthy_uuids
+                       and dev.chip.uuid not in self._checkpoint.quarantine]
+        if token is not None:
+            self._quarantine_barrier(token)
+        return devices
 
     def prepared_claim_uids(self) -> List[str]:
         with self._lock:

@@ -341,7 +341,10 @@ class TpuDriver(DriverCallbacks):
                 affected += mark(index)
         if recovered:
             if not affected:
-                return  # chip was never yanked: nothing to republish
+                # Never yanked — or QUARANTINED: the ladder holds the
+                # chip out of the inventory through recovery events
+                # (ping-pong is what graduated it); nothing to republish.
+                return
             log.info("health recovery (%s): re-admitting devices %s",
                      "all chips" if event.chip_index < 0
                      else f"chip {event.chip_index}", affected)
@@ -350,3 +353,17 @@ class TpuDriver(DriverCallbacks):
                         event.kind, event.code, affected)
         self._publish_queue.enqueue(
             None, lambda _obj: self._publish_and_register(), key="publish")
+
+    def clear_quarantine(self, chip_index: Optional[int] = None) -> List[str]:
+        """Operator seam: lift chip quarantine (None = all) and republish
+        the re-admitted devices through the retry queue. Returns the
+        re-admitted device names."""
+        affected = self._state.clear_quarantine(chip_index)
+        if affected:
+            log.info("quarantine cleared (%s): re-admitting devices %s",
+                     "all chips" if chip_index is None
+                     else f"chip {chip_index}", affected)
+            self._publish_queue.enqueue(
+                None, lambda _obj: self._publish_and_register(),
+                key="publish")
+        return affected

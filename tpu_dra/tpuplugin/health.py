@@ -18,9 +18,20 @@ import threading
 from typing import Callable, Iterable, Optional, Set
 
 from tpu_dra.infra.faults import FAULTS
+from tpu_dra.infra.metrics import DefaultRegistry
 from tpu_dra.native.tpuinfo import HealthEvent, TpuInfoBackend
 
 log = logging.getLogger("tpu_dra.tpuplugin.health")
+
+# 1 while a monitor thread is wedged in the backend event wait (stop()
+# timed out joining it): health events are NOT flowing and chips can die
+# unnoticed until restart. Previously a bare attribute nobody exported —
+# an operator watching dashboards had no way to tell a dead health
+# pipeline from a quiet one.
+wedged_gauge = DefaultRegistry.gauge(
+    "tpu_dra_health_monitor_wedged",
+    "1 while the device health monitor thread is wedged in a backend "
+    "wait that never returned (health events not flowing), 0 otherwise")
 
 # Benign/app-level event codes that must not yank a chip (the Xid skip-list
 # analog, device_health.go:320-342). Codes model: <100 = app/driver-level
@@ -57,6 +68,10 @@ class DeviceHealthMonitor:
         self.wedged = False
 
     def start(self) -> None:
+        # A (re)started monitor clears the tripwire: the gauge reports
+        # the CURRENT pipeline, not a predecessor a restart replaced.
+        self.wedged = False
+        wedged_gauge.set(0)
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="tpu-health-monitor")
         self._thread.start()
@@ -67,6 +82,7 @@ class DeviceHealthMonitor:
             self._thread.join(timeout=WAIT_TIMEOUT_S + 1)
             if self._thread.is_alive():
                 self.wedged = True
+                wedged_gauge.set(1)
                 log.error(
                     "health monitor thread did not stop within %.1fs — "
                     "wedged in the backend event wait; health events are "

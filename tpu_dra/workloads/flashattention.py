@@ -112,17 +112,31 @@ def rope_half(x, positions):
     even/odd interleave) because contiguous half-slices are the cheap
     shape for VMEM lane slicing; as an architecture choice the pairings
     are equally expressive, they just must match everywhere.
+
+    Expressed as the SAME multiply-add the kernel tables use —
+    ``x * cos_t + roll(x, D/2) * sinm_t`` (_rope_tables) — rather than
+    slice-halves + concatenate: the two formulations are bitwise the
+    same math, but the slice+concat shape is miscompiled by this
+    container's XLA CPU SPMD partitioner when the head_dim axis is
+    sharded (a model-parallel mesh whose 'model' extent exceeds
+    n_heads spills into head_dim) — observed as multi-unit logit
+    divergence in the tier-1 TP-parity tests, identical in f32, gone
+    under the roll form. roll() lowers to a collective-permute-style
+    reshard the partitioner handles correctly.
     """
     d = x.shape[-1]
     half = d // 2
-    freqs = jnp.exp(jnp.arange(0, half, dtype=jnp.float32)
-                    * (-2.0 * math.log(ROPE_BASE) / d))
+    j = jnp.arange(d, dtype=jnp.float32) % half
+    freqs = jnp.exp(j * (-2.0 * math.log(ROPE_BASE) / d))
     angles = positions[..., None, None].astype(jnp.float32) * freqs
-    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    cos_t = jnp.cos(angles)
+    # Rotation sign pattern: -sin pairs the first half with its +D/2
+    # partner, +sin the second half with its -D/2 partner (the roll).
+    sign = jnp.where(jnp.arange(d) < half, -1.0, 1.0)
+    sinm_t = jnp.sin(angles) * sign
     xf = x.astype(jnp.float32)
-    x1, x2 = xf[..., :half], xf[..., half:]
-    return jnp.concatenate([x1 * cos - x2 * sin,
-                            x1 * sin + x2 * cos], axis=-1).astype(x.dtype)
+    return (xf * cos_t
+            + jnp.roll(xf, half, axis=-1) * sinm_t).astype(x.dtype)
 
 
 def _rope_tables(s: int, d: int):
