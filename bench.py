@@ -1321,6 +1321,40 @@ def bench_mfu(jax_probe, steps: int = 10):
     return out
 
 
+def bench_trace_overhead(n_spans: int = 200_000):
+    """Tracer cost at scheduler-churn scale (SURVEY §19): ns per
+    begin/end pair with emission ON (ids + open-span tracking + ring
+    append) and OFF (timestamps only — the floor the breakdown
+    derivation always pays), plus the sustained spans/s the enabled
+    path delivers. hack/perf.sh separately A/Bs whole phases
+    (claim-to-ready p50, scheduler churn throughput) tracing-off vs
+    tracing-on in the same round and gates the delta at ≤5%."""
+    from tpu_dra.infra.trace import TRACER
+
+    def spin(n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            span = TRACER.begin("bench.overhead", root=True)
+            span.end()
+        return time.perf_counter() - t0
+
+    spin(n_spans // 10)  # warm (allocator, ring steady state)
+    wall_on = spin(n_spans)
+    TRACER.set_enabled(False)
+    try:
+        spin(n_spans // 10)
+        wall_off = spin(n_spans)
+    finally:
+        TRACER.set_enabled(True)
+    return {
+        "trace_overhead_ns_per_span": round(wall_on / n_spans * 1e9, 1),
+        "trace_overhead_off_ns_per_span": round(
+            wall_off / n_spans * 1e9, 1),
+        "trace_spans_per_s": int(n_spans / wall_on),
+        "trace_overhead_spans": n_spans,
+    }
+
+
 def main():
     out = {}
     try:
@@ -1415,6 +1449,10 @@ def main():
         out.update(bench_chaos_recovery())
     except Exception as e:  # noqa: BLE001 — chaos phase is best-effort
         out["chaos_recovery_error"] = str(e)
+    try:
+        out.update(bench_trace_overhead())
+    except Exception as e:  # noqa: BLE001 — tracer phase is best-effort
+        out["trace_overhead_error"] = str(e)
     if jax_probe is None:
         out["psum_error"] = out["mfu_error"] = "jax unavailable"
     else:

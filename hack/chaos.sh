@@ -29,14 +29,21 @@ REPO_ROOT="$(cd "$(dirname "$0")/.." && pwd)"
 SEEDS="${1:-${CHAOS_SEEDS:-25}}"
 EVENTS="${2:-${CHAOS_EVENTS:-60}}"
 WITNESS_EDGES="$REPO_ROOT/.lockwitness-edges.chaos.json"
-rm -f "$WITNESS_EDGES"
+# Any matrix violation exports a flight-recorder dump here (SURVEY §19)
+# so failed seeds ship their evidence — recent spans, fault firings and
+# workqueue events around the violation — next to the logs.
+FLIGHTREC_DUMP="${TPU_DRA_FLIGHTREC_DUMP:-$REPO_ROOT/.flightrec.chaos.json}"
+rm -f "$WITNESS_EDGES" "$FLIGHTREC_DUMP"
 
 echo ">> chaos matrix: ${SEEDS} seeded schedules x ${EVENTS} events"
 JAX_PLATFORMS=cpu TPU_DRA_TPUINFO_BACKEND=fake \
 TPU_DRA_LOCK_WITNESS_EXPORT="$WITNESS_EDGES" \
+TPU_DRA_FLIGHTREC_DUMP="$FLIGHTREC_DUMP" \
   python -m tpu_dra.simcluster.chaos \
     --seeds "$SEEDS" --seed-start "${CHAOS_SEED_START:-0}" \
-    --events "$EVENTS"
+    --events "$EVENTS" \
+  || { echo "!! chaos matrix failed; flight-recorder dump (if any):" \
+            "$FLIGHTREC_DUMP"; exit 1; }
 
 echo ">> chaos soak (slow-marked pytest tier, lock witness on)"
 JAX_PLATFORMS=cpu TPU_DRA_LOCK_WITNESS=1 \

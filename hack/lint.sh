@@ -10,7 +10,9 @@
 #    R3 zero-copy informer reads are read-only, R4 fault-site registry
 #    coverage, R5 metric catalog, R6 feature-gate names, R7 prepare-
 #    pipeline except paths unwind, R8 no success externalization before
-#    the terminal store — plus the draracer interprocedural pass
+#    the terminal store, R12 span begin/end discipline (every
+#    tracer.begin outside a with-form must end()/abandon() on all
+#    paths — SURVEY §19) — plus the draracer interprocedural pass
 #    (SURVEY §16): R9 whole-tree *_locked reachability over the call
 #    graph, R10 guarded-by inference, R11 static lock-order graph
 #    acyclicity. Any unsuppressed finding fails, and so does any
@@ -37,7 +39,7 @@ python -m compileall -q \
   "$REPO_ROOT/tpu_dra" "$REPO_ROOT/tests" "$REPO_ROOT/bench.py" \
   "$REPO_ROOT/hack"
 
-echo ">> dralint (R1-R11) + fault-site coverage"
+echo ">> dralint (R1-R12) + fault-site coverage"
 python -m tpu_dra.analysis --root "$REPO_ROOT" --sites-report \
   --require-justified ${DRALINT_NO_CACHE:+--no-cache}
 

@@ -830,3 +830,137 @@ class TestResultCache:
         assert [f.to_dict() for f in warm.suppressed] \
             == [f.to_dict() for f in cold.suppressed]
         assert warm.files == cold.files
+
+
+# ---------------------------------------------------------------------------
+# R12: span begin/end discipline (the claim tracer, SURVEY §19)
+# ---------------------------------------------------------------------------
+
+class TestR12SpanDiscipline:
+    def test_fires_on_never_ended_span(self):
+        out = lint("""
+            def alloc(tracer):
+                span = tracer.begin("sched.allocate")
+                do_work(span.trace_id)
+        """, "R12")
+        assert rule_ids(out) == ["R12"]
+        assert "never" in out[0].message
+
+    def test_fires_on_discarded_begin(self):
+        out = lint("""
+            def alloc():
+                TRACER.begin("sched.allocate")
+                do_work()
+        """, "R12")
+        assert rule_ids(out) == ["R12"]
+        assert "discarded" in out[0].message
+
+    def test_fires_when_close_not_in_finally_past_risky_code(self):
+        # The close exists but a call between begin and close can raise
+        # straight past it — the span leaks on that path.
+        out = lint("""
+            def alloc(tracer):
+                span = tracer.begin("sched.allocate")
+                commit_allocation()
+                span.end()
+        """, "R12")
+        assert rule_ids(out) == ["R12"]
+        assert "finally" in out[0].message
+
+    def test_fires_when_early_return_skips_close(self):
+        out = lint("""
+            def alloc(tracer, ready):
+                span = tracer.begin("x")
+                if not ready:
+                    return None
+                span.end()
+        """, "R12")
+        assert rule_ids(out) == ["R12"]
+
+    def test_close_in_finally_passes(self):
+        out = lint("""
+            def alloc(tracer):
+                span = tracer.begin("sched.allocate")
+                ok = False
+                try:
+                    commit_allocation()
+                    ok = True
+                finally:
+                    if ok:
+                        span.end()
+                    else:
+                        span.abandon("write failed")
+        """, "R12")
+        assert out == []
+
+    def test_tracer_end_form_in_finally_passes(self):
+        out = lint("""
+            def alloc(tracer):
+                span = tracer.begin("x")
+                try:
+                    work()
+                finally:
+                    tracer.end(span)
+        """, "R12")
+        assert out == []
+
+    def test_straight_line_begin_end_passes(self):
+        # Nothing between begin and end can raise: no finally needed.
+        out = lint("""
+            def stamp(tracer):
+                span = tracer.begin("x")
+                span.end()
+        """, "R12")
+        assert out == []
+
+    def test_with_form_passes(self):
+        out = lint("""
+            def timed(tracer):
+                with tracer.span("prepare.apply"):
+                    risky_work()
+        """, "R12")
+        assert out == []
+
+    def test_escaping_span_is_callers_problem(self):
+        # Stored into an attribute / returned / passed on: ownership
+        # transferred — the dynamic zero-open-span gates cover it.
+        out = lint("""
+            def start(self, tracer):
+                self._span = tracer.begin("x")
+
+            def mint(tracer):
+                span = tracer.begin("x")
+                return span
+
+            def hand_off(tracer, registry):
+                span = tracer.begin("x")
+                registry.adopt(span)
+        """, "R12")
+        assert out == []
+
+    def test_nested_scope_close_does_not_vouch_for_outer(self):
+        # The close lives in a nested def that may never run.
+        out = lint("""
+            def outer(tracer):
+                span = tracer.begin("x")
+                def later():
+                    span.end()
+                do_work()
+        """, "R12")
+        assert rule_ids(out) == ["R12"]
+
+    def test_test_modules_exempt(self):
+        out = lint("""
+            def test_spans(tracer):
+                span = tracer.begin("x")
+                do_work()
+        """, "R12", relpath="tests/test_x.py")
+        assert out == []
+
+    def test_justified_suppression(self):
+        out = lint("""
+            def alloc(tracer):
+                span = tracer.begin("x")  # dralint: ignore[R12] — closed by the watchdog on timeout
+                do_work()
+        """, "R12")
+        assert out == []

@@ -966,3 +966,124 @@ class TestTimesliceReconciliation:
         # not parked as PrepareStarted.
         fresh = CheckpointManager(ckpt_dir).load()
         assert "mp-crash" not in fresh.claims
+
+
+class TestClaimTracing:
+    """SURVEY §19: one Allocated claim yields ONE well-nested span tree
+    spanning scheduler → RPC → prepare/journal/CDI → env export → mesh
+    plan, stitched across every hop by W3C-style traceparent strings."""
+
+    def test_prepare_trace_tree_rpc_rooted(self, harness):
+        """A directly-prepared claim (no scheduler) roots its trace at
+        rpc.prepare; the prepare pipeline, CDI env export and mesh
+        build all continue the same trace."""
+        from tpu_dra.infra import trace
+        from tpu_dra.topology.meshexport import plan_from_env
+
+        snap = trace.TRACER.open_ids()
+        claim = make_claim(harness["cluster"], ["chip-0", "chip-1"])
+        assert grpc_prepare(harness, claim).error == ""
+        env = claim_env(harness, claim["metadata"]["uid"])
+        # The claim CDI env carries the trace context next to the
+        # coordinate export — the workload container's continuation key.
+        assert "TPU_DRA_TRACEPARENT" in env
+        assert "TPU_CHIP_COORDS" in env
+        parsed = trace.parse_traceparent(env["TPU_DRA_TRACEPARENT"])
+        assert parsed is not None
+        trace_id = parsed[0]
+        plan = plan_from_env(env)
+        assert plan.n_devices == 2
+        # Structure: rpc.prepare roots the trace; prepare.claim nests
+        # under it; the CDI and journal spans and the mesh build nest
+        # under prepare.claim.
+        assert trace.verify_trace(trace_id) == []
+        tree = {parent: sorted(s.name for s in children)
+                for parent, children in
+                trace.span_tree(trace_id).items()}
+        assert tree[""] == ["rpc.prepare"]
+        assert tree["rpc.prepare"] == ["prepare.claim"]
+        kids = tree["prepare.claim"]
+        assert "prepare.cdi_write" in kids
+        assert "prepare.journal" in kids
+        assert "mesh.build" in kids
+        assert trace.TRACER.open_since(snap) == []
+
+    def test_full_loop_scheduler_to_mesh(self, harness):
+        """The acceptance tree: a claim ALLOCATED by the real sim
+        scheduler (traceparent stamped into the claim annotation in the
+        allocation write) is prepared over the real DRA gRPC socket and
+        mesh-planned from its CDI env — one trace, rooted at
+        sched.pod_seen, well-nested through mesh.build."""
+        from tpu_dra.infra import trace
+        from tpu_dra.k8s.resources import DEVICECLASSES, NODES, PODS
+        from tpu_dra.simcluster.scheduler import Scheduler
+        from tpu_dra.testing import DEFAULT_SCHED_SELECTOR
+        from tpu_dra.topology.meshexport import plan_from_env
+
+        snap = trace.TRACER.open_ids()
+        cluster = harness["cluster"]
+        # The driver already published node-a's ResourceSlice at start;
+        # give the scheduler the rest of the control plane: the Node,
+        # a DeviceClass selecting whole chips, a claim and its pod.
+        cluster.create(NODES, {"apiVersion": "v1", "kind": "Node",
+                               "metadata": {"name": "node-a",
+                                            "labels": {}}})
+        cluster.create(DEVICECLASSES, {
+            "apiVersion": "resource.k8s.io/v1", "kind": "DeviceClass",
+            "metadata": {"name": "tpu.dev"},
+            "spec": {"selectors": [
+                {"cel": {"expression": DEFAULT_SCHED_SELECTOR}}]}})
+        claim = cluster.create(RESOURCECLAIMS, {
+            "apiVersion": "resource.k8s.io/v1", "kind": "ResourceClaim",
+            "metadata": {"name": "traced", "namespace": "default"},
+            "spec": {"devices": {"requests": [
+                {"name": "tpu",
+                 "exactly": {"deviceClassName": "tpu.dev",
+                             "count": 4}}]}}})
+        cluster.create(PODS, {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "traced-pod", "namespace": "default"},
+            "spec": {"containers": [{"name": "c", "image": "x"}],
+                     "resourceClaims": [
+                         {"name": "tpu",
+                          "resourceClaimName": "traced"}]}},
+            namespace="default")
+        Scheduler(cluster).reconcile_once()
+        allocated = cluster.get(RESOURCECLAIMS, "traced", "default")
+        assert (allocated.get("status") or {}).get("allocation"), \
+            "scheduler did not allocate the claim"
+        ann_tp = (allocated["metadata"].get("annotations") or {}).get(
+            trace.TRACEPARENT_ANNOTATION)
+        parsed = trace.parse_traceparent(ann_tp)
+        assert parsed is not None, \
+            f"no traceparent annotation stamped at allocation: {ann_tp!r}"
+        trace_id = parsed[0]
+
+        # Prepare over the real wire, then build the mesh from the env.
+        assert grpc_prepare(harness, allocated).error == ""
+        env = claim_env(harness, allocated["metadata"]["uid"])
+        env_parsed = trace.parse_traceparent(env["TPU_DRA_TRACEPARENT"])
+        assert env_parsed is not None and env_parsed[0] == trace_id, \
+            "the CDI env export switched traces mid-claim"
+        plan = plan_from_env(env)
+        assert plan.n_devices == 4
+
+        # ONE well-nested tree, scheduler → RPC → prepare/journal/CDI →
+        # env export → mesh plan (asserted structurally).
+        assert trace.verify_trace(trace_id) == []
+        tree = trace.span_tree(trace_id)
+        names = {parent: sorted(s.name for s in children)
+                 for parent, children in tree.items()}
+        assert names[""] == ["sched.pod_seen"]
+        assert names["sched.pod_seen"] == ["sched.allocate"]
+        assert names["sched.allocate"] == ["rpc.prepare"]
+        assert names["rpc.prepare"] == ["prepare.claim"]
+        kids = names["prepare.claim"]
+        assert "prepare.cdi_write" in kids
+        assert "prepare.journal" in kids
+        assert "mesh.build" in kids
+        # Every span closed ok — nothing dangling after the loop closes.
+        for children in tree.values():
+            for s in children:
+                assert s.end_ns is not None and s.status == "ok", s
+        assert trace.TRACER.open_since(snap) == []

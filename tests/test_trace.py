@@ -1,0 +1,396 @@
+"""infra.trace: span tracer, W3C-style propagation, flight recorder
+(SURVEY §19).
+
+Covers the span lifecycle (begin/end/abandon idempotency, the with-form
+and its thread-local current-span stack), traceparent round-trips and
+malformed-input tolerance, open-span tracking and the completeness
+verifier, the trace.emit degradation contract, the tracing-off mode
+(timestamps survive, ids/emission do not), the flight recorder's ring /
+dump triggers (wedged health monitor, SIGUSR1), and the lock-free
+metric tallies.
+"""
+
+import json
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+from tpu_dra.infra import trace
+from tpu_dra.infra.faults import FAULTS, Always, OneShot
+from tpu_dra.infra.trace import (
+    RECORDER, TRACER, FlightRecorder, Tracer, format_traceparent,
+    parse_traceparent, span_tree, verify_trace,
+)
+
+
+@pytest.fixture
+def tracer():
+    """A private tracer+recorder so assertions never race the global
+    singletons' traffic from sibling tests."""
+    rec = FlightRecorder(maxlen=256)
+    return Tracer(rec), rec
+
+
+class TestTraceparent:
+    def test_round_trip(self):
+        t, _ = tracer_pair = (Tracer(FlightRecorder()), None)
+        span = t.begin("x", root=True)
+        tp = span.traceparent()
+        assert tp.startswith("00-") and tp.endswith("-01")
+        assert parse_traceparent(tp) == (span.trace_id, span.span_id)
+        span.end()
+
+    @pytest.mark.parametrize("bad", [
+        None, "", "garbage", "00-short-short-01",
+        "01-" + "a" * 32 + "-" + "b" * 16 + "-01",  # unknown version
+        "00-" + "z" * 32 + "-" + "b" * 16 + "-01",  # non-hex
+        "00-" + "a" * 32 + "-" + "b" * 15 + "-01",  # short span id
+    ])
+    def test_malformed_is_tolerated(self, bad):
+        assert parse_traceparent(bad) is None
+        # A begin with a torn traceparent starts a FRESH trace instead
+        # of crashing the pipeline that carried it.
+        t = Tracer(FlightRecorder())
+        span = t.begin("x", traceparent=bad, root=True)
+        assert span.trace_id and not span.parent_id
+        span.end()
+
+    def test_format_empty_ids(self):
+        assert format_traceparent("", "") == ""
+
+
+class TestSpanLifecycle:
+    def test_begin_end_records(self, tracer):
+        t, rec = tracer
+        span = t.begin("op", root=True, attributes={"k": "v"})
+        assert t.open_spans() == [span]
+        span.end()
+        assert t.open_spans() == []
+        assert rec.spans() == [span]
+        assert span.status == "ok" and span.end_ns >= span.start_ns
+
+    def test_close_is_idempotent(self, tracer):
+        t, rec = tracer
+        span = t.begin("op", root=True)
+        span.end()
+        end_ns = span.end_ns
+        span.abandon("late")  # crash-path finally double-close
+        assert span.status == "ok" and span.end_ns == end_ns
+        assert len(rec.spans()) == 1
+        # The late abandon must not scribble its reason onto the
+        # already-emitted span either — the ring holds the SAME object,
+        # and a dump showing status ok + error='late' would lie.
+        assert not (span.attributes or {}).get("error")
+
+    def test_abandon_statuses(self, tracer):
+        t, rec = tracer
+        a = t.begin("a", root=True)
+        a.abandon()
+        b = t.begin("b", root=True)
+        b.abandon("disk on fire")
+        assert a.status == "abandoned"
+        assert b.status == "error"
+        assert b.attributes["error"] == "disk on fire"
+
+    def test_explicit_parent_and_traceparent(self, tracer):
+        t, _ = tracer
+        root = t.begin("root", root=True)
+        child = t.begin("child", parent=root)
+        assert (child.trace_id, child.parent_id) == (root.trace_id,
+                                                     root.span_id)
+        hop = t.begin("hop", traceparent=child.traceparent())
+        assert (hop.trace_id, hop.parent_id) == (root.trace_id,
+                                                 child.span_id)
+        for s in (hop, child, root):
+            s.end()
+
+    def test_with_form_and_current_stack(self, tracer):
+        t, _ = tracer
+        assert t.current() is None
+        with t.span("outer", root=True) as outer:
+            assert t.current() is outer
+            with t.span("inner") as inner:
+                assert t.current() is inner
+                assert inner.parent_id == outer.span_id
+                # explicit begin with no parent attaches to current
+                leaf = t.begin("leaf")
+                assert leaf.parent_id == inner.span_id
+                leaf.end()
+                # ... unless the caller pins a root
+                detached = t.begin("detached", root=True)
+                assert detached.trace_id != outer.trace_id
+                detached.end()
+            assert t.current() is outer
+        assert t.current() is None
+
+    def test_with_form_marks_error_on_exception(self, tracer):
+        t, rec = tracer
+        with pytest.raises(ValueError):
+            with t.span("boom", root=True):
+                raise ValueError("nope")
+        (span,) = rec.spans()
+        assert span.status == "error"
+        assert "ValueError" in span.attributes["error"]
+
+    def test_stack_is_thread_local(self, tracer):
+        t, _ = tracer
+        seen = {}
+
+        def other():
+            seen["current"] = t.current()
+
+        with t.span("main-only", root=True):
+            th = threading.Thread(target=other)
+            th.start()
+            th.join()
+        assert seen["current"] is None
+
+    def test_record_span_backdates(self, tracer):
+        t, rec = tracer
+        span = t.record_span("synth", 0.25)
+        assert span.end_ns is not None
+        assert span.duration_s == pytest.approx(0.25, rel=1e-6)
+
+    def test_duration_live_while_open(self, tracer):
+        t, _ = tracer
+        span = t.begin("x", root=True)
+        time.sleep(0.01)
+        assert span.duration_ms >= 5
+        span.end()
+
+
+class TestDisabledMode:
+    def test_disabled_spans_time_but_never_emit(self):
+        rec = FlightRecorder(maxlen=16)
+        t = Tracer(rec)
+        t.set_enabled(False)
+        span = t.begin("x", root=True)
+        time.sleep(0.005)
+        span.end()
+        assert span.duration_ms >= 2          # breakdowns keep working
+        assert span.traceparent() == ""       # no id minted
+        assert rec.spans() == []              # nothing emitted
+        assert t.open_spans() == []           # never tracked
+        t.set_enabled(True)
+        span2 = t.begin("x", root=True)
+        span2.end()
+        assert rec.spans() == [span2]
+
+
+class TestOpenTrackingAndVerification:
+    def test_open_since_window(self, tracer):
+        t, _ = tracer
+        old = t.begin("old", root=True)
+        snap = t.open_ids()
+        new = t.begin("new", root=True)
+        assert [s.name for s in t.open_since(snap)] == ["new"]
+        new.end()
+        assert t.open_since(snap) == []
+        old.end()
+
+    def test_verify_complete_tree(self, tracer):
+        t, _ = tracer
+        root = t.begin("sched.pod_seen", root=True)
+        child = t.begin("rpc.prepare", parent=root)
+        leaf = t.begin("prepare.claim", parent=child)
+        for s in (leaf, child, root):
+            s.end()
+        assert verify_trace(root.trace_id, tracer=t) == []
+        tree = span_tree(root.trace_id, tracer=t)
+        assert [s.name for s in tree[""]] == ["sched.pod_seen"]
+        assert [s.name for s in tree["rpc.prepare"]] == ["prepare.claim"]
+
+    def test_verify_flags_open_span(self, tracer):
+        t, _ = tracer
+        root = t.begin("r", root=True)
+        out = verify_trace(root.trace_id, tracer=t)
+        assert any("still open" in v for v in out)
+        root.end()
+
+    def test_verify_flags_missing_parent(self, tracer):
+        t, _ = tracer
+        orphan = t.begin(
+            "child", traceparent="00-" + "a" * 32 + "-" + "b" * 16 + "-01")
+        orphan.end()
+        out = verify_trace("a" * 32, tracer=t)
+        assert any("missing parent" in v for v in out)
+
+    def test_verify_flags_prepare_outside_rpc(self, tracer):
+        t, _ = tracer
+        root = t.begin("sched.pod_seen", root=True)
+        rpc = t.begin("rpc.prepare", parent=root)
+        stray = t.begin("prepare.claim", parent=root)  # sibling, not child
+        for s in (stray, rpc, root):
+            s.end()
+        out = verify_trace(root.trace_id, tracer=t)
+        assert any("does not nest under any rpc" in v for v in out)
+
+    def test_verify_unknown_trace(self, tracer):
+        t, _ = tracer
+        assert verify_trace("f" * 32, tracer=t) == ["trace " + "f" * 32 +
+                                                    ": no spans recorded"]
+
+
+class TestEmitFaultDegradation:
+    def test_drop_counts_and_marks_trace(self, tracer):
+        t, rec = tracer
+        span = t.begin("x", root=True)
+        with FAULTS.armed("trace.emit", OneShot()):
+            span.end()  # the drop must never raise into the caller
+        assert rec.spans() == []
+        assert t.trace_dropped(span.trace_id)
+        assert t._tally_dropped.value == 1
+        # Structure checks skip a dropped trace entirely — even when
+        # EVERY span was lost at the emit seam (the chaos walks arm
+        # trace.emit against real allocations); zero-open still holds.
+        assert verify_trace(span.trace_id, tracer=t) == []
+
+    def test_operation_survives_hard_outage(self, tracer):
+        t, rec = tracer
+        with FAULTS.armed("trace.emit", Always()):
+            for _ in range(5):
+                with t.span("op", root=True):
+                    pass
+        assert t.open_spans() == []
+        assert rec.spans() == []
+        assert t._tally_dropped.value == 5
+
+    def test_sync_metrics_pushes_tallies(self, tracer):
+        t, _ = tracer
+        from tpu_dra.infra import trace as tr
+        before_started = tr.SPANS_STARTED.value()
+        before_ok = tr.SPANS_COMPLETED.value(labels={"status": "ok"})
+        with t.span("a", root=True):
+            pass
+        b = t.begin("b", root=True)
+        b.abandon("x")
+        t.sync_metrics()
+        assert tr.SPANS_STARTED.value() == before_started + 2
+        assert tr.SPANS_COMPLETED.value(
+            labels={"status": "ok"}) == before_ok + 1
+        # A second sync with no new spans pushes nothing.
+        t.sync_metrics()
+        assert tr.SPANS_STARTED.value() == before_started + 2
+
+
+class TestFlightRecorder:
+    def test_ring_bounds_and_kinds(self):
+        rec = FlightRecorder(maxlen=4)
+        t = Tracer(rec)
+        rec.record_wq("q", "add", "k1")
+        rec.record_fault("trace.emit")
+        for i in range(4):
+            with t.span(f"s{i}", root=True):
+                pass
+        events = rec.snapshot()
+        assert len(events) == 4  # oldest evicted silently
+        assert {e["kind"] for e in events} == {"span"}
+
+    def test_dump_writes_json_with_open_spans(self, tmp_path):
+        leak = TRACER.begin("leaky", root=True)
+        try:
+            path = str(tmp_path / "dump.json")
+            out = RECORDER.dump(reason="manual", path=path)
+            assert out == path
+            doc = json.loads(open(path).read())
+            assert doc["reason"] == "manual"
+            assert any(s["name"] == "leaky" for s in doc["open_spans"])
+            assert isinstance(doc["events"], list)
+        finally:
+            leak.abandon("test over")
+
+    def test_wedged_health_monitor_dumps(self, tmp_path, monkeypatch):
+        """The health monitor's wedged branch is a dump trigger: a
+        backend whose event wait never returns forces the stop()
+        timeout, and the dump lands on disk."""
+        from tpu_dra.infra.metrics import DefaultRegistry
+        from tpu_dra.tpuplugin.health import DeviceHealthMonitor
+
+        monkeypatch.setenv("TPU_DRA_FLIGHTRECORDER_DIR", str(tmp_path))
+
+        class WedgedBackend:
+            def wait_health_event(self, timeout):
+                time.sleep(30)  # ignores the timeout: wedged
+
+        mon = DeviceHealthMonitor(WedgedBackend(), lambda e: None)
+        mon.start()
+        time.sleep(0.05)
+        mon.stop()
+        assert mon.wedged
+        dumps = [f for f in os.listdir(tmp_path)
+                 if f.startswith("tpu-dra-flightrec-")]
+        assert dumps, "wedged monitor did not dump the flight recorder"
+        doc = json.loads((tmp_path / dumps[0]).read_text())
+        assert doc["reason"] == "wedged"
+
+    def test_sigusr1_dumps(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TPU_DRA_FLIGHTRECORDER_DIR", str(tmp_path))
+        old = signal.getsignal(signal.SIGUSR1)
+        try:
+            assert trace.install_signal_handler()
+            os.kill(os.getpid(), signal.SIGUSR1)
+            # Give the interpreter a bytecode boundary to run it.
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                if any(f.startswith("tpu-dra-flightrec-")
+                       for f in os.listdir(tmp_path)):
+                    break
+                time.sleep(0.01)
+            assert any(f.startswith("tpu-dra-flightrec-")
+                       for f in os.listdir(tmp_path))
+        finally:
+            signal.signal(signal.SIGUSR1, old)
+
+    def test_dump_rate_limit(self, tmp_path, monkeypatch):
+        """Storm-prone triggers (the wedged RPC pipeline) rate-limit:
+        within the window the previous dump IS the evidence — no fresh
+        multi-MB file per retrying RPC."""
+        monkeypatch.setenv("TPU_DRA_FLIGHTRECORDER_DIR", str(tmp_path))
+        trace._last_dump_ns.pop("storm-test", None)
+        first = trace.dump_flight_recorder("storm-test",
+                                           min_interval_s=60.0)
+        assert first.startswith(str(tmp_path))
+        second = trace.dump_flight_recorder("storm-test",
+                                            min_interval_s=60.0)
+        assert second.startswith("<rate-limited")
+        # Unlimited reasons (manual, sigusr1, chaos) never suppress.
+        a = trace.dump_flight_recorder("manual")
+        b = trace.dump_flight_recorder("manual")
+        assert a != b and not b.startswith("<")
+
+    def test_fault_firings_recorded(self):
+        """The fault registry's fire observer lands armed firings in
+        the GLOBAL recorder's ring next to the spans they perturbed."""
+        with FAULTS.armed("k8s.api.request", Always()):
+            with pytest.raises(Exception):
+                FAULTS.check("k8s.api.request")
+        assert any(ev.get("kind") == "fault"
+                   and ev.get("site") == "k8s.api.request"
+                   for ev in RECORDER.snapshot())
+
+
+class TestConcurrency:
+    def test_parallel_span_storm_loses_nothing(self, tracer):
+        """The lock-free hot path under contention: every begun span is
+        tracked open exactly until closed, the started tally is exact,
+        and the ring holds the most recent completions."""
+        t, rec = tracer
+        n_threads, per = 8, 200
+
+        def worker(i):
+            for j in range(per):
+                with t.span(f"w{i}", root=True):
+                    pass
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(n_threads)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert t.open_spans() == []
+        assert t._tally_started.value == n_threads * per
+        assert len(rec.spans()) == min(256, n_threads * per)

@@ -53,6 +53,24 @@ class _ZeroLimiter(RateLimiter):
         return 0.0
 
 
+def _trace_snapshot():
+    """Open-span ids at scenario build time: terminal-state checks
+    assert only spans THIS scenario began were drained (SURVEY §19) —
+    a sibling test's leaked span must not fail the model checker."""
+    from tpu_dra.infra import trace
+    return trace.TRACER.open_ids()
+
+
+def _open_span_violations(snapshot) -> List[str]:
+    """The span-closure invariant at every terminal state — including
+    crash-recovery replays: the prepare pipeline's finally must leave
+    only CLOSED (possibly abandoned) spans behind, whatever the
+    interleaving or crash point did."""
+    from tpu_dra.infra import trace
+    return trace.open_span_violations(snapshot,
+                                      context="at terminal state")
+
+
 def _mk_claim(name: str, devices: List[str], rv: int,
               uid: Optional[str] = None) -> Dict:
     return {
@@ -163,7 +181,7 @@ class SchedChurnScenario:
         sched.spawn("producer2", producer2)
         sched.spawn("stopper", stopper)
         return {"queue": queue, "index": index, "truth": truth,
-                "overlaps": overlaps}
+                "overlaps": overlaps, "trace_snap": _trace_snapshot()}
 
     def check(self, ctx) -> List[str]:
         from tpu_dra.simcluster.chaos import chip_conflicts
@@ -184,6 +202,7 @@ class SchedChurnScenario:
         claims = [truth[k] for k in sorted(truth)]
         violations.extend(index.diff_against(claims))
         violations.extend(chip_conflicts(claims))
+        violations.extend(_open_span_violations(ctx["trace_snap"]))
         return violations
 
     def cleanup(self, ctx) -> None:
@@ -356,7 +375,8 @@ class EvictChurnScenario:
         sched.spawn("evictor", evictor)
         sched.spawn("stopper", stopper)
         return {"queue": queue, "index": index, "truth": truth,
-                "dead": dead, "evicted": evicted}
+                "dead": dead, "evicted": evicted,
+                "trace_snap": _trace_snapshot()}
 
     def check(self, ctx) -> List[str]:
         import heapq
@@ -386,6 +406,7 @@ class EvictChurnScenario:
                     violations.append(
                         f"claim {key} bound to dead device(s) "
                         f"{on_dead} after eviction")
+        violations.extend(_open_span_violations(ctx["trace_snap"]))
         return violations
 
     def cleanup(self, ctx) -> None:
@@ -448,7 +469,8 @@ class BatchPrepareScenario:
         sched.spawn("batch2", batch2)
         sched.spawn("health", health)
         return {"tmp": tmp, "state": state, "cdi": cdi,
-                "claims": claims, "results": results}
+                "claims": claims, "results": results,
+                "trace_snap": _trace_snapshot()}
 
     def check(self, ctx) -> List[str]:
         from tpu_dra.tpuplugin.checkpoint import PREPARE_COMPLETED
@@ -478,6 +500,7 @@ class BatchPrepareScenario:
             v.append(f"idempotent re-prepare failed: {err}")
         if len(state.healthy_devices()) != len(state.allocatable):
             v.append("health marks not fully reversed")
+        v.extend(_open_span_violations(ctx["trace_snap"]))
         return v
 
     def cleanup(self, ctx) -> None:
@@ -526,7 +549,8 @@ class BatchPrepareCrashScenario:
         claims = {n: _mk_claim(n, [f"chip-{i}"], rv=1)
                   for i, n in enumerate(("ca", "cb", "cc"))}
         return {"tmp": tmp, "state": state, "cdi": cdi,
-                "claims": claims, "externalized": {}}
+                "claims": claims, "externalized": {},
+                "trace_snap": _trace_snapshot()}
 
     def body(self, ctx) -> None:
         from tpu_dra.infra.faults import FAULTS, Always
@@ -640,6 +664,10 @@ class BatchPrepareCrashScenario:
             if specs != want:
                 v.append(f"replay CDI specs {sorted(specs)} != "
                          f"{sorted(want)}")
+            # Span closure INCLUDING crash-recovery replays: the crash
+            # unwound prepare_batch through its finally (spans
+            # abandoned, never leaked), and the replay closed its own.
+            v.extend(_open_span_violations(ctx["trace_snap"]))
             return v
         finally:
             if state2 is not None:
@@ -685,7 +713,7 @@ class QuarantineCrashScenario:
         uuids = {c.index: c.uuid for c in backend.chips()}
         return {"tmp": tmp, "state": state, "uuids": uuids,
                 "claims": {"qa": _mk_claim("qa", ["chip-2"], rv=1)},
-                "externalized": {}}
+                "externalized": {}, "trace_snap": _trace_snapshot()}
 
     @staticmethod
     def _ladder(state, chip: int) -> None:
@@ -803,6 +831,7 @@ class QuarantineCrashScenario:
             if any(state2.allocatable[n].chip.uuid == uuids[1]
                    for n in names):
                 v.append("replayed quarantine of chip 1 still published")
+            v.extend(_open_span_violations(ctx["trace_snap"]))
             return v
         finally:
             if state2 is not None:

@@ -908,6 +908,180 @@ class NoSuccessBeforeTerminalStore(Rule):
 
 
 # ---------------------------------------------------------------------------
+# R12: span begin/end discipline (the claim tracer, SURVEY §19)
+# ---------------------------------------------------------------------------
+
+_SPAN_CLOSERS = {"end", "abandon"}
+
+
+def _is_tracer_recv(chain: List[str]) -> bool:
+    """Receiver names the tracer by convention (``TRACER``, ``tracer``,
+    ``self._tracer`` …) — the same naming-keys-the-rule design as the
+    ``*_lock`` family."""
+    return any("tracer" in _norm(c) for c in chain[:-1])
+
+
+def _span_begin_call(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "begin"
+            and _is_tracer_recv(attr_chain(node.func)))
+
+
+def _walk_scope(fn) -> Iterator[ast.AST]:
+    """Walk `fn`'s body WITHOUT descending into nested functions /
+    lambdas — each nested scope gets its own R12 visit, so a begin
+    there is neither double-reported nor credited with an outer close."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _close_target(node: ast.Call) -> Optional[str]:
+    """The span variable a close call closes: ``x.end()`` /
+    ``x.abandon()`` / ``TRACER.end(x)`` / ``TRACER.abandon(x)``."""
+    if not isinstance(node.func, ast.Attribute) \
+            or node.func.attr not in _SPAN_CLOSERS:
+        return None
+    recv = node.func.value
+    if isinstance(recv, ast.Name):
+        chain = attr_chain(node.func)
+        if _is_tracer_recv(chain):
+            if node.args and isinstance(node.args[0], ast.Name):
+                return node.args[0].id
+            return None
+        return recv.id
+    return None
+
+
+@register
+class SpanBeginEndDiscipline(Rule):
+    """R12: every ``tracer.begin(...)`` outside the ``with``-form must
+    have an ``end()``/``abandon()`` on all paths — a span that leaks
+    open poisons the trace-completeness invariants chaos and drmc gate
+    on (zero open spans at quiesce / every terminal state), and its
+    trace silently stops attributing.
+
+    Lexical approximation (same altitude as R7): a begun span held in a
+    local variable must be (a) discarded — a finding outright, the span
+    can never be closed; (b) closed somewhere — no close at all is a
+    finding; and (c) closed in a ``finally`` block whenever anything
+    between the begin and the close can raise (a call, a raise, an
+    early return) — a straight-line begin/close pair needs no finally.
+    A span that ESCAPES the function (returned, stored into an
+    attribute/subscript, aliased, or passed to a non-close call) is the
+    caller's to close — the dynamic zero-open-span gates backstop those
+    paths. The ``with TRACER.span(...)`` form closes itself and is
+    always clean."""
+
+    rule_id = "R12"
+    title = "span begin/end discipline"
+
+    def scan(self, module: Module, ctx: ProjectContext) -> Iterator[Finding]:
+        if module.is_test or module.is_chaos:
+            return iter(())
+        findings: List[Finding] = []
+        for fn in ast.walk(module.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            findings.extend(self._scan_function(module, fn))
+        return iter(findings)
+
+    def _scan_function(self, module: Module, fn) -> List[Finding]:
+        begins: Dict[str, ast.Call] = {}       # var -> begin call node
+        discarded: List[ast.Call] = []
+        closes: Dict[str, List[ast.Call]] = {}
+        escaped: Set[str] = set()
+        finally_calls: Set[int] = set()        # id() of calls in finalbody
+        for node in _walk_scope(fn):
+            if isinstance(node, ast.Try):
+                for stmt in node.finalbody:
+                    for sub in ast.walk(stmt):
+                        if isinstance(sub, ast.Call):
+                            finally_calls.add(id(sub))
+            elif isinstance(node, ast.Expr) \
+                    and _span_begin_call(node.value):
+                discarded.append(node.value)
+            elif isinstance(node, ast.Assign):
+                if _span_begin_call(node.value):
+                    if len(node.targets) == 1 \
+                            and isinstance(node.targets[0], ast.Name):
+                        begins[node.targets[0].id] = node.value
+                    # attribute/subscript/tuple target: escapes — the
+                    # holder's owner closes it (device_state's b.span).
+                elif isinstance(node.value, ast.Name):
+                    escaped.add(node.value.id)  # aliased/stored
+            elif isinstance(node, (ast.Return, ast.Yield)) \
+                    and node.value is not None:
+                for sub in ast.walk(node.value):
+                    if isinstance(sub, ast.Name):
+                        escaped.add(sub.id)
+            elif isinstance(node, ast.Call):
+                target = _close_target(node)
+                if target is not None:
+                    closes.setdefault(target, []).append(node)
+                else:
+                    for arg in list(node.args) \
+                            + [kw.value for kw in node.keywords]:
+                        if isinstance(arg, ast.Name):
+                            escaped.add(arg.id)
+        out: List[Finding] = []
+        for call in discarded:
+            out.append(Finding(
+                rule="R12", path=module.relpath, line=call.lineno,
+                col=call.col_offset,
+                message=f"tracer.begin() result discarded in {fn.name}()"
+                        " — the span can never be ended (bind it and "
+                        "end()/abandon() it, or use the with-form)"))
+        for var, begin in sorted(begins.items()):
+            if var in escaped:
+                continue  # ownership transferred; dynamic gates cover it
+            var_closes = closes.get(var, [])
+            if not var_closes:
+                out.append(Finding(
+                    rule="R12", path=module.relpath, line=begin.lineno,
+                    col=begin.col_offset,
+                    message=f"span '{var}' begun in {fn.name}() is never"
+                            " end()ed/abandon()ed — it leaks open and "
+                            "fails the quiesce zero-open-span invariant"))
+                continue
+            if any(id(c) in finally_calls for c in var_closes):
+                continue  # closed on all paths by construction
+            last_close = max(c.lineno for c in var_closes)
+            # Exclude the begin/close calls AND their sub-expressions
+            # (a multi-line begin's attribute dict is not risky work).
+            own = {id(n) for n in ast.walk(begin)}
+            for c in var_closes:
+                own |= {id(n) for n in ast.walk(c)}
+            risky = False
+            for node in _walk_scope(fn):
+                if id(node) in own:
+                    continue
+                if begin.lineno < getattr(node, "lineno", -1) < last_close:
+                    if isinstance(node, (ast.Raise, ast.Return)):
+                        risky = True
+                        break
+                    if isinstance(node, ast.Call):
+                        risky = True
+                        break
+            if risky:
+                out.append(Finding(
+                    rule="R12", path=module.relpath, line=begin.lineno,
+                    col=begin.col_offset,
+                    message=f"span '{var}' begun in {fn.name}() is "
+                            "closed, but code between begin and close "
+                            "can raise/return past it — move the "
+                            "end()/abandon() into a finally (or use "
+                            "the with-form)"))
+        return out
+
+
+# ---------------------------------------------------------------------------
 # Site-coverage report (informational; hack/lint.sh --sites-report)
 # ---------------------------------------------------------------------------
 

@@ -11,12 +11,14 @@ queue (:386-434); the node removes itself from the status on shutdown.
 from __future__ import annotations
 
 import logging
+import os
 import queue
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
 from tpu_dra.api import types as apitypes
+from tpu_dra.infra.trace import ENV_TRACEPARENT, TRACER
 from tpu_dra.infra.workqueue import default_cd_daemon_rate_limiter
 from tpu_dra.k8s import ApiClient, COMPUTEDOMAINS
 from tpu_dra.k8s.client import ConflictError, NotFoundError
@@ -179,6 +181,18 @@ class ComputeDomainManager:
             mine["status"] = want
             try:
                 self._client.update_status(COMPUTEDOMAINS, cd)
+                if ready:
+                    # Trace-loop closure (SURVEY §19): a daemon launched
+                    # from a CD claim's CDI env carries the claim's
+                    # TPU_DRA_TRACEPARENT — the readiness mirror is the
+                    # claim's last control-plane hop, landed as a closed
+                    # ``cd.ready`` span on the same trace. No env, no
+                    # span (in-sim daemons run without the claim env).
+                    tp = os.environ.get(ENV_TRACEPARENT)
+                    if tp:
+                        TRACER.record_span(
+                            "cd.ready", 0.0, traceparent=tp,
+                            attributes={"node": self._node_name})
                 return
             except ConflictError:
                 time.sleep(backoff.when(0))

@@ -20,6 +20,9 @@ from tpu_dra.api import scheme as apischeme
 from tpu_dra.api import types as apitypes
 from tpu_dra.cdi.handler import CDIHandler
 from tpu_dra.cdplugin import deviceinfo
+from tpu_dra.infra.trace import (
+    ENV_TRACEPARENT, TRACEPARENT_ANNOTATION, TRACER,
+)
 from tpu_dra.cdplugin.computedomain import (
     ComputeDomainManager, PermanentError, RetryableNotReady,
 )
@@ -209,7 +212,27 @@ class DeviceState:
             config.domain_id, require_domain_ready=strict)  # raises retryable
 
         env = self._cd.workload_env(cd, channel_ids, config.allocation_mode)
-        self._cdi.create_claim_spec_file(uid, env)
+        # Trace continuation (SURVEY §19): a scheduler-allocated CD
+        # channel claim carries a traceparent annotation; the cd.prepare
+        # span rides into the workload env so the CD daemon's readiness
+        # mirror closes the loop on the same trace.
+        span = TRACER.begin(
+            "cd.prepare", root=True,
+            traceparent=(claim["metadata"].get("annotations") or {}).get(
+                TRACEPARENT_ANNOTATION),
+            attributes={"claim_uid": uid})
+        ok = False
+        try:
+            tp = span.traceparent()
+            if tp:
+                env[ENV_TRACEPARENT] = tp
+            self._cdi.create_claim_spec_file(uid, env)
+            ok = True
+        finally:
+            if ok:
+                span.end()
+            else:
+                span.abandon("cd claim spec write failed")
         self._first_attempt.pop(uid, None)
         return self._complete(uid)
 

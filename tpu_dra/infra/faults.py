@@ -202,7 +202,26 @@ SITES: Dict[str, str] = {
         "conflict, daemon peer-config rewrite error); threatens: a CD "
         "stuck Ready with a dead member, or a daemon crash-looping on "
         "dead peers instead of backing off",
+    "trace.emit":
+        "span emission into the flight recorder fails (a real "
+        "exporter's queue-full/serialization error); threatens: the "
+        "span pipeline's degradation contract — the span must drop "
+        "counted (trace marked incomplete), the traced operation must "
+        "never see the failure, and quiesce invariants must still hold",
 }
+
+
+# Observer called (outside the registry lock) with the site name every
+# time an armed site fires — the flight recorder (infra/trace.py)
+# installs itself here so fault firings land in the evidence ring next
+# to the spans they perturbed. A hook rather than an import keeps this
+# module dependency-free.
+_fire_observer: Optional[Callable[[str], None]] = None
+
+
+def set_fire_observer(observer: Optional[Callable[[str], None]]) -> None:
+    global _fire_observer
+    _fire_observer = observer
 
 
 @dataclass
@@ -289,7 +308,13 @@ class FaultRegistry:
             if not armed.schedule():
                 return None
             armed.fired += 1
-            return armed
+        # Observer outside the lock: the flight recorder's ring append
+        # is cheap, but an observer must never extend the registry
+        # lock's hold window (guards run on every hot path).
+        observer = _fire_observer
+        if observer is not None:
+            observer(site)
+        return armed
 
     def fires(self, site: str) -> bool:
         """Control-flow guard: True when the armed schedule fires."""

@@ -18,6 +18,19 @@ from typing import Dict, List, Optional, Tuple
 from tpu_dra.infra import debug
 
 
+def _escape_label_value(value: str) -> str:
+    """Prometheus text-exposition label-value escaping: backslash,
+    double-quote and newline must be escaped or a hostile/accidental
+    value ('say "hi"\\n') tears the scrape line."""
+    return (str(value).replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
+
+
+def _escape_help(text: str) -> str:
+    """HELP lines escape backslash and newline (quotes are legal)."""
+    return text.replace("\\", r"\\").replace("\n", r"\n")
+
+
 class _Metric:
     def __init__(self, name: str, help_text: str, kind: str):
         self.name = name
@@ -29,20 +42,36 @@ class _Metric:
     def _key(self, labels: Optional[Dict[str, str]]):
         return tuple(sorted((labels or {}).items()))
 
-    def value(self, labels: Optional[Dict[str, str]] = None) -> float:
-        """Current scalar for one label set (0.0 when never touched) —
-        the programmatic read seam tests and the bench use instead of
-        scraping the text exposition."""
+    def value(self, labels: Optional[Dict[str, str]] = None,
+              default: float = 0.0) -> float:
+        """Current scalar for one label set — the programmatic read seam
+        tests and the bench use instead of scraping the text exposition.
+
+        Empty-state contract: a label set never touched returns
+        `default` (0.0) — identical to a counter that exists but never
+        incremented, which is what PromQL's absent-as-zero arithmetic
+        assumes. Callers that must distinguish "never touched" from
+        "zero" pass a sentinel default or check ``labelsets()``."""
         with self._lock:
-            return self._values.get(self._key(labels), 0.0)
+            return self._values.get(self._key(labels), default)
+
+    def labelsets(self) -> List[Dict[str, str]]:
+        """Label sets that have actually been touched — the explicit
+        never-touched-vs-zero discriminator ``value()`` cannot be."""
+        with self._lock:
+            return [dict(k) for k in sorted(self._values)]
 
     def expose(self) -> List[str]:
+        # Label sets render stably sorted (the _key tuples are
+        # themselves label-name-sorted), so consecutive scrapes of the
+        # same state are byte-identical and scrape diffs stay readable.
         with self._lock:
-            lines = [f"# HELP {self.name} {self.help}",
+            lines = [f"# HELP {self.name} {_escape_help(self.help)}",
                      f"# TYPE {self.name} {self.kind}"]
             for key, val in sorted(self._values.items()):
                 if key:
-                    lbl = ",".join(f'{k}="{v}"' for k, v in key)
+                    lbl = ",".join(
+                        f'{k}="{_escape_label_value(v)}"' for k, v in key)
                     lines.append(f"{self.name}{{{lbl}}} {val}")
                 else:
                     lines.append(f"{self.name} {val}")
@@ -105,7 +134,7 @@ class Histogram(_Metric):
 
     def expose(self) -> List[str]:
         with self._lock:
-            lines = [f"# HELP {self.name} {self.help}",
+            lines = [f"# HELP {self.name} {_escape_help(self.help)}",
                      f"# TYPE {self.name} histogram"]
             cum = 0
             for b, c in zip(self._buckets, self._counts):
@@ -116,11 +145,26 @@ class Histogram(_Metric):
             lines.append(f"{self.name}_count {self._n}")
             return lines
 
-    def percentile(self, q: float) -> float:
-        """Approximate percentile from bucket upper bounds (for bench/report)."""
+    @property
+    def empty(self) -> bool:
+        """True while nothing has been observed — the explicit check
+        for callers that must not mistake the empty-state percentile
+        default for a measured zero."""
+        with self._lock:
+            return self._n == 0
+
+    def percentile(self, q: float, default: float = 0.0) -> float:
+        """Approximate percentile from bucket upper bounds (for
+        bench/report).
+
+        Empty-state contract: with zero observations there is no
+        distribution to query, so `default` (0.0) is returned — pinned
+        by test, documented here, and distinguishable via ``empty`` /
+        ``count`` rather than silently ambiguous. Values above the
+        largest finite bucket report +Inf (the bucket that holds them)."""
         with self._lock:
             if self._n == 0:
-                return 0.0
+                return default
             target = q * self._n
             cum = 0
             for b, c in zip(self._buckets, self._counts):
@@ -230,6 +274,15 @@ METRICS_CATALOG: Dict[str, str] = {
     # + the content-hash fallback tier), trended by CI
     "tpu_dra_lint_findings_total": "analysis/core.py",
     "tpu_dra_lint_cache_hits_total": "analysis/core.py",
+    # infra/trace.py — the claim-tracing span layer + flight recorder
+    # (SURVEY §19): span lifecycle volume (started/completed by status/
+    # dropped at the trace.emit seam), the evidence ring's occupancy,
+    # and dumps written by trigger (wedged|chaos-violation|sigusr1)
+    "tpu_dra_trace_spans_started_total": "infra/trace.py",
+    "tpu_dra_trace_spans_completed_total": "infra/trace.py",
+    "tpu_dra_trace_spans_dropped_total": "infra/trace.py",
+    "tpu_dra_flightrecorder_ring_occupancy": "infra/trace.py",
+    "tpu_dra_flightrecorder_dumps_total": "infra/trace.py",
 }
 
 

@@ -33,6 +33,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Optional
 
+from tpu_dra.infra.trace import RECORDER as _FLIGHTREC
+
 
 # ---------------------------------------------------------------------------
 # drmc seam (tpu_dra/analysis/drmc): deterministic-scheduler hooks
@@ -262,6 +264,13 @@ class WorkQueue:
         delay reaction to a fresh event. Event-storm fan-in (N
         capacity-freed events all nudging the same pending pods)
         collapses to one queued item per key instead of N."""
+        if _FLIGHTREC.enabled:
+            # Queue events are flight-recorder evidence (SURVEY §19): a
+            # wedge dump shows what was queued when. Recorded OUTSIDE
+            # _cond — the ring append is lock-free, and extending the
+            # queue's critical section by even ~1µs per item is a
+            # measurable tax on a contended 4-worker pool.
+            _FLIGHTREC.record_wq(self._name or "?", "add", key)
         with self._cond:
             self._yield_op("queue.add", key)
             if dedupe and key and self._queued_keys.get(key, 0) > 0:
@@ -392,6 +401,11 @@ class WorkQueue:
 
     def _process(self, item: WorkItem) -> None:
         attempts = self._rl.num_requeues(item.item_id)
+        if _FLIGHTREC.enabled:
+            # The "get" evidence, outside _cond (see enqueue): stamped
+            # at processing start, which is what add->get gap analysis
+            # in a dump actually wants.
+            _FLIGHTREC.record_wq(self._name or "?", "get", item.key)
         try:
             item.callback(item.obj)
         except Exception as e:  # noqa: BLE001 — retryable by contract

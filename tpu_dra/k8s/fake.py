@@ -172,6 +172,15 @@ class FakeCluster(ApiClient):
             if subresource == "status":
                 merged = json_deepcopy(current)
                 merged["status"] = json_deepcopy(obj.get("status"))
+                # Kubernetes permits metadata (labels/annotations)
+                # changes through the status subresource — the
+                # scheduler stamps the claim's traceparent annotation
+                # in the SAME write as the allocation (SURVEY §19), so
+                # the fake must not silently strip it.
+                for mkey in ("labels", "annotations"):
+                    if mkey in meta:
+                        merged["metadata"][mkey] = json_deepcopy(
+                            meta[mkey])
             else:
                 merged = obj
                 # status subresource: spec-updates do not touch status

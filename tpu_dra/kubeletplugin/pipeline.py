@@ -29,6 +29,7 @@ import time
 from typing import Dict, Iterable, List
 
 from tpu_dra.infra.metrics import DefaultRegistry
+from tpu_dra.infra.trace import dump_flight_recorder
 
 INFLIGHT_RPCS = DefaultRegistry.gauge(
     "tpu_dra_prepare_inflight_rpcs",
@@ -76,9 +77,20 @@ class RpcPipeline:
         unique = list(dict.fromkeys(uids))
         t0 = time.perf_counter()
         if not self._window.acquire(timeout=self._timeout_s):
+            # A window that never frees means in-flight RPCs are wedged
+            # somewhere past admission — exactly the moment the flight
+            # recorder's evidence (open spans name the stuck stage and
+            # thread) matters. Dump before failing the RPC (SURVEY
+            # §19.3); the dump never raises, and it is rate-limited —
+            # a sustained wedge fails every retrying RPC, and each one
+            # writing a fresh multi-MB ring would fill the wedged
+            # node's tmp with identical evidence.
+            dump_path = dump_flight_recorder("pipeline-wedged",
+                                             min_interval_s=60.0)
             raise PipelineTimeout(
                 f"prepare pipeline window full for {self._timeout_s}s "
-                "(in-flight RPCs wedged?)")
+                "(in-flight RPCs wedged?); flight recorder dumped to "
+                f"{dump_path}")
         gate = threading.Event()
         with self._gates_lock:
             predecessors = [self._last_gate[u] for u in unique

@@ -44,6 +44,7 @@ from typing import Dict, List, Optional
 from tpu_dra.api.types import API_VERSION, TPU_DRIVER_NAME
 from tpu_dra.cdi.handler import CDIHandler
 from tpu_dra.infra import featuregates, lockwitness
+from tpu_dra.infra import trace
 from tpu_dra.infra.faults import (
     FAULTS, EveryNth, OneShot, Probabilistic, Schedule,
 )
@@ -74,7 +75,7 @@ log = logging.getLogger("simcluster.chaos")
 CHAOS_SITES = ("k8s.api.request", "cdi.claim_write", "checkpoint.store",
                "checkpoint.corrupt", "prepare.batch_fetch",
                "prepare.batch_apply", "prepare.journal_append",
-               "prepare.journal_compact", "health.flap")
+               "prepare.journal_compact", "health.flap", "trace.emit")
 
 TS_CONFIG = [{"source": "FromClaim", "requests": [], "opaque": {
     "driver": TPU_DRIVER_NAME, "parameters": {
@@ -154,6 +155,10 @@ class ChaosHarness:
         # Under a session-level install (TPU_DRA_LOCK_WITNESS=1) the
         # graph predates this harness: report only THIS walk's window.
         self._witness_snap = lockwitness.WITNESS.snapshot()
+        # Open-span snapshot (SURVEY §19): quiesce asserts every span
+        # THIS walk began was closed — a leaked sibling-test span must
+        # not fail this harness, hence the window.
+        self._trace_snap = trace.TRACER.open_ids()
         self.seed = seed
         self.rng = random.Random(seed)
         self.report = ChaosReport(seed=seed)
@@ -569,6 +574,14 @@ class ChaosHarness:
         v.extend(lockwitness.WITNESS.violations_since(
             self._witness_snap, max_hold_s=LOCK_HOLD_OUTLIER_S))
 
+        # 9. Trace completeness (SURVEY §19): every span this walk began
+        # — across prepare storms, crash restarts (the prepare_batch
+        # finally abandons mid-crash spans), fault-aborted batches and
+        # the trace.emit drop path — must be CLOSED at quiesce. An open
+        # span here is a leaked attribution context: exactly the bug
+        # class the span discipline (dralint R12) states lexically.
+        v.extend(trace.open_span_violations(self._trace_snap))
+
 
 def run_schedule(seed: int, n_events: int = 40, chips: int = 4) -> ChaosReport:
     """One seeded fault schedule to quiesce; the chaos tier's unit."""
@@ -608,7 +621,8 @@ def run_matrix(seeds: List[int], n_events: int = 40) -> Dict:
 # chaos-tested on the production path.
 SCHED_CHAOS_SITES = ("k8s.api.request", "k8s.watch.drop",
                      "sched.watch_event", "sched.index_apply",
-                     "sched.shard_apply", "sched.snapshot_commit")
+                     "sched.shard_apply", "sched.snapshot_commit",
+                     "trace.emit")
 
 
 def chip_conflicts(claims: List[Dict]) -> List[str]:
@@ -675,6 +689,8 @@ class SchedulerChaosHarness:
         lockwitness.install()
         self._witnessed = True
         self._witness_snap = lockwitness.WITNESS.snapshot()
+        # Per-walk open-span window (invariant 9 / SURVEY §19).
+        self._trace_snap = trace.TRACER.open_ids()
         self.seed = seed
         self.rng = random.Random(seed ^ 0x5C4ED)
         self.report = ChaosReport(seed=seed)
@@ -845,6 +861,25 @@ class SchedulerChaosHarness:
         # acyclic lock graph and no outlier-length data-lock hold.
         v.extend(lockwitness.WITNESS.violations_since(
             self._witness_snap, max_hold_s=LOCK_HOLD_OUTLIER_S))
+        # Trace completeness (SURVEY §19): every Allocated claim must
+        # carry the traceparent annotation the scheduler stamped in the
+        # allocation write, and that trace must be a complete span tree
+        # — all spans closed, parents precede children (a trace that
+        # lost a span to the trace.emit fault skips structure but still
+        # owes zero open spans). Then the walk-wide open-span sweep.
+        for claim in claims:
+            if not (claim.get("status") or {}).get("allocation"):
+                continue
+            tp = (claim["metadata"].get("annotations") or {}).get(
+                trace.TRACEPARENT_ANNOTATION)
+            parsed = trace.parse_traceparent(tp)
+            if parsed is None:
+                v.append(f"allocated claim {claim['metadata']['name']} "
+                         f"carries no valid traceparent annotation "
+                         f"({tp!r})")
+                continue
+            v.extend(trace.verify_trace(parsed[0]))
+        v.extend(trace.open_span_violations(self._trace_snap))
 
     def close(self) -> None:
         try:
@@ -1547,12 +1582,22 @@ def main(argv=None) -> int:
     # to dead hardware, no double allocation).
     summary["node_death"] = run_nodedeath_matrix(seeds,
                                                  n_events=args.events)
+    failed = bool(summary["violations"]
+                  or summary["watch_flake_violations"]
+                  or summary["scheduler"]["violations"]
+                  or summary["topology"]["violations"]
+                  or summary["node_death"]["violations"])
+    if failed:
+        # Any matrix violation ships its evidence (SURVEY §19): the
+        # flight recorder holds the recent spans, fault firings and
+        # queue events around whatever went wrong. hack/chaos.sh pins
+        # the path via TPU_DRA_FLIGHTREC_DUMP so failed seeds leave an
+        # artifact next to the logs.
+        summary["flight_recorder_dump"] = trace.dump_flight_recorder(
+            "chaos-violation",
+            path=os.environ.get("TPU_DRA_FLIGHTREC_DUMP"))
     print(json.dumps(summary, indent=2))
-    return 1 if (summary["violations"]
-                 or summary["watch_flake_violations"]
-                 or summary["scheduler"]["violations"]
-                 or summary["topology"]["violations"]
-                 or summary["node_death"]["violations"]) else 0
+    return 1 if failed else 0
 
 
 if __name__ == "__main__":

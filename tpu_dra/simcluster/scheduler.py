@@ -69,6 +69,7 @@ from tpu_dra.infra.metrics import (
     SCHED_WATCH_EVENTS, SCHED_WORKERS, TOPO_ALLOCS, TOPO_FREE_CUBOID,
     TOPO_SCORE_SECONDS, Timer,
 )
+from tpu_dra.infra.trace import TRACEPARENT_ANNOTATION, TRACER
 from tpu_dra.infra.workqueue import (
     ExponentialFailureRateLimiter, WorkQueue,
 )
@@ -1848,14 +1849,42 @@ class Scheduler:
                 f"snapshot commit kept conflicting on {node}")
         try:
             for claim, allocation, _k, _e in staged:
-                upd = json_deepcopy(claim)
-                upd.setdefault("status", {})["allocation"] = allocation
-                # Re-allocation supersedes a prior eviction: the marker
-                # must describe the claim's CURRENT state or not exist.
-                upd["status"].pop("evicted", None)
-                updated = self._client.update_status(
-                    RESOURCECLAIMS, upd, upd["metadata"].get("namespace"))
-                self._after_claim_write(updated)
+                # Per-claim trace root (SURVEY §19): sched.pod_seen →
+                # sched.allocate, the allocate span's traceparent
+                # stamped into the claim annotations in the SAME status
+                # write (K8s status subresource carries metadata) — the
+                # node driver, prepare pipeline, CDI env export and
+                # mesh builder all continue this trace.
+                t_root = TRACER.begin(
+                    "sched.pod_seen", root=True,
+                    attributes={"claim": claim_key(claim), "node": node})
+                t_alloc = TRACER.begin("sched.allocate", parent=t_root)
+                written = False
+                try:
+                    upd = json_deepcopy(claim)
+                    upd.setdefault("status", {})["allocation"] = \
+                        allocation
+                    # Re-allocation supersedes a prior eviction: the
+                    # marker must describe the claim's CURRENT state or
+                    # not exist.
+                    upd["status"].pop("evicted", None)
+                    tp = t_alloc.traceparent()
+                    if tp:
+                        upd["metadata"].setdefault(
+                            "annotations", {})[TRACEPARENT_ANNOTATION] \
+                            = tp
+                    updated = self._client.update_status(
+                        RESOURCECLAIMS, upd,
+                        upd["metadata"].get("namespace"))
+                    self._after_claim_write(updated)
+                    written = True
+                finally:
+                    if written:
+                        t_alloc.end()
+                        t_root.end()
+                    else:
+                        t_alloc.abandon("allocation write failed")
+                        t_root.abandon("allocation write failed")
         finally:
             # Reservations end when the real allocations are indexed
             # (success: _after_claim_write applied them) or when the

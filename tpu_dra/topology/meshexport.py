@@ -44,6 +44,7 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 from tpu_dra.infra.faults import FAULTS
 from tpu_dra.infra.metrics import MESH_BUILDS
+from tpu_dra.infra.trace import ENV_TRACEPARENT, TRACER
 from tpu_dra.topology.mesh import (
     Coord, Mesh, TORUS_GENERATIONS, format_topology, parse_topology,
 )
@@ -346,11 +347,29 @@ def plan_from_env(env: Dict[str, str]) -> MeshPlan:
     """MeshPlan from ONE worker's claim CDI env (the workload
     container's view): TPU_VISIBLE_CHIPS selects the chips,
     TPU_CHIP_COORDS places them, TPU_SLICE_TOPOLOGY declares the
-    fabric. Refusals per _env_chip_coords."""
-    worker = int(env.get(ENV_WORKER_INDEX, "0") or 0)
-    dims = parse_topology(env.get(ENV_SLICE_TOPOLOGY, ""))
-    generation = env.get(ENV_GENERATION, "")
-    return plan_from_coords(_env_chip_coords(env, worker), dims, generation)
+    fabric. Refusals per _env_chip_coords.
+
+    Closes the claim's trace loop (SURVEY §19): when the env carries
+    TPU_DRA_TRACEPARENT (exported by the prepare pipeline next to the
+    coordinates), the build lands as a ``mesh.build`` span on the same
+    trace the scheduler started — status error on refusal."""
+    span = TRACER.begin("mesh.build", root=True,
+                        traceparent=env.get(ENV_TRACEPARENT))
+    ok = False
+    try:
+        worker = int(env.get(ENV_WORKER_INDEX, "0") or 0)
+        dims = parse_topology(env.get(ENV_SLICE_TOPOLOGY, ""))
+        generation = env.get(ENV_GENERATION, "")
+        plan = plan_from_coords(_env_chip_coords(env, worker), dims,
+                                generation)
+        span.set(n_devices=plan.n_devices, contiguous=plan.contiguous)
+        ok = True
+        return plan
+    finally:
+        if ok:
+            span.end()
+        else:
+            span.abandon("mesh build refused")
 
 
 def plan_from_worker_envs(envs: Sequence[Dict[str, str]]) -> MeshPlan:
@@ -402,10 +421,29 @@ def plan_from_worker_envs(envs: Sequence[Dict[str, str]]) -> MeshPlan:
         raise MeshBuildError(
             f"workers declare conflicting generations {sorted(gens_seen)}")
     generation = next(iter(gens_seen)) if gens_seen else ""
-    merged: Dict[Tuple[int, int], Coord] = {}
-    for env in envs:
-        merged.update(_env_chip_coords(env, int(env["TPU_WORKER_ID"])))
-    return plan_from_coords(merged, dims, generation, n_workers=len(envs))
+    # Multi-worker builds continue worker 0's claim trace (every worker
+    # of one gang computes the identical plan; one span per build call
+    # keeps the tree a tree).
+    span = TRACER.begin(
+        "mesh.build", root=True,
+        traceparent=next((e.get(ENV_TRACEPARENT) for e in envs
+                          if e.get(ENV_TRACEPARENT)), None),
+        attributes={"n_workers": len(envs)})
+    ok = False
+    try:
+        merged: Dict[Tuple[int, int], Coord] = {}
+        for env in envs:
+            merged.update(_env_chip_coords(env,
+                                           int(env["TPU_WORKER_ID"])))
+        plan = plan_from_coords(merged, dims, generation,
+                                n_workers=len(envs))
+        ok = True
+        return plan
+    finally:
+        if ok:
+            span.end()
+        else:
+            span.abandon("mesh build refused")
 
 
 def plan_from_allocation(claim: Dict, slices: List[Dict]) -> MeshPlan:

@@ -27,6 +27,9 @@ from tpu_dra.cdi.handler import CDIHandler, visible_chips_env
 from tpu_dra.infra import featuregates, vfs
 from tpu_dra.infra.faults import FAULTS
 from tpu_dra.infra.metrics import DefaultRegistry
+from tpu_dra.infra.trace import (
+    ENV_TRACEPARENT, TRACEPARENT_ANNOTATION, TRACER,
+)
 from tpu_dra.kubeletplugin.server import PreparedDevice, PrepareResult
 from tpu_dra.native.tpuinfo import Chip, TpuInfoBackend
 from tpu_dra.tpuplugin import deviceinfo
@@ -119,6 +122,11 @@ class _BatchClaim:
     # or when specs were written synchronously). The commit barrier
     # awaits it before any result externalizes.
     cdi_future: Optional[object] = None
+    # The member's prepare.claim span (SURVEY §19): continues the
+    # trace the RPC layer stamped into the claim annotation; its
+    # children (prepare.sharing/guards/cdi*/journal) ARE the timings —
+    # the `timings` dict above is derived from their durations.
+    span: Optional[object] = None
 
 
 class DeviceState:
@@ -299,11 +307,31 @@ class DeviceState:
         Per-phase wall times of the last fully-successful batch land in
         `last_batch_breakdown`; single-claim batches additionally keep
         the historical `last_prepare_breakdown` (VERDICT r3: the r2->r3
-        regression was never attributed)."""
+        regression was never attributed). Both dicts are DERIVED from
+        the span layer (SURVEY §19): every phase below is a span, the
+        byte-compatible stopwatch keys are the spans' durations, and
+        the try/finally here guarantees no span outlives the batch —
+        a crash point anywhere inside leaves only closed (abandoned)
+        spans, which chaos/drmc assert at every terminal state."""
+        batch_span = TRACER.begin("prepare.batch", root=True,
+                                  attributes={"n_claims": len(claims)})
+        todo: List[_BatchClaim] = []
+        try:
+            return self._prepare_batch_spanned(claims, batch_span, todo)
+        finally:
+            for b in todo:
+                span = b.span
+                if span is not None:
+                    # Idempotent: the normal path already closed it in
+                    # the results loop — this catches crash/abort paths.
+                    span.abandon("prepare aborted mid-batch")
+            batch_span.end()
+
+    def _prepare_batch_spanned(self, claims: List[Dict],
+                               batch_span, todo: List[_BatchClaim]
+                               ) -> Dict[str, PrepareResult]:
         results: Dict[str, PrepareResult] = {}
         batch_timings: Dict[str, float] = {}
-        t_total = time.perf_counter()
-        todo: List[_BatchClaim] = []
         with self._lock:
             # Pure phase first (no side effects): idempotency check,
             # allocation parsing, opaque-config resolution and the FULL
@@ -313,59 +341,73 @@ class DeviceState:
             # names every chip each member will touch — a SIGKILL
             # mid-apply must leave a record that rollback AND the
             # startup time-slice reconciliation's `held` set can see.
-            t0 = time.perf_counter()
-            for claim in claims:
-                uid = claim["metadata"]["uid"]
-                if uid in results or any(b.uid == uid for b in todo):
-                    continue  # duplicate uid in one RPC: one result
-                existing = self._checkpoint.claims.get(uid)
-                if existing is not None and \
-                        existing.state == PREPARE_COMPLETED and \
-                        self._cdi.claim_spec_exists(uid):
-                    # Idempotent fast path — but only while the claim
-                    # CDI spec is actually on disk. A crash can persist
-                    # the terminal checkpoint sync yet lose the spec's
-                    # never-synced rename (drmc crash point: every
-                    # clean-image crash past the fdatasync); vouching
-                    # for the lost file would hand kubelet CDI ids that
-                    # fail container creation. Fall through instead:
-                    # the full pipeline re-applies side effects
-                    # idempotently and rewrites the spec.
-                    results[uid] = PrepareResult(devices=[
-                        _prepared_device_from_record(r)
-                        for r in existing.devices])
-                    continue
-                try:
-                    config_results = self._resolve_claim_configs(claim)
-                    records = self._build_records(uid, config_results)
-                except Exception as e:  # noqa: BLE001 — claim error
-                    results[uid] = PrepareResult(
-                        error=f"prepare devices: {e}")
-                    continue
-                configs = [cr.config for cr in config_results]
-                todo.append(_BatchClaim(
-                    uid=uid, claim=claim, config_results=config_results,
-                    records=records,
-                    hazardous=any(self._config_hazard(c)
-                                  for c in configs),
-                    # Passthrough (IOMMU-group rebinds yank sibling
-                    # chips) and unknown config kinds serialize on the
-                    # hazard lock; everything else — including
-                    # multiprocess, whose Deployment and daemon dirs
-                    # are per-claim — is covered by its chip locks.
-                    serialize=any(
-                        not isinstance(c, (apitypes.TpuConfig,
-                                           apitypes.SubsliceConfig))
-                        for c in configs),
-                    # Only sharing strategies block (tpuctl execs,
-                    # coordinator-Deployment round trips); env-only
-                    # applies are too cheap for pool dispatch to win.
-                    slow_apply=any(
-                        not isinstance(c, apitypes.SubsliceConfig)
-                        and (not isinstance(c, apitypes.TpuConfig)
-                             or c.sharing is not None)
-                        for c in configs)))
-            batch_timings["decode"] = time.perf_counter() - t0
+            with TRACER.span("prepare.decode",
+                             parent=batch_span) as t_decode:
+                for claim in claims:
+                    uid = claim["metadata"]["uid"]
+                    if uid in results or any(b.uid == uid for b in todo):
+                        continue  # duplicate uid in one RPC: one result
+                    existing = self._checkpoint.claims.get(uid)
+                    if existing is not None and \
+                            existing.state == PREPARE_COMPLETED and \
+                            self._cdi.claim_spec_exists(uid):
+                        # Idempotent fast path — but only while the claim
+                        # CDI spec is actually on disk. A crash can persist
+                        # the terminal checkpoint sync yet lose the spec's
+                        # never-synced rename (drmc crash point: every
+                        # clean-image crash past the fdatasync); vouching
+                        # for the lost file would hand kubelet CDI ids that
+                        # fail container creation. Fall through instead:
+                        # the full pipeline re-applies side effects
+                        # idempotently and rewrites the spec.
+                        results[uid] = PrepareResult(devices=[
+                            _prepared_device_from_record(r)
+                            for r in existing.devices])
+                        continue
+                    try:
+                        config_results = self._resolve_claim_configs(claim)
+                        records = self._build_records(uid, config_results)
+                    except Exception as e:  # noqa: BLE001 — claim error
+                        results[uid] = PrepareResult(
+                            error=f"prepare devices: {e}")
+                        continue
+                    configs = [cr.config for cr in config_results]
+                    todo.append(_BatchClaim(
+                        uid=uid, claim=claim,
+                        config_results=config_results,
+                        records=records,
+                        # The member's prepare.claim span continues the
+                        # trace the RPC layer stamped into the claim
+                        # annotation (fresh root when none — direct
+                        # DeviceState callers trace too). Closed in the
+                        # results loop; the prepare_batch finally
+                        # abandons it on crash paths.
+                        span=TRACER.begin(
+                            "prepare.claim", root=True,
+                            traceparent=(claim["metadata"].get(
+                                "annotations") or {}).get(
+                                TRACEPARENT_ANNOTATION),
+                            attributes={"claim_uid": uid}),
+                        hazardous=any(self._config_hazard(c)
+                                      for c in configs),
+                        # Passthrough (IOMMU-group rebinds yank sibling
+                        # chips) and unknown config kinds serialize on
+                        # the hazard lock; everything else — including
+                        # multiprocess, whose Deployment and daemon dirs
+                        # are per-claim — is covered by its chip locks.
+                        serialize=any(
+                            not isinstance(c, (apitypes.TpuConfig,
+                                               apitypes.SubsliceConfig))
+                            for c in configs),
+                        # Only sharing strategies block (tpuctl execs,
+                        # coordinator-Deployment round trips); env-only
+                        # applies are too cheap for pool dispatch to win.
+                        slow_apply=any(
+                            not isinstance(c, apitypes.SubsliceConfig)
+                            and (not isinstance(c, apitypes.TpuConfig)
+                                 or c.sharing is not None)
+                            for c in configs)))
+            batch_timings["decode"] = t_decode.duration_s
             if not todo:
                 return results
             for b in todo:
@@ -384,36 +426,41 @@ class DeviceState:
                 # unconditional unprepare delete reconcile without a
                 # record. The group sync happens OUTSIDE the state lock
                 # (below) so concurrent RPCs coalesce their fdatasyncs.
-                t0 = time.perf_counter()
-                try:
-                    intent_token = self._ckpt_mgr.journal_commit(
-                        self._checkpoint,
-                        present=[b.uid for b in hazardous], intent=True)
-                except Exception as e:  # noqa: BLE001 — no side effects
-                    # applied for ANY member yet and disk never saw the
-                    # records: unwind them in memory and fail the batch;
-                    # kubelet retries each claim from scratch.
-                    for b in todo:
-                        self._checkpoint.claims.pop(b.uid, None)
-                        results[b.uid] = PrepareResult(
-                            error=f"intent store: {e}")
-                    return results
-                batch_timings["checkpoint_start"] = time.perf_counter() - t0
+                with TRACER.span("prepare.checkpoint_start",
+                                 parent=batch_span) as t_intent:
+                    try:
+                        intent_token = self._ckpt_mgr.journal_commit(
+                            self._checkpoint,
+                            present=[b.uid for b in hazardous],
+                            intent=True)
+                    except Exception as e:  # noqa: BLE001 — no side
+                        # effects applied for ANY member yet and disk
+                        # never saw the records: unwind them in memory
+                        # and fail the batch; kubelet retries each claim
+                        # from scratch.
+                        for b in todo:
+                            self._checkpoint.claims.pop(b.uid, None)
+                            results[b.uid] = PrepareResult(
+                                error=f"intent store: {e}")
+                        return results
+                batch_timings["checkpoint_start"] = t_intent.duration_s
         if intent_token is not None:
             # Durable intent BEFORE any side effect runs — the same
             # store-before-side-effects contract as the slot scheme,
             # with the sync group-committed across RPCs.
-            t0 = time.perf_counter()
-            try:
-                self._ckpt_mgr.journal_barrier(intent_token)
-            except Exception as e:  # noqa: BLE001 — sync failed before
-                # any side effect: abort the batch. The appended intent
-                # record may still be durable; a restart replays it as
-                # PrepareStarted and unprepare/GC finish the cleanup —
-                # the same recovery as a crash mid-prepare.
-                self._abort_unsynced_intent(todo, results, e)
-                return results
-            batch_timings["checkpoint_start"] += time.perf_counter() - t0
+            with TRACER.span("prepare.checkpoint_start",
+                             parent=batch_span) as t_ibar:
+                try:
+                    self._ckpt_mgr.journal_barrier(intent_token)
+                except Exception as e:  # noqa: BLE001 — sync failed
+                    # before any side effect: abort the batch. The
+                    # appended intent record may still be durable; a
+                    # restart replays it as PrepareStarted and
+                    # unprepare/GC finish the cleanup — the same
+                    # recovery as a crash mid-prepare.
+                    self._abort_unsynced_intent(todo, results, e)
+                    return results
+            batch_timings["checkpoint_start"] += t_ibar.duration_s
 
         # Side-effect application OUTSIDE the global lock: members on
         # disjoint chip sets run concurrently, chip locks serialize
@@ -424,12 +471,13 @@ class DeviceState:
         # Claim-spec writes are SUBMITTED here (async pool) and awaited
         # at the commit barrier below, overlapping the terminal append
         # + group sync.
-        t0 = time.perf_counter()
-        self._apply_batch(todo)
-        # One writer task for the whole batch's claim specs: its
-        # write+rename loop overlaps the terminal append + group sync.
-        self._submit_spec_writes(todo)
-        batch_timings["apply"] = time.perf_counter() - t0
+        with TRACER.span("prepare.apply", parent=batch_span) as t_apply:
+            self._apply_batch(todo)
+            # One writer task for the whole batch's claim specs: its
+            # write+rename loop overlaps the terminal append + group
+            # sync.
+            self._submit_spec_writes(todo)
+        batch_timings["apply"] = t_apply.duration_s
 
         token: Optional[int] = None
         failed: List[_BatchClaim] = []
@@ -449,42 +497,47 @@ class DeviceState:
                     deferred[b.uid] = err
             for b in survivors:
                 self._checkpoint.claims[b.uid].state = PREPARE_COMPLETED
-            t0 = time.perf_counter()
-            try:
-                # The group commit: every member's terminal outcome —
-                # survivors completed, failures erased, deferred unwinds
-                # parked PrepareStarted — in ONE journal record; the
-                # durable sync is the barrier below, outside this lock.
-                token = self._ckpt_mgr.journal_commit(
-                    self._checkpoint,
-                    present=[b.uid for b in survivors]
-                    + sorted(deferred),
-                    absent=[b.uid for b in failed
-                            if b.uid not in deferred])
-            except Exception as e:  # noqa: BLE001 — terminal append
-                # failed: survivors are fully applied but not durably
-                # completed; a crash now would replay them as
-                # PrepareStarted. Unwind them too and persist the
-                # rollback, so the kubelet retry starts from a clean
-                # slate instead of half-committed state.
-                self._await_cdi(todo)
-                self._rollback_survivors_locked(
-                    todo, survivors, deferred, f"checkpoint store: {e}")
-            batch_timings["checkpoint_final"] = time.perf_counter() - t0
+            with TRACER.span("prepare.checkpoint_final",
+                             parent=batch_span) as t_final:
+                try:
+                    # The group commit: every member's terminal outcome
+                    # — survivors completed, failures erased, deferred
+                    # unwinds parked PrepareStarted — in ONE journal
+                    # record; the durable sync is the barrier below,
+                    # outside this lock.
+                    token = self._ckpt_mgr.journal_commit(
+                        self._checkpoint,
+                        present=[b.uid for b in survivors]
+                        + sorted(deferred),
+                        absent=[b.uid for b in failed
+                                if b.uid not in deferred])
+                except Exception as e:  # noqa: BLE001 — terminal append
+                    # failed: survivors are fully applied but not
+                    # durably completed; a crash now would replay them
+                    # as PrepareStarted. Unwind them too and persist the
+                    # rollback, so the kubelet retry starts from a clean
+                    # slate instead of half-committed state.
+                    self._await_cdi(todo)
+                    self._rollback_survivors_locked(
+                        todo, survivors, deferred,
+                        f"checkpoint store: {e}")
+            batch_timings["checkpoint_final"] = t_final.duration_s
 
         if token is not None:
-            t0 = time.perf_counter()
-            try:
-                # The durable half of the group commit: one fdatasync
-                # shared by every RPC whose barrier overlaps.
-                self._ckpt_mgr.journal_barrier(token)
-            except Exception as e:  # noqa: BLE001 — the record may or
-                # may not be durable; roll the survivors back and
-                # persist the erasure through the synced slot path.
-                self._rollback_after_sync_failure(
-                    todo, survivors, deferred, e)
-                token = None
-            batch_timings["checkpoint_final"] += time.perf_counter() - t0
+            with TRACER.span("prepare.checkpoint_final",
+                             parent=batch_span) as t_fbar:
+                try:
+                    # The durable half of the group commit: one
+                    # fdatasync shared by every RPC whose barrier
+                    # overlaps.
+                    self._ckpt_mgr.journal_barrier(token)
+                except Exception as e:  # noqa: BLE001 — the record may
+                    # or may not be durable; roll the survivors back and
+                    # persist the erasure through the synced slot path.
+                    self._rollback_after_sync_failure(
+                        todo, survivors, deferred, e)
+                    token = None
+            batch_timings["checkpoint_final"] += t_fbar.duration_s
         if token is not None:
             # Commit barrier: claim-spec writes must have landed before
             # any success externalizes. A member whose spec write failed
@@ -500,7 +553,9 @@ class DeviceState:
                 failed = failed + cdi_failed
 
         with self._lock:
-            batch_timings["total"] = time.perf_counter() - t_total
+            # `total` is the batch root span's live duration — the one
+            # clock every other phase key is a slice of.
+            batch_timings["total"] = batch_span.duration_s
             for b in todo:
                 if b.uid in deferred:
                     log.warning(
@@ -513,9 +568,23 @@ class DeviceState:
                 elif b.error is not None:
                     results[b.uid] = PrepareResult(error=b.error)
                 else:
+                    if token is not None and b.span is not None:
+                        # The batch shares ONE terminal journal append
+                        # + group sync; attribute the member's share as
+                        # a synthesized child so the claim's tree shows
+                        # where its durability cost went.
+                        TRACER.record_span(
+                            "prepare.journal",
+                            batch_timings.get("checkpoint_final", 0.0),
+                            parent=b.span)
                     results[b.uid] = PrepareResult(devices=[
                         _prepared_device_from_record(r)
                         for r in b.records])
+                if b.span is not None:
+                    if b.error is not None:
+                        b.span.abandon(b.error)
+                    else:
+                        b.span.end()
 
             if survivors and not failed:
                 self.last_batch_breakdown = {
@@ -562,15 +631,16 @@ class DeviceState:
             if fut is None:
                 continue
             b.cdi_future = None
-            t0 = time.perf_counter()
-            try:
-                # Shared future: the first member's wait covers the
-                # batch, the rest read the cached result.
-                errors = fut.result()
-            except Exception as e:  # noqa: BLE001 — whole task died
-                errors = {b.uid: str(e)}
+            with TRACER.span("prepare.cdi_wait",
+                             parent=b.span) as t_wait:
+                try:
+                    # Shared future: the first member's wait covers the
+                    # batch, the rest read the cached result.
+                    errors = fut.result()
+                except Exception as e:  # noqa: BLE001 — whole task died
+                    errors = {b.uid: str(e)}
             b.timings["cdi_wait"] = (b.timings.get("cdi_wait", 0.0)
-                                     + time.perf_counter() - t0)
+                                     + t_wait.duration_s)
             err = errors.get(b.uid)
             if err is not None:
                 if b.error is None:
@@ -803,52 +873,56 @@ class DeviceState:
 
         for cr in config_results:
             group_chips = self._chips_for_results(cr.results)
-            t0 = time.perf_counter()
-            sharing_env = self._apply_sharing_config(uid, cr, group_chips)
+            with TRACER.span("prepare.sharing", parent=b.span) as t_sh:
+                sharing_env = self._apply_sharing_config(uid, cr,
+                                                         group_chips)
             timings["sharing"] = (timings.get("sharing", 0.0)
-                                  + time.perf_counter() - t0)
+                                  + t_sh.duration_s)
             claim_env.update(sharing_env.get("env", {}))
             claim_mounts.extend(sharing_env.get("mounts", []))
-            t0 = time.perf_counter()
-
-            for result in cr.results:
-                dev = self.allocatable[result["device"]]
-                chip_indices.add(dev.chip.index)
-                claim_chips[dev.chip.index] = dev.chip
-                if dev.type == deviceinfo.DEVICE_TYPE_SUBSLICE:
-                    ss = dev.subslice
-                    subslice_cores.setdefault(dev.chip.index, set()).update(
-                        range(ss.core_start, ss.core_start + ss.core_count))
-                    subslice_hbm_total += ss.hbm_bytes
-                if isinstance(cr.config, apitypes.PassthroughConfig):
-                    if self._pt_manager is not None:
+            with TRACER.span("prepare.guards", parent=b.span) as t_gd:
+                for result in cr.results:
+                    dev = self.allocatable[result["device"]]
+                    chip_indices.add(dev.chip.index)
+                    claim_chips[dev.chip.index] = dev.chip
+                    if dev.type == deviceinfo.DEVICE_TYPE_SUBSLICE:
+                        ss = dev.subslice
+                        subslice_cores.setdefault(
+                            dev.chip.index, set()).update(
+                            range(ss.core_start,
+                                  ss.core_start + ss.core_count))
+                        subslice_hbm_total += ss.hbm_bytes
+                    if isinstance(cr.config, apitypes.PassthroughConfig):
+                        if self._pt_manager is not None:
+                            self._assert_group_exclusive(
+                                dev.chip, uid, passthrough=True)
+                        self._backend.set_exclusive_mode(dev.chip.index,
+                                                         True)
+                        claim_env["TPU_PASSTHROUGH"] = "true"
+                        if self._pt_manager is not None:
+                            # Full VFIO rebind: the chip leaves the
+                            # accel driver; the claim gets
+                            # /dev/vfio/<group> nodes instead of a
+                            # usable /dev/accelN. Rebinding yanks every
+                            # function in the IOMMU group, which the
+                            # exclusivity assert above made safe.
+                            group = self._pt_manager.configure(
+                                dev.chip,
+                                sibling_dev_paths=self._group_dev_paths(
+                                    dev.chip))
+                            claim_device_nodes.extend(
+                                n for n in
+                                self._pt_manager.cdi_device_nodes(group)
+                                if n not in claim_device_nodes)
+                    elif self._pt_manager is not None:
+                        # Reverse guard: a normal claim must not land on
+                        # a chip whose IOMMU group a passthrough claim
+                        # holds — its /dev/accelN is gone while the
+                        # group sits on vfio-pci.
                         self._assert_group_exclusive(
-                            dev.chip, uid, passthrough=True)
-                    self._backend.set_exclusive_mode(dev.chip.index, True)
-                    claim_env["TPU_PASSTHROUGH"] = "true"
-                    if self._pt_manager is not None:
-                        # Full VFIO rebind: the chip leaves the accel
-                        # driver; the claim gets /dev/vfio/<group> nodes
-                        # instead of a usable /dev/accelN. Rebinding
-                        # yanks every function in the IOMMU group, which
-                        # the exclusivity assert above made safe.
-                        group = self._pt_manager.configure(
-                            dev.chip,
-                            sibling_dev_paths=self._group_dev_paths(
-                                dev.chip))
-                        claim_device_nodes.extend(
-                            n for n in
-                            self._pt_manager.cdi_device_nodes(group)
-                            if n not in claim_device_nodes)
-                elif self._pt_manager is not None:
-                    # Reverse guard: a normal claim must not land on a
-                    # chip whose IOMMU group a passthrough claim holds —
-                    # its /dev/accelN is gone while the group sits on
-                    # vfio-pci.
-                    self._assert_group_exclusive(
-                        dev.chip, uid, passthrough=False)
+                            dev.chip, uid, passthrough=False)
             timings["guards"] = (timings.get("guards", 0.0)
-                                 + time.perf_counter() - t0)
+                                 + t_gd.duration_s)
 
         if subslice_cores:
             # Aggregate across all subslices of the claim. Single-chip claims
@@ -870,6 +944,14 @@ class DeviceState:
         # topology (coordinate-less nodes keep their exact old env).
         claim_env.update(export_topology_env(
             [claim_chips[i] for i in sorted(claim_chips)]))
+        # Trace-context export (SURVEY §19): the claim's span rides the
+        # CDI env next to TPU_CHIP_COORDS, so the workload-side mesh
+        # build and the CD daemon readiness mirror continue the SAME
+        # trace the scheduler started at allocation.
+        if b.span is not None:
+            tp = b.span.traceparent()
+            if tp:
+                claim_env[ENV_TRACEPARENT] = tp
         # CPU half on THIS thread (json + the cdi.claim_write fault
         # site, so a config/ENOSPC-simulating failure takes the plain
         # apply-error rollback); only the pure-I/O half (tmp write +
@@ -878,27 +960,27 @@ class DeviceState:
         # the crash enumerator needs one deterministic durable-op
         # sequence, and the sync write exercises the same crash images
         # (the spec rename is never dir-synced either way).
-        t0 = time.perf_counter()
-        path, text = self._cdi.serialize_claim_spec(
-            uid, claim_env, mounts=claim_mounts or None,
-            device_nodes=claim_device_nodes or None)
-        if self._cdi_pool is not None and vfs.installed() is None:
-            # Deferred to the batch's single writer task (submitted at
-            # the end of the apply phase): the write+rename syscalls
-            # (GIL-released) overlap the terminal append + group sync,
-            # and the commit barrier (_await_cdi) collects them before
-            # any result externalizes.
-            b.cdi_spec = (path, text)
-        else:
-            self._cdi.write_claim_spec(path, text)
-        timings["cdi_write"] = time.perf_counter() - t0
+        with TRACER.span("prepare.cdi_write", parent=b.span) as t_cdi:
+            path, text = self._cdi.serialize_claim_spec(
+                uid, claim_env, mounts=claim_mounts or None,
+                device_nodes=claim_device_nodes or None)
+            if self._cdi_pool is not None and vfs.installed() is None:
+                # Deferred to the batch's single writer task (submitted
+                # at the end of the apply phase): the write+rename
+                # syscalls (GIL-released) overlap the terminal append +
+                # group sync, and the commit barrier (_await_cdi)
+                # collects them before any result externalizes.
+                b.cdi_spec = (path, text)
+            else:
+                self._cdi.write_claim_spec(path, text)
+        timings["cdi_write"] = t_cdi.duration_s
 
     def _submit_spec_writes(self, todo: List[_BatchClaim]) -> None:
         """ONE writer task for every member's pending spec: a single
         pool wakeup + a sequential loop of GIL-releasing syscalls.
         Sub-ms per-member tasks measured ~7x slower than this (executor
         wakeup thrash). Members that failed apply never write a spec."""
-        pending = [(b.uid, b.cdi_spec, b.timings) for b in todo
+        pending = [(b.uid, b.cdi_spec, b.timings, b.span) for b in todo
                    if b.cdi_spec is not None and b.error is None]
         for b in todo:
             b.cdi_spec = None
@@ -914,14 +996,16 @@ class DeviceState:
         any member whose write failed (isolation); the timings dicts
         are member-private, ordered against readers by the future."""
         errors: Dict[str, str] = {}
-        for uid, (path, text), timings in pending:
-            t0 = time.perf_counter()
-            try:
-                self._cdi.write_claim_spec(path, text)
-            except Exception as e:  # noqa: BLE001 — isolate the member
-                errors[uid] = str(e)
+        for uid, (path, text), timings, span in pending:
+            # The span is parented explicitly (this runs on the writer
+            # pool thread — the thread-local stack is the RPC thread's).
+            with TRACER.span("prepare.cdi_io", parent=span) as t_io:
+                try:
+                    self._cdi.write_claim_spec(path, text)
+                except Exception as e:  # noqa: BLE001 — isolate the
+                    errors[uid] = str(e)  # member
             timings["cdi_io"] = (timings.get("cdi_io", 0.0)
-                                 + time.perf_counter() - t0)
+                                 + t_io.duration_s)
         return errors
 
     def _group_chip_indices(self, chip: Chip) -> List[int]:

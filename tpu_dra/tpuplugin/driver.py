@@ -18,6 +18,7 @@ from tpu_dra.infra import featuregates
 from tpu_dra.infra.faults import FAULTS
 from tpu_dra.infra.flock import Flock, SharedFlock
 from tpu_dra.infra.metrics import DefaultRegistry
+from tpu_dra.infra.trace import TRACEPARENT_ANNOTATION, TRACER
 from tpu_dra.infra.workqueue import WorkQueue, default_prep_unprep_rate_limiter
 from tpu_dra.k8s import ApiClient, RESOURCECLAIMS
 from tpu_dra.k8s.client import NotFoundError
@@ -175,17 +176,35 @@ class TpuDriver(DriverCallbacks):
             # Window never freed (wedged in-flight RPCs): fail fast so
             # kubelet retries instead of piling blocked handlers.
             return {c.uid: PrepareResult(error=str(e)) for c in claims}
+        # uid -> the claim's rpc-level span: continues the trace the
+        # scheduler stamped into the claim annotation (SURVEY §19) and
+        # re-stamps its OWN traceparent before the state machine sees
+        # the object, so every prepare.* span nests under rpc.prepare.
+        rpc_spans: Dict[str, object] = {}
         try:
             objs = []
             for claim, (obj, err) in self._fetch_claims(claims):
                 if err is not None:
                     results[claim.uid] = PrepareResult(error=err)
-                else:
-                    objs.append(obj)
+                    continue
+                span = TRACER.begin(
+                    "rpc.prepare", root=True,
+                    traceparent=(obj["metadata"].get("annotations")
+                                 or {}).get(TRACEPARENT_ANNOTATION),
+                    attributes={"claim_uid": claim.uid})
+                tp = span.traceparent()
+                if tp:
+                    obj["metadata"].setdefault(
+                        "annotations", {})[TRACEPARENT_ANNOTATION] = tp
+                rpc_spans[claim.uid] = span
+                objs.append(obj)
             try:
                 self._pipeline.order(ticket)
                 self._pu_lock.acquire(timeout=10.0)
             except TimeoutError as e:
+                for span in rpc_spans.values():
+                    span.abandon(str(e))
+                rpc_spans.clear()
                 return {c.uid: PrepareResult(error=str(e))
                         for c in claims}
             try:
@@ -204,6 +223,14 @@ class TpuDriver(DriverCallbacks):
             wire_queue_seconds.observe(ticket.queue_s)
             return results
         finally:
+            for uid, span in rpc_spans.items():
+                res = results.get(uid)
+                if res is None:
+                    span.abandon("no result recorded (handler error)")
+                elif res.error:
+                    span.abandon(res.error)
+                else:
+                    span.end()
             self._pipeline.done(ticket)
 
     def unprepare_claims(self, claims: List[Claim]) -> Dict[str, str]:
@@ -232,18 +259,25 @@ class TpuDriver(DriverCallbacks):
     def record_wire(self, stage_s: Dict[str, float]) -> None:
         """Per-RPC wire attribution from the gRPC handler (server.py):
         decode/encode/handler seconds, merged with the pipeline queue
-        share measured here. Kept as last-RPC ms for the bench."""
-        wire_decode_seconds.observe(stage_s.get("decode", 0.0))
-        wire_encode_seconds.observe(stage_s.get("encode", 0.0))
+        share measured here. The stage stopwatches are synthesized into
+        ``rpc.<stage>`` spans and the bench's `last_wire_breakdown`
+        keys are DERIVED from those spans (SURVEY §19: the span layer
+        is the single source of truth for attribution; the stopwatch
+        keys keep their byte-compatible names)."""
         queue_s = getattr(self._wire_tls, "queue_s", 0.0)
         self._wire_tls.queue_s = 0.0  # consumed: don't smear onto a
         # later RPC on this thread that timed out before measuring.
+        spans = {
+            stage: TRACER.record_span(f"rpc.{stage}", seconds)
+            for stage, seconds in (
+                ("decode", stage_s.get("decode", 0.0)),
+                ("queue", queue_s),
+                ("encode", stage_s.get("encode", 0.0)),
+                ("handler", stage_s.get("handler", 0.0)))}
+        wire_decode_seconds.observe(spans["decode"].duration_s)
+        wire_encode_seconds.observe(spans["encode"].duration_s)
         self.last_wire_breakdown = {
-            "decode": stage_s.get("decode", 0.0) * 1e3,
-            "queue": queue_s * 1e3,
-            "encode": stage_s.get("encode", 0.0) * 1e3,
-            "handler": stage_s.get("handler", 0.0) * 1e3,
-        }
+            stage: span.duration_ms for stage, span in spans.items()}
 
     def _fetch_claims(self, claims: List[Claim]
                       ) -> List[Tuple[Claim, Tuple[Optional[Dict],

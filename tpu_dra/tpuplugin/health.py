@@ -19,6 +19,7 @@ from typing import Callable, Iterable, Optional, Set
 
 from tpu_dra.infra.faults import FAULTS
 from tpu_dra.infra.metrics import DefaultRegistry
+from tpu_dra.infra.trace import dump_flight_recorder
 from tpu_dra.native.tpuinfo import HealthEvent, TpuInfoBackend
 
 log = logging.getLogger("tpu_dra.tpuplugin.health")
@@ -83,11 +84,16 @@ class DeviceHealthMonitor:
             if self._thread.is_alive():
                 self.wedged = True
                 wedged_gauge.set(1)
+                # Flight-recorder dump trigger (SURVEY §19): the wedge
+                # ships its evidence — recent spans, fault firings and
+                # queue events around the moment the pipeline died.
+                dump_path = dump_flight_recorder("wedged")
                 log.error(
                     "health monitor thread did not stop within %.1fs — "
                     "wedged in the backend event wait; health events are "
-                    "NOT flowing (chips can die unnoticed until restart)",
-                    WAIT_TIMEOUT_S + 1)
+                    "NOT flowing (chips can die unnoticed until "
+                    "restart); flight recorder dumped to %s",
+                    WAIT_TIMEOUT_S + 1, dump_path)
 
     def _run(self) -> None:
         """The eventSet.Wait loop (device_health.go:146-204)."""

@@ -14,7 +14,7 @@ import threading
 
 from tpu_dra.api.types import TPU_DRIVER_NAME
 from tpu_dra.cdi.handler import CDIHandler
-from tpu_dra.infra import debug, featuregates
+from tpu_dra.infra import debug, featuregates, trace
 from tpu_dra.infra.flags import (
     Flag, FlagSet, apply_feature_gates, feature_gate_flag, logging_flags,
     setup_logging,
@@ -68,6 +68,10 @@ def main(argv=None) -> int:
     apply_feature_gates(ns)
     fs.dump_config(ns, logger)
     debug.start_debug_signal_handlers()
+    # SIGUSR1 -> flight-recorder dump (recent spans + fault firings +
+    # queue events, SURVEY §19): the "what is this plugin doing RIGHT
+    # NOW" lever for a wedged pod, next to the stack-dump handlers.
+    trace.install_signal_handler()
 
     backend = get_backend()
     # Transient API-server failures (rolling upgrade, LB blips)
