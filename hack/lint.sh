@@ -15,18 +15,33 @@
 #    paths — SURVEY §19) — plus the draracer interprocedural pass
 #    (SURVEY §16): R9 whole-tree *_locked reachability over the call
 #    graph, R10 guarded-by inference, R11 static lock-order graph
-#    acyclicity. Any unsuppressed finding fails, and so does any
+#    acyclicity — plus drflow (SURVEY §20): R13 whole-tree escape
+#    analysis of zero-copy informer views, R14 stale-snapshot
+#    check-then-act across lock releases (REVALIDATES protocol
+#    annotations), R15 swallowed-exception audit w/ declared fault-site
+#    degradations. Any unsuppressed finding fails, and so does any
 #    suppression comment WITHOUT a justification string
 #    (--require-justified): the waiver count can never grow silently.
 #    Whole-tree runs are incremental (per-file result cache,
-#    .dralint-cache.json); DRALINT_NO_CACHE=1 forces a cold run.
+#    .dralint-cache.json); DRALINT_NO_CACHE=1 forces a cold run; the
+#    scan phase parallelizes with --jobs (DRALINT_JOBS, default auto)
+#    and a cold run is wall-clock-gated so extraction cost cannot
+#    silently regress. The per-rule findings/suppressions/timing table
+#    renders after every run.
 # 3. The fault-site coverage report (informational): guard + arm
 #    locations per registered site.
-# 4. drmc — the deterministic model checker gate (hack/drmc.sh):
+# 4. View-shadow cross-validation (SURVEY §20): a seeded scheduler
+#    chaos walk runs with every zero-copy view content-hashed at
+#    hand-out and re-hashed at quiesce; any in-place mutation fails the
+#    walk, and the exported drift set must map to statically
+#    R13-implicated view seeds (observed ⊆ static, both directions:
+#    the drmc stale-read probe is R14's runtime half).
+# 5. drmc — the deterministic model checker gate (hack/drmc.sh):
 #    interleaving exploration + crash-point enumeration over the
-#    scheduler-churn and batch-prepare scenarios — run with the lock
-#    witness EXPORTING its observed acquisition-order edges.
-# 5. observed ⊆ static: every runtime edge the drmc run observed must
+#    scheduler-churn, batch-prepare, evict-churn and stale-read-fixed
+#    scenarios — run with the lock witness EXPORTING its observed
+#    acquisition-order edges.
+# 6. observed ⊆ static: every runtime edge the drmc run observed must
 #    be in R11's static lock-order graph. An unexplained edge means
 #    the call graph under-approximates — the gate fails so the model
 #    gets fixed rather than quietly trusted.
@@ -39,9 +54,38 @@ python -m compileall -q \
   "$REPO_ROOT/tpu_dra" "$REPO_ROOT/tests" "$REPO_ROOT/bench.py" \
   "$REPO_ROOT/hack"
 
-echo ">> dralint (R1-R12) + fault-site coverage"
+echo ">> dralint (R1-R15) + fault-site coverage + per-rule table"
 python -m tpu_dra.analysis --root "$REPO_ROOT" --sites-report \
+  --rule-table --jobs "${DRALINT_JOBS:-auto}" \
   --require-justified ${DRALINT_NO_CACHE:+--no-cache}
+
+echo ">> dralint cold-run wall-clock gate (--jobs, no cache)"
+# The parallel-extraction satellite's regression bound: a COLD
+# whole-tree run (no result cache read or written) must finish inside
+# the timeout even as the rule families grow — if this trips, the
+# extraction got slower, not the machine.
+timeout 180 python -m tpu_dra.analysis --root "$REPO_ROOT" \
+  --no-cache --jobs "${DRALINT_JOBS:-auto}" >/dev/null
+
+echo ">> view-shadow chaos walk (drflow R13 runtime cross-validation)"
+# One seeded scheduler-churn walk with the zero-copy view shadow
+# enabled: quiesce fails on any in-place view mutation, and the drift
+# set is exported for the observed⊆static check below.
+VIEW_DRIFTS="$REPO_ROOT/.viewshadow-drifts.lint.json"
+rm -f "$VIEW_DRIFTS"
+TPU_DRA_VIEW_SHADOW_EXPORT="$VIEW_DRIFTS" JAX_PLATFORMS=cpu python - <<'PY'
+from tpu_dra.simcluster.chaos import run_sched_schedule
+r = run_sched_schedule(11, 40)
+if not r.ok:
+    print("view-shadow chaos walk violations:")
+    for v in r.violations:
+        print("  ", v)
+raise SystemExit(0 if r.ok else 1)
+PY
+
+echo ">> view-shadow cross-validation (observed drifts ⊆ static R13)"
+python -m tpu_dra.analysis --root "$REPO_ROOT" \
+  --check-view-shadow "$VIEW_DRIFTS" ${DRALINT_NO_CACHE:+--no-cache}
 
 rm -f "$WITNESS_EDGES"
 TPU_DRA_LOCK_WITNESS_EXPORT="$WITNESS_EDGES" "$REPO_ROOT/hack/drmc.sh"

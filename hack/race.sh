@@ -26,7 +26,8 @@ set -euo pipefail
 REPO_ROOT="$(cd "$(dirname "$0")/.." && pwd)"
 N="${1:-3}"
 WITNESS_EDGES="$REPO_ROOT/.lockwitness-edges.race.json"
-rm -f "$WITNESS_EDGES"
+VIEW_DRIFTS="$REPO_ROOT/.viewshadow-drifts.race.json"
+rm -f "$WITNESS_EDGES" "$VIEW_DRIFTS"
 
 echo ">> lint gate (dralint)"
 "$REPO_ROOT/hack/lint.sh"
@@ -47,6 +48,8 @@ for i in $(seq 1 "$N"); do
   echo "-- iteration $i/$N"
   TPU_DRA_LOCK_WITNESS=1 \
   TPU_DRA_LOCK_WITNESS_EXPORT="$WITNESS_EDGES" \
+  TPU_DRA_VIEW_SHADOW=1 \
+  TPU_DRA_VIEW_SHADOW_EXPORT="$VIEW_DRIFTS" \
   python -m pytest "$REPO_ROOT/tests/test_cd_integration.py" \
     "$REPO_ROOT/tests/test_stress_failover.py" \
     "$REPO_ROOT/tests/test_multiprocess_e2e.py" -q -p no:cacheprovider
@@ -55,5 +58,9 @@ done
 echo ">> lock-order witness cross-validation (observed ⊆ static)"
 python -m tpu_dra.analysis --root "$REPO_ROOT" \
   --check-witness "$WITNESS_EDGES"
+
+echo ">> view-shadow cross-validation (observed drifts ⊆ static R13)"
+python -m tpu_dra.analysis --root "$REPO_ROOT" \
+  --check-view-shadow "$VIEW_DRIFTS"
 
 echo ">> race tier green"

@@ -28,6 +28,20 @@ if _WITNESS_SESSION:
 
 
 def pytest_sessionfinish(session, exitstatus):
+    # View shadow (SURVEY §20): a session run with TPU_DRA_VIEW_SHADOW=1
+    # re-hashes every recorded zero-copy view at exit and FAILS on
+    # drift, exporting the drift set for the drflow R13 observed⊆static
+    # gate — the view analog of the witness block below.
+    if os.environ.get("TPU_DRA_VIEW_SHADOW") == "1":
+        from tpu_dra.k8s import informer as _informer
+        drifts = _informer.SHADOW.verify()
+        _informer.SHADOW.export()
+        if drifts:
+            print("\n!! zero-copy view drifts (drflow R13 runtime "
+                  "shadow):")
+            for d in drifts:
+                print(f"   {d['key']} handed out at {d['site']}")
+            session.exitstatus = 3
     if not _WITNESS_SESSION:
         return
     from tpu_dra.infra import lockwitness

@@ -1,0 +1,937 @@
+"""drflow (tpu_dra/analysis/flowanalysis): interprocedural escape,
+stale-snapshot check-then-act and swallowed-error analysis (ISSUE 14).
+
+Mirrors test_raceanalysis's tiers, plus the BOTH-DIRECTIONS acceptance
+the ISSUE names: the deliberately buggy shapes are asserted caught
+statically (R13/R14 findings on fixture source) AND dynamically (a
+zero-copy view mutated in place trips the runtime view shadow; the
+drmc stale-read probe finds the capacity overrun the same source shape
+statically flags) — observed⊆static, like PR 9's witness gate.
+"""
+
+import json
+import textwrap
+import threading
+from pathlib import Path
+
+import pytest
+
+from tpu_dra.analysis import ProjectContext, core, lint_sources
+from tpu_dra.analysis.flowanalysis import FlowAnalysis, check_view_shadow
+from tpu_dra.k8s import informer as informer_mod
+from tpu_dra.k8s.informer import Lister, ViewShadow, load_drifts
+
+
+def lint(sources, rules, ctx=None):
+    if isinstance(sources, str):
+        sources = {"pkg/fixture.py": sources}
+    return lint_sources(
+        {rel: textwrap.dedent(src) for rel, src in sources.items()},
+        rule_ids=set(rules.split(",")), ctx=ctx)
+
+
+def line_of(src, needle, occurrence=1):
+    for i, ln in enumerate(textwrap.dedent(src).splitlines(), 1):
+        if needle in ln:
+            occurrence -= 1
+            if not occurrence:
+                return i
+    raise AssertionError(f"{needle!r} not in fixture")
+
+
+def rule_ids(findings):
+    return [f.rule for f in findings]
+
+
+# A class whose lister hands out zero-copy views (the informer shape
+# the R13 seeds key on).
+CACHE = """
+    class Cache:
+        def run(self):
+            return self._informers["pods"].lister.list()
+"""
+
+
+# ---------------------------------------------------------------------------
+# R13: whole-tree escape analysis
+# ---------------------------------------------------------------------------
+
+class TestR13Escape:
+    def test_cross_module_arg_flow_fires(self):
+        helper = """
+            def patch(pod, v):
+                pod["spec"]["nodeName"] = v
+        """
+        user = """
+            from pkg.helper import patch
+
+            class C:
+                def run(self):
+                    pod = self._informers["pods"].lister.get("a")
+                    patch(pod, "n1")
+        """
+        out = lint({"pkg/helper.py": helper, "pkg/user.py": user}, "R13")
+        assert rule_ids(out) == ["R13"]
+        assert out[0].path == "pkg/helper.py"
+        assert out[0].line == line_of(helper, 'pod["spec"]')
+        assert "pkg/user.py:6" in out[0].message  # the view seed site
+
+    def test_deepcopy_launders(self):
+        user = """
+            import copy
+
+            def patch(pod, v):
+                pod["spec"]["nodeName"] = v
+
+            class C:
+                def run(self):
+                    pod = copy.deepcopy(
+                        self._informers["pods"].lister.get("a"))
+                    patch(pod, "n1")
+        """
+        assert lint({"pkg/user.py": user}, "R13") == []
+
+    def test_json_deepcopy_launders(self):
+        user = """
+            from tpu_dra.k8s.client import json_deepcopy
+
+            def patch(pod, v):
+                pod["spec"]["nodeName"] = v
+
+            class C:
+                def run(self):
+                    pod = json_deepcopy(
+                        self._informers["pods"].lister.get("a"))
+                    patch(pod, "n1")
+        """
+        assert lint({"pkg/user.py": user}, "R13") == []
+
+    def test_aliased_deepcopy_import_launders(self):
+        # The unified laundering predicate resolves import aliases —
+        # both hatches, both spellings (ISSUE 14 satellite).
+        user = """
+            from copy import deepcopy as dc
+
+            def patch(pod, v):
+                pod["spec"]["nodeName"] = v
+
+            class C:
+                def run(self):
+                    pod = dc(self._informers["pods"].lister.get("a"))
+                    patch(pod, "n1")
+        """
+        assert lint({"pkg/user.py": user}, "R13") == []
+
+    def test_aliased_json_deepcopy_import_launders(self):
+        user = """
+            from tpu_dra.k8s.client import json_deepcopy as jdc
+
+            def patch(pod, v):
+                pod["spec"]["nodeName"] = v
+
+            class C:
+                def run(self):
+                    pod = jdc(self._informers["pods"].lister.get("a"))
+                    patch(pod, "n1")
+        """
+        assert lint({"pkg/user.py": user}, "R13") == []
+
+    def test_r3_accepts_aliased_deepcopy_too(self):
+        # The SAME predicate backs R3 (one definition, two rules).
+        src = """
+            from copy import deepcopy as dc
+
+            def handle(lister):
+                pod = dc(lister.get("a"))
+                pod["spec"]["x"] = 1
+        """
+        assert lint(src, "R3") == []
+
+    def test_return_flow_fires(self):
+        src = """
+            class C:
+                def _get(self, name):
+                    return self._informers["pods"].lister.get(name)
+
+                def run(self):
+                    pod = self._get("a")
+                    pod["spec"]["x"] = 1
+        """
+        out = lint(src, "R13")
+        assert rule_ids(out) == ["R13"]
+        assert out[0].line == line_of(src, 'pod["spec"]["x"]')
+
+    def test_container_attr_store_and_element_mutation_fires(self):
+        src = """
+            class C:
+                def remember(self):
+                    self._cache["a"] = self._informers["p"].lister.get("a")
+
+                def corrupt(self):
+                    pod = self._cache["a"]
+                    pod["meta"] = {}
+        """
+        out = lint(src, "R13")
+        assert rule_ids(out) == ["R13"]
+        assert out[0].line == line_of(src, 'pod["meta"]')
+
+    def test_container_restructuring_is_clean(self):
+        # The container HOLDS views; popping an entry restructures the
+        # container, not a view.
+        src = """
+            class C:
+                def remember(self):
+                    self._cache["a"] = self._informers["p"].lister.get("a")
+
+                def forget(self):
+                    self._cache.pop("a", None)
+        """
+        assert lint(src, "R13") == []
+
+    def test_append_store_then_iteration_mutation_fires(self):
+        src = """
+            class C:
+                def collect(self):
+                    for pod in self._informers["p"].lister.list():
+                        self._pending.append(pod)
+
+                def flush(self):
+                    for pod in self._pending:
+                        pod["status"] = {}
+        """
+        out = lint(src, "R13")
+        assert rule_ids(out) == ["R13"]
+        assert out[0].line == line_of(src, 'pod["status"]')
+
+    def test_closure_capture_fires(self):
+        src = """
+            def register(cb):
+                pass
+
+            class C:
+                def run(self):
+                    pod = self._informers["p"].lister.get("a")
+
+                    def fixup():
+                        pod["spec"]["x"] = 1
+                    register(fixup)
+        """
+        out = lint(src, "R13")
+        assert rule_ids(out) == ["R13"]
+        assert out[0].line == line_of(src, 'pod["spec"]["x"]')
+
+    def test_propagator_preserves_taint(self):
+        src = """
+            class C:
+                def run(self):
+                    pods = sorted(self._informers["p"].lister.list(),
+                                  key=len)
+                    first = pods[0]
+                    first.update({})
+        """
+        out = lint(src, "R13")
+        assert rule_ids(out) == ["R13"]
+
+    def test_view_ok_annotation_sanctions(self):
+        src = """
+            class C:
+                def run(self):
+                    pod = self._informers["p"].lister.get("a")
+                    # drflow: view-ok[single-writer module: this informer has no other consumer]
+                    pod["spec"]["x"] = 1
+        """
+        assert lint(src, "R13") == []
+
+    def test_view_ok_without_reason_fires(self):
+        src = """
+            class C:
+                def run(self):
+                    pod = self._informers["p"].lister.get("a")
+                    # drflow: view-ok
+                    pod["spec"]["x"] = 1
+        """
+        out = lint(src, "R13")
+        assert rule_ids(out) == ["R13"]
+        assert "without a reason" in out[0].message
+
+    def test_view_ok_flow_stays_shadow_implicated(self):
+        # A sanctioned hatch is still a statically-KNOWN flow: its seed
+        # must be implicated so a runtime drift there reads as
+        # explained, not as static under-approximation.
+        from tpu_dra.analysis.raceanalysis import extract_module
+        from tpu_dra.analysis.flowanalysis import (
+            _CalleeCache, _R13Pass,
+        )
+        from tpu_dra.analysis.raceanalysis import shared_resolver
+        src = textwrap.dedent("""
+            class C:
+                def run(self):
+                    pod = self._informers["p"].lister.get("a")
+                    # drflow: view-ok[single-writer module]
+                    pod["spec"]["x"] = 1
+        """)
+        mod = core.parse_module(Path("pkg/fixture.py"), Path("."),
+                                source=src)
+        res = shared_resolver({"pkg/fixture.py": extract_module(mod)})
+        p = _R13Pass(res, _CalleeCache(res))
+        assert p.run() == []  # sanctioned: no finding
+        assert p.implicated == {"pkg/fixture.py:4"}
+
+    def test_read_only_sinks_are_clean(self):
+        src = """
+            def digest(pod):
+                return pod.get("spec", {}).get("nodeName")
+
+            class C:
+                def run(self):
+                    pod = self._informers["p"].lister.get("a")
+                    return digest(pod)
+        """
+        assert lint(src, "R13") == []
+
+
+# ---------------------------------------------------------------------------
+# R14: stale-snapshot check-then-act
+# ---------------------------------------------------------------------------
+
+STORE_SRC = """
+    import threading
+
+    class Store:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []
+            self.capacity = 1
+
+        def count(self):
+            with self._lock:
+                return len(self._items)
+
+        def admit(self, k):
+            with self._lock:
+                self._items.append(k)
+
+        # drflow: REVALIDATES:_items
+        def try_admit(self, k):
+            with self._lock:
+                if len(self._items) >= self.capacity:
+                    return False
+                self._items.append(k)
+                return True
+"""
+
+
+class TestR14StaleSnapshot:
+    def test_with_block_snapshot_fires(self):
+        src = """
+            import threading
+
+            class Counter:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+
+                def bump(self, limit):
+                    with self._lock:
+                        n = self._n
+                    if n < limit:
+                        with self._lock:
+                            self._n = n + 1
+        """
+        out = lint(src, "R14")
+        assert rule_ids(out) == ["R14"]
+        assert out[0].line == line_of(src, "self._n = n + 1")
+        assert "stale snapshot" in out[0].message
+
+    def test_reread_under_lock_is_clean(self):
+        src = """
+            import threading
+
+            class Counter:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+
+                def bump(self, limit):
+                    with self._lock:
+                        n = self._n
+                    if n < limit:
+                        with self._lock:
+                            if self._n < limit:
+                                self._n = self._n + 1
+        """
+        assert lint(src, "R14") == []
+
+    def test_getter_act_pair_fires(self):
+        user = """
+            from pkg.store import Store
+
+            def taker(s: Store, k):
+                n = s.count()
+                if n < s.capacity:
+                    s.admit(k)
+        """
+        out = lint({"pkg/store.py": STORE_SRC, "pkg/user.py": user},
+                   "R14")
+        assert rule_ids(out) == ["R14"]
+        assert out[0].path == "pkg/user.py"
+        assert out[0].line == line_of(user, "s.admit(k)")
+        assert "locked getter" in out[0].message
+
+    def test_revalidating_act_is_clean(self):
+        # try_admit carries the REVALIDATES annotation (and really does
+        # re-check under the lock): the same guard shape is sanctioned.
+        user = """
+            from pkg.store import Store
+
+            def taker(s: Store, k):
+                n = s.count()
+                if n < s.capacity:
+                    s.try_admit(k)
+        """
+        out = lint({"pkg/store.py": STORE_SRC, "pkg/user.py": user},
+                   "R14")
+        assert out == []
+
+    def test_reservation_claim_is_clean(self):
+        # The spawn-slot shape: the guarded expression test-and-sets a
+        # claim under the lock — the actor is serialized, not racing.
+        src = """
+            import threading
+
+            class Mgr:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._proc = None
+                    self._spawning = False
+
+                def _claim_locked(self):
+                    if self._spawning:
+                        return False
+                    self._spawning = True
+                    return True
+
+                def ensure(self):
+                    with self._lock:
+                        spawn = self._proc is None and self._claim_locked()
+                    if spawn:
+                        self._proc = object()
+        """
+        assert lint(src, "R14") == []
+
+    def test_ctor_handle_snapshot_is_clean(self):
+        # A construction-time handle read under the lock is a VALUE:
+        # nothing mutates it, nothing goes stale.
+        src = """
+            import threading
+
+            class C:
+                def __init__(self, mgr):
+                    self._lock = threading.Lock()
+                    self._mgr = mgr
+                    self._done = False
+
+                def run(self):
+                    with self._lock:
+                        m = self._mgr
+                    if m is not None:
+                        with self._lock:
+                            self._done = True
+        """
+        assert lint(src, "R14") == []
+
+    def test_dralint_ignore_suppresses_with_justification(self):
+        src = """
+            import threading
+
+            class Counter:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+
+                def bump(self, limit):
+                    with self._lock:
+                        n = self._n
+                    if n < limit:
+                        with self._lock:
+                            self._n = n + 1  # dralint: ignore[R14] — single-writer counter
+        """
+        assert lint(src, "R14") == []
+
+
+# ---------------------------------------------------------------------------
+# R15: swallowed-exception audit
+# ---------------------------------------------------------------------------
+
+class TestR15Swallow:
+    def _one(self, body, rules="R15", ctx=None):
+        return lint(body, rules, ctx=ctx)
+
+    def test_silent_broad_handler_fires(self):
+        src = """
+            def run(step):
+                try:
+                    step()
+                except Exception:
+                    pass
+        """
+        out = self._one(src)
+        assert rule_ids(out) == ["R15"]
+        assert out[0].line == line_of(src, "except Exception")
+        assert "swallows the error silently" in out[0].message
+
+    def test_bare_except_fires(self):
+        src = """
+            def run(step):
+                try:
+                    step()
+                except:  # noqa: E722
+                    pass
+        """
+        assert rule_ids(self._one(src)) == ["R15"]
+
+    def test_narrow_handler_does_not_swallow_audit(self):
+        src = """
+            def run(step):
+                try:
+                    step()
+                except ValueError:
+                    pass
+        """
+        assert self._one(src) == []
+
+    @pytest.mark.parametrize("body", [
+        "raise",
+        "LOG.warning('step failed')",
+        "print('step failed')",
+        "FAILS.inc()",
+        "self._degrade('step')",
+        "errors.append(str(e))",
+    ])
+    def test_disciplined_handlers_are_clean(self, body):
+        src = f"""
+            def run(self, step, errors):
+                try:
+                    step()
+                except Exception as e:
+                    {body}
+        """
+        assert self._one(src) == []
+
+    def test_swallow_ok_with_reason_sanctions(self):
+        src = """
+            def run(step):
+                try:
+                    step()
+                except Exception:  # drflow: swallow-ok[probe failure IS the signal]
+                    pass
+        """
+        assert self._one(src) == []
+
+    def test_swallow_ok_without_reason_fires(self):
+        src = """
+            def run(step):
+                try:
+                    step()
+                except Exception:  # drflow: swallow-ok
+                    pass
+        """
+        out = self._one(src)
+        assert rule_ids(out) == ["R15"]
+        assert "without a reason" in out[0].message
+
+    def _site_ctx(self):
+        ctx = ProjectContext(root=Path("."))
+        ctx.fault_sites = {"sched.shard_apply": 1}
+        ctx.fault_degradations = {"sched.shard_apply": "mark_dirty"}
+        return ctx
+
+    def test_guarded_site_without_declared_degradation_fires(self):
+        # Narrow FaultInjected handlers are held to the declared route
+        # too — that is how injected faults are usually caught.
+        src = """
+            from tpu_dra.infra.faults import FAULTS, FaultInjected
+
+            def apply(shard, claim, log):
+                try:
+                    FAULTS.check("sched.shard_apply", claim=claim)
+                    shard.put(claim)
+                except FaultInjected:
+                    log.warning("apply failed")
+        """
+        out = self._one(src, ctx=self._site_ctx())
+        assert rule_ids(out) == ["R15"]
+        assert "mark_dirty" in out[0].message
+
+    def test_guarded_site_routed_to_degradation_is_clean(self):
+        src = """
+            from tpu_dra.infra.faults import FAULTS, FaultInjected
+
+            def apply(shard, claim):
+                try:
+                    FAULTS.check("sched.shard_apply", claim=claim)
+                    shard.put(claim)
+                except FaultInjected:
+                    shard.mark_dirty("apply fault")
+                    raise
+        """
+        assert self._one(src, ctx=self._site_ctx()) == []
+
+
+# ---------------------------------------------------------------------------
+# TreeResolver edges the new rules lean on (ISSUE 14 satellite)
+# ---------------------------------------------------------------------------
+
+class TestResolverEdges:
+    def test_decorated_def_still_resolves(self):
+        # R13 must flow through a helper wearing a decorator.
+        src = """
+            def traced(fn):
+                return fn
+
+            @traced
+            def patch(pod, v):
+                pod["spec"]["x"] = v
+
+            class C:
+                def run(self):
+                    pod = self._informers["p"].lister.get("a")
+                    patch(pod, 1)
+        """
+        out = lint(src, "R13")
+        assert rule_ids(out) == ["R13"]
+        assert out[0].line == line_of(src, 'pod["spec"]["x"]')
+
+    def test_functools_partial_flow(self):
+        # A *_locked bound method wrapped in functools.partial and
+        # invoked later resolves through the partial to its target.
+        src = """
+            import threading
+            from functools import partial
+
+            class M:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def _work_locked(self, k):
+                    pass
+
+                def run(self):
+                    cb = partial(self._work_locked, "a")
+                    cb()
+        """
+        out = lint(src, "R9")
+        assert set(rule_ids(out)) == {"R9"}
+        # the CALL through the partial resolved to its target (not just
+        # the escaping-reference finding on the partial() line)
+        assert any("resolves to" in f.message and "_work_locked"
+                   in f.message for f in out)
+
+    def test_property_getter_types_the_value(self):
+        # obj.prop resolves to the getter's RETURN type, so a call on
+        # the property value dispatches into the returned class.
+        src = """
+            import threading
+
+            class Inner:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def mutate_locked(self):
+                    pass
+
+            class Outer:
+                def __init__(self):
+                    self._inner = Inner()
+
+                @property
+                def inner(self) -> Inner:
+                    return self._inner
+
+            def entry(o: Outer):
+                o.inner.mutate_locked()
+        """
+        out = lint(src, "R9")
+        assert rule_ids(out) == ["R9"]
+        assert out[0].line == line_of(src, "o.inner.mutate_locked()")
+
+    def test_property_getter_view_flow(self):
+        # R13 through a property: the getter returns a view; mutating
+        # the property value fires.
+        src = """
+            class C:
+                @property
+                def pods(self):
+                    return self._informers["p"].lister.list()
+
+                def run(self):
+                    pods = self.pods
+                    pods.clear()
+        """
+        out = lint(src, "R13")
+        assert rule_ids(out) == ["R13"]
+        assert out[0].line == line_of(src, "pods.clear()")
+
+
+# ---------------------------------------------------------------------------
+# Runtime view shadow (the observed half of R13)
+# ---------------------------------------------------------------------------
+
+class TestViewShadow:
+    def _shadow(self):
+        sh = ViewShadow()
+        sh.enabled = True
+        return sh
+
+    def test_drift_detected_and_keyed_by_site(self):
+        sh = self._shadow()
+        pod = {"metadata": {"name": "a"}, "spec": {"nodeName": ""}}
+        sh.record(pod)
+        assert sh.verify() == []
+        pod["spec"]["nodeName"] = "n1"  # the in-place mutation
+        drifts = sh.verify()
+        assert len(drifts) == 1
+        assert drifts[0]["key"] == "a"
+        assert drifts[0]["site"].startswith("tests/test_flowanalysis.py:")
+        # idempotent: the same drift does not re-report
+        assert sh.verify() == []
+        assert len(sh.violations_since(0)) == 1
+
+    def test_lister_handout_is_shadowed(self):
+        prev = informer_mod.SHADOW.enable()
+        informer_mod.SHADOW.reset()
+        try:
+            store = {"a": {"metadata": {"name": "a"}, "spec": {}}}
+            lister = Lister(store, threading.RLock(), deep_copy=False)
+            snap = informer_mod.SHADOW.snapshot()
+            pod = lister.get("a")
+            # dralint: ignore[R3] — the deliberate violation this test exists to catch at runtime
+            pod["spec"]["nodeName"] = "oops"  # the bug class, live
+            v = informer_mod.SHADOW.violations_since(snap)
+            assert len(v) == 1 and "mutated in place" in v[0]
+        finally:
+            informer_mod.SHADOW.reset()
+            informer_mod.SHADOW.restore(prev)
+
+    def test_deepcopy_lister_is_not_shadowed(self):
+        prev = informer_mod.SHADOW.enable()
+        informer_mod.SHADOW.reset()
+        try:
+            store = {"a": {"metadata": {"name": "a"}, "spec": {}}}
+            lister = Lister(store, threading.RLock(), deep_copy=True)
+            pod = lister.get("a")
+            # dralint: ignore[R3] — deep-copy lister: the mutation is sanctioned, the test proves it is unshadowed
+            pod["spec"]["nodeName"] = "fine"  # private copy: allowed
+            assert informer_mod.SHADOW.verify() == []
+        finally:
+            informer_mod.SHADOW.reset()
+            informer_mod.SHADOW.restore(prev)
+
+    def test_export_merge_and_load(self, tmp_path):
+        sh = self._shadow()
+        pod = {"metadata": {"name": "a"}, "x": 0}
+        sh.record(pod)
+        pod["x"] = 1
+        path = tmp_path / "drifts.json"
+        assert sh.export(str(path)) == str(path)
+        drifts = load_drifts(str(path))
+        assert len(drifts) == 1 and drifts[0]["key"] == "a"
+        # merging a second export keeps prior drifts
+        sh2 = self._shadow()
+        obj = {"metadata": {"name": "b"}, "y": 0}
+        sh2.record(obj)
+        obj["y"] = 2
+        sh2.export(str(path))
+        assert {d["key"] for d in load_drifts(str(path))} == {"a", "b"}
+
+    def test_load_drifts_missing_file_raises(self, tmp_path):
+        with pytest.raises(OSError):
+            load_drifts(str(tmp_path / "nope.json"))
+        bad = tmp_path / "bad.json"
+        bad.write_text("[]")
+        with pytest.raises(ValueError):
+            load_drifts(str(bad))
+
+    def test_check_view_shadow_classification(self):
+        rule = FlowAnalysis()
+        rule.view_sites_recognized = {"a.py:1", "a.py:2"}
+        rule.view_sites_implicated = {"a.py:1"}
+        problems = check_view_shadow(rule, [
+            {"site": "a.py:1", "key": "explained"},
+            {"site": "a.py:2", "key": "missed"},
+            {"site": "b.py:9", "key": "blind"},
+        ])
+        assert len(problems) == 2
+        assert any("under-approximates" in p for p in problems)
+        assert any("unknown to the static analyzer" in p
+                   for p in problems)
+
+    def test_both_directions_on_the_same_shape(self):
+        """The acceptance fixture: ONE buggy consumer shape is caught
+        by the runtime shadow (drift at quiesce) AND by static R13 —
+        observed⊆static holds in both directions."""
+        # Static: the consumer's source fires R13.
+        src = """
+            def handle(pod):
+                pod["spec"]["x"] = 1
+
+            class C:
+                def run(self):
+                    pod = self._informers["p"].lister.get("a")
+                    handle(pod)
+        """
+        assert rule_ids(lint(src, "R13")) == ["R13"]
+        # Dynamic: the same mutation against a REAL zero-copy lister
+        # trips the shadow.
+        prev = informer_mod.SHADOW.enable()
+        informer_mod.SHADOW.reset()
+        try:
+            store = {"a": {"metadata": {"name": "a"}, "spec": {}}}
+            lister = Lister(store, threading.RLock(), deep_copy=False)
+
+            def handle(pod):
+                # dralint: ignore[R3] — the deliberate violation this test exists to catch at runtime
+                pod["spec"]["x"] = 1
+
+            handle(lister.get("a"))
+            assert len(informer_mod.SHADOW.verify()) == 1
+        finally:
+            informer_mod.SHADOW.reset()
+            informer_mod.SHADOW.restore(prev)
+
+
+# ---------------------------------------------------------------------------
+# drmc stale-read probe (the observed half of R14)
+# ---------------------------------------------------------------------------
+
+class TestStaleReadProbe:
+    def test_probe_violates_and_static_r14_flags_the_shape(self):
+        from tpu_dra.analysis.drmc.explore import explore
+        from tpu_dra.analysis.drmc.scenarios import StaleReadProbeScenario
+        r = explore(StaleReadProbeScenario(), budget=50)
+        assert r.violation is not None, "drmc must find the overrun"
+        assert "overrun" in r.violation.violations[0]
+        # The SAME source shape (sans the in-tree suppression) is a
+        # static R14 finding: observed⊆static in both directions.
+        user = """
+            from pkg.store import Store
+
+            def taker(s: Store, k):
+                n = s.count()
+                if n < s.capacity:
+                    s.admit(k)
+        """
+        out = lint({"pkg/store.py": STORE_SRC, "pkg/user.py": user},
+                   "R14")
+        assert rule_ids(out) == ["R14"]
+
+    def test_fixed_scenario_explores_clean(self):
+        from tpu_dra.analysis.drmc.explore import explore
+        from tpu_dra.analysis.drmc.scenarios import StaleReadFixedScenario
+        r = explore(StaleReadFixedScenario(), budget=100)
+        assert r.violation is None
+        assert r.schedules >= 10  # genuinely explored, not short-circuited
+
+    def test_probe_violation_replays(self):
+        from tpu_dra.analysis.drmc.explore import explore, replay
+        from tpu_dra.analysis.drmc.scenarios import StaleReadProbeScenario
+        r = explore(StaleReadProbeScenario(), budget=50)
+        assert r.violation is not None
+        out = replay(StaleReadProbeScenario(), r.violation.trace)
+        assert out.violations == r.violation.violations
+
+
+# ---------------------------------------------------------------------------
+# Cache / parallel-scan parity (ISSUE 14 satellites)
+# ---------------------------------------------------------------------------
+
+def _fixture_tree(tmp_path: Path) -> Path:
+    """A mini-project with one finding, one justified suppression, and
+    cross-file state, exercising scan + finalize + facts replay."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "store.py").write_text(textwrap.dedent("""
+        import threading
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = {}
+
+            def put_locked(self, k, v):
+                self._items[k] = v
+    """))
+    (pkg / "user.py").write_text(textwrap.dedent("""
+        from pkg.store import Store
+
+        def swallow(step):
+            try:
+                step()
+            except Exception:
+                pass
+
+        def ok(step):
+            try:
+                step()
+            except Exception:  # dralint: ignore[R15] — fixture waiver
+                pass
+    """))
+    return tmp_path
+
+
+def _report_key(report):
+    return ([f.to_dict() for f in report.findings],
+            [f.to_dict() for f in report.suppressed],
+            [f.to_dict() for f in report.unjustified])
+
+
+class TestScanParity:
+    def test_warm_vs_cold_parity(self, tmp_path):
+        root = _fixture_tree(tmp_path)
+        cold = core.run([root / "pkg"], root=root, use_cache=True)
+        assert cold.cache_hits == 0
+        cache = json.loads((root / core.CACHE_FILENAME).read_text())
+        # facts are stored ONCE for the shared draracer/drflow blob
+        for entry in cache["files"].values():
+            assert "R13" not in entry["facts"]
+        warm = core.run([root / "pkg"], root=root, use_cache=True)
+        assert warm.cache_hits == warm.files == cold.files
+        assert _report_key(warm) == _report_key(cold)
+        assert any(f.rule == "R15" for f in cold.findings)
+        assert any(f.rule == "R15" for f in cold.suppressed)
+        assert not cold.unjustified  # the fixture waiver carries a reason
+
+    def test_jobs_parity(self, tmp_path):
+        root = _fixture_tree(tmp_path)
+        serial = core.run([root / "pkg"], root=root)
+        parallel = core.run([root / "pkg"], root=root, jobs=2)
+        assert _report_key(serial) == _report_key(parallel)
+        assert "<scan-pool>" in parallel.timings
+        # and a parallel cold run primes a cache warm serial runs hit
+        cold = core.run([root / "pkg"], root=root, use_cache=True,
+                        jobs=2)
+        warm = core.run([root / "pkg"], root=root, use_cache=True)
+        assert warm.cache_hits == warm.files
+        assert _report_key(cold) == _report_key(warm)
+
+    def test_rule_filter_without_draracer_still_resolves(self, tmp_path):
+        # Regression: under --rules R13,R14,R15 draracer is filtered
+        # out, so drflow must contribute the shared facts blob itself —
+        # an empty finalize tree here silently disabled R13/R14.
+        root = _fixture_tree(tmp_path)
+        (root / "pkg" / "viewer.py").write_text(textwrap.dedent("""
+            class C:
+                def run(self):
+                    pod = self._informers["p"].lister.get("a")
+                    pod["spec"]["x"] = 1
+        """))
+        report = core.run([root / "pkg"], root=root,
+                          rule_ids={"R13", "R14", "R15"})
+        assert any(f.rule == "R13" for f in report.findings)
+
+    def test_rule_table_timings_present(self, tmp_path):
+        root = _fixture_tree(tmp_path)
+        report = core.run([root / "pkg"], root=root)
+        doc = report.to_dict()
+        assert "timings_s" in doc
+        assert any(k.startswith("R") for k in doc["timings_s"])

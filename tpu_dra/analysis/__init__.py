@@ -12,6 +12,7 @@ deterministic model checker — lives in ``tpu_dra.analysis.drmc``
 
 from tpu_dra.analysis import rules as _rules  # noqa: F401 — registers R1-R8
 from tpu_dra.analysis import raceanalysis as _race  # noqa: F401 — R9-R11
+from tpu_dra.analysis import flowanalysis as _flow  # noqa: F401 — R13-R15
 from tpu_dra.analysis.core import (
     Finding, Module, ProjectContext, Report, Rule, all_rules, find_root,
     lint_source, lint_sources, render, run,

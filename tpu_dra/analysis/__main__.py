@@ -17,7 +17,7 @@ import argparse
 import sys
 from pathlib import Path
 
-from tpu_dra.analysis import core, raceanalysis, rules
+from tpu_dra.analysis import core, flowanalysis, raceanalysis, rules
 
 
 def main(argv=None) -> int:
@@ -35,6 +35,14 @@ def main(argv=None) -> int:
     ap.add_argument("--no-cache", action="store_true",
                     help="ignore and do not write the per-file result "
                          "cache (.dralint-cache.json)")
+    ap.add_argument("--jobs", default="1",
+                    help="scan-phase worker processes: an int, or "
+                         "'auto' for min(8, cpu count) (cold "
+                         "whole-tree runs; warm runs are cache-bound "
+                         "and stay serial)")
+    ap.add_argument("--rule-table", action="store_true",
+                    help="print the per-rule findings/suppressions/"
+                         "timing table after the run")
     ap.add_argument("--show-suppressed", action="store_true")
     ap.add_argument("--sites-report", action="store_true",
                     help="also print the fault-site coverage table "
@@ -49,6 +57,12 @@ def main(argv=None) -> int:
                          "exported to FILE is a subset of the static "
                          "lock-order graph (observed ⊆ static); an "
                          "unexplained runtime edge exits 1")
+    ap.add_argument("--check-view-shadow", metavar="FILE", default=None,
+                    help="assert every runtime view-shadow drift "
+                         "exported to FILE (k8s.informer.viewshadow) "
+                         "maps to a statically R13-implicated view "
+                         "seed (observed ⊆ static); an unexplained "
+                         "drift exits 1")
     ap.add_argument("--require-justified", action="store_true",
                     help="fail when any suppressed finding's ignore "
                          "comment carries no justification string")
@@ -76,9 +90,11 @@ def main(argv=None) -> int:
             rule_ids.add("R4")  # the table is R4's collection
         if args.locks_report or args.check_witness:
             rule_ids.add("R9")  # draracer's collection (R9-R11)
+        if args.check_view_shadow:
+            rule_ids.add("R13")  # drflow's collection (R13-R15)
     active = core.all_rules()
     report = core.run(paths, root=root, rules=active, rule_ids=rule_ids,
-                      use_cache=not args.no_cache)
+                      use_cache=not args.no_cache, jobs=args.jobs)
     print(core.render(report, as_json=args.as_json,
                       show_suppressed=args.show_suppressed))
     # Under --json, stdout is the machine-readable document — the
@@ -109,6 +125,44 @@ def main(argv=None) -> int:
             name = f"{row['class']}.{row['attr']}"
             print(f"{name:58} {str(row['guard']):16} {row['how']:>10} "
                   f"{row['guarded']:4d} {row['unguarded']:5d}", file=out)
+    if args.rule_table:
+        # One row per rule id (ISSUE 14's CI table): findings and
+        # suppressions from the report's per-rule counts, wall-clock
+        # from the runner's per-rule-class timers (a combined rule
+        # bills its whole pass to its primary id; parallel scans bill
+        # the pool under <scan-pool>).
+        doc = report.to_dict()
+        by_f = doc["findings_by_rule"]
+        by_s = doc["suppressed_by_rule"]
+        rows = set(by_f) | set(by_s) | set(report.timings)
+        print(f"{'rule':12} {'findings':>8} {'suppressed':>10} "
+              f"{'seconds':>8}", file=out)
+        for rid in sorted(rows, key=lambda r: (r.startswith("<"),
+                                               len(r), r)):
+            t = report.timings.get(rid)
+            secs = f"{t:8.3f}" if t is not None else f"{'-':>8}"
+            print(f"{rid:12} {by_f.get(rid, 0):8d} "
+                  f"{by_s.get(rid, 0):10d} {secs}", file=out)
+    if args.check_view_shadow:
+        from tpu_dra.k8s import informer as informer_mod
+        flow = next(r for r in active
+                    if isinstance(r, flowanalysis.FlowAnalysis))
+        try:
+            drifts = informer_mod.load_drifts(args.check_view_shadow)
+        except (OSError, ValueError) as exc:
+            # Same contract as --check-witness: a missing export must
+            # not turn the gate green.
+            print(f"dralint: cannot read view-shadow export "
+                  f"{args.check_view_shadow}: {exc}", file=sys.stderr)
+            return 2
+        problems = flowanalysis.check_view_shadow(flow, drifts)
+        for p in problems:
+            print(f"viewshadow: {p}", file=out)
+        print(f"viewshadow: {len(drifts)} observed drift(s), "
+              f"{len(flow.view_sites_recognized)} recognized view "
+              f"site(s), {len(problems)} unexplained", file=out)
+        if problems:
+            status = max(status, 1)
     if args.check_witness:
         from tpu_dra.infra import lockwitness
         try:

@@ -196,6 +196,9 @@ class ProjectContext:
     for cross-file orphan findings."""
     root: Path
     fault_sites: Dict[str, int] = field(default_factory=dict)   # site -> line
+    # site -> declared degradation-helper name (faults.DEGRADATIONS):
+    # drflow R15 requires handlers guarding these sites to route there.
+    fault_degradations: Dict[str, str] = field(default_factory=dict)
     fault_sites_path: str = ""
     metric_catalog: Dict[str, int] = field(default_factory=dict)
     metric_catalog_path: str = ""
@@ -212,6 +215,8 @@ class ProjectContext:
         if faults.exists():
             ctx.fault_sites_path = str(faults.relative_to(root))
             ctx.fault_sites = _dict_literal_keys(faults, "SITES")
+            ctx.fault_degradations = _dict_literal_items(
+                faults, "DEGRADATIONS")
         metrics = root / "tpu_dra" / "infra" / "metrics.py"
         if metrics.exists():
             ctx.metric_catalog_path = str(metrics.relative_to(root))
@@ -238,6 +243,29 @@ def _dict_literal_keys(path: Path, name: str) -> Dict[str, int]:
                 return {k.value: k.lineno for k in node.value.keys
                         if isinstance(k, ast.Constant)
                         and isinstance(k.value, str)}
+    return {}
+
+
+def _dict_literal_items(path: Path, name: str) -> Dict[str, str]:
+    """String key -> string value of the module-level dict literal
+    assigned to `name` (non-string entries are skipped)."""
+    tree = ast.parse(path.read_text(encoding="utf-8"))
+    for node in tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        for t in targets:
+            if (isinstance(t, ast.Name) and t.id == name
+                    and isinstance(node.value, ast.Dict)):
+                return {k.value: v.value
+                        for k, v in zip(node.value.keys,
+                                        node.value.values)
+                        if isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)
+                        and isinstance(v, ast.Constant)
+                        and isinstance(v.value, str)}
     return {}
 
 
@@ -280,10 +308,21 @@ class Rule:
     # --rules filtering keeps working (core also post-filters findings
     # by id, so asking for R10 from a combined rule yields only R10).
     provides: frozenset = frozenset()
+    # Cache key this rule's FACTS live under. Defaults to rule_id; a
+    # rule that CONSUMES another rule's extraction (drflow R13-R15
+    # rides draracer's per-module blob) names that rule's id here so
+    # the blob is stored once and replayed to both — absorb_facts gets
+    # the shared blob, module_facts should return None (the producing
+    # rule already contributed it).
+    facts_key: str = ""
 
     @classmethod
     def provided_ids(cls) -> frozenset:
         return cls.provides or frozenset({cls.rule_id})
+
+    @classmethod
+    def facts_id(cls) -> str:
+        return cls.facts_key or cls.rule_id
 
     def scan(self, module: Module, ctx: ProjectContext) -> Iterator[Finding]:
         return iter(())
@@ -324,6 +363,12 @@ class Report:
     # Suppressed findings whose ignore comment has no justification
     # string — the lint.sh --require-justified gate.
     unjustified: List[Finding] = field(default_factory=list)
+    # Per-rule-class wall-clock seconds (scan accumulated across files
+    # + finalize), keyed by the rule's primary id — the --rule-table
+    # timing column. Parallel scans bill the pool's wall time to
+    # "<scan-pool>" since per-rule attribution dissolves across
+    # processes.
+    timings: Dict[str, float] = field(default_factory=dict)
     # The context the run was performed against (registries + scanned
     # set) — lets callers (e.g. --sites-report) reuse the parse.
     ctx: Optional["ProjectContext"] = None
@@ -348,6 +393,8 @@ class Report:
                 "suppressed": [f.to_dict() for f in self.suppressed],
                 "findings_by_rule": self._by_rule(self.findings),
                 "suppressed_by_rule": self._by_rule(self.suppressed),
+                "timings_s": {k: round(v, 4)
+                              for k, v in sorted(self.timings.items())},
                 "suppressed_unjustified":
                     [f.to_dict() for f in self.unjustified]}
 
@@ -393,10 +440,11 @@ def find_root(start: Path) -> Path:
 # cross-file FACTS each rule contributed (Rule.module_facts), which are
 # replayed through absorb_facts so finalize sees the whole tree.
 
-CACHE_VERSION = 2
+CACHE_VERSION = 3
 CACHE_FILENAME = ".dralint-cache.json"
 
-_RULES_SOURCES = ("core.py", "rules.py", "raceanalysis.py")
+_RULES_SOURCES = ("core.py", "rules.py", "raceanalysis.py",
+                  "flowanalysis.py")
 _REGISTRY_SOURCES = ("infra/faults.py", "infra/metrics.py",
                      "infra/featuregates.py")
 
@@ -470,11 +518,88 @@ def _rel(path: Path, root: Path) -> str:
         return str(path)
 
 
+# ---------------------------------------------------------------------------
+# Per-module scan (shared by the serial loop and the --jobs pool)
+# ---------------------------------------------------------------------------
+
+def _scan_module(mod: Module, active: Sequence[Rule], ctx: ProjectContext,
+                 timings: Optional[Dict[str, float]] = None,
+                 ) -> Tuple[List[Finding], List[Finding], Dict[str, Dict]]:
+    """Run every rule's scan phase over one module, returning
+    (findings, suppressed, facts-by-key). The one definition of the
+    scan-phase protocol — the multiprocessing workers and the in-process
+    loop must agree byte for byte or warm/cold/parallel runs diverge."""
+    import time
+    mod_findings: List[Finding] = []
+    mod_suppressed: List[Finding] = []
+    facts: Dict[str, Dict] = {}
+    for rule in active:
+        t0 = time.perf_counter() if timings is not None else 0.0
+        for finding in rule.scan(mod, ctx):
+            if mod.suppressed(finding.rule, finding.line):
+                mod_suppressed.append(finding)
+            else:
+                mod_findings.append(finding)
+        rule_facts = rule.module_facts()
+        if rule_facts is not None:
+            # setdefault: two rules sharing a facts_key (draracer and
+            # drflow both ride the R9 extraction) contribute it once.
+            facts.setdefault(rule.facts_id(), rule_facts)
+        if timings is not None:
+            timings[rule.rule_id] = (timings.get(rule.rule_id, 0.0)
+                                     + time.perf_counter() - t0)
+    return mod_findings, mod_suppressed, facts
+
+
+# Pool workers re-create the registries once per process (initializer),
+# not once per file — ProjectContext.load parses three infra modules.
+_POOL_STATE: Optional[Tuple[Path, "ProjectContext"]] = None
+
+
+def _pool_init(root_str: str) -> None:
+    global _POOL_STATE
+    # Rule registration lives in the package __init__ — inherited under
+    # fork, but a spawn-based start method needs the explicit import.
+    import tpu_dra.analysis  # noqa: F401
+    root = Path(root_str)
+    _POOL_STATE = (root, ProjectContext.load(root))
+
+
+def _pool_scan(item: Tuple[str, str]) -> Tuple[str, Optional[Dict]]:
+    """One file's scan phase in a worker process: returns a cache-entry
+    -shaped payload (findings/suppressed/suppressions/facts) the parent
+    absorbs exactly like a cache hit. None = unparseable (compileall
+    owns syntax errors, same as the serial path)."""
+    rel, source = item
+    assert _POOL_STATE is not None
+    root, ctx = _POOL_STATE
+    mod = parse_module(root / rel, root, source=source)
+    if mod is None:
+        return rel, None
+    mod_findings, mod_suppressed, facts = _scan_module(
+        mod, all_rules(), ctx)
+    return rel, {
+        "findings": [f.to_dict() for f in mod_findings],
+        "suppressed": [f.to_dict() for f in mod_suppressed],
+        "suppressions": _suppressions_doc(mod),
+        "facts": facts,
+    }
+
+
+def resolve_jobs(jobs: object) -> int:
+    """'auto'/0 -> min(8, cpu count), else int(jobs) floored at 1."""
+    import os
+    if jobs in ("auto", 0, "0", None):
+        return max(1, min(8, os.cpu_count() or 1))
+    return max(1, int(jobs))  # type: ignore[arg-type]
+
+
 def run(paths: Sequence[Path], root: Optional[Path] = None,
         rules: Optional[Iterable[Rule]] = None,
         rule_ids: Optional[Set[str]] = None,
-        use_cache: bool = False) -> Report:
+        use_cache: bool = False, jobs: int = 1) -> Report:
     import hashlib
+    import time
 
     paths = [Path(p) for p in paths]
     root = Path(root) if root else find_root(paths[0] if paths else Path("."))
@@ -492,7 +617,7 @@ def run(paths: Sequence[Path], root: Optional[Path] = None,
     cache = _load_cache(cache_path, keys) if use_cache else {"files": {}}
 
     report = Report(ctx=ctx)
-    modules: List[Module] = []
+    pending: List[Tuple[str, str]] = []  # (relpath, source) to scan
     cached: Dict[str, Dict] = {}     # relpath -> valid cache entry
     stats: Dict[str, Dict] = {}      # relpath -> fresh stat for new entry
     refreshed: Dict[str, Dict] = {}  # content-hash hits with new stat keys
@@ -521,22 +646,64 @@ def run(paths: Sequence[Path], root: Optional[Path] = None,
             cached[rel] = entry
             refreshed[rel] = entry
             continue
-        mod = parse_module(f, root, source=data.decode("utf-8"))
-        if mod is not None:
-            modules.append(mod)
-            stats[rel] = {"mtime_ns": st.st_mtime_ns,
-                          "size": st.st_size, "sha1": sha}
-    report.files = len(modules) + len(cached)
+        pending.append((rel, data.decode("utf-8")))
+        stats[rel] = {"mtime_ns": st.st_mtime_ns,
+                      "size": st.st_size, "sha1": sha}
+
+    # Scan phase. Every module is scanned by FRESH per-file rule
+    # instances (exactly what a pool worker does) and reduced to a
+    # cache-entry-shaped payload; `active` instances are populated
+    # purely through absorb_facts below, in sorted relpath order, so
+    # warm, cold, serial and --jobs runs feed finalize identically.
+    rule_classes = [type(r) for r in active]
+    scanned: Dict[str, Dict] = {}         # relpath -> entry payload
+    modules_by_rel: Dict[str, Module] = {}
+    jobs = min(resolve_jobs(jobs), max(1, len(pending)))
+    if set(rule_classes) != set(_RULE_CLASSES):
+        # Pool workers instantiate the REGISTERED rule set; a filtered
+        # or custom rule list must scan serially or the workers would
+        # silently run different rules than the caller asked for.
+        jobs = 1
+    if jobs > 1:
+        import multiprocessing
+        t0 = time.perf_counter()
+        with multiprocessing.Pool(jobs, initializer=_pool_init,
+                                  initargs=(str(root),)) as pool:
+            for rel, payload in pool.imap_unordered(
+                    _pool_scan, pending, chunksize=4):
+                if payload is not None:
+                    scanned[rel] = payload
+        report.timings["<scan-pool>"] = time.perf_counter() - t0
+    else:
+        for rel, source in pending:
+            mod = parse_module(root / rel, root, source=source)
+            if mod is None:
+                continue  # compileall (hack/lint.sh) owns syntax errors
+            mod_findings, mod_suppressed, facts = _scan_module(
+                mod, [cls() for cls in rule_classes], ctx,
+                timings=report.timings)
+            modules_by_rel[rel] = mod
+            scanned[rel] = {
+                "findings": [f.to_dict() for f in mod_findings],
+                "suppressed": [f.to_dict() for f in mod_suppressed],
+                "suppressions": _suppressions_doc(mod),
+                "facts": facts,
+            }
+
+    report.files = len(scanned) + len(cached)
     report.cache_hits = len(cached)
-    ctx.scanned = {m.relpath for m in modules} | set(cached)
+    ctx.scanned = set(scanned) | set(cached)
 
     by_rel: Dict[str, object] = {}
-    for rel in sorted(cached):
-        entry = cached[rel]
-        replayed = _CachedSuppressions(entry.get("suppressions") or {})
+    new_entries: Dict[str, Dict] = dict(refreshed)
+    entries = {**cached, **scanned}
+    for rel in sorted(entries):
+        entry = entries[rel]
+        replayed = modules_by_rel.get(rel) or _CachedSuppressions(
+            entry.get("suppressions") or {})
         by_rel[rel] = replayed
         for rule in active:
-            facts = (entry.get("facts") or {}).get(rule.rule_id)
+            facts = (entry.get("facts") or {}).get(rule.facts_id())
             if facts is not None:
                 rule.absorb_facts(rel, facts, ctx)
         report.findings.extend(Finding(**d) for d in entry["findings"])
@@ -545,38 +712,15 @@ def run(paths: Sequence[Path], root: Optional[Path] = None,
             report.suppressed.append(f)
             if not replayed.suppression_justified(f.rule, f.line):
                 report.unjustified.append(f)
+        if use_cache and rel in stats:
+            new_entries[rel] = {**stats[rel],
+                                "findings": entry["findings"],
+                                "suppressed": entry["suppressed"],
+                                "suppressions": entry["suppressions"],
+                                "facts": entry["facts"]}
 
-    new_entries: Dict[str, Dict] = dict(refreshed)
-    for mod in modules:
-        mod_findings: List[Finding] = []
-        mod_suppressed: List[Finding] = []
-        facts: Dict[str, Dict] = {}
-        for rule in active:
-            for finding in rule.scan(mod, ctx):
-                if mod.suppressed(finding.rule, finding.line):
-                    mod_suppressed.append(finding)
-                else:
-                    mod_findings.append(finding)
-            rule_facts = rule.module_facts()
-            if rule_facts is not None:
-                facts[rule.rule_id] = rule_facts
-        report.findings.extend(mod_findings)
-        report.suppressed.extend(mod_suppressed)
-        for f in mod_suppressed:
-            if not mod.suppression_justified(f.rule, f.line):
-                report.unjustified.append(f)
-        if use_cache and mod.relpath in stats:
-            new_entries[mod.relpath] = {
-                **stats[mod.relpath],
-                "findings": [f.to_dict() for f in mod_findings],
-                "suppressed": [f.to_dict() for f in mod_suppressed],
-                "suppressions": _suppressions_doc(mod),
-                "facts": facts,
-            }
-
-    for m in modules:
-        by_rel[m.relpath] = m
     for rule in active:
+        t0 = time.perf_counter()
         for finding in rule.finalize(ctx):
             mod = by_rel.get(finding.path)
             if mod is not None and mod.suppressed(finding.rule, finding.line):
@@ -586,6 +730,9 @@ def run(paths: Sequence[Path], root: Optional[Path] = None,
                     report.unjustified.append(finding)
             else:
                 report.findings.append(finding)
+        report.timings[rule.rule_id] = (
+            report.timings.get(rule.rule_id, 0.0)
+            + time.perf_counter() - t0)
     if rule_ids:
         report.findings = [f for f in report.findings
                            if f.rule in rule_ids]

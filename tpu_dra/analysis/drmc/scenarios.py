@@ -262,6 +262,112 @@ class RacyIndexScenario:
         pass
 
 
+class _BoundedStore:
+    """A capacity-bounded admission set: the minimal model of every
+    check-then-act surface in the tree (slot claims, quota admission,
+    the allocation index). ``count`` reads under the lock; ``admit``
+    writes under the lock; NOTHING ties the pair together — that is
+    the caller's job, and the stale-read probe exercises both ways of
+    doing it."""
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self.admitted: List[str] = []
+        self._lock = threading.Lock()
+
+    def count(self) -> int:
+        with self._lock:
+            return len(self.admitted)
+
+    def admit(self, key: str) -> None:
+        with self._lock:
+            self.admitted.append(key)
+
+    # drflow: REVALIDATES:admitted
+    def try_admit(self, key: str) -> bool:
+        """The sanctioned act: re-validates the capacity bound against
+        LIVE state under the lock (the try_commit shape drflow R14's
+        REVALIDATES annotation documents)."""
+        with self._lock:
+            if len(self.admitted) >= self.capacity:
+                return False
+            self.admitted.append(key)
+            return True
+
+
+class StaleReadProbeScenario:
+    """Check-then-act on a STALE SNAPSHOT across a lock release: the
+    capacity check reads under the store's lock, the lock releases at
+    return, and the admit runs on the stale decision — two takers can
+    both observe the free slot and overrun the bound. drmc must find
+    the violating schedule; drflow R14 flags the same source shape
+    statically (tests assert both directions, the R13-R15 analog of
+    racy-index's seeded-replay acceptance)."""
+
+    name = "stale-read-probe"
+
+    def build(self, sched) -> Dict:
+        store = _BoundedStore(capacity=1)
+
+        def taker(key: str):
+            def body() -> None:
+                # BUG (on purpose): count() releases the lock before
+                # admit() re-acquires it — nothing revalidates the
+                # bound (static analog: drflow R14).
+                n = store.count()
+                if n < store.capacity:
+                    store.admit(key)  # dralint: ignore[R14] — deliberately racy probe fixture: drmc finds the interleaving; test_flowanalysis asserts the static finding
+            return body
+
+        sched.spawn("take-a", taker("a"))
+        sched.spawn("take-b", taker("b"))
+        return {"store": store}
+
+    def check(self, ctx) -> List[str]:
+        store = ctx["store"]
+        if len(store.admitted) > store.capacity:
+            return [f"capacity {store.capacity} overrun: "
+                    f"{sorted(store.admitted)} all admitted on a stale "
+                    "count"]
+        return []
+
+    def cleanup(self, ctx) -> None:
+        pass
+
+
+class StaleReadFixedScenario:
+    """The SANCTIONED counterpart: the act routes through try_admit,
+    which re-validates the bound under the lock (the REVALIDATES
+    protocol). No schedule may overrun — this one IS a gate scenario,
+    so the protocol the static annotation documents stays dynamically
+    proven."""
+
+    name = "stale-read-fixed"
+
+    def build(self, sched) -> Dict:
+        store = _BoundedStore(capacity=1)
+
+        def taker(key: str):
+            def body() -> None:
+                if store.count() < store.capacity:
+                    store.try_admit(key)
+            return body
+
+        sched.spawn("take-a", taker("a"))
+        sched.spawn("take-b", taker("b"))
+        return {"store": store}
+
+    def check(self, ctx) -> List[str]:
+        store = ctx["store"]
+        if len(store.admitted) > store.capacity:
+            return [f"capacity {store.capacity} overrun through "
+                    "try_admit: the revalidating commit is broken"]
+        return []
+
+    def cleanup(self, ctx) -> None:
+        pass
+
+
 # ---------------------------------------------------------------------------
 # evict-churn: eviction racing the optimistic bind pipeline (SURVEY §18)
 # ---------------------------------------------------------------------------
@@ -844,12 +950,16 @@ INTERLEAVING_SCENARIOS = {
     BatchPrepareScenario.name: BatchPrepareScenario,
     EvictChurnScenario.name: EvictChurnScenario,
     RacyIndexScenario.name: RacyIndexScenario,
+    StaleReadProbeScenario.name: StaleReadProbeScenario,
+    StaleReadFixedScenario.name: StaleReadFixedScenario,
 }
 
-# Scenarios the CI gate runs (racy-index is the negative fixture: it is
-# SUPPOSED to violate, so it lives in tests, not the gate).
+# Scenarios the CI gate runs (racy-index and stale-read-probe are the
+# negative fixtures: they are SUPPOSED to violate, so they live in
+# tests, not the gate; stale-read-fixed keeps the REVALIDATES protocol
+# dynamically proven).
 GATE_SCENARIOS = (SchedChurnScenario.name, BatchPrepareScenario.name,
-                  EvictChurnScenario.name)
+                  EvictChurnScenario.name, StaleReadFixedScenario.name)
 
 CRASH_SCENARIOS = {
     BatchPrepareCrashScenario.name: BatchPrepareCrashScenario,

@@ -73,7 +73,8 @@ from tpu_dra.analysis.core import (
     Finding, Module, ProjectContext, Rule, register,
 )
 from tpu_dra.analysis.rules import (
-    _STATE_MUTATORS, attr_chain, is_data_lock_name,
+    _MUTATORS as _MUTATOR_METHODS, _STATE_MUTATORS, attr_chain,
+    is_data_lock_name, module_imports as _module_imports,
 )
 
 # Guarded-by inference thresholds (SURVEY §16.3): an attribute is
@@ -83,6 +84,14 @@ from tpu_dra.analysis.rules import (
 # deliberately torn-read tolerant, and R10 stays silent.
 MIN_GUARDED = 4
 GUARD_RATIO = 0.75
+
+# Pure builtins whose result directly derives from their arguments —
+# attrs read inside them still count as snapshot reads for R14.
+_PURE_BUILTINS = {
+    "len", "sorted", "list", "tuple", "set", "dict", "frozenset",
+    "min", "max", "sum", "any", "all", "bool", "int", "float", "str",
+    "reversed", "enumerate", "zip", "abs", "round",
+}
 
 # Method names too ubiquitous for the dynamic-dispatch fallback: an
 # unresolved receiver calling one of these must not edge into every
@@ -164,7 +173,16 @@ def describe_expr(node: ast.AST,
                     return {"t": "lock", "line": a.lineno}
             return {"t": "lock", "line": node.lineno, "bare_cond": True}
         desc: Dict = {"t": "call", "func": describe_expr(node.func,
-                                                        lock_names)}
+                                                        lock_names),
+                      "line": node.lineno}
+        # Positional args ride along (capped): drflow's taint walk and
+        # the functools.partial chase both need to see through a call
+        # expression used as a VALUE (``snap = sorted(lister.list())``,
+        # ``partial(self._on_evt, key)``) — call RECORDS carry args
+        # only for calls made as statements/receivers.
+        if node.args:
+            desc["args"] = [describe_expr(a, lock_names)
+                            for a in node.args[:5]]
         arg_locks = _find_lock_creations(node, lock_names)
         if arg_locks:
             desc["arg_locks"] = arg_locks
@@ -277,10 +295,15 @@ class _FuncRecorder(ast.NodeVisitor):
         for t in node.targets:
             self._bind(t, desc)
             self._record_self_assign(t, node, desc)
-            if isinstance(t, ast.Subscript) and desc.get("t") == "call":
+            self._record_flow_bind(t, node, desc)
+            if isinstance(t, ast.Subscript) and desc.get("t") in (
+                    "call", "name", "attr", "sub", "iter"):
                 # d[k] = Cls(...): the container binding gains the
                 # element — ``inf[name] = Informer(...)`` must let
                 # ``inf["pods"].on_add(...)`` resolve its receiver.
+                # Non-call elements ride along too: ``self._cache[k] =
+                # view`` makes the cache a container OF the view for
+                # drflow's taint walk.
                 elem = {"t": "container", "locks": [], "elems": [desc]}
                 if isinstance(t.value, ast.Name):
                     self.rec["locals"].setdefault(
@@ -306,6 +329,103 @@ class _FuncRecorder(ast.NodeVisitor):
                 and target.value.id == "self"):
             self.rec["self_assigns"].append(
                 {"attr": target.attr, "line": stmt.lineno, "value": desc})
+
+    def _record_flow_bind(self, target, stmt, desc: Dict) -> None:
+        """drflow (R13/R14) bindings: call results bound to a name
+        (with their line — `locals` descriptors are lineless), and
+        snapshot binds — a name bound under a held data lock from
+        state rooted at the lock's own receiver, which goes STALE the
+        moment the with-block releases (R14's check-then-act seed)."""
+        if not isinstance(target, ast.Name):
+            return
+        if desc.get("t") == "call":
+            self.rec.setdefault("call_binds", []).append(
+                {"var": target.id, "line": stmt.lineno, "desc": desc})
+        lock = next((h for h in reversed(self.held)
+                     if h.get("attr") and is_data_lock_name(h["attr"])
+                     and h.get("end") is not None), None)
+        if lock is None:
+            return
+        base = lock.get("base")
+        attrs: set = set()
+        rhs_calls: set = set()
+
+        def collect(n: ast.AST) -> None:
+            """Receiver-state attrs the bound value DIRECTLY derives
+            from. A non-builtin call's ARGUMENTS are consumed by the
+            callee — the bind takes the call's RESULT, whose staleness
+            is the locked-getter seed's job — but the call's receiver
+            chain is still a direct read (``self._proc.poll()`` reads
+            ``_proc``); pure builtins (len/sorted/min ...) pass their
+            arguments' reads through (``n = len(self._items)``)."""
+            if isinstance(n, ast.Call):
+                cchain = attr_chain(n.func)
+                if len(cchain) == 2 and cchain[0] == base:
+                    # A receiver-method call INSIDE the guarded
+                    # expression: if it writes state, the bind is a
+                    # test-and-set claim, not a naked check (R14).
+                    rhs_calls.add(cchain[1])
+                if (isinstance(n.func, ast.Name)
+                        and n.func.id in _PURE_BUILTINS):
+                    for a in n.args:
+                        collect(a)
+                else:
+                    collect(n.func)
+                return
+            chain = attr_chain(n) if isinstance(n, ast.Attribute) else None
+            if (chain and len(chain) > 1 and chain[0] == base
+                    and chain[1] != lock["attr"]):
+                attrs.add(chain[1])
+            for child in ast.iter_child_nodes(n):
+                collect(child)
+
+        collect(stmt.value)
+        if not attrs:
+            return  # nothing receiver-state-derived: not a snapshot
+        self.rec.setdefault("snap_binds", []).append(
+            {"var": target.id, "line": stmt.lineno, "base": base,
+             "lock_attr": lock["attr"], "release": lock["end"],
+             "attrs": sorted(attrs), "rhs_calls": sorted(rhs_calls)})
+
+    def visit_Return(self, node):  # noqa: N802
+        # A value returned while holding a data lock is a SNAPSHOT the
+        # moment it crosses the return (the getter pattern): callers
+        # that guard-then-act on it are R14's interprocedural seed.
+        if node.value is not None:
+            lock = next((h for h in reversed(self.held)
+                         if h.get("attr")
+                         and is_data_lock_name(h["attr"])), None)
+            if lock is not None and lock.get("base") == "self":
+                attrs = set()
+                for n in ast.walk(node.value):
+                    chain = (attr_chain(n)
+                             if isinstance(n, ast.Attribute) else None)
+                    if (chain and len(chain) > 1 and chain[0] == "self"
+                            and chain[1] != lock["attr"]):
+                        attrs.add(chain[1])
+                if attrs:
+                    cur = self.rec.setdefault(
+                        "ret_locked", {"lock_attr": lock["attr"],
+                                       "attrs": []})
+                    cur["attrs"] = sorted(set(cur["attrs"]) | attrs)
+        self.generic_visit(node)
+
+    def _record_test(self, node) -> None:
+        names = sorted({n.id for n in ast.walk(node.test)
+                        if isinstance(n, ast.Name)})
+        if not names or not node.body:
+            return
+        end = max((getattr(s, "end_lineno", None) or s.lineno
+                   for s in node.body), default=node.lineno)
+        self.rec.setdefault("tests", []).append(
+            {"line": node.lineno, "names": names,
+             "span": [node.body[0].lineno, end]})
+
+    def visit_If(self, node):  # noqa: N802
+        self._record_test(node)
+        self.generic_visit(node)
+
+    visit_While = visit_If  # noqa: N815
 
     def _visit_comp(self, node):
         # Comprehensions execute inline: generator targets are scope
@@ -361,6 +481,12 @@ class _FuncRecorder(ast.NodeVisitor):
                      "lockish": _lockish_desc(item.context_expr),
                      "via": "with"})
                 entry = _held_entry(desc)
+                # The with's last line = the release boundary: a value
+                # bound inside and used past it crossed the release
+                # (R14). Explicit .acquire() entries carry no end —
+                # flow-insensitively held to function exit, so nothing
+                # lexically "crosses" their release.
+                entry["end"] = getattr(node, "end_lineno", None)
                 self.held.append(entry)
                 pushed.append(entry)
             if item.optional_vars is not None:
@@ -521,19 +647,6 @@ def _parse_guard_comments(source: str) -> Dict[int, str]:
     return out
 
 
-def _module_imports(tree: ast.AST) -> Dict[str, str]:
-    """name -> dotted target for module-level imports."""
-    out: Dict[str, str] = {}
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for alias in node.names:
-                out[alias.asname or alias.name.split(".")[0]] = alias.name
-        elif isinstance(node, ast.ImportFrom) and node.module \
-                and node.level == 0:
-            for alias in node.names:
-                out[alias.asname or alias.name] = \
-                    f"{node.module}.{alias.name}"
-    return out
 
 
 def _scoped_returns(fn) -> List[ast.AST]:
@@ -582,6 +695,101 @@ def _scoped_lambdas(fn) -> List[ast.Lambda]:
         if isinstance(node, ast.Lambda):
             out.append(node)
         stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def _scoped_walk(fn) -> Iterator[ast.AST]:
+    """Every node in `fn`'s own scope, stopping at nested defs and
+    lambdas (their bodies belong to their own records)."""
+    stack: List[ast.AST] = list(fn.body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _collect_mutations(fn) -> List[Dict]:
+    """drflow (R13) mutation sinks in `fn`'s own scope: writes through
+    an attribute/subscript chain, ``del``, and mutator-method calls —
+    each reduced to the chain's ROOT: a local/param name
+    (``{"root": name}``) or a self attribute (``{"root": "self",
+    "attr": a}``). A plain ``self.a = v`` / ``x = v`` REBINDS rather
+    than mutates and is not recorded (stores are tracked separately
+    through self_assigns / locals)."""
+    out: List[Dict] = []
+
+    def add(chain: List[str], line: int, what: str,
+            rebind_ok: bool) -> None:
+        if not chain:
+            return
+        if chain[0] == "self":
+            if len(chain) < 2 or (rebind_ok and len(chain) == 2):
+                return
+            out.append({"root": "self", "attr": chain[1],
+                        "line": line, "what": what})
+        elif not rebind_ok or len(chain) > 1:
+            out.append({"root": chain[0], "line": line, "what": what})
+
+    for node in _scoped_walk(fn):
+        targets: Tuple = ()
+        what = "assignment to"
+        if isinstance(node, ast.Assign):
+            targets = tuple(node.targets)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = (node.target,)
+        elif isinstance(node, ast.Delete):
+            targets, what = tuple(node.targets), "del of"
+        for t in targets:
+            if isinstance(t, ast.Subscript):
+                add(attr_chain(t), t.lineno, what, rebind_ok=False)
+            elif isinstance(t, ast.Attribute):
+                # x.y = v mutates x; self.y = v rebinds the attribute.
+                add(attr_chain(t), t.lineno, what, rebind_ok=True)
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _MUTATOR_METHODS):
+            add(attr_chain(node.func.value), node.lineno,
+                f".{node.func.attr}() on", rebind_ok=False)
+    return out
+
+
+# drflow annotation grammar (SURVEY §20): ``# drflow: view-ok[reason]``
+# sanctions a mutation of a laundered-by-protocol view (R13);
+# ``# drflow: swallow-ok[reason]`` sanctions a silent except handler
+# (R15; the reason is REQUIRED — an empty one still counts as a
+# finding under --require-justified semantics); ``# drflow:
+# REVALIDATES:<field>`` on a def declares the function re-checks
+# <field> against live state under its lock, which sanctions
+# check-then-act flows routed through it (R14). ``<field>`` may be
+# ``*`` (revalidates everything it touches).
+_DRFLOW_RE = re.compile(
+    r"#\s*drflow:\s*(?P<kind>view-ok|swallow-ok|REVALIDATES)"
+    r"(?:\[(?P<reason>[^\]]*)\])?"
+    r"(?::(?P<field>[A-Za-z_*][A-Za-z0-9_.*]*))?")
+
+
+def _parse_drflow_comments(source: str) -> Dict[str, Dict[str, str]]:
+    out: Dict[str, Dict[str, str]] = {
+        "view_ok": {}, "swallow_ok": {}, "revalidates": {}}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _DRFLOW_RE.search(tok.string)
+            if not m:
+                continue
+            line = str(tok.start[0])
+            kind = m.group("kind")
+            if kind == "REVALIDATES":
+                out["revalidates"][line] = m.group("field") or "*"
+            else:
+                out[kind.replace("-", "_")][line] = (
+                    m.group("reason") or "").strip()
+    except (tokenize.TokenError, IndentationError):
+        pass
     return out
 
 
@@ -652,6 +860,10 @@ def extract_module(module: Module) -> Dict:
             "ret_ann": (".".join(attr_chain(node.returns))
                         if getattr(node, "returns", None) is not None
                         and attr_chain(node.returns) else None),
+            "decorators": [".".join(attr_chain(d))
+                           for d in node.decorator_list
+                           if attr_chain(d)],
+            "mutations": _collect_mutations(node),
         }
         v = _FuncRecorder(rec, lock_names)
         v.run(node)
@@ -738,6 +950,7 @@ def extract_module(module: Module) -> Dict:
         "global_locks": global_locks,
         "global_insts": global_insts,
         "guards": {str(k): v for k, v in guards.items()},
+        "drflow": _parse_drflow_comments(module.source),
     }
     module._race_facts = facts  # type: ignore[attr-defined]
     return facts
@@ -746,6 +959,9 @@ def extract_module(module: Module) -> Dict:
 # ---------------------------------------------------------------------------
 # Whole-tree resolver (finalize-time)
 # ---------------------------------------------------------------------------
+
+_MISSING_CHAIN = object()
+
 
 @dataclass
 class _ClassInfo:
@@ -778,7 +994,22 @@ class TreeResolver:
         # live as long as the resolver (facts are held by `modules`).
         self._call_memo: Dict[Tuple[str, int],
                               Tuple[List[str], bool]] = {}
+        # (fid, id(desc)) -> (desc, result) for top-level resolve_type.
+        # The DESC REFERENCE in the value is load-bearing: it pins the
+        # descriptor alive so its id can never be reused by a transient
+        # dict (the id-keyed-memo poisoning bug class).
+        self._type_memo: Dict[Tuple[str, int],
+                              Tuple[Dict, Optional[Dict]]] = {}
+        # (chain tuple, rel) -> class id; the unique-global-name
+        # fallback scans every class otherwise (hot under drflow).
+        self._chain_memo: Dict[Tuple[Tuple[str, ...], Optional[str]],
+                               Optional[str]] = {}
+        # Type results move during _build (return-summary fixpoints,
+        # ctor-arg flow update the tables resolve_type reads): the
+        # memo only switches on once the tables are final.
+        self._memo_enabled = False
         self._build()
+        self._memo_enabled = True
 
     # -- construction -------------------------------------------------------
 
@@ -790,7 +1021,10 @@ class TreeResolver:
         return p.replace("/", ".")
 
     def _build(self) -> None:
-        for rel, facts in self.modules.items():
+        # Sorted: the modules dict's insertion order varies between
+        # warm/cold/parallel runs; resolution (methods_by_name order,
+        # unique-name class fallbacks) must not.
+        for rel, facts in sorted(self.modules.items()):
             self.dotted[self._dotted_name(rel)] = rel
             for cname, cinfo in facts["classes"].items():
                 cid = f"{rel}::{cname}"
@@ -804,7 +1038,7 @@ class TreeResolver:
                 self.funcs[fid] = rec
                 self.func_mod[fid] = rel
         # Attach methods + attr tables.
-        for rel, facts in self.modules.items():
+        for rel, facts in sorted(self.modules.items()):
             guards = {int(k): v for k, v in facts.get("guards", {}).items()}
             for qual, rec in facts["functions"].items():
                 fid = f"{rel}::{qual}"
@@ -1071,6 +1305,13 @@ class TreeResolver:
             lfid = f"{rel}::<lambda@{desc['line']}:{desc['col']}>"
             if lfid in self.funcs:
                 return {lfid}
+        if t == "call":
+            # functools.partial(self._on_evt, key): the partial IS the
+            # callable — its target is the first argument.
+            chain = self._desc_chain(desc.get("func", {})) or []
+            if chain and chain[-1] == "partial" and desc.get("args"):
+                return self._callables_of(desc["args"][0], fid,
+                                          depth + 1)
         return set()
 
     @staticmethod
@@ -1151,6 +1392,17 @@ class TreeResolver:
                              rel: Optional[str] = None) -> Optional[str]:
         """ClassName / mod.ClassName chains to a class id; with no
         module context, fall back to a unique global name match."""
+        key = (tuple(chain), rel)
+        hit = self._chain_memo.get(key, _MISSING_CHAIN)
+        if hit is not _MISSING_CHAIN:
+            return hit
+        out = self._resolve_class_chain_uncached(chain, rel)
+        self._chain_memo[key] = out
+        return out
+
+    def _resolve_class_chain_uncached(self, chain: List[str],
+                                      rel: Optional[str] = None
+                                      ) -> Optional[str]:
         if rel is not None:
             sym = self._module_symbol(rel, chain[0])
             if sym is not None:
@@ -1247,9 +1499,23 @@ class TreeResolver:
     def resolve_type(self, desc: Dict, fid: str,
                      depth: int = 0) -> Optional[Dict]:
         """{'cls': cid} | {'lock': [sites]} for an expression descriptor
-        evaluated in `fid`'s scope, else None (unknown)."""
+        evaluated in `fid`'s scope, else None (unknown). Top-level
+        resolutions are memoized (descriptors are immutable once
+        extracted; the memo holds the desc so id-reuse cannot alias)."""
         if depth > 6 or desc is None:
             return None
+        if depth == 0 and self._memo_enabled:
+            key = (fid, id(desc))
+            hit = self._type_memo.get(key)
+            if hit is not None and hit[0] is desc:
+                return hit[1]
+            out = self._resolve_type_inner(desc, fid, 0)
+            self._type_memo[key] = (desc, out)
+            return out
+        return self._resolve_type_inner(desc, fid, depth)
+
+    def _resolve_type_inner(self, desc: Dict, fid: str,
+                            depth: int) -> Optional[Dict]:
         rel = self.func_mod.get(fid)
         rec = self.funcs.get(fid)
         if rec is None or rel is None:
@@ -1322,6 +1588,13 @@ class TreeResolver:
                         return {"container_of": at["elem"]}
                 m = self.class_method(info, desc["attr"])
                 if m is not None:
+                    decs = self.funcs.get(m, {}).get("decorators") or ()
+                    if any(d.split(".")[-1] in ("property",
+                                                "cached_property")
+                           for d in decs):
+                        # Property access: the VALUE is the getter's
+                        # return, not a bound method.
+                        return self.returns.get(m)
                     return {"func": m, "method": True,
                             "of_cls": info.cid, "mname": desc["attr"]}
                 return None
@@ -1443,6 +1716,24 @@ class TreeResolver:
         return []
 
 
+# Draracer and drflow finalize against the SAME tree facts (shared
+# through one cache key): building the resolver twice per run would
+# double the dominant finalize cost. Keyed by the facts objects'
+# identities — a new run's fresh extraction misses, a second rule's
+# identical absorption hits.
+_RESOLVER_CACHE: Optional[Tuple[frozenset, TreeResolver]] = None
+
+
+def shared_resolver(tree_facts: Dict[str, Dict]) -> TreeResolver:
+    global _RESOLVER_CACHE
+    key = frozenset((rel, id(f)) for rel, f in tree_facts.items())
+    if _RESOLVER_CACHE is not None and _RESOLVER_CACHE[0] == key:
+        return _RESOLVER_CACHE[1]
+    res = TreeResolver(tree_facts)
+    _RESOLVER_CACHE = (key, res)
+    return res
+
+
 # ---------------------------------------------------------------------------
 # R9/R10/R11: one combined rule over the shared extraction
 # ---------------------------------------------------------------------------
@@ -1488,7 +1779,7 @@ class RaceAnalysis(Rule):
     def finalize(self, ctx: ProjectContext) -> Iterator[Finding]:
         if not self.tree_facts:
             return
-        res = self.resolver = TreeResolver(self.tree_facts)
+        res = self.resolver = shared_resolver(self.tree_facts)
         yield from self._r9(res)
         yield from self._r10(res)
         yield from self._r11(res)

@@ -87,6 +87,42 @@ def base_name(node: ast.AST) -> Optional[str]:
     return node.id if isinstance(node, ast.Name) else None
 
 
+def module_imports(tree: ast.AST) -> Dict[str, str]:
+    """name -> dotted target for module-level imports (shared by the
+    draracer extraction and the laundering predicate below)."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                out[alias.asname or alias.name.split(".")[0]] = alias.name
+        elif isinstance(node, ast.ImportFrom) and node.module \
+                and node.level == 0:
+            for alias in node.names:
+                out[alias.asname or alias.name] = \
+                    f"{node.module}.{alias.name}"
+    return out
+
+
+def is_laundering_chain(chain: List[str],
+                        imports: Optional[Dict[str, str]] = None) -> bool:
+    """THE sanctioned view-laundering predicate (SURVEY §10/§20),
+    shared by R3 and drflow R13: ``copy.deepcopy`` and the JSON-shaped
+    fast path ``json_deepcopy`` (k8s.client) turn a zero-copy informer
+    view into a private object. Both spellings are recognized directly
+    and through module import aliases (``from copy import deepcopy as
+    dc``; ``import copy as c; c.deepcopy``) when the module's import
+    map is supplied."""
+    if not chain:
+        return False
+    dotted = ".".join(chain)
+    if imports and chain[0] in imports:
+        dotted = ".".join([imports[chain[0]], *chain[1:]])
+    parts = dotted.split(".")
+    return (parts[-1] == "json_deepcopy"
+            or parts[-2:] == ["copy", "deepcopy"]
+            or parts == ["deepcopy"])
+
+
 # ---------------------------------------------------------------------------
 # R1/R2 shared visitor: lexical lock context
 # ---------------------------------------------------------------------------
@@ -269,9 +305,11 @@ class _TaintWalker:
     ``k8s.client.json_deepcopy`` — launders a view into a private
     object."""
 
-    def __init__(self, module: Module, zero_copy_events: bool):
+    def __init__(self, module: Module, zero_copy_events: bool,
+                 imports: Optional[Dict[str, str]] = None):
         self.module = module
         self.zero_copy_events = zero_copy_events
+        self.imports = imports
         self.findings: List[Finding] = []
 
     # -- expression classification -----------------------------------------
@@ -286,8 +324,7 @@ class _TaintWalker:
             return base in tainted if base else False
         if isinstance(node, ast.Call):
             chain = attr_chain(node.func)
-            if (chain[-2:] == ["copy", "deepcopy"]
-                    or chain[-1:] == ["json_deepcopy"]):
+            if is_laundering_chain(chain, self.imports):
                 return False  # the sanctioned escape hatches
             if chain and chain[-1] in _PROPAGATORS and len(chain) == 1:
                 return any(self._tainted_expr(a, tainted)
@@ -430,10 +467,11 @@ class ZeroCopyViewsReadOnly(Rule):
 
     def scan(self, module: Module, ctx: ProjectContext) -> Iterator[Finding]:
         zero_copy = self._module_has_zero_copy_events(module)
+        imports = module_imports(module.tree)
         findings: List[Finding] = []
         for node in ast.walk(module.tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                walker = _TaintWalker(module, zero_copy)
+                walker = _TaintWalker(module, zero_copy, imports)
                 walker.run(node)
                 findings.extend(walker.findings)
         return iter(findings)

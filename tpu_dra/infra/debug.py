@@ -36,7 +36,10 @@ def start_debug_signal_handlers(path: str = STACK_DUMP_PATH) -> None:
     def _handler(signum, frame):
         try:
             dump_stacks(path)
-        except Exception:
-            pass
+        except Exception as e:  # noqa: BLE001
+            # Signal-handler context: logging machinery may deadlock;
+            # a raw stderr line is async-signal-tolerable and beats a
+            # dump that silently never happened.
+            sys.stderr.write(f"stack dump to {path} failed: {e}\n")
 
     signal.signal(signal.SIGUSR2, _handler)

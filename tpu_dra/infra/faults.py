@@ -210,6 +210,22 @@ SITES: Dict[str, str] = {
         "never see the failure, and quiesce invariants must still hold",
 }
 
+# Declared degradations (drflow R15, SURVEY §20): sites whose injected
+# failure has ONE sanctioned degrade path. A broad except handler
+# whose try body guards one of these sites must route to the named
+# helper (call-chain tail contains the name) or re-raise — an injected
+# fault that only gets logged leaves the degrade path chaos thinks is
+# covered untested. Sites absent here only owe the generic non-swallow
+# discipline.
+DEGRADATIONS: Dict[str, str] = {
+    # A failed shard apply MUST dirty the shard so the guarded
+    # full-resync fallback converges it (scheduler._checked_shard).
+    # (cd.member_loss deliberately has NO entry: the controller
+    # degrades the domain but the daemon's sanctioned reaction is a
+    # re-offered retry — two valid paths, no single declared one.)
+    "sched.shard_apply": "mark_dirty",
+}
+
 
 # Observer called (outside the registry lock) with the site name every
 # time an armed site fires — the flight recorder (infra/trace.py)

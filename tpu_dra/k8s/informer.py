@@ -10,16 +10,199 @@ cache the DaemonSet manager uses to see its own writes
 from __future__ import annotations
 
 import copy
+import logging
+import os
 import random
+import sys
 import threading
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
+from tpu_dra.infra.metrics import DefaultRegistry as _METRICS
 from tpu_dra.k8s.client import ApiClient, GVR
+
+log = logging.getLogger("tpu_dra.informer")
+
+# Stream failures are invisible by design (the loop relists), which is
+# exactly why they must be counted: a flapping apiserver shows up here
+# long before anything user-visible degrades.
+_RELISTS = _METRICS.counter(
+    "tpu_dra_informer_relists_total",
+    "informer list/watch stream failures that forced a relist")
 
 
 # Sentinel returned by Informer._set for writes that lost an RV race
 # (see _set); watch loops skip dispatch for them.
 STALE = object()
+
+
+# ---------------------------------------------------------------------------
+# View shadow: the runtime half of drflow R13 (SURVEY §20)
+# ---------------------------------------------------------------------------
+# The static escape analysis promises that zero-copy views reach only
+# read-only sinks. The shadow CHECKS that promise in chaos runs:
+# every view handed out (lister reads, index lookups, zero-copy event
+# dispatch) is content-hashed at hand-out, keyed by the CALLER's
+# source site; quiesce re-hashes the very same objects. Legitimate
+# cache updates REPLACE objects wholesale (watch events build new
+# dicts), so a changed hash means someone mutated the handed-out view
+# in place — a drift. Drifts are chaos violations AND feed the
+# observed⊆static gate (analysis --check-view-shadow): every drift
+# site must be a statically R13-implicated view seed, or the static
+# model under-approximates and lint fails.
+
+class ViewShadow:
+    """Bounded sampler: (object identity, hand-out site) pairs are
+    recorded once with their content hash; ``verify()`` re-hashes.
+    No-op unless enabled (chaos harnesses / TPU_DRA_VIEW_SHADOW=1)."""
+
+    MAX_SAMPLES = 4096
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.enabled = os.environ.get("TPU_DRA_VIEW_SHADOW") == "1"
+        self._samples: Dict[Tuple[str, int], Tuple[Dict, str, str]] = {}
+        self._overflow = 0
+        self._drifts: List[Dict] = []
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def enable(self) -> bool:
+        """Returns the previous enabled state (harness save/restore)."""
+        prev, self.enabled = self.enabled, True
+        return prev
+
+    def restore(self, prev: bool) -> None:
+        self.enabled = prev
+
+    def reset(self) -> None:
+        with self._lock:
+            self._samples.clear()
+            self._drifts.clear()
+            self._overflow = 0
+
+    # -- recording ----------------------------------------------------------
+
+    @staticmethod
+    def _digest(obj) -> str:
+        import hashlib
+        import json as _json
+        try:
+            blob = _json.dumps(obj, sort_keys=True, default=repr)
+        except (TypeError, ValueError):
+            blob = repr(obj)
+        return hashlib.sha1(blob.encode()).hexdigest()
+
+    @staticmethod
+    def _caller_site() -> str:
+        """relpath:line of the first frame outside this module — the
+        hand-out site, keyed the way the static analyzer keys view
+        reads."""
+        f = sys._getframe(2)
+        own = __file__
+        while f is not None and f.f_code.co_filename == own:
+            f = f.f_back
+        if f is None:
+            return "?:0"
+        path = f.f_code.co_filename.replace(os.sep, "/")
+        for marker in ("tpu_dra/", "tests/", "hack/"):
+            idx = path.rfind("/" + marker)
+            if idx >= 0:
+                return f"{path[idx + 1:]}:{f.f_lineno}"
+        return f"{path.rsplit('/', 1)[-1]}:{f.f_lineno}"
+
+    def record(self, obj) -> None:
+        if not self.enabled or not isinstance(obj, dict):
+            return
+        site = self._caller_site()
+        key = (site, id(obj))
+        with self._lock:
+            if key in self._samples:
+                return  # keep the EARLIEST hash: maximal drift window
+            if len(self._samples) >= self.MAX_SAMPLES:
+                self._overflow += 1
+                return
+            try:
+                name = meta_namespace_key(obj)
+            except KeyError:
+                name = "?"
+            self._samples[key] = (obj, self._digest(obj), name)
+
+    # -- verification -------------------------------------------------------
+
+    def verify(self) -> List[Dict]:
+        """Re-hash every sampled object; new drifts are recorded AND
+        returned. Idempotent per drift: a site+object pair reports
+        once."""
+        with self._lock:
+            fresh: List[Dict] = []
+            for (site, _oid), (obj, h, name) in list(self._samples.items()):
+                if self._digest(obj) != h:
+                    fresh.append({"site": site, "key": name})
+                    del self._samples[(site, _oid)]
+            self._drifts.extend(fresh)
+            return fresh
+
+    def snapshot(self) -> int:
+        with self._lock:
+            return len(self._drifts)
+
+    def violations_since(self, snap: int) -> List[str]:
+        self.verify()
+        with self._lock:
+            return [
+                f"zero-copy view drift: object {d['key']!r} handed out "
+                f"at {d['site']} was mutated in place (SURVEY §10 "
+                "ownership rule; static analog: drflow R13)"
+                for d in self._drifts[snap:]]
+
+    # -- export (the lint.sh observed⊆static seam) --------------------------
+
+    EXPORT_ENV = "TPU_DRA_VIEW_SHADOW_EXPORT"
+
+    def export(self, path: Optional[str] = None) -> Optional[str]:
+        """Merge observed drifts into the JSON file at `path` (default
+        $TPU_DRA_VIEW_SHADOW_EXPORT; None = no-op). Merging mirrors the
+        lock witness: several harness runs accumulate one file. An
+        EMPTY export is still written — the gate reading the file
+        distinguishes 'ran drift-free' from 'never ran'."""
+        import json as _json
+        path = path or os.environ.get(self.EXPORT_ENV)
+        if not path:
+            return None
+        self.verify()
+        with self._lock:
+            drifts = {(d["site"], d["key"]) for d in self._drifts}
+        try:
+            with open(path, encoding="utf-8") as fh:
+                for d in _json.load(fh).get("drifts", ()):
+                    drifts.add((d.get("site", "?"), d.get("key", "?")))
+        except (OSError, ValueError):
+            pass
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as fh:
+                _json.dump({"drifts": [{"site": s, "key": k}
+                                       for s, k in sorted(drifts)]}, fh)
+            os.replace(tmp, path)
+        except OSError:
+            return None  # best-effort, like the witness export
+        return path
+
+
+def load_drifts(path: str) -> List[Dict]:
+    """Read a view-shadow export for --check-view-shadow. Raises on a
+    missing/garbled file: an absent export turning the gate green would
+    be the silent under-approximation the gate exists to catch."""
+    import json as _json
+    with open(path, encoding="utf-8") as fh:
+        doc = _json.load(fh)
+    if not isinstance(doc, dict) or not isinstance(doc.get("drifts"),
+                                                   list):
+        raise ValueError(f"{path}: not a view-shadow export")
+    return list(doc["drifts"])
+
+
+SHADOW = ViewShadow()
 
 
 def meta_namespace_key(obj: Dict) -> str:
@@ -63,13 +246,20 @@ class Lister:
             obj = self._store.get(key)
             if obj is None:
                 return None
-            return copy.deepcopy(obj) if self._deep_copy else obj
+            if self._deep_copy:
+                return copy.deepcopy(obj)
+        SHADOW.record(obj)  # zero-copy hand-out: shadow the view
+        return obj
 
     def list(self) -> List[Dict]:
         with self._lock:
             if self._deep_copy:
                 return [copy.deepcopy(o) for o in self._store.values()]
-            return list(self._store.values())
+            objs = list(self._store.values())
+        if SHADOW.enabled:
+            for o in objs:
+                SHADOW.record(o)
+        return objs
 
 
 class Informer:
@@ -148,7 +338,11 @@ class Informer:
             objs = self._indices.get(index, {}).get(value, {}).values()
             if self._copy_on_read:
                 return [copy.deepcopy(o) for o in objs]
-            return list(objs)
+            out = list(objs)
+        if SHADOW.enabled:
+            for o in out:
+                SHADOW.record(o)
+        return out
 
     def update_cache(self, obj: Dict) -> None:
         """Mutation cache: record our own write so the next read sees it
@@ -207,6 +401,9 @@ class Informer:
                     idx.setdefault(val, {})[key] = new
 
     def _dispatch(self, handlers, *args) -> None:
+        if not self._copy_events and SHADOW.enabled:
+            for a in args:
+                SHADOW.record(a)
         for h in handlers:
             try:
                 # copy_events=False: handlers share the cached object and
@@ -228,7 +425,7 @@ class Informer:
             self._listed_ok = False
             try:
                 self._list_and_watch()
-            except Exception:  # noqa: BLE001 — relist on any stream failure
+            except Exception as e:  # noqa: BLE001 — relist on any stream failure
                 if self._stop.is_set():
                     return
                 # A successful LIST (even if the watch later died, e.g.
@@ -239,6 +436,10 @@ class Informer:
                     backoff = self.RELIST_BACKOFF_BASE
                 else:
                     backoff = min(backoff * 2, self.RELIST_BACKOFF_MAX)
+                _RELISTS.inc()
+                log.debug("informer %s list/watch failed (%s: %s); "
+                          "relisting in <=%.1fs", self._gvr.plural,
+                          type(e).__name__, e, backoff)
                 self._stop.wait(backoff * (0.75 + 0.5 * random.random()))
 
     def _list_and_watch(self) -> None:

@@ -490,7 +490,7 @@ def probe_jax_tpu_devices() -> Optional[Tuple[int, str]]:
             return None
         devs = jax_mod.devices()
         return len(devs), getattr(devs[0], "device_kind", "")
-    except Exception:  # noqa: BLE001 — probe is advisory only
+    except Exception:  # noqa: BLE001 # drflow: swallow-ok[advisory probe: no importable TPU backend is the normal outcome on CPU hosts]
         return None
 
 

@@ -134,7 +134,12 @@ class WebhookCaller:
         try:
             service = self._cluster.get(SERVICES, svc.get("name", ""),
                                         svc.get("namespace"))
-        except Exception:  # noqa: BLE001
+        except Exception as e:  # noqa: BLE001
+            # An unreachable webhook silently skipped is a policy hole:
+            # the failurePolicy decides the outcome, but the lookup
+            # failure itself must be visible.
+            log.warning("webhook service %s/%s lookup failed: %s",
+                        svc.get("namespace"), svc.get("name"), e)
             return None
         return (service["metadata"].get("annotations") or {}).get(
             ENDPOINT_ANNOTATION)

@@ -51,6 +51,7 @@ from tpu_dra.infra.faults import (
 from tpu_dra.k8s import (
     FakeCluster, PODS, RESOURCECLAIMS, RESOURCESLICES, RetryingApiClient,
 )
+from tpu_dra.k8s import informer as informer_mod
 from tpu_dra.k8s.informer import Informer
 from tpu_dra.kubeletplugin.server import Claim, PrepareResult
 from tpu_dra.native.tpuinfo import FakeBackend, HealthEvent, default_fake_chips
@@ -237,7 +238,7 @@ class ChaosHarness:
             try:
                 self._build_stack()
                 return
-            except Exception:  # noqa: BLE001 — crash loop, retry
+            except Exception:  # noqa: BLE001 # drflow: swallow-ok[crash-looping restart under armed faults is the modeled outcome; report.crashes counts it]
                 time.sleep(0.002)
         self._harvest_faults()
         FAULTS.reset()
@@ -691,6 +692,11 @@ class SchedulerChaosHarness:
         self._witness_snap = lockwitness.WITNESS.snapshot()
         # Per-walk open-span window (invariant 9 / SURVEY §19).
         self._trace_snap = trace.TRACER.open_ids()
+        # View shadow (SURVEY §20): every zero-copy view the scheduler
+        # reads this walk is content-hashed at hand-out; quiesce
+        # asserts none drifted (the runtime half of drflow R13).
+        self._shadow_prev = informer_mod.SHADOW.enable()
+        self._shadow_snap = informer_mod.SHADOW.snapshot()
         self.seed = seed
         self.rng = random.Random(seed ^ 0x5C4ED)
         self.report = ChaosReport(seed=seed)
@@ -716,7 +722,9 @@ class SchedulerChaosHarness:
             self._pod_seq = 0
         except BaseException:
             # Anything after install() failing must release the witness
-            # refcount, or threading.Lock stays patched process-wide.
+            # refcount, or threading.Lock stays patched process-wide
+            # (and the view shadow must not stay enabled either).
+            informer_mod.SHADOW.restore(self._shadow_prev)
             self._witnessed = False
             lockwitness.uninstall()
             raise
@@ -880,11 +888,18 @@ class SchedulerChaosHarness:
                 continue
             v.extend(trace.verify_trace(parsed[0]))
         v.extend(trace.open_span_violations(self._trace_snap))
+        # View-shadow sweep (SURVEY §20): any zero-copy view mutated in
+        # place since hand-out is a violation — the runtime complement
+        # of drflow R13, and the drift set the lint.sh observed⊆static
+        # gate cross-validates.
+        v.extend(informer_mod.SHADOW.violations_since(self._shadow_snap))
 
     def close(self) -> None:
         try:
             self.sched.stop()
         finally:
+            informer_mod.SHADOW.export()
+            informer_mod.SHADOW.restore(self._shadow_prev)
             if self._witnessed:
                 self._witnessed = False
                 lockwitness.uninstall()

@@ -535,7 +535,7 @@ class NodeSim:
                 return subprocess.run(
                     cmd, env=proc._env,  # type: ignore[attr-defined]
                     capture_output=True, timeout=10).returncode == 0
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 # drflow: swallow-ok[probe failure IS the signal: returns not-ready]
                 return False
         if "httpGet" in probe:
             hg = probe["httpGet"]
@@ -549,7 +549,7 @@ class NodeSim:
                     if hg.get("scheme") == "HTTPS" else None
                 urllib.request.urlopen(url, timeout=5, context=ctx)
                 return True
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 # drflow: swallow-ok[probe failure IS the signal: returns not-ready]
                 return False
         return True
 

@@ -169,7 +169,7 @@ class CoordinatorNodeSim:
             try:
                 deps = self._cluster.list(DEPLOYMENTS, self._namespace,
                                           label_selector=sel)
-            except Exception:  # noqa: BLE001 — cluster shutting down
+            except Exception:  # noqa: BLE001 # drflow: swallow-ok[fake cluster shutting down mid-tick; the loop exits on the next stop wait]
                 continue
             seen = set()
             for dep in deps:
@@ -244,7 +244,7 @@ class CoordinatorNodeSim:
         dep.setdefault("status", {})["readyReplicas"] = want
         try:
             self._cluster.update_status(DEPLOYMENTS, dep, self._namespace)
-        except Exception:  # noqa: BLE001 — conflict: next tick retries
+        except Exception:  # noqa: BLE001 # drflow: swallow-ok[optimistic status write lost an RV race; the next kubelet tick retries]
             pass
 
 
@@ -682,13 +682,13 @@ class MeshSliceHarness:
         for worker, uid in self._prepared:
             try:
                 self.states[worker].unprepare_batch([uid])
-            except Exception:  # noqa: BLE001 — teardown is best-effort
+            except Exception:  # noqa: BLE001 # drflow: swallow-ok[test-harness teardown is best-effort; rmtree below removes the residue]
                 pass
         self._prepared.clear()
         for state in self.states:
             try:
                 state.close()
-            except Exception:  # noqa: BLE001 — teardown is best-effort
+            except Exception:  # noqa: BLE001 # drflow: swallow-ok[test-harness teardown is best-effort; rmtree below removes the residue]
                 pass
         self._rmtree(self.tmp, ignore_errors=True)
 

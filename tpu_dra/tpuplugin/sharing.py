@@ -18,6 +18,7 @@ Reference: cmd/gpu-kubelet-plugin/sharing.go:60-451 —
 
 from __future__ import annotations
 
+import logging
 import os
 import shutil
 import subprocess
@@ -28,6 +29,9 @@ from tpu_dra.api import types as apitypes
 from tpu_dra.k8s import ApiClient, DEPLOYMENTS, new_object_meta
 from tpu_dra.k8s.client import AlreadyExistsError, ConflictError, NotFoundError
 from tpu_dra.native.tpuinfo import Chip, TpuInfoBackend
+
+log = logging.getLogger("tpu_dra.sharing")
+
 
 class TimeSlicingManager:
     """Programs per-chip time-slice quanta (SetTimeSlice analog)."""
@@ -268,5 +272,9 @@ class MultiprocessManager:
         for chip in chips:
             try:
                 self._backend.set_exclusive_mode(chip.index, False)
-            except Exception:  # noqa: BLE001 — chip may be gone
-                pass
+            except Exception as e:  # noqa: BLE001 — chip may be gone
+                # Visible, not fatal: a vanished chip cannot have its
+                # mode cleared, but a HEALTHY chip left exclusive would
+                # silently refuse the next shared claim.
+                log.warning("clearing exclusive mode on chip %d "
+                            "failed: %s", chip.index, e)

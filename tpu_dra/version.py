@@ -17,6 +17,6 @@ def git_commit() -> str:
         )
         if out.returncode == 0:
             return out.stdout.strip()
-    except Exception:
+    except Exception:  # drflow: swallow-ok[no git checkout available: 'unknown' is the documented fallback]
         pass
     return "unknown"
