@@ -1,0 +1,283 @@
+"""Async RPC front-end (SURVEY §21): framed-RPC protocol semantics,
+loop/executor boundary behavior, concurrent-load correctness over both
+transports, the loop-lag/in-flight instruments, and the
+prepare.rpc_admit fault site's no-leak contract."""
+
+import threading
+import uuid
+
+import pytest
+
+from tpu_dra.api.types import TPU_DRIVER_NAME
+from tpu_dra.cdi.handler import CDIHandler
+from tpu_dra.infra.faults import FAULTS, Always, OneShot
+from tpu_dra.k8s import FakeCluster, RESOURCECLAIMS
+from tpu_dra.kubeletplugin import aio_server
+from tpu_dra.kubeletplugin.aio_server import (
+    FRAME_HEADER, MAX_FRAME_BYTES, METHOD_ERROR, METHOD_PREPARE,
+)
+from tpu_dra.kubeletplugin.gen import dra_v1_pb2 as dra
+from tpu_dra.kubeletplugin.server import (
+    FramedClient, FramedRpcError, framed_stubs, kubelet_stubs, self_probe,
+)
+from tpu_dra.native.tpuinfo import FakeBackend, default_fake_chips
+from tpu_dra.tpuplugin.checkpoint import CheckpointManager
+from tpu_dra.tpuplugin.device_state import DeviceState
+from tpu_dra.tpuplugin.driver import TpuDriver
+
+
+@pytest.fixture
+def driver(tmp_path):
+    cluster = FakeCluster()
+    backend = FakeBackend(default_fake_chips(8, "v5p", slice_id="aio"))
+    state = DeviceState(
+        backend=backend,
+        cdi=CDIHandler(str(tmp_path / "cdi"),
+                       driver_root=str(tmp_path / "drv")),
+        checkpoints=CheckpointManager(str(tmp_path / "plugin")),
+        driver_name=TPU_DRIVER_NAME, node_name="node-a")
+    drv = TpuDriver(state=state, client=cluster,
+                    driver_name=TPU_DRIVER_NAME, node_name="node-a",
+                    plugin_dir=str(tmp_path / "plugin"),
+                    registry_dir=str(tmp_path / "registry"))
+    drv.start()
+    drv.cluster = cluster
+    yield drv
+    drv.shutdown()
+
+
+def make_claim(cluster, devices, name=None):
+    name = name or f"c-{uuid.uuid4().hex[:8]}"
+    return cluster.create(RESOURCECLAIMS, {
+        "apiVersion": "resource.k8s.io/v1", "kind": "ResourceClaim",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {"devices": {"requests": [{"name": "tpu"}]}},
+        "status": {"allocation": {"devices": {
+            "results": [{"request": "tpu", "driver": TPU_DRIVER_NAME,
+                         "pool": "node-a", "device": d} for d in devices],
+            "config": []}}},
+    })
+
+
+def prepare_req(obj):
+    req = dra.NodePrepareResourcesRequest()
+    c = req.claims.add()
+    c.uid = obj["metadata"]["uid"]
+    c.name = obj["metadata"]["name"]
+    c.namespace = obj["metadata"]["namespace"]
+    return req
+
+
+def unprepare_req(obj):
+    req = dra.NodeUnprepareResourcesRequest()
+    c = req.claims.add()
+    c.uid = obj["metadata"]["uid"]
+    c.name = obj["metadata"]["name"]
+    c.namespace = obj["metadata"]["namespace"]
+    return req
+
+
+class TestFramedProtocol:
+    def test_prepare_unprepare_roundtrip(self, driver):
+        client, prepare, unprepare = framed_stubs(driver.server.fast_socket)
+        try:
+            obj = make_claim(driver.cluster, ["chip-0"])
+            uid = obj["metadata"]["uid"]
+            resp = prepare(prepare_req(obj))
+            assert resp.claims[uid].error == ""
+            assert resp.claims[uid].devices[0].device_name == "chip-0"
+            uresp = unprepare(unprepare_req(obj))
+            assert uresp.claims[uid].error == ""
+        finally:
+            client.close()
+
+    def test_ping(self, driver):
+        client = FramedClient(driver.server.fast_socket)
+        try:
+            assert client.ping()
+        finally:
+            client.close()
+
+    def test_unknown_method_errors_without_killing_connection(self, driver):
+        client = FramedClient(driver.server.fast_socket)
+        try:
+            with pytest.raises(FramedRpcError, match="unknown framed-RPC"):
+                client._call(42, b"")
+            # The connection survives a bad request: the error frames
+            # THAT response, not the stream.
+            assert client.ping()
+        finally:
+            client.close()
+
+    def test_garbage_body_errors_without_killing_connection(self, driver):
+        client = FramedClient(driver.server.fast_socket)
+        try:
+            with pytest.raises(FramedRpcError):
+                client._call(METHOD_PREPARE, b"\xff\xfe not a proto")
+            assert client.ping()
+        finally:
+            client.close()
+
+    def test_oversized_frame_refused(self, driver):
+        client = FramedClient(driver.server.fast_socket)
+        try:
+            # Header claims a body past MAX_FRAME_BYTES: the server must
+            # refuse from the header alone (never buffer toward it).
+            client._sock.sendall(
+                FRAME_HEADER.pack(MAX_FRAME_BYTES + 1, METHOD_PREPARE))
+            hdr = client._read_exact(FRAME_HEADER.size)
+            length, method = FRAME_HEADER.unpack(hdr)
+            assert method == METHOD_ERROR
+            assert b"exceeds" in client._read_exact(length)
+        finally:
+            client.close()
+
+    def test_concurrent_connections_disjoint_claims(self, driver):
+        """N client threads on N connections prepare/unprepare disjoint
+        chips concurrently — every RPC succeeds and every claim ends
+        unprepared (the pipeline overlap path under the new front-end)."""
+        errors = []
+
+        def worker(chip):
+            client, prepare, unprepare = framed_stubs(
+                driver.server.fast_socket)
+            try:
+                for _ in range(8):
+                    obj = make_claim(driver.cluster, [f"chip-{chip}"])
+                    uid = obj["metadata"]["uid"]
+                    resp = prepare(prepare_req(obj))
+                    if resp.claims[uid].error:
+                        errors.append(resp.claims[uid].error)
+                        return
+                    uresp = unprepare(unprepare_req(obj))
+                    if uresp.claims[uid].error:
+                        errors.append(uresp.claims[uid].error)
+                        return
+            except Exception as e:  # noqa: BLE001 — collected for assert
+                errors.append(repr(e))
+            finally:
+                client.close()
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        assert errors == []
+        assert driver._state.prepared_claim_uids() == []
+
+    def test_both_transports_share_one_driver(self, driver):
+        """A claim prepared over gRPC unprepares over the framed path:
+        both front-ends feed the same DeviceState through the same
+        pipeline (the seam contract)."""
+        channel, gprepare, _ = kubelet_stubs(driver.server.dra_socket)
+        client, _, funprepare = framed_stubs(driver.server.fast_socket)
+        try:
+            obj = make_claim(driver.cluster, ["chip-3"])
+            uid = obj["metadata"]["uid"]
+            assert gprepare(prepare_req(obj)).claims[uid].error == ""
+            assert uid in driver._state.prepared_claim_uids()
+            assert funprepare(unprepare_req(obj)).claims[uid].error == ""
+            assert uid not in driver._state.prepared_claim_uids()
+        finally:
+            channel.close()
+            client.close()
+
+
+class TestFrontEndInstruments:
+    def test_loop_lag_histogram_observes(self, driver):
+        """The lag monitor ticks on the live loop: the histogram count
+        grows while the server is up."""
+        import time
+
+        n0 = aio_server.RPC_LOOP_LAG.count
+        deadline = time.monotonic() + 5.0
+        while aio_server.RPC_LOOP_LAG.count <= n0:
+            assert time.monotonic() < deadline, \
+                "loop-lag monitor never observed a tick"
+            time.sleep(0.05)
+
+    def test_sustained_inflight_settles_to_zero(self, driver):
+        client, prepare, unprepare = framed_stubs(driver.server.fast_socket)
+        try:
+            obj = make_claim(driver.cluster, ["chip-1"])
+            prepare(prepare_req(obj))
+            unprepare(unprepare_req(obj))
+        finally:
+            client.close()
+        assert aio_server.SUSTAINED_INFLIGHT.value() == 0.0
+
+    def test_self_probe_covers_fast_socket(self, driver):
+        assert self_probe(driver.server)
+
+    def test_registration_isolated_from_wedged_rpc_pool(self, driver):
+        """Every RPC worker wedged in a stalled prepare must NOT starve
+        kubelet's GetInfo — registration rides its own pool (a
+        data-path stall must not read as a dead plugin and deregister
+        the driver)."""
+        import grpc
+
+        from tpu_dra.kubeletplugin.gen import pluginregistration_pb2 as reg
+
+        assert driver.first_published.wait(10)
+        release = threading.Event()
+        for _ in range(driver.server.RPC_POOL_WORKERS):
+            driver.server._pool.submit(release.wait)
+        try:
+            channel = grpc.insecure_channel(
+                f"unix://{driver.server.registration_socket}")
+            try:
+                get_info = channel.unary_unary(
+                    "/pluginregistration.Registration/GetInfo",
+                    request_serializer=reg.InfoRequest.SerializeToString,
+                    response_deserializer=reg.PluginInfo.FromString)
+                info = get_info(reg.InfoRequest(), timeout=5)
+                assert info.name == TPU_DRIVER_NAME
+            finally:
+                channel.close()
+        finally:
+            release.set()
+
+
+class TestAdmissionFaultSite:
+    def test_admit_fault_fails_rpc_without_leaking_gates(self, driver):
+        """prepare.rpc_admit armed: the RPC fails with a per-claim error
+        BEFORE any window slot or ordering gate registers — the same
+        claim's next RPC proceeds untouched (no wedged successor)."""
+        client, prepare, unprepare = framed_stubs(driver.server.fast_socket)
+        try:
+            obj = make_claim(driver.cluster, ["chip-2"])
+            uid = obj["metadata"]["uid"]
+            FAULTS.arm("prepare.rpc_admit", OneShot())
+            try:
+                resp = prepare(prepare_req(obj))
+                assert "prepare.rpc_admit" in resp.claims[uid].error
+            finally:
+                FAULTS.reset()
+            # No leaked gate/slot: the retry succeeds immediately.
+            resp = prepare(prepare_req(obj))
+            assert resp.claims[uid].error == ""
+            assert unprepare(unprepare_req(obj)).claims[uid].error == ""
+            assert driver._pipeline._last_gate == {}
+            assert driver._pipeline._inflight == 0
+        finally:
+            client.close()
+
+    def test_admit_fault_fails_unprepare_retryably(self, driver):
+        client, prepare, unprepare = framed_stubs(driver.server.fast_socket)
+        try:
+            obj = make_claim(driver.cluster, ["chip-4"])
+            uid = obj["metadata"]["uid"]
+            assert prepare(prepare_req(obj)).claims[uid].error == ""
+            FAULTS.arm("prepare.rpc_admit", Always())
+            try:
+                uresp = unprepare(unprepare_req(obj))
+                assert "prepare.rpc_admit" in uresp.claims[uid].error
+                # Still prepared: the refusal rolled nothing forward.
+                assert uid in driver._state.prepared_claim_uids()
+            finally:
+                FAULTS.reset()
+            assert unprepare(unprepare_req(obj)).claims[uid].error == ""
+        finally:
+            client.close()

@@ -154,6 +154,90 @@ class TestR2BlockingUnderLock:
         assert lint(src, "R2") == []
 
 
+class TestR2BlockingInCoroutine:
+    """R2's coroutine family member (SURVEY §21): blocking calls
+    lexically inside an ``async def`` stall the event loop and must be
+    offloaded to an executor."""
+
+    @pytest.mark.parametrize("call", [
+        "fcntl.flock(fd, fcntl.LOCK_EX)",
+        "os.fdatasync(fd)",
+        "os.fsync(fd)",
+        "fut.result()",
+        "fut.result(timeout=5)",
+        "self._lock.acquire()",
+        "time.sleep(0.1)",
+        "subprocess.run(argv)",
+        "self._client.get(PODS, name)",
+        "self._cond.wait(0.5)",
+    ])
+    def test_fires_in_async_def(self, call):
+        out = lint(f"""
+            class S:
+                async def handle(self, reader):
+                    {call}
+        """, "R2")
+        assert rule_ids(out) == ["R2"], (call, out)
+        assert "coroutine" in out[0].message
+
+    @pytest.mark.parametrize("src", [
+        # The sanctioned shape: blocking work behind run_in_executor.
+        """
+        class S:
+            async def handle(self, body):
+                loop = asyncio.get_running_loop()
+                return await loop.run_in_executor(self._pool,
+                                                  self._dispatch, body)
+        """,
+        # Awaiting asyncio primitives is the loop working as designed.
+        """
+        class S:
+            async def handle(self, reader, writer):
+                body = await reader.readexactly(4)
+                writer.write(body)
+                await writer.drain()
+        """,
+        # A nested sync def's body runs elsewhere (executor/callback),
+        # like the lock-context reset: not the coroutine's own frame.
+        """
+        class S:
+            async def handle(self):
+                def work():
+                    os.fdatasync(self._fd)
+                await self._offload(work)
+        """,
+        # The same blocking call OUTSIDE any coroutine stays R2-clean
+        # (the under-lock branch is separate).
+        """
+        class S:
+            def sync_path(self, fd):
+                os.fdatasync(fd)
+        """,
+        # executor.submit() schedules; it does not block the loop.
+        """
+        class S:
+            async def handle(self):
+                self._pool.submit(self._work)
+        """,
+    ])
+    def test_negative(self, src):
+        assert lint(src, "R2") == []
+
+    def test_lock_and_coroutine_both_fire(self):
+        """A blocking call under a lock inside a coroutine is two
+        distinct violations — both contexts name their victim."""
+        out = lint("""
+            class S:
+                async def bad(self):
+                    with self._lock:
+                        time.sleep(1)
+        """, "R2")
+        assert rule_ids(out) == ["R2", "R2"]
+        msgs = sorted(f.message for f in out)
+        assert "coroutine" in msgs[0] or "coroutine" in msgs[1]
+        assert any("holding" in m for m in msgs)
+
+
 # ---------------------------------------------------------------------------
 # R3: zero-copy informer reads are read-only
 # ---------------------------------------------------------------------------

@@ -20,7 +20,7 @@ from tpu_dra.cdi.handler import CDIHandler
 from tpu_dra.infra import featuregates
 from tpu_dra.k8s import FakeCluster, RESOURCECLAIMS, RESOURCESLICES, DEPLOYMENTS
 from tpu_dra.kubeletplugin.gen import dra_v1_pb2 as dra
-from tpu_dra.kubeletplugin.server import kubelet_stubs
+from tpu_dra.kubeletplugin.server import framed_stubs, kubelet_stubs
 from tpu_dra.native.tpuinfo import FakeBackend, HealthEvent, default_fake_chips
 from tpu_dra.tpuplugin.checkpoint import CheckpointManager
 from tpu_dra.tpuplugin.device_state import DeviceState
@@ -50,8 +50,13 @@ def opaque(params, source="FromClaim", requests=None):
             "opaque": {"driver": TPU_DRIVER_NAME, "parameters": params}}
 
 
-@pytest.fixture
-def harness(tmp_path):
+@pytest.fixture(params=["grpc", "framed"])
+def harness(request, tmp_path):
+    """The full node-driver stack, parametrized over BOTH async
+    front-end transports (SURVEY §21): the kubelet-facing grpc.aio
+    socket and the framed-RPC fast socket. Every wire-level assertion
+    in this file — including the claim-tracing structural trees — runs
+    against each; the sync thread-per-RPC server is retired."""
     cluster = FakeCluster()
     backend = FakeBackend(default_fake_chips(4, "v5p", slice_id="slice-A"))
     cdi = CDIHandler(str(tmp_path / "cdi"), driver_root=str(tmp_path / "drv"))
@@ -67,11 +72,14 @@ def harness(tmp_path):
                        plugin_dir=str(tmp_path / "plugin"),
                        registry_dir=str(tmp_path / "registry"))
     driver.start()
-    channel, prepare, unprepare = kubelet_stubs(driver.server.dra_socket)
+    if request.param == "grpc":
+        conn, prepare, unprepare = kubelet_stubs(driver.server.dra_socket)
+    else:
+        conn, prepare, unprepare = framed_stubs(driver.server.fast_socket)
     yield {"cluster": cluster, "backend": backend, "cdi": cdi, "state": state,
            "driver": driver, "prepare": prepare, "unprepare": unprepare,
-           "tmp": tmp_path, "ckpt": ckpt}
-    channel.close()
+           "tmp": tmp_path, "ckpt": ckpt, "transport": request.param}
+    conn.close()
     driver.shutdown()
 
 

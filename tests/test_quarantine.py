@@ -202,6 +202,79 @@ class TestClears:
             state.close()
 
 
+class TestClearPersistenceDegrade:
+    """Chaos-found (PR 15, seed 7): an operator clear whose journal
+    append fails must not stand memory-only — a restart would replay
+    the still-durable graduation record and silently resurrect the
+    quarantine the operator lifted. The clear degrades journal → slot
+    store → ROLLBACK, so memory and disk always agree."""
+
+    def _graduate(self, state, chip=0):
+        flap(state, chip)
+        state.mark_unhealthy(chip)
+        assert chip_uuid(state, chip) in state.quarantined_chips()
+
+    def test_journal_failure_degrades_to_slot_store(self, tmp_path):
+        state = make_state(str(tmp_path), threshold=2)
+        try:
+            self._graduate(state)
+            FAULTS.arm("prepare.journal_append", Always())
+            try:
+                cleared = state.clear_quarantine(0)
+            finally:
+                FAULTS.reset()
+            assert cleared  # the slot store accepted the clear
+            assert state.quarantined_chips() == {}
+        finally:
+            state.close()
+        state2 = make_state(str(tmp_path), threshold=2)
+        try:
+            # The synced slot image's fresh seq supersedes the durable
+            # graduation journal record: the clear survives restart.
+            assert state2.quarantined_chips() == {}
+        finally:
+            state2.close()
+
+    def test_total_persistence_failure_rolls_back(self, tmp_path):
+        state = make_state(str(tmp_path), threshold=2)
+        try:
+            self._graduate(state)
+            # checkpoint.store breaks BOTH schemes (journal_commit
+            # consults it too): nothing durable accepts the clear.
+            FAULTS.arm("checkpoint.store", Always())
+            try:
+                assert state.clear_quarantine(0) == []
+            finally:
+                FAULTS.reset()
+            # Rolled back: still quarantined in memory AND after
+            # restart — memory and disk agree in both worlds.
+            assert chip_uuid(state, 0) in state.quarantined_chips()
+            assert 0 not in published_chip_indices(state)
+        finally:
+            state.close()
+        state2 = make_state(str(tmp_path), threshold=2)
+        try:
+            assert chip_uuid(state2, 0) in state2.quarantined_chips()
+        finally:
+            state2.close()
+
+    def test_clear_retries_cleanly_after_fault_lifts(self, tmp_path):
+        state = make_state(str(tmp_path), threshold=2)
+        try:
+            self._graduate(state)
+            FAULTS.arm("checkpoint.store", Always())
+            try:
+                assert state.clear_quarantine(0) == []
+            finally:
+                FAULTS.reset()
+            cleared = state.clear_quarantine(0)
+            assert cleared
+            assert state.quarantined_chips() == {}
+            assert 0 in published_chip_indices(state)
+        finally:
+            state.close()
+
+
 class TestFlapFaultSite:
     def test_persistence_failure_degrades_and_retries(self, tmp_path):
         """health.flap firing at graduation must leave the chip

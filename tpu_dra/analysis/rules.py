@@ -139,27 +139,44 @@ class _LockContextVisitor(ast.NodeVisitor):
         self.ctx = ctx
         self.lock_stack: List[str] = []
         self.func_stack: List[str] = []
+        # Lexically inside an ``async def`` body: blocking work here
+        # stalls the event loop, not just a lock's waiters (the R2
+        # coroutine check, SURVEY §21). A nested sync def resets it the
+        # same way it resets the lock stack — its body runs when
+        # called, which for the narrow lexical check is "elsewhere"
+        # (typically on an executor or as a callback).
+        self.coro_depth = 0
         self.findings: List[Finding] = []
 
     # -- scope handling -----------------------------------------------------
 
     def _visit_function(self, node) -> None:
         saved = self.lock_stack
+        saved_coro = self.coro_depth
         self.lock_stack = ([f"{node.name}()"]
                            if node.name.endswith("_locked") else [])
+        self.coro_depth = (self.coro_depth + 1
+                           if isinstance(node, ast.AsyncFunctionDef) else 0)
         self.func_stack.append(node.name)
         self.generic_visit(node)
         self.func_stack.pop()
         self.lock_stack = saved
+        self.coro_depth = saved_coro
 
     visit_FunctionDef = _visit_function
     visit_AsyncFunctionDef = _visit_function
 
     def visit_Lambda(self, node: ast.Lambda) -> None:
         saved = self.lock_stack
+        saved_coro = self.coro_depth
         self.lock_stack = []
+        self.coro_depth = 0
         self.generic_visit(node)
         self.lock_stack = saved
+        self.coro_depth = saved_coro
+
+    def in_coroutine(self) -> bool:
+        return self.coro_depth > 0
 
     def visit_With(self, node: ast.With) -> None:
         held = [lockish_context(item) for item in node.items]
@@ -251,12 +268,47 @@ def blocking_reason(node: ast.Call) -> Optional[str]:
     return None
 
 
+def coroutine_blocking_reason(node: ast.Call) -> Optional[str]:
+    """Why this call would stall the event loop from a coroutine, or
+    None (the R2 coroutine check, SURVEY §21). Everything that blocks
+    under a lock blocks the loop too, plus the loop-specific set the
+    front-end swap made load-bearing: fdatasync/fsync (the journal's
+    group commit), flock (already in the shared set), Future.result()
+    and Event/lock acquire waits — all of which belong on an executor
+    (``run_in_executor``), never in an ``async def`` body."""
+    reason = blocking_reason(node)
+    if reason:
+        return reason
+    chain = attr_chain(node.func)
+    if not chain:
+        return None
+    last = chain[-1]
+    recv = chain[:-1]
+    if chain[0] in ("os", "vfs") and last in ("fdatasync", "fsync"):
+        return f"{chain[0]}.{last} (durable-sync syscall)"
+    if last == "result" and recv:
+        return ".result() (blocks the loop on a Future)"
+    if last == "acquire" and recv and is_data_lock_name(recv[-1]):
+        return ".acquire() on a data lock (blocks the loop)"
+    if last in _MUTEX_WAITERS and recv and is_cond_name(recv[-1]):
+        # Condition.wait releases ITS lock but still parks the thread —
+        # on the loop thread that parks the whole reactor.
+        return ".wait() (parks the loop thread)"
+    return None
+
+
 @register
 class NoBlockingUnderLock(Rule):
     """R2: no blocking operations inside a ``with *_lock`` body or a
     ``*_locked`` function — sleeps, subprocess spawns, socket/API-client
     verbs and flock syscalls stall every other thread queued on the
-    lock (and the watchdog/readiness paths behind them)."""
+    lock (and the watchdog/readiness paths behind them).
+
+    Coroutine family member (SURVEY §21): the same discipline lexically
+    inside ``async def`` bodies, where the victim is the event loop —
+    flock, fdatasync, ``Future.result()``, lock acquires and the shared
+    blocking set must be offloaded to an executor, never awaited-around
+    on the loop thread."""
 
     rule_id = "R2"
     title = "no blocking work under a data lock"
@@ -269,6 +321,14 @@ class NoBlockingUnderLock(Rule):
                     self.emit("R2", node,
                               f"blocking call {reason} while holding "
                               f"{self.lock_stack[-1]}")
+            if self.in_coroutine():
+                reason = coroutine_blocking_reason(node)
+                if reason:
+                    self.emit("R2", node,
+                              f"blocking call {reason} inside a "
+                              "coroutine — it stalls the event loop; "
+                              "offload it to an executor "
+                              "(run_in_executor)")
             self.generic_visit(node)
 
     def scan(self, module: Module, ctx: ProjectContext) -> Iterator[Finding]:

@@ -134,6 +134,13 @@ SITES: Dict[str, str] = {
     "checkpoint.store":
         "checkpoint store fails; threatens: claim state-machine "
         "durability, prepare idempotency",
+    "prepare.rpc_admit":
+        "pipelined RPC admission refuses the RPC before a window slot "
+        "or ordering gate is registered (the async front-end's "
+        "admission seam, SURVEY §21); threatens: per-claim error "
+        "surfacing — the RPC must fail with retryable per-claim errors "
+        "and leak neither a window slot nor a claim-uid gate a "
+        "successor would wait on forever",
     "prepare.journal_append":
         "append-only checkpoint journal append fails (ENOSPC on the "
         "journal while the slot scheme may still work); threatens: "

@@ -173,6 +173,33 @@ class Histogram(_Metric):
                     return b
             return float("inf")
 
+    def bucket_counts(self) -> Tuple[int, ...]:
+        """Raw per-bucket counts snapshot (finite buckets + overflow) —
+        the baseline handle for ``percentile_since``."""
+        with self._lock:
+            return tuple(self._counts)
+
+    def percentile_since(self, baseline: Tuple[int, ...], q: float,
+                         default: float = 0.0) -> float:
+        """``percentile`` over only the observations made AFTER
+        `baseline` (a ``bucket_counts()`` snapshot) — the phase-scoped
+        read a bench window needs when the histogram already carries a
+        process lifetime of observations. Same contracts as
+        ``percentile``: `default` when the window is empty, +Inf above
+        the largest finite bucket."""
+        with self._lock:
+            deltas = [c - b for c, b in zip(self._counts, baseline)]
+            n = sum(deltas)
+            if n == 0:
+                return default
+            target = q * n
+            cum = 0
+            for b, c in zip(self._buckets, deltas):
+                cum += c
+                if cum >= target:
+                    return b
+            return float("inf")
+
 
 class Registry:
     def __init__(self):
@@ -221,6 +248,12 @@ METRICS_CATALOG: Dict[str, str] = {
     "tpu_dra_prepare_wire_encode_seconds": "tpuplugin/driver.py",
     # kubeletplugin/pipeline.py — pipelined RPC admission
     "tpu_dra_prepare_inflight_rpcs": "kubeletplugin/pipeline.py",
+    # kubeletplugin/aio_server.py — async RPC front-end (SURVEY §21):
+    # event-loop scheduling-lag histogram (blocking work leaked onto
+    # the loop shows here first) and the front-end-wide in-flight RPC
+    # gauge the sustained-load bench watches
+    "tpu_dra_rpc_loop_lag_seconds": "kubeletplugin/aio_server.py",
+    "tpu_dra_rpc_sustained_inflight": "kubeletplugin/aio_server.py",
     # tpuplugin/health.py + device_state.py — failure-domain recovery
     # (SURVEY §18): the wedged-monitor tripwire and the chip-quarantine
     # ladder's exclusion count

@@ -28,6 +28,7 @@ import threading
 import time
 from typing import Dict, Iterable, List
 
+from tpu_dra.infra.faults import FAULTS
 from tpu_dra.infra.metrics import DefaultRegistry
 from tpu_dra.infra.trace import dump_flight_recorder
 
@@ -75,6 +76,12 @@ class RpcPipeline:
         overlapping claim sets. Raises PipelineTimeout when the window
         never frees — the caller fails the RPC."""
         unique = list(dict.fromkeys(uids))
+        # Injection site for the async front-end's admission path
+        # (SURVEY §21): an admission refusal must fail THIS RPC with a
+        # per-claim error (kubelet retries) before any window slot or
+        # gate registration exists to leak — the chaos prepare walk
+        # arms it against exactly that invariant.
+        FAULTS.check("prepare.rpc_admit", uids=unique)
         t0 = time.perf_counter()
         if not self._window.acquire(timeout=self._timeout_s):
             # A window that never frees means in-flight RPCs are wedged

@@ -1,4 +1,4 @@
-"""DRA plugin gRPC server + registration + ResourceSlice publishing.
+"""DRA plugin RPC server + registration + ResourceSlice publishing.
 
 Reference: the kubeletplugin.Helper from k8s.io/dynamic-resource-allocation
 that cmd/*/driver.go:73-82 builds on. It:
@@ -13,22 +13,43 @@ that cmd/*/driver.go:73-82 builds on. It:
 The gRPC services are registered with hand-rolled method handlers (we
 generate message gencode with protoc but service stubs by hand — grpc_tools
 is not available in this environment).
+
+Since SURVEY §21 the front-end is ASYNC: one event loop thread (see
+aio_server.py) hosts a grpc.aio server on the kubelet DRA socket (wire
+compatibility — kubelet speaks gRPC) plus a framed-RPC listener on
+``dra-fast.sock`` (the sub-0.5ms prepare transport). Both feed the SAME
+blocking handlers — pipeline admission, SharedFlock, group commit —
+through a shared executor; the thread-per-RPC ``grpc.server`` is
+retired. The handlers themselves are transport-independent
+(``DraHandlers``), which is what the PR 7 seam promised: the server
+swapped, ``DeviceState`` and the admission pipeline did not move.
 """
 
 from __future__ import annotations
 
+import asyncio
 import os
+import socket
 import threading
 import time
-from concurrent import futures
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
 import grpc
 
+from tpu_dra.kubeletplugin import aio_server
+from tpu_dra.kubeletplugin.aio_server import (
+    FRAME_HEADER, METHOD_ERROR, METHOD_PING, METHOD_PREPARE,
+    METHOD_UNPREPARE, EventLoopThread, FramedRpcServer,
+    aio_service_handlers,
+)
 from tpu_dra.kubeletplugin.gen import dra_v1_pb2 as dra
 from tpu_dra.kubeletplugin.gen import pluginregistration_pb2 as reg
 from tpu_dra.k8s import ApiClient, RESOURCESLICES
+
+_DRA_SERVICE = "k8s.io.kubelet.pkg.apis.dra.v1.DRAPlugin"
+_REG_SERVICE = "pluginregistration.Registration"
 
 
 @dataclass
@@ -77,13 +98,54 @@ class DriverCallbacks:
         drops it."""
 
 
-def _dra_service(callbacks: DriverCallbacks) -> grpc.GenericRpcHandler:
-    def node_prepare(request: dra.NodePrepareResourcesRequest, context):
+class DraHandlers:
+    """Transport-independent DRA method implementations.
+
+    Every method here BLOCKS (pipeline admission, flock, fdatasync) —
+    the async front-end must only ever call them through an executor.
+    Two surfaces per method: ``*_msg`` for transports handing parsed
+    protobuf messages (grpc.aio) and ``*_bytes`` for the framed path
+    (wire parse/serialize included in the decode/encode stopwatches, so
+    the attribution stays honest about what each transport pays)."""
+
+    def __init__(self, callbacks: DriverCallbacks):
+        self._callbacks = callbacks
+
+    # -- NodePrepareResources ----------------------------------------------
+
+    def node_prepare_msg(self, request) -> "dra.NodePrepareResourcesResponse":
         t_in = time.perf_counter()
         claims = [Claim(uid=c.uid, name=c.name, namespace=c.namespace)
                   for c in request.claims]
         t_decoded = time.perf_counter()
-        results = dict(callbacks.prepare_claims(claims))
+        results = dict(self._callbacks.prepare_claims(claims))
+        t_done = time.perf_counter()
+        resp = self._build_prepare_response(claims, results)
+        t_out = time.perf_counter()
+        self._callbacks.record_wire({"decode": t_decoded - t_in,
+                                     "encode": t_out - t_done,
+                                     "handler": t_out - t_in})
+        return resp
+
+    def node_prepare_bytes(self, body: bytes) -> bytes:
+        t_in = time.perf_counter()
+        request = dra.NodePrepareResourcesRequest.FromString(body)
+        claims = [Claim(uid=c.uid, name=c.name, namespace=c.namespace)
+                  for c in request.claims]
+        t_decoded = time.perf_counter()
+        results = dict(self._callbacks.prepare_claims(claims))
+        t_done = time.perf_counter()
+        payload = self._build_prepare_response(
+            claims, results).SerializeToString()
+        t_out = time.perf_counter()
+        self._callbacks.record_wire({"decode": t_decoded - t_in,
+                                     "encode": t_out - t_done,
+                                     "handler": t_out - t_in})
+        return payload
+
+    @staticmethod
+    def _build_prepare_response(claims: List[Claim],
+                                results: Dict[str, PrepareResult]):
         for claim in claims:
             # A driver bug that dropped a claim from the result map must
             # surface as that claim's error, not a missing response entry
@@ -91,7 +153,6 @@ def _dra_service(callbacks: DriverCallbacks) -> grpc.GenericRpcHandler:
             results.setdefault(
                 claim.uid,
                 PrepareResult(error="driver returned no result for claim"))
-        t_done = time.perf_counter()
         resp = dra.NodePrepareResourcesResponse()
         for uid, res in results.items():
             # Built in place: the map entry materializes on first access,
@@ -106,16 +167,24 @@ def _dra_service(callbacks: DriverCallbacks) -> grpc.GenericRpcHandler:
                     dev.device_name = d.device_name
                     dev.cdi_device_ids.extend(d.cdi_device_ids)
                     dev.request_names.extend(d.request_names)
-        t_out = time.perf_counter()
-        callbacks.record_wire({"decode": t_decoded - t_in,
-                               "encode": t_out - t_done,
-                               "handler": t_out - t_in})
         return resp
 
-    def node_unprepare(request: dra.NodeUnprepareResourcesRequest, context):
+    # -- NodeUnprepareResources --------------------------------------------
+
+    def node_unprepare_msg(self, request
+                           ) -> "dra.NodeUnprepareResourcesResponse":
         claims = [Claim(uid=c.uid, name=c.name, namespace=c.namespace)
                   for c in request.claims]
-        errors = dict(callbacks.unprepare_claims(claims))
+        return self._build_unprepare_response(
+            claims, dict(self._callbacks.unprepare_claims(claims)))
+
+    def node_unprepare_bytes(self, body: bytes) -> bytes:
+        request = dra.NodeUnprepareResourcesRequest.FromString(body)
+        return self.node_unprepare_msg(request).SerializeToString()
+
+    @staticmethod
+    def _build_unprepare_response(claims: List[Claim],
+                                  errors: Dict[str, str]):
         for claim in claims:
             errors.setdefault(claim.uid,
                               "driver returned no result for claim")
@@ -128,51 +197,56 @@ def _dra_service(callbacks: DriverCallbacks) -> grpc.GenericRpcHandler:
                 resp.claims[uid].SetInParent()
         return resp
 
-    handlers = {
-        "NodePrepareResources": grpc.unary_unary_rpc_method_handler(
-            node_prepare,
-            request_deserializer=dra.NodePrepareResourcesRequest.FromString,
-            response_serializer=dra.NodePrepareResourcesResponse.SerializeToString),
-        "NodeUnprepareResources": grpc.unary_unary_rpc_method_handler(
-            node_unprepare,
-            request_deserializer=dra.NodeUnprepareResourcesRequest.FromString,
-            response_serializer=dra.NodeUnprepareResourcesResponse.SerializeToString),
-    }
-    return grpc.method_handlers_generic_handler(
-        "k8s.io.kubelet.pkg.apis.dra.v1.DRAPlugin", handlers)
+    # -- framed dispatch ----------------------------------------------------
+
+    def dispatch_frame(self, method: int, body: bytes) -> bytes:
+        if method == METHOD_PREPARE:
+            return self.node_prepare_bytes(body)
+        if method == METHOD_UNPREPARE:
+            return self.node_unprepare_bytes(body)
+        raise ValueError(f"unknown framed-RPC method id {method}")
 
 
-def _registration_service(driver_name: str, endpoint: str,
-                          on_status: Optional[Callable[[bool, str], None]] = None
-                          ) -> grpc.GenericRpcHandler:
-    def get_info(request: reg.InfoRequest, context):
+def _dra_aio_services(handlers: DraHandlers) -> Dict[str, Dict[str, tuple]]:
+    return {_DRA_SERVICE: {
+        "NodePrepareResources": (
+            handlers.node_prepare_msg,
+            dra.NodePrepareResourcesRequest.FromString,
+            dra.NodePrepareResourcesResponse.SerializeToString),
+        "NodeUnprepareResources": (
+            handlers.node_unprepare_msg,
+            dra.NodeUnprepareResourcesRequest.FromString,
+            dra.NodeUnprepareResourcesResponse.SerializeToString),
+    }}
+
+
+def _registration_services(driver_name: str, endpoint: str,
+                           on_status: Optional[Callable[[bool, str], None]]
+                           ) -> Dict[str, Dict[str, tuple]]:
+    def get_info(request):
         return reg.PluginInfo(type="DRAPlugin", name=driver_name,
                               endpoint=endpoint, supported_versions=["v1"])
 
-    def notify(request: reg.RegistrationStatus, context):
+    def notify(request):
         if on_status:
             on_status(request.plugin_registered, request.error)
         return reg.RegistrationStatusResponse()
 
-    handlers = {
-        "GetInfo": grpc.unary_unary_rpc_method_handler(
-            get_info,
-            request_deserializer=reg.InfoRequest.FromString,
-            response_serializer=reg.PluginInfo.SerializeToString),
-        "NotifyRegistrationStatus": grpc.unary_unary_rpc_method_handler(
-            notify,
-            request_deserializer=reg.RegistrationStatus.FromString,
-            response_serializer=reg.RegistrationStatusResponse.SerializeToString),
-    }
-    return grpc.method_handlers_generic_handler("pluginregistration.Registration",
-                                                handlers)
+    return {_REG_SERVICE: {
+        "GetInfo": (get_info, reg.InfoRequest.FromString,
+                    reg.PluginInfo.SerializeToString),
+        "NotifyRegistrationStatus": (
+            notify, reg.RegistrationStatus.FromString,
+            reg.RegistrationStatusResponse.SerializeToString),
+    }}
 
 
 def self_probe(server: "DRAPluginServer", timeout: float = 3.0) -> bool:
     """Liveness self-probe (gpu plugin health.go:118-144): dial the
     plugin's own sockets as kubelet would — GetInfo on the registration
-    socket, NodePrepareResources with an empty request on the DRA socket —
-    and report whether both answered."""
+    socket, NodePrepareResources with an empty request on the DRA socket
+    — plus a ping on the framed fast socket, and report whether all
+    answered."""
     try:
         channel, prepare, _ = kubelet_stubs(server.dra_socket)
         try:
@@ -184,7 +258,7 @@ def self_probe(server: "DRAPluginServer", timeout: float = 3.0) -> bool:
             reg_channel = grpc.insecure_channel(f"unix://{reg_sock}")
             try:
                 get_info = reg_channel.unary_unary(
-                    "/pluginregistration.Registration/GetInfo",
+                    f"/{_REG_SERVICE}/GetInfo",
                     request_serializer=reg.InfoRequest.SerializeToString,
                     response_deserializer=reg.PluginInfo.FromString)
                 info = get_info(reg.InfoRequest(), timeout=timeout)
@@ -192,35 +266,124 @@ def self_probe(server: "DRAPluginServer", timeout: float = 3.0) -> bool:
                     return False
             finally:
                 reg_channel.close()
+        if server.fast_socket and os.path.exists(server.fast_socket):
+            client = FramedClient(server.fast_socket, timeout_s=timeout)
+            try:
+                if not client.ping():
+                    return False
+            finally:
+                client.close()
         return True
-    except grpc.RpcError:
+    except (grpc.RpcError, OSError):
         return False
 
 
 def kubelet_stubs(dra_socket: str):
-    """Client-side stubs acting as kubelet: (channel, prepare, unprepare).
+    """Client-side gRPC stubs acting as kubelet: (channel, prepare,
+    unprepare).
 
-    Single source of truth for the DRA v1 method paths/serializers used by
-    the bench harness and the e2e tests; close the returned channel when
-    done."""
+    Single source of truth for the DRA v1 method paths/serializers used
+    by the e2e tests and the gRPC side of the bench harness; close the
+    returned channel when done. The framed fast-path equivalent is
+    ``framed_stubs``."""
     channel = grpc.insecure_channel(f"unix://{dra_socket}")
     prepare = channel.unary_unary(
-        "/k8s.io.kubelet.pkg.apis.dra.v1.DRAPlugin/NodePrepareResources",
+        f"/{_DRA_SERVICE}/NodePrepareResources",
         request_serializer=dra.NodePrepareResourcesRequest.SerializeToString,
         response_deserializer=dra.NodePrepareResourcesResponse.FromString)
     unprepare = channel.unary_unary(
-        "/k8s.io.kubelet.pkg.apis.dra.v1.DRAPlugin/NodeUnprepareResources",
+        f"/{_DRA_SERVICE}/NodeUnprepareResources",
         request_serializer=dra.NodeUnprepareResourcesRequest.SerializeToString,
         response_deserializer=dra.NodeUnprepareResourcesResponse.FromString)
     return channel, prepare, unprepare
 
 
+class FramedRpcError(RuntimeError):
+    """Server-side error surfaced over the framed transport."""
+
+
+class FramedClient:
+    """Blocking framed-RPC client over the plugin's fast socket.
+
+    NOT thread-safe: one request/response in flight per connection by
+    protocol design — use one client per thread (concurrency =
+    connections, which is how the sustained bench drives depth)."""
+
+    def __init__(self, fast_socket: str, timeout_s: float = 30.0):
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.settimeout(timeout_s)
+        self._sock.connect(fast_socket)
+
+    def _call(self, method: int, payload: bytes) -> bytes:
+        self._sock.sendall(FRAME_HEADER.pack(len(payload), method)
+                           + payload)
+        header = self._read_exact(FRAME_HEADER.size)
+        length, resp_method = FRAME_HEADER.unpack(header)
+        body = self._read_exact(length)
+        if resp_method == METHOD_ERROR:
+            raise FramedRpcError(body.decode("utf-8", "replace"))
+        return body
+
+    def _read_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("framed-RPC server closed the "
+                                      "connection mid-response")
+            buf += chunk
+        return buf
+
+    def prepare(self, request: "dra.NodePrepareResourcesRequest"
+                ) -> "dra.NodePrepareResourcesResponse":
+        body = self._call(METHOD_PREPARE, request.SerializeToString())
+        return dra.NodePrepareResourcesResponse.FromString(body)
+
+    def unprepare(self, request: "dra.NodeUnprepareResourcesRequest"
+                  ) -> "dra.NodeUnprepareResourcesResponse":
+        body = self._call(METHOD_UNPREPARE, request.SerializeToString())
+        return dra.NodeUnprepareResourcesResponse.FromString(body)
+
+    def ping(self) -> bool:
+        return self._call(METHOD_PING, b"") == b""
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass  # drflow: swallow-ok[idempotent close on teardown]
+
+
+def framed_stubs(fast_socket: str, timeout_s: float = 30.0):
+    """Framed-transport analog of kubelet_stubs: (client, prepare,
+    unprepare) with the same request/response message types — call
+    ``client.close()`` when done."""
+    client = FramedClient(fast_socket, timeout_s=timeout_s)
+    return client, client.prepare, client.unprepare
+
+
 class DRAPluginServer:
     """Hosts the DRA + Registration services on unix sockets.
 
-    plugin_dir:   /var/lib/kubelet/plugins/<driver>/   (dra.sock lives here)
+    plugin_dir:   /var/lib/kubelet/plugins/<driver>/   (dra.sock +
+                  dra-fast.sock live here)
     registry_dir: /var/lib/kubelet/plugins_registry/   (watcher socket)
-    """
+
+    One asyncio event loop thread (aio_server.EventLoopThread) reacts
+    for every listener; one executor runs every blocking handler."""
+
+    # Executor width bounds concurrent blocking DRA handlers, matching
+    # the retired sync server's thread pool (and sitting BELOW the
+    # pipeline's in-flight window of 16 — with the async front-end the
+    # pool, not the window, is the binding concurrency limit; excess
+    # RPCs queue in the executor instead of on handler threads).
+    RPC_POOL_WORKERS = 8
+    # Registration gets its own tiny pool, as the retired server gave
+    # it a dedicated 2-thread gRPC server: kubelet's GetInfo/
+    # NotifyRegistrationStatus must answer even while every RPC worker
+    # is wedged in a stalled prepare (a data-path stall must not read
+    # as a dead plugin and deregister the driver).
+    REG_POOL_WORKERS = 2
 
     def __init__(self, driver_name: str, node_name: str,
                  callbacks: DriverCallbacks,
@@ -234,23 +397,64 @@ class DRAPluginServer:
         if registry_dir:
             os.makedirs(registry_dir, exist_ok=True)
         self.dra_socket = os.path.join(plugin_dir, "dra.sock")
+        self.fast_socket = os.path.join(plugin_dir, "dra-fast.sock")
         self.registration_registered = threading.Event()
-        self._server: Optional[grpc.Server] = None
-        self._reg_server: Optional[grpc.Server] = None
+        self._loop_thread: Optional[EventLoopThread] = None
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._reg_pool: Optional[ThreadPoolExecutor] = None
+        self._server = None           # grpc.aio server (DRA socket)
+        self._framed: Optional[FramedRpcServer] = None
+        self._reg_server = None       # grpc.aio server (registration)
         self._stopped = False
         # Serializes start_registration() against stop(): they run on
         # different threads (publish retry queue vs driver shutdown).
         self._reg_lock = threading.Lock()
 
+    # -- loop-side coroutines (no blocking work here: dralint R2) -----------
+
+    async def _start_main(self) -> None:
+        handlers = DraHandlers(self._callbacks)
+        self._server = grpc.aio.server()
+        for h in aio_service_handlers(_dra_aio_services(handlers),
+                                      self._pool):
+            self._server.add_generic_rpc_handlers([h])
+        self._server.add_insecure_port(f"unix://{self.dra_socket}")
+        await self._server.start()
+        self._framed = FramedRpcServer(self.fast_socket,
+                                       handlers.dispatch_frame, self._pool)
+        await self._framed.start()
+        asyncio.get_running_loop().create_task(aio_server.lag_monitor())
+
+    async def _start_registration(self, reg_sock: str) -> None:
+        self._reg_server = grpc.aio.server()
+        services = _registration_services(
+            self.driver_name, self.dra_socket,
+            on_status=lambda ok, err: (
+                self.registration_registered.set() if ok else None))
+        for h in aio_service_handlers(services, self._reg_pool):
+            self._reg_server.add_generic_rpc_handlers([h])
+        self._reg_server.add_insecure_port(f"unix://{reg_sock}")
+        await self._reg_server.start()
+
+    async def _stop_servers(self, grace: float) -> None:
+        if self._server is not None:
+            await self._server.stop(grace)
+        if self._framed is not None:
+            await self._framed.stop()
+        if self._reg_server is not None:
+            await self._reg_server.stop(grace)
+
+    # -- lifecycle (called from plain threads) ------------------------------
+
     def start(self, register: bool = True) -> None:
-        for sock in [self.dra_socket]:
+        for sock in (self.dra_socket, self.fast_socket):
             if os.path.exists(sock):
                 os.unlink(sock)
-        self._server = grpc.server(
-            futures.ThreadPoolExecutor(max_workers=8),
-            handlers=[_dra_service(self._callbacks)])
-        self._server.add_insecure_port(f"unix://{self.dra_socket}")
-        self._server.start()
+        self._loop_thread = EventLoopThread()
+        self._pool = ThreadPoolExecutor(
+            max_workers=self.RPC_POOL_WORKERS,
+            thread_name_prefix="tpu-dra-rpc")
+        self._loop_thread.submit(self._start_main()).result(timeout=10.0)
         if register:
             self.start_registration()
 
@@ -272,23 +476,32 @@ class DRAPluginServer:
                 self._registry_dir, f"{self.driver_name}-reg.sock")
             if os.path.exists(reg_sock):
                 os.unlink(reg_sock)
-            self._reg_server = grpc.server(
-                futures.ThreadPoolExecutor(max_workers=2),
-                handlers=[_registration_service(
-                    self.driver_name, self.dra_socket,
-                    on_status=lambda ok, err: (
-                        self.registration_registered.set() if ok else None))])
-            self._reg_server.add_insecure_port(f"unix://{reg_sock}")
-            self._reg_server.start()
+            if self._reg_pool is None:
+                self._reg_pool = ThreadPoolExecutor(
+                    max_workers=self.REG_POOL_WORKERS,
+                    thread_name_prefix="tpu-dra-reg")
+            self._loop_thread.submit(
+                self._start_registration(reg_sock)).result(timeout=10.0)
             self.registration_socket = reg_sock
 
     def stop(self, grace: float = 2.0) -> None:
         with self._reg_lock:
             self._stopped = True
-        if self._server:
-            self._server.stop(grace).wait()
-        if self._reg_server:
-            self._reg_server.stop(grace).wait()
+        if self._loop_thread is not None:
+            self._loop_thread.submit(self._stop_servers(grace)).result(
+                timeout=grace + 10.0)
+            # Drain the executors BEFORE stopping the loop: an
+            # in-flight handler finishing after loop close would try to
+            # deliver its future result onto a dead loop (noisy, and
+            # the RPC's response frame would be lost mid-write).
+            for pool in (self._pool, self._reg_pool):
+                if pool is not None:
+                    pool.shutdown(wait=True)
+            self._loop_thread.stop()
+        else:
+            for pool in (self._pool, self._reg_pool):
+                if pool is not None:
+                    pool.shutdown(wait=True)
 
 
 # ---------------------------------------------------------------------------

@@ -70,11 +70,16 @@ log = logging.getLogger("simcluster.chaos")
 # DeviceState parallel apply), so the group-commit rollback machinery is
 # chaos-tested on the exact production path; the prepare.journal_* sites
 # break the append-only journal's append and bounded-lag compaction the
-# same way (SURVEY §14). health.flap breaks the quarantine ladder's
-# graduation persistence (SURVEY §18): the chip must degrade to
-# transient-unhealthy and re-graduate, never half-quarantine.
+# same way (SURVEY §14). prepare.rpc_admit refuses RPCs at the async
+# front-end's admission seam before any window slot or ordering gate
+# exists (SURVEY §21): the walk must see a clean per-claim failure and
+# retry, never a leaked gate wedging a successor RPC. health.flap
+# breaks the quarantine ladder's graduation persistence (SURVEY §18):
+# the chip must degrade to transient-unhealthy and re-graduate, never
+# half-quarantine.
 CHAOS_SITES = ("k8s.api.request", "cdi.claim_write", "checkpoint.store",
-               "checkpoint.corrupt", "prepare.batch_fetch",
+               "checkpoint.corrupt", "prepare.rpc_admit",
+               "prepare.batch_fetch",
                "prepare.batch_apply", "prepare.journal_append",
                "prepare.journal_compact", "health.flap", "trace.emit")
 

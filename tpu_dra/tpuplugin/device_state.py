@@ -1453,15 +1453,36 @@ class DeviceState:
                        or rec.get("chip_index") == chip_index]
             if not cleared:
                 return []
+            saved = {uuid: self._checkpoint.quarantine[uuid]
+                     for uuid in cleared}
             affected = self._clear_quarantine_locked(cleared)
             try:
                 token = self._ckpt_mgr.journal_commit(
                     self._checkpoint, quarantine=True)
-            except Exception:  # noqa: BLE001 — the clear stands in
-                # memory (the operator asked for it); durability rides
-                # the next transition or compaction.
-                log.warning("quarantine clear could not persist",
-                            exc_info=True)
+            except Exception:  # noqa: BLE001 — degrade to the slot
+                # scheme before giving up: a journal-only failure
+                # (ENOSPC on the journal file) leaves the synced slot
+                # store working, and its fresh seq supersedes the
+                # still-durable graduation records (the same
+                # maybe-durable supersede the prepare rollback paths
+                # use). Leaving the clear memory-only instead would
+                # resurrect the quarantine on restart — an operator
+                # command silently undone (chaos-found, seed 7).
+                log.warning("quarantine clear journal append failed; "
+                            "degrading to slot store", exc_info=True)
+                try:
+                    self._ckpt_mgr.store(self._checkpoint)
+                except Exception:  # noqa: BLE001 — nothing durable
+                    # accepted the clear: ROLL IT BACK so memory and
+                    # disk agree (the chip stays quarantined, loudly;
+                    # the operator retries once storage recovers).
+                    self._checkpoint.quarantine.update(saved)
+                    quarantined_chips_gauge.set(
+                        len(self._checkpoint.quarantine))
+                    log.error("quarantine clear could not persist on "
+                              "any scheme; clear rolled back for %s",
+                              sorted(saved), exc_info=True)
+                    return []
         if token is not None:
             self._quarantine_barrier(token)
         return affected

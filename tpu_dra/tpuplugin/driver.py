@@ -15,7 +15,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Tuple
 
 from tpu_dra.infra import featuregates
-from tpu_dra.infra.faults import FAULTS
+from tpu_dra.infra.faults import FAULTS, FaultInjected
 from tpu_dra.infra.flock import Flock, SharedFlock
 from tpu_dra.infra.metrics import DefaultRegistry
 from tpu_dra.infra.trace import TRACEPARENT_ANNOTATION, TRACER
@@ -172,8 +172,9 @@ class TpuDriver(DriverCallbacks):
         results: Dict[str, PrepareResult] = {}
         try:
             ticket = self._pipeline.admit(c.uid for c in claims)
-        except TimeoutError as e:
-            # Window never freed (wedged in-flight RPCs): fail fast so
+        except (TimeoutError, FaultInjected) as e:
+            # Window never freed (wedged in-flight RPCs) or an injected
+            # admission refusal (prepare.rpc_admit): fail fast so
             # kubelet retries instead of piling blocked handlers.
             return {c.uid: PrepareResult(error=str(e)) for c in claims}
         # uid -> the claim's rpc-level span: continues the trace the
@@ -239,7 +240,7 @@ class TpuDriver(DriverCallbacks):
         group-committed unprepare per RPC."""
         try:
             ticket = self._pipeline.admit(c.uid for c in claims)
-        except TimeoutError as e:
+        except (TimeoutError, FaultInjected) as e:
             return {c.uid: str(e) for c in claims}
         try:
             try:

@@ -124,7 +124,9 @@ def main(argv=None) -> int:
         signal.signal(sig, lambda *_: stop.set())
 
     driver.start()
-    logger.info("tpu kubelet plugin serving on %s", driver.server.dra_socket)
+    logger.info("tpu kubelet plugin serving on %s (kubelet gRPC) + %s "
+                "(framed fast path)", driver.server.dra_socket,
+                driver.server.fast_socket)
     stop.wait()
     driver.shutdown()
     if metrics_srv:
