@@ -311,6 +311,44 @@ class _BenchDriver:
             unprepare(ureq)
         return lat / n_claims
 
+    def hot_restart(self):
+        """Hot driver upgrade on the SAME plugin/checkpoint dirs
+        (SURVEY §22): drain the pipeline, run the journal barrier, take
+        the old incarnation's sockets down, then bring up a fresh
+        CheckpointManager/DeviceState/TpuDriver whose recovery replays
+        the journal. Returns (drain_s, recovered_claims). Clients
+        riding RetryingFramedClient mask the socket gap."""
+        from tpu_dra.api.types import TPU_DRIVER_NAME
+        from tpu_dra.cdi.handler import CDIHandler
+        from tpu_dra.kubeletplugin.server import framed_stubs, kubelet_stubs
+        from tpu_dra.tpuplugin.checkpoint import CheckpointManager
+        from tpu_dra.tpuplugin.device_state import DeviceState
+        from tpu_dra.tpuplugin.driver import TpuDriver
+        from tpu_dra.tpuplugin.sharing import TimeSlicingManager
+
+        self.channel.close()
+        self.framed_client.close()
+        drain_s = self.driver.shutdown(drain=True)
+        cdi = CDIHandler(self.cdi_dir,
+                         driver_root=os.path.join(self.tmp, "drv"))
+        self.state = DeviceState(
+            backend=self.backend, cdi=cdi,
+            checkpoints=CheckpointManager(os.path.join(self.tmp, "p")),
+            driver_name=TPU_DRIVER_NAME, node_name="bench-node",
+            ts_manager=TimeSlicingManager(self.backend))
+        recovered = len(self.state.prepared_claim_uids())
+        self.driver = TpuDriver(state=self.state, client=self.cluster,
+                                driver_name=TPU_DRIVER_NAME,
+                                node_name="bench-node",
+                                plugin_dir=os.path.join(self.tmp, "p"),
+                                registry_dir=os.path.join(self.tmp, "r"))
+        self.driver.start()
+        self.channel, self._prepare_grpc, self._unprepare_grpc = \
+            kubelet_stubs(self.driver.server.dra_socket)
+        self.framed_client, self._prepare_framed, self._unprepare_framed = \
+            framed_stubs(self.driver.server.fast_socket)
+        return drain_s, recovered
+
     def close(self):
         self.channel.close()
         self.framed_client.close()
@@ -811,6 +849,257 @@ def bench_prepare_sustained(duration_s: float = None, workers: int = None,
     if errors:
         out["prepare_sustained_first_error"] = errors[0]
     return out
+
+
+def bench_hot_restart(duration_s: float = None, workers: int = None,
+                      chips_per_worker: int = 2, n_restarts: int = None):
+    """Hot driver upgrade under sustained load (SURVEY §22): `workers`
+    client threads on RetryingFramedClient drive prepare/unprepare
+    flat-out while the kubelet plugin is restarted `n_restarts` times
+    mid-stream — drain window (in-flight RPCs finish, new admissions
+    refused), journal barrier, sockets down, fresh driver incarnation
+    recovering from the checkpoint journal on the SAME dirs. The gate:
+    ZERO failed RPCs (every refusal/socket gap masked by client
+    retry-on-reconnect) and zero leaked claims, with the drain window
+    bounded (hack/perf.sh)."""
+    import threading
+
+    from tpu_dra.kubeletplugin.gen import dra_v1_pb2 as dra
+    from tpu_dra.kubeletplugin.server import (
+        RPC_RECONNECTS, RetryingFramedClient,
+    )
+    from tpu_dra.native.tpuinfo import FakeBackend, default_fake_chips
+
+    duration_s = duration_s if duration_s is not None else float(
+        os.environ.get("TPU_DRA_BENCH_RESTART_S", "12"))
+    workers = workers if workers is not None else int(
+        os.environ.get("TPU_DRA_BENCH_RESTART_WORKERS", "6"))
+    n_restarts = n_restarts if n_restarts is not None else int(
+        os.environ.get("TPU_DRA_BENCH_RESTARTS", "2"))
+
+    bd = _BenchDriver(
+        FakeBackend(default_fake_chips(workers * chips_per_worker, "v5p",
+                                       slice_id="restart")),
+        prefix="tpu-dra-bench-restart-")
+    fast_socket = bd.driver.server.fast_socket
+    stop = threading.Event()
+    lat_ms: list = []
+    errors: list = []
+    lat_lock = threading.Lock()
+    reconnects0 = RPC_RECONNECTS.value()
+
+    def worker(w):
+        my_chips = bd.chips[w * chips_per_worker:(w + 1) * chips_per_worker]
+        objs = [_make_claim(bd.cluster, [c], f"restart-{w}-{c}")
+                for c in my_chips]
+        reqs = []
+        for obj in objs:
+            req = dra.NodePrepareResourcesRequest()
+            ureq = dra.NodeUnprepareResourcesRequest()
+            for r in (req.claims.add(), ureq.claims.add()):
+                r.uid = obj["metadata"]["uid"]
+                r.name = obj["metadata"]["name"]
+                r.namespace = "default"
+            reqs.append((obj["metadata"]["uid"], req, ureq))
+        my_lats, my_errors = [], []
+        client = RetryingFramedClient(fast_socket, max_elapsed_s=30.0)
+        try:
+            i = 0
+            while not stop.is_set():
+                uid, req, ureq = reqs[i % len(reqs)]
+                i += 1
+                t0 = time.perf_counter()
+                resp = client.prepare(req)
+                my_lats.append((time.perf_counter() - t0) * 1e3)
+                if resp.claims[uid].error:
+                    my_errors.append(resp.claims[uid].error)
+                t0 = time.perf_counter()
+                uresp = client.unprepare(ureq)
+                my_lats.append((time.perf_counter() - t0) * 1e3)
+                if uresp.claims[uid].error:
+                    my_errors.append(uresp.claims[uid].error)
+        except Exception as e:  # noqa: BLE001 — every escape IS a
+            my_errors.append(repr(e))  # failed RPC the gate counts
+        finally:
+            client.close()
+        with lat_lock:
+            lat_ms.extend(my_lats)
+            errors.extend(my_errors)
+
+    drain_s: list = []
+    recovered: list = []
+    try:
+        threads = [threading.Thread(target=worker, args=(w,))
+                   for w in range(workers)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        # Restarts spread evenly through the window: load before,
+        # through, and after each one.
+        for k in range(n_restarts):
+            time.sleep(duration_s / (n_restarts + 1))
+            d, r = bd.hot_restart()
+            drain_s.append(d)
+            recovered.append(r)
+        time.sleep(duration_s / (n_restarts + 1))
+        stop.set()
+        for t in threads:
+            t.join(60)
+        wall_s = time.perf_counter() - t0
+        leaked = bd.state.prepared_claim_uids()
+    finally:
+        stop.set()
+        bd.close()
+
+    lat_ms.sort()
+    out = {
+        "hot_restart_restarts": n_restarts,
+        "hot_restart_duration_s": round(wall_s, 1),
+        "hot_restart_workers": workers,
+        "hot_restart_rpcs": len(lat_ms),
+        "hot_restart_failed_rpcs": len(errors),
+        "hot_restart_reconnects": int(RPC_RECONNECTS.value() - reconnects0),
+        "hot_restart_drain_s_max": round(max(drain_s, default=0.0), 3),
+        "hot_restart_recovered_claims": sum(recovered),
+        "hot_restart_leaked_claims": len(leaked),
+        "hot_restart_p50_ms": round(statistics.median(lat_ms), 3)
+        if lat_ms else None,
+        "hot_restart_p99_ms": round(_pctl(lat_ms, 0.99), 3)
+        if lat_ms else None,
+    }
+    if errors:
+        out["hot_restart_first_error"] = errors[0]
+    return out
+
+
+def bench_sched_failover(n_failovers: int = None, n_nodes: int = 12,
+                         chips_per_node: int = 2, window: int = 8):
+    """HA scheduler failover under churn (SURVEY §22): an active +
+    standby Scheduler pair behind LeaderElectors over one fenced Lease,
+    pod churn running throughout. Each round kills the acting leader
+    cold (no lease release — the standby must wait out expiry) and
+    measures kill -> the standby's FIRST new allocation landing:
+    expiry detection + takeover CAS + full index resync + first
+    commit. Reports the p50 hack/perf.sh gates."""
+    import threading
+
+    from tpu_dra.infra.leaderelect import LeaderElector, install_fencing
+    from tpu_dra.k8s import FakeCluster, PODS, RESOURCECLAIMS
+    from tpu_dra.simcluster.scheduler import Scheduler
+    from tpu_dra.testing import seed_sched_inventory
+
+    n_failovers = n_failovers if n_failovers is not None else int(
+        os.environ.get("TPU_DRA_BENCH_FAILOVER_N", "5"))
+    lease_duration_s = 0.4
+
+    lat_ms = []
+    for round_i in range(n_failovers):
+        cluster = FakeCluster()
+        install_fencing(cluster)
+        seed_sched_inventory(cluster, nodes=n_nodes,
+                             chips_per_node=chips_per_node,
+                             node_fmt="n{i:03d}")
+        scheds, electors = [], []
+        for ident in ("sched-a", "sched-b"):
+            sched = Scheduler(cluster, gc_sweep_interval=3600.0)
+            sched.start(standby=True)
+
+            def on_started(gen, s=sched):
+                s.set_lease_generation(gen)
+                s.promote()
+
+            electors.append(LeaderElector(
+                cluster, ident, lease_duration_s=lease_duration_s,
+                renew_interval_s=0.1, on_started_leading=on_started,
+                seed=round_i))
+            scheds.append(sched)
+        stop = threading.Event()
+
+        def churn():
+            i = 0
+            while not stop.is_set():
+                pods = cluster.list(PODS, namespace="default")
+                for pod in pods:
+                    if pod["spec"].get("nodeName"):
+                        try:
+                            cluster.delete(PODS,
+                                           pod["metadata"]["name"],
+                                           "default")
+                        # drflow: swallow-ok[delete racing scheduler GC]
+                        except Exception:  # noqa: BLE001
+                            pass
+                for _ in range(max(0, window - len(pods))):
+                    cluster.create(PODS, {
+                        "apiVersion": "v1", "kind": "Pod",
+                        "metadata": {"name": f"fo-{round_i}-{i:05d}",
+                                     "namespace": "default"},
+                        "spec": {"containers": [{"name": "c",
+                                                 "image": "x"}],
+                                 "resourceClaims": [
+                                     {"name": "t",
+                                      "resourceClaimTemplateName":
+                                          "tmpl"}]},
+                    }, namespace="default")
+                    i += 1
+                stop.wait(0.005)
+
+        def allocated_uids():
+            return {c["metadata"]["uid"]
+                    for c in cluster.list(RESOURCECLAIMS,
+                                          namespace="default")
+                    if (c.get("status") or {}).get("allocation")}
+
+        churn_t = threading.Thread(target=churn, daemon=True)
+        try:
+            # Leader first, wait for it to act, then the standby.
+            electors[0].start()
+            deadline = time.monotonic() + 10.0
+            while not electors[0].is_leader \
+                    and time.monotonic() < deadline:
+                time.sleep(0.005)
+            electors[1].start()
+            churn_t.start()
+            # Steady state: the leader is allocating under churn.
+            deadline = time.monotonic() + 30.0
+            while not allocated_uids() and time.monotonic() < deadline:
+                time.sleep(0.005)
+            if not allocated_uids():
+                raise RuntimeError("leader never allocated under churn")
+            # Kill the leader cold: elector gone (no release), workers
+            # gone. The standby must detect expiry, CAS the takeover,
+            # resync, and commit.
+            before = allocated_uids()
+            t_kill = time.perf_counter()
+            electors[0].stop()
+            scheds[0].stop()
+            deadline = time.monotonic() + 30.0
+            t_first = None
+            while time.monotonic() < deadline:
+                if allocated_uids() - before:
+                    t_first = time.perf_counter()
+                    break
+                time.sleep(0.002)
+            if t_first is None:
+                raise RuntimeError(
+                    "standby never allocated after leader kill")
+            lat_ms.append((t_first - t_kill) * 1e3)
+        finally:
+            stop.set()
+            churn_t.join(5)
+            for el in electors:
+                el.stop()
+            for sched in scheds:
+                sched.stop()
+
+    lat_ms.sort()
+    return {
+        "sched_failover_rounds": n_failovers,
+        "sched_failover_lease_duration_s": lease_duration_s,
+        "sched_failover_nodes": n_nodes,
+        "sched_failover_to_alloc_p50_ms": round(
+            statistics.median(lat_ms), 1),
+        "sched_failover_to_alloc_max_ms": round(max(lat_ms), 1),
+    }
 
 
 def bench_chaos_recovery(n: int = 7):
@@ -1618,6 +1907,19 @@ def main():
         out.update(bench_prepare_sustained())
     except Exception as e:  # noqa: BLE001 — sustained phase best-effort
         out["prepare_sustained_error"] = str(e)
+    try:
+        # Hot-restart phase (SURVEY §22): plugin restarted mid-stream
+        # under load; the zero-failed-RPC + bounded-drain gates ride
+        # these keys (hack/perf.sh).
+        out.update(bench_hot_restart())
+    except Exception as e:  # noqa: BLE001 — restart phase best-effort
+        out["hot_restart_error"] = str(e)
+    try:
+        # HA failover phase (SURVEY §22): leader killed under churn;
+        # p50 of kill -> standby's first allocation.
+        out.update(bench_sched_failover())
+    except Exception as e:  # noqa: BLE001 — failover phase best-effort
+        out["sched_failover_error"] = str(e)
     try:
         out.update(bench_sched_churn())
     except Exception as e:  # noqa: BLE001 — churn phase is best-effort

@@ -18,7 +18,13 @@
 #    chip quarantine racing pod churn with sched.evict armed — every
 #    evicted claim ends Allocated-on-live-chips or Pending-with-reason,
 #    never a claim pinned to a dead/quarantined chip; the node walk
-#    additionally asserts quarantine survives crash-restart).
+#    additionally asserts quarantine survives crash-restart), and the
+#    HA leader-kill walk (SURVEY §22: two scheduler replicas behind a
+#    fenced Lease, leader kills racing pod churn and node-death
+#    eviction with sched.lease_renew / sched.takeover_resync armed —
+#    never two acting leaders' commits both land, no double
+#    allocation, no claim leaked across takeover, at most one acting
+#    leader at quiesce).
 #    Violations exit non-zero.
 # 2. The @slow chaos soak tests (excluded from tier-1 by -m 'not slow').
 # 3. Witness cross-validation: the acquisition-order edges the whole

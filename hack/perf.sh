@@ -37,6 +37,15 @@
 #    appends/group-syncs >= PERF_COALESCE_RATIO_MIN (default 1.5,
 #    measures ~2.5) with no retry loop (the old idle-probe gate
 #    retried 5 rounds because coalescing was opportunistic there).
+# 2c. Hot-restart phase (ISSUE 16, SURVEY §22): the kubelet plugin is
+#    restarted PERF_RESTARTS times mid-stream under framed churn —
+#    gated on ZERO failed RPCs (drain + journal recovery + client
+#    retry-on-reconnect mask the gap entirely), zero leaked claims,
+#    and the drain window under PERF_DRAIN_GATE_S.
+# 2d. Scheduler failover phase (ISSUE 16): active-standby HA takeover
+#    under pod churn — lease-expiry-to-first-new-allocation p50 gated
+#    under PERF_FAILOVER_P50_GATE_MS (tripwire; the 0.4s lease expiry
+#    wait dominates by design).
 # 3. Scheduler churn gates on the fake backend (SCHED_NODES x
 #    SCHED_PODS, defaults 100x500): steady-state full relists MUST be 0
 #    (event-driven, not poll-and-scan), CEL compiles MUST not exceed
@@ -251,6 +260,80 @@ if ratio is None or ratio < ratio_min:
              f"group_syncs={out['prepare_sustained_journal_group_syncs']})"
              " — the cross-RPC group commit stopped sharing fdatasyncs "
              "at depth")
+EOF
+
+echo ">> hot-restart phase (${PERF_RESTART_S:-12}s churn across ${PERF_RESTARTS:-2} plugin restarts: zero failed RPCs)"
+# ISSUE 16 gates (SURVEY §22): restart the kubelet plugin mid-stream
+# under sustained prepare/unprepare churn. The drain window bounds the
+# in-flight quiesce, the checkpoint journal + idempotent prepare
+# recover the claim set, and the framed clients' bounded
+# retry-on-reconnect masks the socket gap — so the gate is literal:
+# ZERO failed RPCs, zero leaked claims, drain window under
+# PERF_DRAIN_GATE_S (default 5; measures ~0.005 — the gate carries
+# headroom for CI boxes where an in-flight batch straddles the drain).
+JAX_PLATFORMS=cpu TPU_DRA_TPUINFO_BACKEND=fake \
+  TPU_DRA_BENCH_RESTART_S="${PERF_RESTART_S:-12}" \
+  TPU_DRA_BENCH_RESTARTS="${PERF_RESTARTS:-2}" \
+  PERF_DRAIN_GATE_S="${PERF_DRAIN_GATE_S:-5}" \
+  python - <<'EOF'
+import json
+import os
+import sys
+
+import bench
+
+out = bench.bench_hot_restart()
+print(json.dumps(out))
+if out.get("hot_restart_error"):
+    sys.exit(f"REGRESSION: hot-restart phase error: "
+             f"{out['hot_restart_error']}")
+if out["hot_restart_failed_rpcs"]:
+    sys.exit(f"REGRESSION: {out['hot_restart_failed_rpcs']} failed RPCs "
+             f"across {out['hot_restart_restarts']} plugin restarts "
+             f"(first: {out.get('hot_restart_first_error')}) — the "
+             "drain + retry-on-reconnect contract must mask the "
+             "restart gap completely")
+if out["hot_restart_leaked_claims"]:
+    sys.exit(f"REGRESSION: {out['hot_restart_leaked_claims']} claims "
+             "leaked across the restarts (journal recovery lost state)")
+drain_gate = float(os.environ["PERF_DRAIN_GATE_S"])
+if out["hot_restart_drain_s_max"] > drain_gate:
+    sys.exit(f"REGRESSION: drain window "
+             f"{out['hot_restart_drain_s_max']}s > {drain_gate}s "
+             "(PERF_DRAIN_GATE_S) — shutdown no longer quiesces the "
+             "admission pipeline promptly")
+if out["hot_restart_reconnects"] < out["hot_restart_restarts"]:
+    sys.exit(f"REGRESSION: only {out['hot_restart_reconnects']} client "
+             f"reconnects across {out['hot_restart_restarts']} restarts "
+             "— the phase did not actually exercise the reconnect path")
+EOF
+
+echo ">> scheduler failover phase (HA lease takeover to first allocation under churn)"
+# ISSUE 16 gate: active-standby takeover latency. The floor is the
+# lease expiry wait itself (0.4s lease duration in the bench), so the
+# p50 gate (default 2000ms) is a tripwire against takeover-resync
+# pathology (full resync thrash, fencing livelock), not a latency SLO.
+JAX_PLATFORMS=cpu TPU_DRA_TPUINFO_BACKEND=fake \
+  TPU_DRA_BENCH_FAILOVER_N="${PERF_FAILOVER_N:-5}" \
+  PERF_FAILOVER_P50_GATE_MS="${PERF_FAILOVER_P50_GATE_MS:-2000}" \
+  python - <<'EOF'
+import json
+import os
+import sys
+
+import bench
+
+out = bench.bench_sched_failover()
+print(json.dumps(out))
+if out.get("sched_failover_error"):
+    sys.exit(f"REGRESSION: failover phase error: "
+             f"{out['sched_failover_error']}")
+gate = float(os.environ["PERF_FAILOVER_P50_GATE_MS"])
+if out["sched_failover_to_alloc_p50_ms"] > gate:
+    sys.exit(f"REGRESSION: failover-to-first-allocation p50 "
+             f"{out['sched_failover_to_alloc_p50_ms']}ms > {gate}ms "
+             "(PERF_FAILOVER_P50_GATE_MS) — standby takeover stopped "
+             "resuming allocation promptly after lease expiry")
 EOF
 
 echo ">> CEL compile-cache tripwire tests"

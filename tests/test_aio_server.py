@@ -281,3 +281,166 @@ class TestAdmissionFaultSite:
             assert unprepare(unprepare_req(obj)).claims[uid].error == ""
         finally:
             client.close()
+
+
+class _RestartablePlugin:
+    """A kubelet plugin the test can hot-restart in place (ISSUE 16
+    tentpole (b)): shutdown(drain=True) quiesces admission, flushes the
+    journal barrier and stops the server; the rebuild recovers the
+    prepared-claim set from the same checkpoint/journal dirs and
+    re-binds the same sockets."""
+
+    def __init__(self, tmp_path):
+        self.cluster = FakeCluster()
+        self.backend = FakeBackend(default_fake_chips(8, "v5p",
+                                                      slice_id="hot"))
+        self.tmp = tmp_path
+        self.driver = None
+        self._build()
+
+    def _build(self):
+        state = DeviceState(
+            backend=self.backend,
+            cdi=CDIHandler(str(self.tmp / "cdi"),
+                           driver_root=str(self.tmp / "drv")),
+            checkpoints=CheckpointManager(str(self.tmp / "plugin")),
+            driver_name=TPU_DRIVER_NAME, node_name="node-a")
+        self.driver = TpuDriver(
+            state=state, client=self.cluster,
+            driver_name=TPU_DRIVER_NAME, node_name="node-a",
+            plugin_dir=str(self.tmp / "plugin"),
+            registry_dir=str(self.tmp / "registry"))
+        self.driver.start()
+
+    def restart(self) -> float:
+        drain_s = self.driver.shutdown(drain=True)
+        self._build()
+        return drain_s
+
+    def close(self):
+        self.driver.shutdown()
+
+
+class TestHotRestart:
+    """Plugin restart mid-stream: the RetryingFramedClient masks the
+    socket gap (bounded retry-on-reconnect), the checkpoint journal
+    recovers the prepared set, and the drain/reconnect fault sites
+    degrade as declared."""
+
+    def test_restart_recovers_journal_and_client_masks_gap(self, tmp_path):
+        from tpu_dra.kubeletplugin.server import RetryingFramedClient
+
+        plugin = _RestartablePlugin(tmp_path)
+        client = RetryingFramedClient(plugin.driver.server.fast_socket,
+                                      max_elapsed_s=10.0)
+        try:
+            pre = make_claim(plugin.cluster, ["chip-0"], name="c-pre")
+            uid_pre = pre["metadata"]["uid"]
+            resp = client.prepare(prepare_req(pre))
+            assert resp.claims[uid_pre].error == ""
+
+            drain_s = plugin.restart()
+            assert drain_s < 5.0
+
+            # Journal recovery: the prepared set survived the restart.
+            assert uid_pre in plugin.driver._state.prepared_claim_uids()
+
+            # The SAME client object rides over the dead socket: the
+            # next RPC reconnects under the hood and succeeds.
+            post = make_claim(plugin.cluster, ["chip-1"], name="c-post")
+            uid_post = post["metadata"]["uid"]
+            resp = client.prepare(prepare_req(post))
+            assert resp.claims[uid_post].error == ""
+            assert client.reconnects >= 1
+
+            # Idempotent recovery end-to-end: the pre-restart claim
+            # unprepares cleanly against the rebuilt state.
+            assert client.unprepare(
+                unprepare_req(pre)).claims[uid_pre].error == ""
+            assert client.unprepare(
+                unprepare_req(post)).claims[uid_post].error == ""
+            assert not plugin.driver._state.prepared_claim_uids()
+        finally:
+            client.close()
+            plugin.close()
+
+    def test_restart_mid_batch_zero_failed_rpcs(self, tmp_path):
+        """Concurrent workers churn prepare/unprepare while the plugin
+        restarts mid-batch: every RPC lands (zero failures) and no
+        claim leaks across the restart."""
+        from tpu_dra.kubeletplugin.server import RetryingFramedClient
+
+        plugin = _RestartablePlugin(tmp_path)
+        failures, lock = [], threading.Lock()
+        n_workers, n_iters = 3, 12
+
+        def worker(w):
+            client = RetryingFramedClient(
+                plugin.driver.server.fast_socket, max_elapsed_s=15.0)
+            try:
+                obj = make_claim(plugin.cluster, [f"chip-{w}"],
+                                 name=f"c-w{w}")
+                uid = obj["metadata"]["uid"]
+                for _ in range(n_iters):
+                    for op, req in ((client.prepare, prepare_req(obj)),
+                                    (client.unprepare,
+                                     unprepare_req(obj))):
+                        err = op(req).claims[uid].error
+                        if err and "draining" not in err:
+                            with lock:
+                                failures.append(err)
+            except Exception as e:  # noqa: BLE001 — collected, asserted
+                with lock:
+                    failures.append(repr(e))
+            finally:
+                client.close()
+
+        threads = [threading.Thread(target=worker, args=(w,))
+                   for w in range(n_workers)]
+        try:
+            for t in threads:
+                t.start()
+            plugin.restart()
+            for t in threads:
+                t.join(timeout=30.0)
+            assert not any(t.is_alive() for t in threads)
+            assert failures == []
+            assert not plugin.driver._state.prepared_claim_uids(), \
+                "claims leaked across the hot restart"
+        finally:
+            plugin.close()
+
+    def test_drain_fault_degrades_to_flightrec_dump(self, driver):
+        """prepare.drain armed (R4 exercise): the drain degrades to a
+        flight-recorder dump instead of waiting out in-flight work,
+        and still returns a bounded window."""
+        FAULTS.arm("prepare.drain", Always())
+        try:
+            elapsed = driver._pipeline.drain(timeout_s=5.0)
+            assert elapsed < 1.0
+            assert driver._pipeline.draining
+        finally:
+            FAULTS.reset()
+
+    def test_reconnect_fault_degrades_to_backoff(self, driver):
+        """prepare.reconnect armed (R4 exercise): the first re-dial
+        attempt faults; the client backs off and the next one lands —
+        the RPC still succeeds, one reconnect recorded."""
+        from tpu_dra.kubeletplugin.server import RetryingFramedClient
+
+        client = RetryingFramedClient(driver.server.fast_socket,
+                                      max_elapsed_s=10.0)
+        try:
+            FAULTS.arm("prepare.reconnect", OneShot())
+            try:
+                obj = make_claim(driver.cluster, ["chip-6"])
+                uid = obj["metadata"]["uid"]
+                assert client.prepare(prepare_req(obj)).claims[
+                    uid].error == ""
+            finally:
+                FAULTS.reset()
+            assert client.reconnects == 1
+            assert client.unprepare(
+                unprepare_req(obj)).claims[uid].error == ""
+        finally:
+            client.close()

@@ -35,6 +35,28 @@ def by_kind_name(docs):
     return {(d["kind"], d["metadata"]["name"]): d for d in docs}
 
 
+def san_dns_names(cert_pem: bytes):
+    """DNS entries of the cert's SubjectAlternativeName, via the
+    cryptography package when present, else the openssl CLI (the same
+    fallback pair helmlite's genSelfSignedCert uses)."""
+    try:
+        from cryptography import x509
+    except ImportError:
+        import re
+        import tempfile
+        with tempfile.NamedTemporaryFile(suffix=".pem") as f:
+            f.write(cert_pem)
+            f.flush()
+            proc = subprocess.run(
+                ["openssl", "x509", "-in", f.name, "-noout", "-text"],
+                capture_output=True, text=True, check=True)
+        return re.findall(r"DNS:([^,\s]+)", proc.stdout)
+    cert = x509.load_pem_x509_certificate(cert_pem)
+    san = cert.extensions.get_extension_for_class(
+        x509.SubjectAlternativeName).value
+    return san.get_values_for_type(x509.DNSName)
+
+
 # ---------------------------------------------------------------------------
 # Default render
 # ---------------------------------------------------------------------------
@@ -200,14 +222,9 @@ class TestWebhookTLS:
         assert b"PRIVATE KEY" in key
 
     def test_selfsigned_cert_has_service_san(self):
-        from cryptography import x509
         docs = by_kind_name(render(namespace="ns1"))
         sec = docs[("Secret", "tpu-dra-driver-webhook-tls")]
-        cert = x509.load_pem_x509_certificate(
-            base64.b64decode(sec["data"]["tls.crt"]))
-        san = cert.extensions.get_extension_for_class(
-            x509.SubjectAlternativeName).value
-        dns = san.get_values_for_type(x509.DNSName)
+        dns = san_dns_names(base64.b64decode(sec["data"]["tls.crt"]))
         assert "tpu-dra-driver-webhook.ns1.svc" in dns
         assert "tpu-dra-driver-webhook.ns1.svc.cluster.local" in dns
 

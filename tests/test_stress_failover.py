@@ -185,3 +185,175 @@ class TestDaemonFailover:
             assert heal < self.HEAL_BUDGET_S
         finally:
             runner.stop()
+
+
+class TestSchedulerHAFailover:
+    """ISSUE 16 tentpole (a): active-standby scheduler HA — lease
+    expiry takeover, generation fencing of the deposed leader, and the
+    double-takeover CAS race. Electors are tick-driven on a fake clock
+    so the expiry/takeover sequence is deterministic."""
+
+    LEASE_S = 1.0
+
+    @staticmethod
+    def _mk_sched(cluster):
+        from tpu_dra.simcluster.scheduler import Scheduler
+        sched = Scheduler(cluster, resync_interval=0.05,
+                          gc_sweep_interval=0.2, workers=2)
+        sched.start(standby=True)
+        for inf in sched._informers.values():
+            inf.RELIST_BACKOFF_BASE = 0.01
+        return sched
+
+    @staticmethod
+    def _claim_of(cluster, pod_name):
+        for c in cluster.list(RESOURCECLAIMS, namespace="default"):
+            owner = (c["metadata"].get("annotations") or {}).get(
+                "sim/owner-pod")
+            if owner == pod_name:
+                return c
+        return None
+
+    def _wait_allocated(self, cluster, pod_name, timeout=5.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            c = self._claim_of(cluster, pod_name)
+            if c is not None and (c.get("status") or {}).get("allocation"):
+                return c
+            time.sleep(0.02)
+        return None
+
+    def test_standby_promotes_on_expiry(self):
+        """Leader dies (renews stop); the warm standby waits out the
+        lease, CASes the takeover, resyncs, and resumes allocation —
+        and the deposed incarnation's stamps never land again."""
+        from tpu_dra.infra.leaderelect import (
+            FENCING_ANNOTATION, LeaderElector, install_fencing,
+        )
+        from tpu_dra.testing import make_sched_pod, seed_sched_inventory
+
+        cluster = FakeCluster()
+        install_fencing(cluster)
+        seed_sched_inventory(cluster, nodes=2, chips_per_node=2)
+        clock = [0.0]
+        scheds, electors = [], []
+        try:
+            for ident in ("rep-a", "rep-b"):
+                sched = self._mk_sched(cluster)
+
+                def on_started(gen, s=sched):
+                    s.set_lease_generation(gen)
+                    s.promote()
+
+                electors.append(LeaderElector(
+                    cluster, ident, lease_duration_s=self.LEASE_S,
+                    renew_interval_s=0.25, clock=lambda: clock[0],
+                    on_started_leading=on_started, seed=7))
+                scheds.append(sched)
+
+            electors[0].tick()  # creates the lease: rep-a leads
+            assert electors[0].is_leader and not scheds[0].is_standby
+            electors[1].tick()  # live foreign leader: stays standby
+            assert not electors[1].is_leader and scheds[1].is_standby
+
+            make_sched_pod(cluster, "pod-pre")
+            claim = self._wait_allocated(cluster, "pod-pre")
+            assert claim is not None, "leader never allocated"
+            assert claim["metadata"]["annotations"][
+                FENCING_ANNOTATION] == "1"
+
+            # rep-a dies cold: no further renews, no lease release.
+            # Standby ticks inside the window stay standby; the tick
+            # past expiry takes over.
+            clock[0] = self.LEASE_S * 0.5
+            electors[1].tick()
+            assert not electors[1].is_leader
+            clock[0] = self.LEASE_S + 0.1
+            electors[1].tick()
+            assert electors[1].is_leader and not scheds[1].is_standby
+            assert electors[1].generation == 2
+
+            make_sched_pod(cluster, "pod-post")
+            claim = self._wait_allocated(cluster, "pod-post")
+            assert claim is not None, "standby never resumed allocation"
+            # Both incarnations' workers saw the pod; only the new
+            # generation's commit may land (rep-a is fenced).
+            assert claim["metadata"]["annotations"][
+                FENCING_ANNOTATION] == "2"
+        finally:
+            for sched in scheds:
+                sched.stop()
+
+    def test_deposed_fenced_write_refused(self):
+        """The fencing reactor refuses a claim-status write stamped
+        with a stale generation, passes the current one, and ignores
+        unstamped writes (non-election clusters)."""
+        from tpu_dra.infra.leaderelect import (
+            FENCING_ANNOTATION, LEASE_NAME, LEASE_NAMESPACE,
+            install_fencing,
+        )
+        from tpu_dra.k8s import LEASES
+        from tpu_dra.k8s.client import ConflictError
+        from tpu_dra.k8s.fake import new_lease
+
+        cluster = FakeCluster()
+        install_fencing(cluster)
+        lease = new_lease(LEASE_NAME, LEASE_NAMESPACE, "rep-b", 1.0, 0.0)
+        lease["spec"]["leaseTransitions"] = 2
+        cluster.create(LEASES, lease)
+        claim = cluster.create(RESOURCECLAIMS, {
+            "apiVersion": "resource.k8s.io/v1", "kind": "ResourceClaim",
+            "metadata": {"name": "c1", "namespace": "default"},
+            "spec": {}})
+
+        stale = dict(claim, metadata=dict(
+            claim["metadata"], annotations={FENCING_ANNOTATION: "1"}))
+        with pytest.raises(ConflictError, match="fenced write refused"):
+            cluster.update(RESOURCECLAIMS, stale, "default")
+
+        current = dict(claim, metadata=dict(
+            claim["metadata"], annotations={FENCING_ANNOTATION: "2"}))
+        updated = cluster.update(RESOURCECLAIMS, current, "default")
+
+        unstamped = dict(updated, metadata=dict(
+            updated["metadata"], annotations={}))
+        cluster.update(RESOURCECLAIMS, unstamped, "default")
+
+    def test_double_takeover_race_single_winner(self):
+        """Two standbys race the takeover CAS on one expired lease:
+        exactly one wins, the generation bumps exactly once, and the
+        loser stays standby (the apiserver RV conflict settles it)."""
+        from tpu_dra.infra.leaderelect import (
+            LEASE_NAME, LEASE_NAMESPACE, LeaderElector,
+        )
+        from tpu_dra.k8s import LEASES
+        from tpu_dra.k8s.fake import new_lease
+
+        for round_i in range(10):
+            cluster = FakeCluster()
+            cluster.create(LEASES, new_lease(
+                LEASE_NAME, LEASE_NAMESPACE, "dead-leader", 0.5, 0.0))
+            clock = [100.0]  # far past expiry
+            a = LeaderElector(cluster, "rep-a", lease_duration_s=0.5,
+                              clock=lambda: clock[0], seed=round_i)
+            b = LeaderElector(cluster, "rep-b", lease_duration_s=0.5,
+                              clock=lambda: clock[0], seed=round_i + 1)
+            barrier = threading.Barrier(2)
+
+            def race(el):
+                barrier.wait()
+                el.tick()
+
+            threads = [threading.Thread(target=race, args=(el,))
+                       for el in (a, b)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            leaders = [el for el in (a, b) if el.is_leader]
+            assert len(leaders) == 1, (
+                f"round {round_i}: {len(leaders)} leaders after the race")
+            lease = cluster.get(LEASES, LEASE_NAME, LEASE_NAMESPACE)
+            assert lease["spec"]["leaseTransitions"] == 2
+            assert lease["spec"]["holderIdentity"] == \
+                leaders[0].identity

@@ -520,6 +520,153 @@ class EvictChurnScenario:
 
 
 # ---------------------------------------------------------------------------
+# takeover-resync: deposed-leader commits vs. HA takeover (SURVEY §22)
+# ---------------------------------------------------------------------------
+
+class TakeoverScenario:
+    """Deposed-leader-commit vs. takeover-resync, against the REAL
+    fencing reactor on a real FakeCluster: an old scheduler incarnation
+    (generation 1, device picks baked from a pre-takeover snapshot —
+    the stale standby view) commits claim allocations while the new
+    incarnation bumps the lease (leaseTransitions 1 -> 2), re-lists
+    cluster truth (_full_resync's rebuild), and re-drives whatever is
+    still unallocated under generation 2. The explorer owns the
+    interleaving of every cluster op; under ALL of them:
+
+    - never two acting leaders' commits both land for one claim (a
+      deposed write arriving after the bump is refused by the fencing
+      reactor; one landing anyway would also surface as the old
+      leader's stale device pick double-allocating a chip the new
+      leader handed out);
+    - no device double-allocation across the takeover;
+    - the new leader is never fenced (its stamp IS the current
+      generation) and leaks no claim: every claim is allocated at
+      quiesce, by exactly one incarnation;
+    - the rebuilt index matches cluster truth."""
+
+    name = "takeover-resync"
+
+    def build(self, sched) -> Dict:
+        from tpu_dra.infra.leaderelect import (
+            FENCING_ANNOTATION, LEASE_NAME, LEASE_NAMESPACE,
+            install_fencing,
+        )
+        from tpu_dra.k8s import LEASES, RESOURCECLAIMS
+        from tpu_dra.k8s.client import ConflictError
+        from tpu_dra.k8s.fake import FakeCluster, new_lease
+        from tpu_dra.simcluster.scheduler import AllocationIndex
+
+        cluster = FakeCluster()  # witnessed: locks created under install
+        install_fencing(cluster)
+        # Fixed clock: the reactor reads only leaseTransitions, so a
+        # frozen renewTime keeps the scenario schedule-deterministic.
+        cluster.create(LEASES, new_lease(
+            LEASE_NAME, LEASE_NAMESPACE, "old", 1.0, 0.0))
+        for key in ("pod-a", "pod-b"):
+            cluster.create(RESOURCECLAIMS, {
+                "apiVersion": "resource.k8s.io/v1",
+                "kind": "ResourceClaim",
+                "metadata": {"name": key, "namespace": "default"},
+                "spec": {"devices": {"requests": [{"name": "tpu"}]}},
+            })
+        index = AllocationIndex()
+        log: Dict[str, List[str]] = {
+            "old_landed": [], "old_refused": [],
+            "new_landed": [], "new_refused": []}
+        devices = ["chip-0", "chip-1"]
+
+        def commit(key: str, device: str, gen: int,
+                   landed: List[str], refused: List[str]) -> None:
+            obj = cluster.get(RESOURCECLAIMS, key, "default")
+            obj["metadata"].setdefault("annotations", {})[
+                FENCING_ANNOTATION] = str(gen)
+            obj["status"] = {"allocation": {"devices": {"results": [
+                {"request": "tpu", "driver": _DRIVER, "pool": _POOL,
+                 "device": device}], "config": []}}}
+            try:
+                updated = cluster.update(RESOURCECLAIMS, obj, "default")
+            except ConflictError:
+                refused.append(key)
+                return
+            landed.append(key)
+            if gen == 2:  # only the new incarnation maintains the index
+                index.apply(updated)
+
+        def old_leader() -> None:
+            # Device picks frozen from the pre-takeover free set: the
+            # deposed leader acting on a world that moved without it.
+            commit("pod-a", devices[0], 1,
+                   log["old_landed"], log["old_refused"])
+            commit("pod-b", devices[1], 1,
+                   log["old_landed"], log["old_refused"])
+
+        def takeover() -> None:
+            # Bump-then-resync, the elector's _takeover + promote() in
+            # miniature: after the CAS lands, every pre-bump commit is
+            # visible to the re-list and every post-bump deposed write
+            # is refused, so the rebuilt view is linearized.
+            lease = cluster.get(LEASES, LEASE_NAME, LEASE_NAMESPACE)
+            lease["spec"]["holderIdentity"] = "new"
+            lease["spec"]["leaseTransitions"] = 2
+            cluster.update(LEASES, lease, LEASE_NAMESPACE)
+            claims = cluster.list(RESOURCECLAIMS, namespace="default")
+            taken, pending = set(), []
+            for c in claims:
+                entries = [r.get("device") for r in
+                           ((c.get("status") or {}).get("allocation")
+                            or {}).get("devices", {}).get("results", [])]
+                if entries:
+                    taken.update(entries)
+                    index.apply(c)
+                else:
+                    pending.append(c["metadata"]["name"])
+            free = [d for d in devices if d not in taken]
+            # Reversed re-drive order: if a deposed write lands where
+            # it must not, its stale pick collides with a chip handed
+            # out here instead of silently shadowing the same one.
+            for key in sorted(pending, reverse=True):
+                commit(key, free.pop(0), 2,
+                       log["new_landed"], log["new_refused"])
+
+        sched.spawn("old-leader", old_leader)
+        sched.spawn("takeover", takeover)
+        return {"cluster": cluster, "index": index, "log": log,
+                "trace_snap": _trace_snapshot()}
+
+    def check(self, ctx) -> List[str]:
+        from tpu_dra.k8s import RESOURCECLAIMS
+        from tpu_dra.simcluster.chaos import chip_conflicts
+
+        cluster, index, log = ctx["cluster"], ctx["index"], ctx["log"]
+        violations: List[str] = []
+        claims = cluster.list(RESOURCECLAIMS, namespace="default")
+        violations.extend(chip_conflicts(claims))
+        violations.extend(index.diff_against(claims))
+        for c in claims:
+            name = c["metadata"]["name"]
+            results = ((c.get("status") or {}).get("allocation")
+                       or {}).get("devices", {}).get("results", [])
+            if not results:
+                violations.append(
+                    f"claim {name} leaked across takeover "
+                    f"(unallocated at quiesce)")
+        both = set(log["old_landed"]) & set(log["new_landed"])
+        if both:
+            violations.append(
+                f"two acting leaders' commits both landed for "
+                f"{sorted(both)}")
+        if log["new_refused"]:
+            violations.append(
+                f"acting leader fenced on its own generation: "
+                f"{log['new_refused']}")
+        violations.extend(_open_span_violations(ctx["trace_snap"]))
+        return violations
+
+    def cleanup(self, ctx) -> None:
+        pass
+
+
+# ---------------------------------------------------------------------------
 # batch-prepare: concurrent DeviceState batches under controlled scheduling
 # ---------------------------------------------------------------------------
 
@@ -949,6 +1096,7 @@ INTERLEAVING_SCENARIOS = {
     SchedChurnScenario.name: SchedChurnScenario,
     BatchPrepareScenario.name: BatchPrepareScenario,
     EvictChurnScenario.name: EvictChurnScenario,
+    TakeoverScenario.name: TakeoverScenario,
     RacyIndexScenario.name: RacyIndexScenario,
     StaleReadProbeScenario.name: StaleReadProbeScenario,
     StaleReadFixedScenario.name: StaleReadFixedScenario,
@@ -959,7 +1107,8 @@ INTERLEAVING_SCENARIOS = {
 # tests, not the gate; stale-read-fixed keeps the REVALIDATES protocol
 # dynamically proven).
 GATE_SCENARIOS = (SchedChurnScenario.name, BatchPrepareScenario.name,
-                  EvictChurnScenario.name, StaleReadFixedScenario.name)
+                  EvictChurnScenario.name, StaleReadFixedScenario.name,
+                  TakeoverScenario.name)
 
 CRASH_SCENARIOS = {
     BatchPrepareCrashScenario.name: BatchPrepareCrashScenario,

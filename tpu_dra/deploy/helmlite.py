@@ -19,7 +19,8 @@ template subset the chart in deployments/helm/tpu-dra-driver uses:
   or, empty, hasKey, trunc, trimSuffix, trimPrefix, lower, upper, replace,
   required, ternary, dict, list, len, contains, hasPrefix, hasSuffix,
   add, sub, mul, append, join, keys, toString, int, fail,
-  genSelfSignedCert (real PEM pair via the cryptography package)
+  genSelfSignedCert (real PEM pair via the cryptography package, with
+  an ``openssl req -x509`` CLI fallback on hosts without it)
 
 Truthiness follows Go templates: false, 0, "", nil, empty list/map are
 falsy. Rendering is strict: unknown functions and malformed actions raise
@@ -485,6 +486,60 @@ def _go_sprintf(fmt: str, args: Tuple[Any, ...]) -> str:
     return out
 
 
+def _gen_self_signed_cert_openssl(cn: str, ips: List[str],
+                                  dns_names: List[str],
+                                  days: int) -> Dict[str, str]:
+    """`openssl req -x509` fallback for hosts without the cryptography
+    package.  Same contract as the primary path: self-signed CA cert
+    (BasicConstraints critical CA:TRUE, EKU serverAuth, SAN covering the
+    CN plus extra DNS/IP entries) and an unencrypted RSA-2048 key, both
+    PEM.  The key comes out PKCS#8 ("BEGIN PRIVATE KEY") rather than
+    TraditionalOpenSSL, which every PEM consumer in the charts accepts."""
+    import os
+    import subprocess
+    import tempfile
+
+    sans = [f"DNS.1 = {cn}"]
+    for d in dns_names or []:
+        if d and d != cn:
+            sans.append(f"DNS.{len(sans) + 1} = {d}")
+    n_ip = 0
+    for ip in ips or []:
+        if ip:
+            n_ip += 1
+            sans.append(f"IP.{n_ip} = {ip}")
+    conf = (
+        "[req]\n"
+        "distinguished_name = dn\n"
+        "prompt = no\n"
+        "[dn]\n"
+        f"CN = {cn}\n"
+        "[v3_ext]\n"
+        "basicConstraints = critical,CA:TRUE\n"
+        "extendedKeyUsage = serverAuth\n"
+        "subjectAltName = @alt\n"
+        "[alt]\n" + "\n".join(sans) + "\n")
+    with tempfile.TemporaryDirectory(prefix="helmlite-cert-") as tmp:
+        cfg = os.path.join(tmp, "req.cnf")
+        crt = os.path.join(tmp, "tls.crt")
+        key = os.path.join(tmp, "tls.key")
+        with open(cfg, "w") as f:
+            f.write(conf)
+        proc = subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+             "-sha256", "-days", str(int(days)), "-keyout", key,
+             "-out", crt, "-config", cfg, "-extensions", "v3_ext"],
+            capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise TemplateError(
+                f"genSelfSignedCert: openssl fallback failed: {proc.stderr}")
+        with open(crt) as f:
+            cert_pem = f.read()
+        with open(key) as f:
+            key_pem = f.read()
+    return {"Cert": cert_pem, "Key": key_pem}
+
+
 def _gen_self_signed_cert(cn: str, ips: List[str], dns_names: List[str],
                           days: int) -> Dict[str, str]:
     """helm/sprig genSelfSignedCert analog: returns {Cert, Key} PEM pair.
@@ -493,10 +548,13 @@ def _gen_self_signed_cert(cn: str, ips: List[str], dns_names: List[str],
     import datetime
     import ipaddress
 
-    from cryptography import x509
-    from cryptography.hazmat.primitives import hashes, serialization
-    from cryptography.hazmat.primitives.asymmetric import rsa
-    from cryptography.x509.oid import ExtendedKeyUsageOID, NameOID
+    try:
+        from cryptography import x509
+        from cryptography.hazmat.primitives import hashes, serialization
+        from cryptography.hazmat.primitives.asymmetric import rsa
+        from cryptography.x509.oid import ExtendedKeyUsageOID, NameOID
+    except ImportError:
+        return _gen_self_signed_cert_openssl(cn, ips, dns_names, days)
 
     key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
     name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, cn)])

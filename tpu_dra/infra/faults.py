@@ -215,6 +215,30 @@ SITES: Dict[str, str] = {
         "span pipeline's degradation contract — the span must drop "
         "counted (trace marked incomplete), the traced operation must "
         "never see the failure, and quiesce invariants must still hold",
+    "sched.lease_renew":
+        "the leader's lease-renew write fails (apiserver blip, CAS "
+        "conflict against a racing takeover); threatens: split brain — "
+        "a leader that cannot renew past the lease duration must step "
+        "down, and its late claim-status commits must be refused by "
+        "the fencing generation, never land next to the new leader's",
+    "sched.takeover_resync":
+        "the standby's takeover index rebuild fails mid-promotion "
+        "(listing refused, shard resync raced); threatens: the new "
+        "leader allocating against a stale AllocationIndex — the "
+        "takeover must re-drive the guarded resync before commits, "
+        "never double-allocate a device the old leader placed",
+    "prepare.drain":
+        "the hot-restart drain window fails (in-flight RPC wedged past "
+        "the bound, drain wait interrupted); threatens: the "
+        "zero-failed-RPC restart contract — shutdown must dump "
+        "flight-recorder evidence and proceed, leaving clients to mask "
+        "the gap by reconnect-retry against the restarted plugin",
+    "prepare.reconnect":
+        "a client's reconnect dial fails while masking the plugin "
+        "restart's socket gap (socket not yet re-listening, transient "
+        "ECONNREFUSED); threatens: RPC loss across the restart — the "
+        "masking retry must back off and redial within its bound, "
+        "never surface the gap to the caller as a failed RPC",
 }
 
 # Declared degradations (drflow R15, SURVEY §20): sites whose injected
@@ -231,6 +255,21 @@ DEGRADATIONS: Dict[str, str] = {
     # degrades the domain but the daemon's sanctioned reaction is a
     # re-offered retry — two valid paths, no single declared one.)
     "sched.shard_apply": "mark_dirty",
+    # A renew that keeps failing past the lease duration has ONE legal
+    # exit: step down (fencing refuses the late writes either way —
+    # stepping down just stops throwing work at a lost lease).
+    "sched.lease_renew": "step_down",
+    # A faulted takeover rebuild re-drives the guarded resync through
+    # the queue (scheduler.request_resync) rather than promoting onto
+    # a dirty index.
+    "sched.takeover_resync": "request_resync",
+    # A drain that cannot complete dumps the flight recorder (the
+    # wedged in-flight RPC is named by its open span) and shutdown
+    # proceeds; clients mask the gap by reconnect-retry.
+    "prepare.drain": "dump_flight_recorder",
+    # A failed reconnect dial stays on the bounded backoff-redial path
+    # (RetryingFramedClient._reconnect_backoff) — masking, not failing.
+    "prepare.reconnect": "backoff",
 }
 
 

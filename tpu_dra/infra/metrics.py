@@ -289,6 +289,14 @@ METRICS_CATALOG: Dict[str, str] = {
     "tpu_dra_sched_snapshot_conflicts_total": "infra/metrics.py",
     "tpu_dra_sched_shard_resyncs_total": "infra/metrics.py",
     "tpu_dra_sched_evictions_total": "infra/metrics.py",
+    # infra/metrics.py — HA control plane (SURVEY §22): leader-lease
+    # state + transition volume; kubeletplugin — the hot-restart drain
+    # window and the client-side reconnect masking counter the
+    # zero-failed-RPC restart gate reads
+    "tpu_dra_sched_leader": "infra/metrics.py",
+    "tpu_dra_sched_lease_transitions_total": "infra/metrics.py",
+    "tpu_dra_rpc_drain_seconds": "kubeletplugin/pipeline.py",
+    "tpu_dra_rpc_reconnects_total": "kubeletplugin/server.py",
     "tpu_dra_workqueue_depth": "infra/metrics.py",
     "tpu_dra_workqueue_busy_workers": "infra/metrics.py",
     "tpu_dra_topo_allocations": "infra/metrics.py",
@@ -440,6 +448,23 @@ SCHED_EVICTIONS = DefaultRegistry.counter(
     "by reason (device_lost|node_lost); every eviction releases through "
     "the claim deallocation write + mutation-cache pipeline and "
     "re-drives the owner pod")
+# -- HA control plane (active-standby leases + takeover, SURVEY §22):
+# defined here rather than in infra/leaderelect.py because the chaos
+# matrix, bench failover phase and perf gates all read them
+# cross-layer — same canonical-home rule as the scheduler instruments
+# above. ---------------------------------------------------------------------
+
+SCHED_LEADER = DefaultRegistry.gauge(
+    "tpu_dra_sched_leader",
+    "1 while this elector holds the scheduler lease, 0 while standby or "
+    "after stepping down/deposal, labeled by identity — the failover "
+    "dashboards' who-is-acting signal")
+SCHED_LEASE_TRANSITIONS = DefaultRegistry.counter(
+    "tpu_dra_sched_lease_transitions_total",
+    "lease acquisitions (first grab + every takeover) observed by the "
+    "electors of this process; each one bumps the fencing generation "
+    "that deposed-leader claim-status writes are refused against")
+
 WORKQUEUE_DEPTH = DefaultRegistry.gauge(
     "tpu_dra_workqueue_depth",
     "items queued (delay heap + per-key deferred) in a named WorkQueue, "

@@ -12,7 +12,7 @@ from tpu_dra.k8s.client import (  # noqa: F401
     RetryingApiClient, label_selector_matches,
 )
 from tpu_dra.k8s.resources import (  # noqa: F401
-    PODS, NODES, DAEMONSETS, DEPLOYMENTS, RESOURCECLAIMS,
+    PODS, NODES, DAEMONSETS, DEPLOYMENTS, LEASES, RESOURCECLAIMS,
     RESOURCECLAIMTEMPLATES, RESOURCESLICES, DEVICECLASSES, COMPUTEDOMAINS,
     new_object_meta,
 )

@@ -26,6 +26,11 @@ DEVICECLASSES = GVR("resource.k8s.io", "v1", "deviceclasses", namespaced=False)
 
 COMPUTEDOMAINS = GVR("resource.tpu.dev", "v1beta1", "computedomains")
 
+# coordination.k8s.io Leases back the HA scheduler's leader election
+# (active-standby failover, SURVEY §22): the elector CASes holder/renew
+# fields under the apiserver's resourceVersion conflict semantics.
+LEASES = GVR("coordination.k8s.io", "v1", "leases")
+
 # Kinds the driver itself never reads but the deployment manifests carry;
 # registered so the fake apiserver can store a full chart install
 # (simcluster tier).

@@ -28,7 +28,7 @@ import threading
 import time
 from typing import Dict, Iterable, List
 
-from tpu_dra.infra.faults import FAULTS
+from tpu_dra.infra.faults import FAULTS, FaultInjected
 from tpu_dra.infra.metrics import DefaultRegistry
 from tpu_dra.infra.trace import dump_flight_recorder
 
@@ -36,6 +36,13 @@ INFLIGHT_RPCS = DefaultRegistry.gauge(
     "tpu_dra_prepare_inflight_rpcs",
     "prepare/unprepare RPCs currently admitted into the pipelined "
     "server (bounded by the in-flight window)")
+
+RPC_DRAIN_SECONDS = DefaultRegistry.histogram(
+    "tpu_dra_rpc_drain_seconds",
+    "time the hot-restart drain window spent waiting for in-flight "
+    "RPCs to finish (SURVEY §22: the shutdown half of the "
+    "zero-failed-RPC restart contract)",
+    buckets=(0.001, 0.005, 0.02, 0.1, 0.5, 1.0, 2.0, 5.0, 10.0, 30.0))
 
 
 class _Ticket:
@@ -54,6 +61,15 @@ class PipelineTimeout(TimeoutError):
     pass
 
 
+class PipelineDraining(RuntimeError):
+    """Raised at admission while the plugin is draining for a hot
+    restart. Deliberately NOT a TimeoutError/FaultInjected: the driver
+    maps those to per-claim errors, but a draining plugin must fail the
+    RPC at the transport (METHOD_ERROR / gRPC error) so the client's
+    retry-on-reconnect masks the restart — the zero-failed-RPC
+    contract (SURVEY §22)."""
+
+
 class RpcPipeline:
     # Fail-fast bound on queueing (admission + ordering): a wedged
     # predecessor RPC must surface as THIS RPC's error for kubelet to
@@ -69,6 +85,11 @@ class RpcPipeline:
         # uid -> the gate of the LAST admitted RPC touching it.
         self._last_gate: Dict[str, threading.Event] = {}
         self._inflight = 0
+        # Hot-restart drain: once set, admit() refuses new RPCs while
+        # drain() waits (on _cv, notified by done()) for the admitted
+        # ones to finish.
+        self._draining = threading.Event()
+        self._cv = threading.Condition(self._gates_lock)
 
     def admit(self, uids: Iterable[str]) -> _Ticket:
         """Block for a window slot (bounded), then register this RPC's
@@ -76,6 +97,13 @@ class RpcPipeline:
         overlapping claim sets. Raises PipelineTimeout when the window
         never frees — the caller fails the RPC."""
         unique = list(dict.fromkeys(uids))
+        if self._draining.is_set():
+            # Refused BEFORE any slot/gate exists to leak. Propagates
+            # past the driver's per-claim error mapping to the
+            # transport, where the retrying client waits out the
+            # restart.
+            raise PipelineDraining(
+                "plugin draining for hot restart; retry after reconnect")
         # Injection site for the async front-end's admission path
         # (SURVEY §21): an admission refusal must fail THIS RPC with a
         # per-claim error (kubelet retries) before any window slot or
@@ -139,4 +167,44 @@ class RpcPipeline:
                     del self._last_gate[u]
             self._inflight -= 1
             INFLIGHT_RPCS.set(self._inflight)
+            if self._inflight == 0:
+                self._cv.notify_all()  # a drain may be waiting
         self._window.release()
+
+    def drain(self, timeout_s: float = DEFAULT_TIMEOUT_S) -> float:
+        """Stop admitting and wait (bounded) for every in-flight RPC to
+        finish — the shutdown half of the hot-restart contract: work
+        past admission completes and commits (the journal barrier runs
+        after this), work not yet admitted is refused for the client to
+        retry against the next incarnation. Returns the seconds spent
+        waiting; observed into ``tpu_dra_rpc_drain_seconds``. A drain
+        that times out with RPCs still in flight dumps the flight
+        recorder (the evidence names the stuck stage) and returns — the
+        journal + idempotent prepare make the cut-off recoverable."""
+        self._draining.set()
+        t0 = time.perf_counter()
+        try:
+            # Injection site: the drain window itself wedges (an
+            # in-flight RPC never completes). Declared degradation:
+            # dump_flight_recorder — evidence out before the process
+            # goes down.
+            FAULTS.check("prepare.drain")
+            deadline = t0 + timeout_s
+            with self._cv:
+                while self._inflight > 0:
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0.0:
+                        break
+                    self._cv.wait(timeout=remaining)
+                stuck = self._inflight
+            if stuck:
+                dump_flight_recorder("drain-timeout", min_interval_s=60.0)
+        except FaultInjected:
+            dump_flight_recorder("drain-faulted", min_interval_s=60.0)
+        elapsed = time.perf_counter() - t0
+        RPC_DRAIN_SECONDS.observe(elapsed)
+        return elapsed
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()

@@ -38,6 +38,8 @@ from typing import Callable, Dict, List, Optional
 
 import grpc
 
+from tpu_dra.infra.faults import FAULTS, FaultInjected
+from tpu_dra.infra.metrics import DefaultRegistry
 from tpu_dra.kubeletplugin import aio_server
 from tpu_dra.kubeletplugin.aio_server import (
     FRAME_HEADER, METHOD_ERROR, METHOD_PING, METHOD_PREPARE,
@@ -360,6 +362,96 @@ def framed_stubs(fast_socket: str, timeout_s: float = 30.0):
     ``client.close()`` when done."""
     client = FramedClient(fast_socket, timeout_s=timeout_s)
     return client, client.prepare, client.unprepare
+
+
+RPC_RECONNECTS = DefaultRegistry.counter(
+    "tpu_dra_rpc_reconnects_total",
+    "framed-RPC client reconnect attempts while masking a plugin "
+    "restart (SURVEY §22: each one is a socket gap the retry loop "
+    "absorbed instead of failing the RPC)")
+
+
+class RetryingFramedClient:
+    """FramedClient wrapper that masks a plugin hot restart.
+
+    During the restart window a caller sees three failure shapes: a
+    ``PipelineDraining`` refusal surfaced as a framed METHOD_ERROR
+    (old incarnation stopping admission), a socket error (socket
+    unlinked / connection reset between incarnations), or a connect
+    refusal (new incarnation not listening yet). All three are
+    retried against a fresh connection with exponential backoff,
+    bounded by a wall-clock deadline — the zero-failed-RPC half of
+    the hot-upgrade contract. Safe because prepare/unprepare are
+    idempotent on the server (checkpoint journal replays/dedupes a
+    batch committed just before the cut).
+
+    Like FramedClient: NOT thread-safe, one per worker thread."""
+
+    def __init__(self, fast_socket: str, timeout_s: float = 30.0,
+                 max_elapsed_s: float = 30.0, backoff_s: float = 0.05,
+                 max_backoff_s: float = 1.0):
+        self._fast_socket = fast_socket
+        self._timeout_s = timeout_s
+        self._max_elapsed_s = max_elapsed_s
+        self._backoff_s = backoff_s
+        self._max_backoff_s = max_backoff_s
+        self._client: Optional[FramedClient] = None
+        self.reconnects = 0
+
+    def _ensure(self) -> FramedClient:
+        if self._client is None:
+            # Injection site: the reconnect dial itself fails (new
+            # incarnation still binding). Declared degradation:
+            # backoff — the retry loop sleeps and redials.
+            FAULTS.check("prepare.reconnect", socket=self._fast_socket)
+            self._client = FramedClient(self._fast_socket,
+                                        timeout_s=self._timeout_s)
+        return self._client
+
+    @staticmethod
+    def _retryable(e: Exception) -> bool:
+        if isinstance(e, (OSError, ConnectionError, FaultInjected)):
+            return True
+        # METHOD_ERROR carries the server exception's text: only the
+        # draining refusal is a restart-window artifact; any other
+        # server error is a real failure the caller must see.
+        return isinstance(e, FramedRpcError) and "draining" in str(e)
+
+    def _reconnect_backoff(self, delay: float) -> None:
+        if self._client is not None:
+            self._client.close()
+            self._client = None
+        self.reconnects += 1
+        RPC_RECONNECTS.inc()
+        time.sleep(delay)
+
+    def _call(self, fn_name: str, *args):
+        deadline = time.monotonic() + self._max_elapsed_s
+        delay = self._backoff_s
+        while True:
+            try:
+                return getattr(self._ensure(), fn_name)(*args)
+            except (FramedRpcError, FaultInjected, OSError) as e:
+                if not self._retryable(e) or time.monotonic() >= deadline:
+                    raise
+                self._reconnect_backoff(delay)
+                delay = min(delay * 2.0, self._max_backoff_s)
+
+    def prepare(self, request: "dra.NodePrepareResourcesRequest"
+                ) -> "dra.NodePrepareResourcesResponse":
+        return self._call("prepare", request)
+
+    def unprepare(self, request: "dra.NodeUnprepareResourcesRequest"
+                  ) -> "dra.NodeUnprepareResourcesResponse":
+        return self._call("unprepare", request)
+
+    def ping(self) -> bool:
+        return self._call("ping")
+
+    def close(self) -> None:
+        if self._client is not None:
+            self._client.close()
+            self._client = None
 
 
 class DRAPluginServer:

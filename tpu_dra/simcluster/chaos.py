@@ -687,8 +687,6 @@ class SchedulerChaosHarness:
 
     def __init__(self, seed: int, *, nodes: int = 4, chips_per_node: int = 2,
                  workers: int = 4):
-        from tpu_dra.simcluster.scheduler import Scheduler
-
         # Witness the scheduler's lock population (informer RLocks,
         # allocation-index lock, pending-set lock, rate-limiter locks):
         # quiesce asserts the acquisition-order graph stayed acyclic.
@@ -718,11 +716,7 @@ class SchedulerChaosHarness:
             # workers=4: the walk exercises the multi-worker pool — the
             # per-key serialization and optimistic snapshot-commit
             # disciplines are chaos invariants, not just bench wins.
-            self.sched = Scheduler(self.client, resync_interval=0.05,
-                                   gc_sweep_interval=0.2, workers=workers)
-            self.sched.start()
-            for inf in self.sched._informers.values():
-                inf.RELIST_BACKOFF_BASE = 0.01  # keep the chaos tier fast
+            self._start_scheduler(workers)
             self.live: Dict[str, None] = {}
             self._pod_seq = 0
         except BaseException:
@@ -738,6 +732,16 @@ class SchedulerChaosHarness:
         from tpu_dra.testing import seed_sched_inventory
         seed_sched_inventory(self.cluster, nodes=self.nodes,
                              chips_per_node=self.chips)
+
+    def _start_scheduler(self, workers: int) -> None:
+        """Seam the HA walk overrides to run a replicated pair behind
+        leader election instead of one always-acting scheduler."""
+        from tpu_dra.simcluster.scheduler import Scheduler
+        self.sched = Scheduler(self.client, resync_interval=0.05,
+                               gc_sweep_interval=0.2, workers=workers)
+        self.sched.start()
+        for inf in self.sched._informers.values():
+            inf.RELIST_BACKOFF_BASE = 0.01  # keep the chaos tier fast
 
     # -- walk ops -----------------------------------------------------------
 
@@ -899,9 +903,13 @@ class SchedulerChaosHarness:
         # gate cross-validates.
         v.extend(informer_mod.SHADOW.violations_since(self._shadow_snap))
 
+    def _stop_scheduler(self) -> None:
+        """Seam paired with _start_scheduler (HA walk stops a pair)."""
+        self.sched.stop()
+
     def close(self) -> None:
         try:
-            self.sched.stop()
+            self._stop_scheduler()
         finally:
             informer_mod.SHADOW.export()
             informer_mod.SHADOW.restore(self._shadow_prev)
@@ -913,6 +921,173 @@ class SchedulerChaosHarness:
 def run_sched_schedule(seed: int, n_events: int = 60) -> ChaosReport:
     """One seeded scheduler-churn walk to quiesce."""
     return SchedulerChaosHarness(seed).run(n_events)
+
+
+# ---------------------------------------------------------------------------
+# HA leader-kill walk (SURVEY §22)
+# ---------------------------------------------------------------------------
+
+# The election/takeover sites the leader-kill walk re-arms on top of the
+# scheduler set: renew failures depose leaders mid-churn, takeover-resync
+# faults force the promote degradation (queued re-resync, dirty shards
+# refusing commits).
+HA_CHAOS_SITES = ("sched.lease_renew", "sched.takeover_resync")
+
+
+class LeaderKillChaosHarness(SchedulerChaosHarness):
+    """The scheduler walk replicated (SURVEY §22): two Scheduler
+    replicas behind LeaderElectors over one fenced Lease, plus walk ops
+    that kill the acting leader cold (no lease release — the standby
+    must wait out expiry, CAS the takeover, resync, resume) and kill/
+    revive nodes so takeovers race pod churn AND eviction. Each kill
+    refills the slot with a fresh standby under a NEW identity, so
+    every kill is a genuine expiry-takeover, and the walk keeps a
+    2-replica pool throughout. Invariants on top of the base set:
+    at most one acting leader at quiesce, and — via the fencing
+    reactor — no deposed leader's late commit landing (it would
+    surface as double allocation / index divergence)."""
+
+    REARM_SITES = SCHED_CHAOS_SITES + HA_CHAOS_SITES
+    LEASE_DURATION_S = 0.3
+
+    def _start_scheduler(self, workers: int) -> None:
+        from tpu_dra.infra.leaderelect import install_fencing
+        install_fencing(self.cluster)
+        self._ha_workers = workers
+        self._incarnation = 0
+        self._replicas: List = [None, None]
+        self._electors: List = [None, None]
+        self.dead_nodes: Dict[str, Dict] = {}
+        self.leader_kills = 0
+        for slot in range(2):
+            self._spawn_replica(slot)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if any(s is not None and not s.is_standby
+                   for s in self._replicas):
+                return  # steady state: an acting leader exists
+            time.sleep(0.005)
+        raise RuntimeError("no replica became acting leader at startup")
+
+    @property
+    def sched(self):
+        """The acting replica (the base walk's invariants read index
+        state through this); mid-takeover, whichever replica exists."""
+        for s in self._replicas:
+            if s is not None and not s.is_standby:
+                return s
+        return next(s for s in self._replicas if s is not None)
+
+    def _spawn_replica(self, slot: int) -> None:
+        from tpu_dra.infra.leaderelect import LeaderElector
+        from tpu_dra.simcluster.scheduler import Scheduler
+        sched = Scheduler(self.client, resync_interval=0.05,
+                          gc_sweep_interval=0.2, workers=self._ha_workers)
+        sched.start(standby=True)
+        for inf in sched._informers.values():
+            inf.RELIST_BACKOFF_BASE = 0.01
+        self._incarnation += 1
+        ident = f"rep{slot}-{self._incarnation}"
+
+        def on_started(gen, s=sched):
+            s.set_lease_generation(gen)
+            s.promote()
+
+        elector = LeaderElector(
+            self.client, ident,
+            lease_duration_s=self.LEASE_DURATION_S,
+            renew_interval_s=0.08,
+            on_started_leading=on_started,
+            seed=self.seed * 101 + self._incarnation)
+        self._replicas[slot] = sched
+        self._electors[slot] = elector
+        elector.start()
+
+    def _op_kill_leader(self) -> None:
+        """Kill the acting leader cold, racing whatever churn/eviction
+        is in flight, and refill the slot with a fresh standby."""
+        idx = next((i for i, el in enumerate(self._electors)
+                    if el is not None and el.is_leader), None)
+        if idx is None:
+            return  # mid-takeover: no acting leader to kill
+        self._electors[idx].stop()
+        self._replicas[idx].stop()
+        self.report.crashes += 1
+        self.leader_kills += 1
+        self._spawn_replica(idx)
+
+    def _op_kill_node(self) -> None:
+        """Node death feeding the eviction scan (so takeovers race
+        eviction, not just churn); at least half the fleet survives."""
+        from tpu_dra.k8s import NODES, RESOURCESLICES
+        alive = sorted(n["metadata"]["name"]
+                       for n in self.cluster.list(NODES))
+        if len(alive) <= max(1, self.nodes // 2):
+            return
+        name = self.rng.choice(alive)
+        node_obj = next(n for n in self.cluster.list(NODES)
+                        if n["metadata"]["name"] == name)
+        slices = [sl for sl in self.cluster.list(RESOURCESLICES)
+                  if (sl.get("spec") or {}).get("nodeName") == name]
+        strip = NodeDeathChaosHarness._strip_meta
+        self.dead_nodes[name] = {
+            "node": strip(node_obj),
+            "slices": [strip(sl) for sl in slices]}
+        for sl in slices:
+            self.cluster.delete(RESOURCESLICES, sl["metadata"]["name"],
+                                None)
+        self.cluster.delete(NODES, name, None)
+
+    def _op_revive_node(self) -> None:
+        from tpu_dra.k8s import NODES, RESOURCESLICES
+        if not self.dead_nodes:
+            return
+        name = self.rng.choice(sorted(self.dead_nodes))
+        saved = self.dead_nodes.pop(name)
+        self.cluster.create(NODES, saved["node"])
+        for sl in saved["slices"]:
+            self.cluster.create(RESOURCESLICES, sl)
+
+    def _ops(self):
+        return super()._ops() + [(self._op_kill_leader, 2),
+                                 (self._op_kill_node, 1),
+                                 (self._op_revive_node, 1)]
+
+    def quiesce_and_verify(self) -> None:
+        # Revive the whole fleet first: quiesce owes every live pod a
+        # bind, which needs the seeded capacity back (evicted claims
+        # re-drive onto the restored nodes).
+        from tpu_dra.k8s import NODES, RESOURCESLICES
+        for name in sorted(self.dead_nodes):
+            saved = self.dead_nodes[name]
+            self.cluster.create(NODES, saved["node"])
+            for sl in saved["slices"]:
+                self.cluster.create(RESOURCESLICES, sl)
+        self.dead_nodes.clear()
+        super().quiesce_and_verify()
+        acting = [el.identity for el in self._electors
+                  if el is not None and el.is_leader]
+        if len(acting) > 1:
+            self.report.violations.append(
+                f"two acting leaders at quiesce: {sorted(acting)}")
+
+    def _stop_scheduler(self) -> None:
+        for elector in self._electors:
+            if elector is not None:
+                elector.stop()
+        for sched in self._replicas:
+            if sched is not None:
+                sched.stop()
+
+
+def run_leaderkill_schedule(seed: int, n_events: int = 60) -> ChaosReport:
+    """One seeded leader-kill walk to quiesce."""
+    return LeaderKillChaosHarness(seed).run(n_events)
+
+
+def run_leaderkill_matrix(seeds: List[int], n_events: int = 60) -> Dict:
+    return _pod_matrix_summary(
+        [run_leaderkill_schedule(seed, n_events) for seed in seeds])
 
 
 # ---------------------------------------------------------------------------
@@ -1602,11 +1777,19 @@ def main(argv=None) -> int:
     # to dead hardware, no double allocation).
     summary["node_death"] = run_nodedeath_matrix(seeds,
                                                  n_events=args.events)
+    # HA leader-kill walk over the same seed matrix (SURVEY §22):
+    # leader kills racing pod churn and eviction, standby takeover via
+    # lease expiry + fenced resync — never two acting leaders' commits
+    # both landing, no double allocation, no claim leaked across
+    # takeover.
+    summary["leader_kill"] = run_leaderkill_matrix(seeds,
+                                                   n_events=args.events)
     failed = bool(summary["violations"]
                   or summary["watch_flake_violations"]
                   or summary["scheduler"]["violations"]
                   or summary["topology"]["violations"]
-                  or summary["node_death"]["violations"])
+                  or summary["node_death"]["violations"]
+                  or summary["leader_kill"]["violations"])
     if failed:
         # Any matrix violation ships its evidence (SURVEY §19): the
         # flight recorder holds the recent spans, fault firings and

@@ -69,6 +69,7 @@ from tpu_dra.infra.metrics import (
     SCHED_WATCH_EVENTS, SCHED_WORKERS, TOPO_ALLOCS, TOPO_FREE_CUBOID,
     TOPO_SCORE_SECONDS, Timer,
 )
+from tpu_dra.infra.leaderelect import FENCING_ANNOTATION
 from tpu_dra.infra.trace import TRACEPARENT_ANNOTATION, TRACER
 from tpu_dra.infra.workqueue import (
     ExponentialFailureRateLimiter, WorkQueue,
@@ -872,11 +873,26 @@ class Scheduler:
         # and leave a cache validated against the surviving value.
         self._rev_seq = itertools.count(1)
         self._started = False
+        # HA mode (SURVEY §22): a standby replica runs warm informers
+        # but leaves the worker pool paused until promote(); the
+        # acting leader's fencing generation is stamped into every
+        # claim-status/bind write (see _stamp_fence) and deliberately
+        # survives deposal — install_fencing refuses the stale stamp.
+        self._standby = False
+        self._promote_lock = threading.Lock()
+        self.lease_generation: Optional[int] = None
 
     # -- lifecycle ----------------------------------------------------------
 
-    def start(self, mode: str = "events") -> None:
+    def start(self, mode: str = "events", standby: bool = False) -> None:
+        """``standby=True`` (events mode only) brings up everything
+        EXCEPT the reconcile workers and sweeper: informers sync and
+        keep the index warm, events enqueue into the paused workqueue
+        (per-key dedupe bounds it by live object count), and nothing
+        writes to the cluster until promote() — the HA replica shape
+        (SURVEY §22)."""
         self._stop.clear()  # both modes: a restart after stop() must run
+        self._standby = standby and mode == "events"
         if mode == "poll":
             self._thread = threading.Thread(target=self._poll_run,
                                             daemon=True,
@@ -944,8 +960,11 @@ class Scheduler:
         # The reconcile pool: N queue consumers with per-key
         # serialization (infra.workqueue); cross-worker allocation
         # safety comes from the snapshot commit step, not from here.
-        self._pool = self._queue.start_workers(self._workers, self._stop)
-        SCHED_WORKERS.set(self._workers)
+        # A standby leaves the pool paused — promote() starts it.
+        if not self._standby:
+            self._pool = self._queue.start_workers(self._workers,
+                                                   self._stop)
+            SCHED_WORKERS.set(self._workers)
         for i in inf.values():
             i.start()
         for i in inf.values():
@@ -954,10 +973,57 @@ class Scheduler:
         # informer sync, so the index is already built; the nudge below
         # only covers pods whose add events raced the pending-set wiring.
         self._nudge_all_pending()
+        if not self._standby:
+            self._sweeper = threading.Thread(target=self._sweep_loop,
+                                             daemon=True,
+                                             name="sim-scheduler-sweep")
+            self._sweeper.start()
+
+    @property
+    def is_standby(self) -> bool:
+        return self._standby
+
+    def set_lease_generation(self, generation: int) -> None:
+        """Adopt the elector's fencing token: every subsequent
+        claim-status/bind write carries it (never cleared — a deposed
+        leader's stale stamp is exactly what fencing refuses)."""
+        self.lease_generation = generation
+
+    def promote(self) -> None:
+        """Standby -> acting leader (the elector's on_started_leading).
+        The informers are already warm; what takeover owes is DISTRUST:
+        every shard of the AllocationIndex is marked dirty and rebuilt
+        through the existing guarded _full_resync path before the
+        worker pool starts committing — the old leader may have
+        allocated right up to its deposal, and commits against a
+        pre-takeover index are how devices double-allocate."""
+        with self._promote_lock:
+            if not self._standby or self._stop.is_set() \
+                    or self._queue is None:
+                return
+            self._standby = False
+        t0 = time.monotonic()
+        try:
+            # Injection site: the takeover rebuild itself fails —
+            # promotion must re-drive the resync, never proceed dirty.
+            FAULTS.check("sched.takeover_resync")
+            self._index.mark_all_dirty("lease takeover")
+            self._full_resync()
+        except FaultInjected:
+            # Declared degradation (sched.takeover_resync): the queued
+            # resync item re-runs the rebuild; until it converges,
+            # dirty shards refuse try_commit, so the promoted workers
+            # degrade to bounded requeues rather than unsafe commits.
+            self.request_resync("takeover resync faulted")
+        self._pool = self._queue.start_workers(self._workers, self._stop)
+        SCHED_WORKERS.set(self._workers)
+        self._nudge_all_pending()
         self._sweeper = threading.Thread(target=self._sweep_loop,
                                          daemon=True,
                                          name="sim-scheduler-sweep")
         self._sweeper.start()
+        log.info("promoted to acting leader in %.3fs (generation %s)",
+                 time.monotonic() - t0, self.lease_generation)
 
     def stop(self) -> None:
         self._stop.set()
@@ -1445,6 +1511,7 @@ class Scheduler:
             "reason": reason,
             "message": f"allocated devices lost ({reason}): "
                        f"{sorted(e[2] for e in entries)}"}
+        self._stamp_fence(upd)
         try:
             updated = self._client.update_status(RESOURCECLAIMS, upd, ns)
         except (ConflictError, NotFoundError) as e:
@@ -1873,6 +1940,10 @@ class Scheduler:
                         upd["metadata"].setdefault(
                             "annotations", {})[TRACEPARENT_ANNOTATION] \
                             = tp
+                    # The commit: fenced — a deposed leader reaching
+                    # here late gets a ConflictError, not a landed
+                    # allocation (SURVEY §22).
+                    self._stamp_fence(upd)
                     updated = self._client.update_status(
                         RESOURCECLAIMS, upd,
                         upd["metadata"].get("namespace"))
@@ -1892,6 +1963,20 @@ class Scheduler:
             # requeued attempt re-picks).
             self._index.release(node, [k for _c, _a, k, _e in staged])
         return True
+
+    def _stamp_fence(self, upd: Dict) -> None:
+        """Stamp the acting leader's lease generation into a
+        claim-status write the fencing reactor guards (allocation +
+        evict — the scheduler's commits; ResourceClaims have no other
+        status writer, so the stamp only ever meets fencing-aware
+        paths). Pod writes stay unstamped: pods are co-written by
+        nodesim, and a stale stamp riding a deepcopy round-trip would
+        fence an innocent writer. No-op outside HA mode (no elector
+        ever set a generation) — the single-process paths pay
+        nothing."""
+        if self.lease_generation is not None:
+            upd["metadata"].setdefault("annotations", {})[
+                FENCING_ANNOTATION] = str(self.lease_generation)
 
     def _after_claim_write(self, obj: Dict) -> None:
         """Mutation-cache discipline for the scheduler's own writes: the

@@ -529,6 +529,16 @@ class CheckpointManager:
                 JOURNAL_GROUP_SYNCS.inc()
                 self._sync_cond.notify_all()
 
+    def journal_flush(self) -> None:
+        """Barrier over everything appended so far — the clean-shutdown
+        journal barrier (SURVEY §22): after the drain window finishes
+        the last in-flight batch, this settles its records so the next
+        incarnation's recovery scan replays a complete tail instead of
+        racing an unsynced one."""
+        with self._sync_cond:
+            token = self._appended_seq
+        self.journal_barrier(token)
+
     def _ensure_journal_fd(self) -> int:
         """Reopen the journal fd after close() — managers outlive the
         DeviceState that closed them in test/recovery rebuilds, exactly

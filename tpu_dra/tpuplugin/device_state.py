@@ -255,6 +255,12 @@ class DeviceState:
                                 "chip %d (continuing)", c.index,
                                 exc_info=True)
 
+    def flush_journal(self) -> None:
+        """Settle every appended journal record to disk — the clean
+        journal barrier the hot-restart drain runs before close()
+        (SURVEY §22), so the next incarnation recovers a complete tail."""
+        self._ckpt_mgr.journal_flush()
+
     def close(self) -> None:
         """Release cached checkpoint slot fds. The manager assumes a
         single writer per process; call this at driver shutdown (and from

@@ -146,13 +146,23 @@ class TpuDriver(DriverCallbacks):
         if publish_wait:
             self.first_published.wait(publish_wait)
 
-    def shutdown(self) -> None:
+    def shutdown(self, drain: bool = True) -> float:
+        """Tear down in drain order (SURVEY §22 hot-restart protocol):
+        stop admitting RPCs and wait out the in-flight pipeline
+        (clients see a draining refusal and retry against the next
+        incarnation), stop auxiliaries, stop the transports, then run
+        the journal barrier so the next incarnation recovers a
+        complete tail. Returns the drain window seconds (0.0 when
+        drain=False — the crash-shaped teardown tests use)."""
+        drain_s = self._pipeline.drain() if drain else 0.0
         if self._health:
             self._health.stop()
         self._publish_queue.shutdown()
         self.server.stop()
         self._fetch_pool.shutdown(wait=True)
+        self._state.flush_journal()
         self._state.close()
+        return drain_s
 
     # -- DRA callbacks ------------------------------------------------------
 

@@ -120,8 +120,19 @@ def main(argv=None) -> int:
         metrics_srv.start()
 
     stop = threading.Event()
+
+    def _on_stop_signal(signum, _frame):
+        # SIGTERM is the hot-upgrade path (SURVEY §22): snapshot the
+        # flight recorder on the way down — if the drain wedges or the
+        # restart goes bad, the evidence of what was in flight at the
+        # kill already exists on disk. Dump before set(): the main
+        # thread starts the drain the moment stop fires.
+        if signum == signal.SIGTERM:
+            trace.dump_flight_recorder("sigterm")
+        stop.set()
+
     for sig in (signal.SIGINT, signal.SIGTERM):
-        signal.signal(sig, lambda *_: stop.set())
+        signal.signal(sig, _on_stop_signal)
 
     driver.start()
     logger.info("tpu kubelet plugin serving on %s (kubelet gRPC) + %s "
