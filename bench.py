@@ -2042,6 +2042,17 @@ def main():
     }
     result.update({k: v for k, v in out.items() if k not in result})
     print(json.dumps(result))
+    # ISSUE 17 satellite: BENCH_r*.json recorders used to capture only
+    # this stdout line inside a "tail" string blob, burying the metric
+    # dict. When TPU_DRA_BENCH_OUT names a file, write the parsed dict
+    # there too so the recorder can fold it in as a structured
+    # top-level "metrics" key and the perf trajectory stays
+    # machine-readable (perf.sh tripwires read both shapes).
+    out_path = os.environ.get("TPU_DRA_BENCH_OUT")
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=2, sort_keys=True)
+            f.write("\n")
 
 
 if __name__ == "__main__":

@@ -25,7 +25,12 @@
 #    quarantine-crash (chip-quarantine journal ops interleaved with a
 #    claim lifecycle) scenarios' durable ops crashed (clean /
 #    all-persisted / torn variants) with recovery invariants asserted
-#    after each restart.
+#    after each restart. Since ISSUE 17 the batch-prepare-crash
+#    scenario forces both binary-journal rotations — compaction
+#    retirement (journal_compact_lag=2) and the size roll
+#    (segment_roll_bytes=64) — so segment creates, old-chain unlinks,
+#    deferred dir syncs, and torn BINARY record tails are all in the
+#    enumerated set.
 #
 # Any invariant violation fails with the schedule trace (or crash
 # point) printed; replay the trace with:

@@ -1,0 +1,139 @@
+#!/usr/bin/env python3
+"""Measure this box's fdatasync floor — the physics under every perf gate.
+
+The durable-commit path's latency decomposes into (a) Python/serialization
+work the storage engine can optimize and (b) the device's flush latency,
+which it cannot. Absolute p50 gates conflate the two and trip on slower
+hosts (the PR 16 finding: a laptop-class NVMe syncs in ~0.05ms, a cloud
+boot disk in ~1ms+). This probe measures (b) directly — an in-place 4KiB
+pwrite + fdatasync on a preallocated file in the target directory, the
+exact op the journal's group-sync leader performs — so perf.sh can budget
+its gates relative to the floor instead of hardcoding one box's numbers.
+
+The probe has a second term: --cpu measures a single-core CPU
+reference (min-of-samples over a fixed serialization-shaped workload),
+because a floor-only budget still conflates device speed with how fast
+this box runs the Python between syncs — see measure_cpu below.
+
+Usage:
+    hack/fsync_probe.py [DIR] [--iters N] [--cpu] [--json]
+
+Prints the floor p50 in milliseconds on stdout (one number, shell-
+consumable) by default; --cpu prints the CPU reference instead; --json
+emits the full percentile breakdown plus the CPU reference.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+
+def measure(directory: str, iters: int = 200, size: int = 4096):
+    """p50/p90/p99 of an in-place pwrite+fdatasync cycle, in ms."""
+    fdatasync = getattr(os, "fdatasync", os.fsync)
+    fd, path = tempfile.mkstemp(prefix=".fsync_probe_", dir=directory)
+    try:
+        # Preallocate + settle so the measured loop never extends the
+        # file: extension turns fdatasync into fsync-with-metadata and
+        # overstates the floor (same reason the journal preallocates).
+        os.pwrite(fd, b"\0" * size, 0)
+        os.fsync(fd)
+        block = b"\x5a" * size
+        samples = []
+        for i in range(iters):
+            t0 = time.perf_counter()
+            os.pwrite(fd, block, 0)
+            fdatasync(fd)
+            samples.append((time.perf_counter() - t0) * 1000.0)
+    finally:
+        os.close(fd)
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+    samples.sort()
+
+    def pct(p):
+        return samples[min(len(samples) - 1, int(len(samples) * p))]
+
+    return {
+        "dir": directory,
+        "iters": iters,
+        "write_bytes": size,
+        "fdatasync_floor_p50_ms": round(pct(0.50), 4),
+        "fdatasync_floor_p90_ms": round(pct(0.90), 4),
+        "fdatasync_floor_p99_ms": round(pct(0.99), 4),
+        "fdatasync_floor_min_ms": round(samples[0], 4),
+    }
+
+
+def measure_cpu(iters: int = 100) -> float:
+    """Single-core CPU reference, in ms: the MINIMUM over `iters` runs
+    of a fixed serialization-shaped workload (dict build + sorted
+    json.dumps + crc32 + loads — the kind of Python the prepare
+    pipeline spends its non-sync time on). The fdatasync floor captures
+    the storage device but says nothing about how fast this box runs
+    Python; an absolute software allowance on top of the floor still
+    trips on a slow core (the PR 17 finding: one host ran the identical
+    hot path ~1.7x slower than the box that calibrated the old 1.0ms
+    gate). The minimum — not the median — is the stable statistic: it
+    measures the core with scheduler noise excluded (same rationale as
+    timeit's best-of)."""
+    import zlib
+
+    def one() -> float:
+        doc = {
+            "claims": {
+                "uid-%d" % j: {
+                    "devices": ["chip-%d" % k for k in range(4)],
+                    "seq": j,
+                    "env": {"TPU_CHIPS": "0,1,2,3",
+                            "TPU_WORKER_ID": str(j)},
+                    "cdi": ["tpu.google.com/device=chip-%d" % k
+                            for k in range(4)],
+                } for j in range(8)
+            },
+            "node": "node-0", "generation": 12345,
+        }
+        t0 = time.perf_counter()
+        for _ in range(6):
+            s = json.dumps(doc, sort_keys=True)
+            zlib.crc32(s.encode())
+            json.loads(s)
+        return (time.perf_counter() - t0) * 1000.0
+
+    one()  # warm the allocator / code paths outside the sample set
+    return round(min(one() for _ in range(iters)), 4)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("dir", nargs="?", default=tempfile.gettempdir(),
+                    help="directory to probe (default: system tmpdir; "
+                         "pass the checkpoint dir for the real device)")
+    ap.add_argument("--iters", type=int, default=200)
+    ap.add_argument("--cpu", action="store_true",
+                    help="print the CPU reference (ms) instead of the "
+                         "fdatasync floor")
+    ap.add_argument("--json", action="store_true",
+                    help="full percentile breakdown instead of bare p50")
+    args = ap.parse_args(argv)
+    if args.cpu and not args.json:
+        print(measure_cpu())
+        return 0
+    result = measure(args.dir, iters=args.iters)
+    if args.json:
+        result["cpu_ref_ms"] = measure_cpu()
+        print(json.dumps(result))
+    else:
+        print(result["fdatasync_floor_p50_ms"])
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

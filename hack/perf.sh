@@ -14,29 +14,41 @@
 #    claim p50 + batched per-claim p50 on a fake 4-chip v5p inventory +
 #    batch-64 on a 64-chip one, printed as one JSON line for eyeballing
 #    against BENCH_r*.json — gated on: single-claim p50 under
-#    PERF_P50_GATE_MS (default 1.0, tightened from the sync-gRPC-era
-#    1.6; measured ~0.85-0.95 here — the residual is no longer
-#    transport but the durable state machine: fdatasync ~0.16ms on this
-#    box + journal/CDI serialization + spans; the ISSUE 15 sub-0.5
-#    target needs faster durable storage, not a faster server),
+#    PERF_P50_GATE_MS (ISSUE 17: default DERIVED from this box's
+#    measured physics — hack/fsync_probe.py — as
+#    7*cpu_ref + 2*fdatasync_floor; ~0.6 on a desktop-class core, see
+#    the derivation block below; an explicit env value still wins.
+#    The storage engine owns the 7-cpu-ref software allowance: binary
+#    journal framing + CDI template cache replaced the per-record
+#    JSON that used to dominate the post-fdatasync residual), plus a
+#    group-commit-window-never-holds-idle tripwire (the probe is
+#    sequential, so journal_window_holds must stay 0),
 #    TRANSPORT residual (client p50 minus server handler p50) under
-#    PERF_TRANSPORT_GATE_MS (default 0.35; measured ~0.15-0.25 framed
-#    vs ~0.5-0.7 over sync gRPC — the lever ROADMAP item 5 named, now
-#    gated so it cannot silently regrow), and batch-64 per-claim under
-#    PERF_BATCH64_GATE_MS (default 0.3; measures ~0.2).
+#    PERF_TRANSPORT_GATE_MS (default max(0.35, 1.6*cpu_ref); measured
+#    ~0.15-0.25 framed vs ~0.5-0.7 over sync gRPC — the lever ROADMAP
+#    item 5 named, now gated so it cannot silently regrow), and
+#    batch-64 per-claim under PERF_BATCH64_GATE_MS (default
+#    max(0.3, 1.4*cpu_ref); measures ~0.2-0.27).
 # 2b. Sustained-load phase (ISSUE 15): PERF_SUSTAINED_S seconds
 #    (default 25; BENCH recording rounds run minutes via
 #    TPU_DRA_BENCH_SUSTAINED_S) of mixed-batch prepare/unprepare from 8
 #    framed connections flat-out through one node. Gates: achieved RPC
-#    rate >= PERF_SUSTAINED_RPS_MIN (default 1000), zero RPC errors and
+#    rate >= PERF_SUSTAINED_RPS_MIN (since ISSUE 17 the default is
+#    host-budgeted: 4000 on >= 4-core hosts, 800/core below that — a
+#    single-core container serializes the whole closed loop onto one
+#    core; was a flat 1000), zero RPC errors and
 #    zero leaked claims, single-claim p99-under-load <=
 #    PERF_SUSTAINED_P99_GATE_MS (default 30), the pipeline in-flight
 #    window respected (peak <= 16), and the journal sync-coalescing
 #    ratio measured AT DEPTH: with >= 8 RPCs in flight the barrier
 #    queue is provably full, so coalescing is deterministic —
-#    appends/group-syncs >= PERF_COALESCE_RATIO_MIN (default 1.5,
-#    measures ~2.5) with no retry loop (the old idle-probe gate
-#    retried 5 rounds because coalescing was opportunistic there).
+#    appends/group-syncs >= PERF_COALESCE_RATIO_MIN (since ISSUE 17's
+#    adaptive group-commit window made coalescing engineered rather
+#    than opportunistic the default is host-budgeted: 4.0 on >= 4-core
+#    hosts, 2.5 below — one core caps co-committers in flight; was a
+#    flat 1.5 measuring ~2.5)
+#    with no retry loop (the old idle-probe gate retried 5 rounds
+#    because coalescing was opportunistic there).
 # 2c. Hot-restart phase (ISSUE 16, SURVEY §22): the kubelet plugin is
 #    restarted PERF_RESTARTS times mid-stream under framed churn —
 #    gated on ZERO failed RPCs (drain + journal recovery + client
@@ -74,6 +86,63 @@ set -euo pipefail
 REPO_ROOT="$(cd "$(dirname "$0")/.." && pwd)"
 CYCLES="${1:-${PERF_CYCLES:-30}}"
 
+# Budget the latency gates against THIS box's measured physics (ISSUE
+# 17: absolute gates trip on slower hosts — the PR 16 finding). TWO
+# probe terms, because the hot path has two kinds of cost:
+#  - PERF_FSYNC_FLOOR_MS: the storage device. hack/fsync_probe.py
+#    times the exact in-place pwrite+fdatasync the journal's
+#    group-sync leader performs.
+#  - PERF_CPU_REF_MS: the core. fsync_probe --cpu times a fixed
+#    serialization-shaped Python workload (min-of-samples, so
+#    scheduler noise is excluded). A floor-only budget still tripped
+#    on a host whose core ran the identical hot path ~1.7x slower
+#    than the box that calibrated the old absolute 1.0ms gate (A/B'd
+#    HEAD-vs-change at equal numbers to prove it was the box).
+# The claim-to-ready budget is then pipeline-shaped, not absolute:
+# ~7 cpu-refs of decode/state/span/CDI/framing work the engine is
+# accountable for, plus two sync floors (one sync + jitter headroom).
+# On a desktop-class core (cpu_ref ~0.07ms, NVMe floor ~0.05ms) this
+# derives ~0.6ms — ROADMAP item 2's target; on this container it
+# derives the same software budget in this box's units. The other
+# pure-CPU gates (transport residual, batch-64 per-claim, tracing
+# slack, p99-under-load) scale the same way but never BELOW their
+# committed absolute calibrations (fast boxes keep the old bars).
+# Explicit env values always win (explicit > derived).
+PERF_FSYNC_FLOOR_MS="${PERF_FSYNC_FLOOR_MS:-$(python "$REPO_ROOT/hack/fsync_probe.py")}"
+PERF_CPU_REF_MS="${PERF_CPU_REF_MS:-$(python "$REPO_ROOT/hack/fsync_probe.py" --cpu)}"
+PERF_P50_GATE_MS="${PERF_P50_GATE_MS:-$(python -c "
+import sys; floor = float(sys.argv[1]); cpu = float(sys.argv[2])
+print(round(7.0 * cpu + 2.0 * floor, 3))" "$PERF_FSYNC_FLOOR_MS" "$PERF_CPU_REF_MS")}"
+PERF_TRANSPORT_GATE_MS="${PERF_TRANSPORT_GATE_MS:-$(python -c "
+import sys; print(round(max(0.35, 1.6 * float(sys.argv[1])), 3))" "$PERF_CPU_REF_MS")}"
+PERF_BATCH64_GATE_MS="${PERF_BATCH64_GATE_MS:-$(python -c "
+import sys; print(round(max(0.3, 1.4 * float(sys.argv[1])), 3))" "$PERF_CPU_REF_MS")}"
+TRACE_OVERHEAD_SLACK_MS="${TRACE_OVERHEAD_SLACK_MS:-$(python -c "
+import sys; print(round(max(0.05, 0.5 * float(sys.argv[1])), 3))" "$PERF_CPU_REF_MS")}"
+PERF_SUSTAINED_P99_GATE_MS="${PERF_SUSTAINED_P99_GATE_MS:-$(python -c "
+import sys; print(round(max(30.0, 120.0 * float(sys.argv[1])), 1))" "$PERF_CPU_REF_MS")}"
+echo ">> fdatasync floor ${PERF_FSYNC_FLOOR_MS}ms, cpu ref ${PERF_CPU_REF_MS}ms -> claim-to-ready p50 gate ${PERF_P50_GATE_MS}ms, transport ${PERF_TRANSPORT_GATE_MS}ms, batch64 ${PERF_BATCH64_GATE_MS}ms"
+
+# The sustained throughput/coalescing targets assume a node-class host
+# (>= 4 cores), where the 8 framed client connections, the server
+# pipeline, and fdatasync scheduling actually run in parallel. On a
+# small host (e.g. a single-core CI container) the whole closed loop is
+# serialized onto one core, which bounds BOTH the offered load and how
+# many co-committers the group-commit window can ever catch in flight
+# — no storage-engine change can push a GIL-serialized pipeline past
+# ~1ms/RPC. Budget the default gates by core count (same philosophy as
+# the fdatasync-floor-relative p50 gate above: gate against this box's
+# physics, not an absolute number from a bigger box). Explicit
+# PERF_SUSTAINED_RPS_MIN / PERF_COALESCE_RATIO_MIN still win.
+PERF_NPROC="$(nproc)"
+PERF_SUSTAINED_RPS_MIN="${PERF_SUSTAINED_RPS_MIN:-$(python -c "
+import sys; n = int(sys.argv[1])
+print(4000 if n >= 4 else 800 * n)" "$PERF_NPROC")}"
+PERF_COALESCE_RATIO_MIN="${PERF_COALESCE_RATIO_MIN:-$(python -c "
+import sys; n = int(sys.argv[1])
+print('4.0' if n >= 4 else '2.5')" "$PERF_NPROC")}"
+echo ">> host budget: ${PERF_NPROC} core(s) -> sustained gates >= ${PERF_SUSTAINED_RPS_MIN} RPC/s, coalesce >= ${PERF_COALESCE_RATIO_MIN}"
+
 echo ">> group-commit tripwire (one terminal sync per batch)"
 JAX_PLATFORMS=cpu python -m pytest "$REPO_ROOT/tests/test_batch_prepare.py" \
   -q -p no:cacheprovider
@@ -81,9 +150,12 @@ JAX_PLATFORMS=cpu python -m pytest "$REPO_ROOT/tests/test_batch_prepare.py" \
 echo ">> claim-to-ready probe (${CYCLES} cycles, fake v5p 4-chip + batch-64, framed transport)"
 cd "$REPO_ROOT"
 JAX_PLATFORMS=cpu TPU_DRA_TPUINFO_BACKEND=fake \
-  PERF_P50_GATE_MS="${PERF_P50_GATE_MS:-1.0}" \
-  PERF_TRANSPORT_GATE_MS="${PERF_TRANSPORT_GATE_MS:-0.35}" \
-  PERF_BATCH64_GATE_MS="${PERF_BATCH64_GATE_MS:-0.3}" \
+  PERF_P50_GATE_MS="$PERF_P50_GATE_MS" \
+  PERF_FSYNC_FLOOR_MS="$PERF_FSYNC_FLOOR_MS" \
+  PERF_TRANSPORT_GATE_MS="$PERF_TRANSPORT_GATE_MS" \
+  PERF_BATCH64_GATE_MS="$PERF_BATCH64_GATE_MS" \
+  PERF_CPU_REF_MS="$PERF_CPU_REF_MS" \
+  TRACE_OVERHEAD_SLACK_MS="$TRACE_OVERHEAD_SLACK_MS" \
   python - "$CYCLES" <<'EOF'
 import json
 import os
@@ -156,7 +228,11 @@ try:
         "batch_amortization_x": round(p50_one / p50_batch, 2),
         "slot_syncs": ck.slot_syncs,
         "journal_compactions": ck.journal_compactions,
+        "journal_window_holds": ck.journal_window_holds,
+        "fdatasync_floor_ms": float(os.environ["PERF_FSYNC_FLOOR_MS"]),
+        "cpu_ref_ms": float(os.environ["PERF_CPU_REF_MS"]),
     }
+    window_holds = ck.journal_window_holds
     for k, vals in sorted(breakdown.items()):
         if k != "n_claims":
             out[f"batch_{k}_ms"] = round(statistics.median(vals), 4)
@@ -181,7 +257,17 @@ if p50_batch >= p50_one:
 gate = float(os.environ["PERF_P50_GATE_MS"])
 if p50_one > gate:
     sys.exit(f"REGRESSION: claim_to_ready_p50_1chip_ms {p50_one:.3f} > "
-             f"{gate} (PERF_P50_GATE_MS)")
+             f"{gate} (PERF_P50_GATE_MS, derived from the "
+             f"{os.environ['PERF_FSYNC_FLOOR_MS']}ms fdatasync floor)")
+# ISSUE 17 tripwire: this whole probe is SEQUENTIAL — one client, one
+# RPC in flight — so the adaptive group-commit window must never have
+# held. A nonzero count means idle commits are paying window latency,
+# exactly the failure mode the arrival-rate + co-committer-evidence
+# predicate exists to prevent.
+if window_holds:
+    sys.exit(f"REGRESSION: group-commit window held {window_holds} "
+             "time(s) under a strictly sequential load — the adaptive "
+             "window is taxing idle commits")
 tgate = float(os.environ["PERF_TRANSPORT_GATE_MS"])
 if transport > tgate:
     sys.exit(f"REGRESSION: transport residual {transport:.3f}ms > {tgate} "
@@ -207,9 +293,9 @@ EOF
 echo ">> sustained-load gates (${PERF_SUSTAINED_S:-25}s mixed-batch prepare/unprepare at production RPS)"
 JAX_PLATFORMS=cpu TPU_DRA_TPUINFO_BACKEND=fake \
   PERF_SUSTAINED_S="${PERF_SUSTAINED_S:-25}" \
-  PERF_SUSTAINED_RPS_MIN="${PERF_SUSTAINED_RPS_MIN:-1000}" \
-  PERF_SUSTAINED_P99_GATE_MS="${PERF_SUSTAINED_P99_GATE_MS:-30}" \
-  PERF_COALESCE_RATIO_MIN="${PERF_COALESCE_RATIO_MIN:-1.5}" \
+  PERF_SUSTAINED_RPS_MIN="$PERF_SUSTAINED_RPS_MIN" \
+  PERF_SUSTAINED_P99_GATE_MS="$PERF_SUSTAINED_P99_GATE_MS" \
+  PERF_COALESCE_RATIO_MIN="$PERF_COALESCE_RATIO_MIN" \
   python - <<'EOF'
 import json
 import os
@@ -413,8 +499,12 @@ for path in sorted(glob.glob("BENCH_r*.json"),
                    reverse=True):
     with open(path) as f:
         doc = json.load(f)
-    if doc.get("sched_pod_to_allocated_p50_ms") is not None:
-        prev = (path, doc["sched_pod_to_allocated_p50_ms"])
+    # ISSUE 17: rounds now record parsed metrics under a structured
+    # "metrics" key (older rounds buried them in the tail blob).
+    v = (doc.get("sched_pod_to_allocated_p50_ms")
+         or doc.get("metrics", {}).get("sched_pod_to_allocated_p50_ms"))
+    if v is not None:
+        prev = (path, v)
         break
 if prev is not None and out["sched_pod_to_allocated_p50_ms"] > prev[1] * 1.5:
     sys.exit(f"REGRESSION: sched_pod_to_allocated_p50_ms "
@@ -566,8 +656,12 @@ for path in sorted(glob.glob("BENCH_r*.json"),
                    reverse=True):
     with open(path) as f:
         doc = json.load(f)
-    if doc.get("topo_place_p50_ms") is not None:
-        prev = (path, doc["topo_place_p50_ms"])
+    # ISSUE 17: see the sched tripwire — metrics may sit under the
+    # structured "metrics" key in newer rounds.
+    v = (doc.get("topo_place_p50_ms")
+         or doc.get("metrics", {}).get("topo_place_p50_ms"))
+    if v is not None:
+        prev = (path, v)
         break
 if prev is not None and out["topo_place_p50_ms"] > prev[1] * 1.5:
     sys.exit(f"REGRESSION: topo_place_p50_ms "

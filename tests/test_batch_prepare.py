@@ -332,13 +332,14 @@ class TestJournalRecovery:
         cp = mgr.load_or_init()
         cp.claims["a"] = PreparedClaim(uid="a", state=PREPARE_COMPLETED)
         self._commit(mgr, cp, present=["a"])
-        journal = mgr.path + ".journal"
+        journal = mgr.active_segment_path
+        tail = mgr._journal_tail
         mgr.close()
-        # A crash tears the record being appended: valid JSON prefix,
-        # broken envelope, right at the tail.
+        # A crash tears the record being appended: a plausible length
+        # header with a garbage body, right at the binary tail.
         with open(journal, "r+b") as f:
-            f.seek(0, 2)
-            f.write(b'{"checksum": 123, "torn')
+            f.seek(tail)
+            f.write(b"\x40\x00\x00\x00torn-record-body")
         mgr2 = self._mgr(tmp_path)
         cp2 = mgr2.load()
         assert sorted(cp2.claims) == ["a"]  # tail dropped, 'a' durable
@@ -360,7 +361,8 @@ class TestJournalRecovery:
         cp = mgr.load_or_init()
         cp.claims["a"] = PreparedClaim(uid="a", state=PREPARE_COMPLETED)
         self._commit(mgr, cp, present=["a"])
-        journal = mgr.path + ".journal"
+        import os
+        seg_name = os.path.basename(mgr.active_segment_path)
         size_before = mgr._journal_tail
         # Append WITHOUT the barrier: the crash window under test.
         cp.claims["b"] = PreparedClaim(uid="b", state=PREPARE_COMPLETED)
@@ -374,7 +376,7 @@ class TestJournalRecovery:
         mgr2.close()
         # Outcome 2: the record was lost (guaranteed floor) — truncate
         # back to the synced tail.
-        with open(kept / "checkpoint.json.journal", "r+b") as f:
+        with open(kept / seg_name, "r+b") as f:
             f.truncate(size_before)
         from tpu_dra.tpuplugin.checkpoint import CheckpointManager
         mgr3 = CheckpointManager(str(kept))
@@ -383,21 +385,23 @@ class TestJournalRecovery:
 
     def test_compaction_failure_degrades_and_recovers(self, tmp_path,
                                                       monkeypatch):
-        """A failed compaction (swap rename EIO) must not fail the
-        commit it rode on: lag keeps growing, appends keep landing, and
-        the next append past the threshold retries the compaction."""
+        """A failed compaction (fresh-segment create EIO) must not fail
+        the commit it rode on: lag keeps growing, appends keep landing,
+        and the next append past the threshold retries the compaction."""
         from tpu_dra.infra import vfs
         from tpu_dra.tpuplugin.checkpoint import PreparedClaim
         mgr = self._mgr(tmp_path, journal_compact_lag=2)
         cp = mgr.load_or_init()
-        real_replace = vfs.replace
+        real_open_fd = vfs.open_fd
         blown = {"n": 0}
 
-        def exploding_replace(src, dst):
-            blown["n"] += 1
-            raise OSError("injected EIO on compaction rename")
+        def exploding_open_fd(path, flags, mode=0o600):
+            if ".wal" in path:
+                blown["n"] += 1
+                raise OSError("injected EIO on segment create")
+            return real_open_fd(path, flags, mode)
 
-        monkeypatch.setattr(vfs, "replace", exploding_replace)
+        monkeypatch.setattr(vfs, "open_fd", exploding_open_fd)
         for i in range(2):
             cp.claims[f"u{i}"] = PreparedClaim(uid=f"u{i}",
                                                state=PREPARE_COMPLETED)
@@ -405,7 +409,7 @@ class TestJournalRecovery:
         assert blown["n"] == 1          # compaction attempted and failed
         assert mgr.journal_lag >= 2     # lag NOT reset
         assert mgr.journal_compactions == 0
-        monkeypatch.setattr(vfs, "replace", real_replace)
+        monkeypatch.setattr(vfs, "open_fd", real_open_fd)
         cp.claims["u2"] = PreparedClaim(uid="u2", state=PREPARE_COMPLETED)
         self._commit(mgr, cp, present=["u2"])  # threshold still crossed
         assert mgr.journal_compactions == 1
@@ -464,20 +468,24 @@ class TestJournalRecovery:
 
     def test_crash_mid_compaction_replays_consistently(self, tmp_path,
                                                        monkeypatch):
-        """A crash between the compaction's slot store and the journal
-        swap leaves stale journal records BELOW the slot image's seq —
-        recovery must skip them, not double-apply."""
+        """A crash between the compaction's slot store and the segment
+        rotation leaves stale journal records BELOW the slot image's
+        seq — recovery must skip them, not double-apply."""
         from tpu_dra.infra import vfs
         from tpu_dra.tpuplugin.checkpoint import PreparedClaim
 
-        def crashing_replace(src, dst):
-            raise KeyboardInterrupt("simulated SIGKILL mid-compaction")
+        real_open_fd = vfs.open_fd
+
+        def crashing_open_fd(path, flags, mode=0o600):
+            if ".wal" in path:
+                raise KeyboardInterrupt("simulated SIGKILL mid-compaction")
+            return real_open_fd(path, flags, mode)
 
         mgr = self._mgr(tmp_path, journal_compact_lag=2)
         cp = mgr.load_or_init()
         cp.claims["a"] = PreparedClaim(uid="a", state=PREPARE_COMPLETED)
         self._commit(mgr, cp, present=["a"])
-        monkeypatch.setattr(vfs, "replace", crashing_replace)
+        monkeypatch.setattr(vfs, "open_fd", crashing_open_fd)
         cp.claims["b"] = PreparedClaim(uid="b", state=PREPARE_COMPLETED)
         with pytest.raises(KeyboardInterrupt):
             # Crosses the threshold: slot store lands, swap "crashes".

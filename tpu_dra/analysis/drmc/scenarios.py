@@ -792,12 +792,17 @@ class BatchPrepareCrashScenario:
         # async_cdi is bypassed anyway while the recorder is installed
         # (determinism of the durable-op sequence); journal_compact_lag
         # is forced low so the body CROSSES the compaction threshold —
-        # the compaction's slot store + journal swap ops (the
-        # "compaction rename") get crash-enumerated too.
+        # the compaction's slot store + segment retirement (fresh
+        # segment create, old-chain unlinks, dir sync) get
+        # crash-enumerated too — and segment_roll_bytes is forced tiny
+        # so appends between compactions ALSO cross the size-roll
+        # rotation (ISSUE 17: settle-old-tail fdatasync, new-segment
+        # create, deferred dir sync).
         state = DeviceState(
             backend=backend, cdi=cdi,
             checkpoints=CheckpointManager(os.path.join(tmp, "plugin"),
-                                          journal_compact_lag=2),
+                                          journal_compact_lag=2,
+                                          segment_roll_bytes=64),
             driver_name=_DRIVER, node_name=_POOL, async_cdi=False)
         claims = {n: _mk_claim(n, [f"chip-{i}"], rv=1)
                   for i, n in enumerate(("ca", "cb", "cc"))}

@@ -50,6 +50,10 @@ class CDIHandler:
         # libtpu discovery under the driver root (root.go:26-69
         # getDriverLibraryPath analog).
         self._libtpu_path = libtpu_path or self._find_libtpu()
+        # Claim-spec template cache: serialized scaffold per claim SHAPE
+        # (mounts + deviceNodes content — everything except env values
+        # and the uid), spliced per claim. See serialize_claim_spec.
+        self._claim_tpl_cache: Dict = {}
         os.makedirs(cdi_root, exist_ok=True)
 
     def _find_libtpu(self) -> Optional[str]:
@@ -122,18 +126,52 @@ class CDIHandler:
         _atomic_write_json(path, spec)
         return path
 
-    def serialize_claim_spec(self, claim_uid: str,
-                             env: Dict[str, str],
-                             mounts: Optional[List[Dict]] = None,
-                             device_nodes: Optional[List[Dict]] = None):
-        """(path, text) of the transient per-claim spec — the CPU half
-        of create_claim_spec_file, split out so an async writer can run
-        the pure-I/O half off-thread without dragging json serialization
-        (GIL-bound) into the overlap window."""
-        # Injection site: a failed claim-spec write is the canonical
-        # mid-prepare failure (full disk, ENOSPC on /var/run/cdi) —
-        # the prepare rollback path must unwind cleanly from here.
-        FAULTS.check("cdi.claim_write", claim_uid=claim_uid)
+    # Sentinels the template builder serializes in place of the dynamic
+    # fields. json.dumps renders each NUL as a six-char unicode escape, so the
+    # tokens cannot collide with any real uid or env value.
+    _ENV_SENTINEL = "\x00env\x00"
+    _UID_SENTINEL = "\x00uid\x00"
+    _TPL_CACHE_MAX = 64
+
+    def _build_claim_template(self, mounts, device_nodes):
+        """Serialize the claim-shape's static scaffold once with
+        sentinel env/uid, then split it into splice parts. Byte-layout
+        source of truth stays json.dumps(indent=2, sort_keys=True) —
+        the template is DERIVED from it, never hand-formatted, so the
+        cached render is byte-identical to the direct path."""
+        text = self._serialize_claim_spec_direct(
+            self._UID_SENTINEL, {"": self._ENV_SENTINEL[1:]},
+            mounts, device_nodes)
+        env_tok = json.dumps(f"={self._ENV_SENTINEL[1:]}")
+        uid_tok = json.dumps(self._UID_SENTINEL)
+        i = text.index(env_tok)
+        j = text.index(uid_tok)
+        nl = text.rindex("\n", 0, i)
+        # (prefix incl. the env-open newline, per-item indent, middle
+        # between env's last item and the uid, suffix after the uid)
+        return (text[:nl + 1], text[nl + 1:i],
+                text[i + len(env_tok):j], text[j + len(uid_tok):])
+
+    def _claim_template(self, mounts, device_nodes):
+        key = (json.dumps(mounts, sort_keys=True) if mounts else None,
+               json.dumps(device_nodes, sort_keys=True)
+               if device_nodes else None)
+        tpl = self._claim_tpl_cache.get(key)
+        if tpl is None:
+            tpl = self._build_claim_template(mounts, device_nodes)
+            if len(self._claim_tpl_cache) >= self._TPL_CACHE_MAX:
+                self._claim_tpl_cache.pop(
+                    next(iter(self._claim_tpl_cache)))
+            self._claim_tpl_cache[key] = tpl
+        return tpl
+
+    def _serialize_claim_spec_direct(self, claim_uid: str,
+                                     env: Dict[str, str],
+                                     mounts: Optional[List[Dict]] = None,
+                                     device_nodes: Optional[List[Dict]]
+                                     = None) -> str:
+        """Uncached reference serialization (template builder input,
+        empty-env shapes, and the byte-identity test oracle)."""
         edits: Dict = {"env": [f"{k}={v}" for k, v in sorted(env.items())]}
         if mounts:
             edits["mounts"] = mounts
@@ -144,8 +182,40 @@ class CDIHandler:
             "kind": f"{self._vendor}/{CDI_CLASS_CLAIM}",
             "devices": [{"name": claim_uid, "containerEdits": edits}],
         }
+        return json.dumps(spec, indent=2, sort_keys=True)
+
+    def serialize_claim_spec(self, claim_uid: str,
+                             env: Dict[str, str],
+                             mounts: Optional[List[Dict]] = None,
+                             device_nodes: Optional[List[Dict]] = None):
+        """(path, text) of the transient per-claim spec — the CPU half
+        of create_claim_spec_file, split out so an async writer can run
+        the pure-I/O half off-thread without dragging json serialization
+        (GIL-bound) into the overlap window.
+
+        Hot path: the shape scaffold (everything but env values and the
+        uid) is serialized once per (mounts, deviceNodes) content and
+        cached; per claim only the env lines and uid are spliced in —
+        no full-spec json.dumps. Cache invalidation is by construction:
+        the key IS the canonical serialization of the shape content, so
+        any mount/device-node change is a different key, and env
+        changes never touch the template at all."""
+        # Injection site: a failed claim-spec write is the canonical
+        # mid-prepare failure (full disk, ENOSPC on /var/run/cdi) —
+        # the prepare rollback path must unwind cleanly from here.
+        FAULTS.check("cdi.claim_write", claim_uid=claim_uid)
         path = self._claim_spec_path(claim_uid)
-        return path, json.dumps(spec, indent=2, sort_keys=True)
+        if not env:
+            # "env": [] collapses to one line — a different scaffold
+            # shape; rare enough to serialize directly.
+            return path, self._serialize_claim_spec_direct(
+                claim_uid, env, mounts, device_nodes)
+        pre, indent, mid, post = self._claim_template(mounts, device_nodes)
+        env_lines = ",\n".join(
+            indent + json.dumps(f"{k}={v}")
+            for k, v in sorted(env.items()))
+        return path, (pre + env_lines + mid
+                      + json.dumps(claim_uid) + post)
 
     def write_claim_spec(self, path: str, text: str) -> None:
         """The I/O half: tmp write + rename through the vfs seam (see

@@ -264,6 +264,8 @@ METRICS_CATALOG: Dict[str, str] = {
     "tpu_dra_journal_group_syncs_total": "tpuplugin/checkpoint.py",
     "tpu_dra_journal_compactions_total": "tpuplugin/checkpoint.py",
     "tpu_dra_journal_lag_records": "tpuplugin/checkpoint.py",
+    "tpu_dra_journal_window_holds_total": "tpuplugin/checkpoint.py",
+    "tpu_dra_journal_rotations_total": "tpuplugin/checkpoint.py",
     # cdplugin/driver.py — ComputeDomain channel prepare
     "tpu_dra_cd_claim_prepare_seconds": "cdplugin/driver.py",
     # cdcontroller/controller.py — CD reconcile loop + failure-domain

@@ -41,6 +41,21 @@ class VfsImpl:
     def pwrite(self, fd: int, data: bytes, offset: int) -> int:
         return os.pwrite(fd, data, offset)
 
+    def preallocate(self, fd: int, offset: int, length: int) -> None:
+        """Zero-fill [offset, offset+length) so later appends land in
+        already-allocated blocks and their fdatasync stays a pure data
+        sync (checkpoint journal segments). Routed through pwrite so a
+        recording implementation that only overrides the primitive ops
+        still shadows the extension as a durable op."""
+        off = 0
+        zeros = b"\0" * min(length, 1 << 20)
+        while off < length:
+            chunk = zeros[:length - off]
+            n = self.pwrite(fd, chunk, offset + off)
+            if n <= 0:
+                raise OSError(f"short preallocation write at {offset + off}")
+            off += n
+
     def ftruncate(self, fd: int, length: int) -> None:
         os.ftruncate(fd, length)
 
@@ -121,6 +136,10 @@ def close_fd(fd: int) -> None:
 
 def pwrite(fd: int, data: bytes, offset: int) -> int:
     return _impl.pwrite(fd, data, offset)
+
+
+def preallocate(fd: int, offset: int, length: int) -> None:
+    _impl.preallocate(fd, offset, length)
 
 
 def ftruncate(fd: int, length: int) -> None:

@@ -19,10 +19,12 @@ from __future__ import annotations
 import json
 import logging
 import os
+import struct
 import threading
+import time
 import zlib
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from tpu_dra.infra import vfs
 from tpu_dra.infra.faults import FAULTS
@@ -53,10 +55,201 @@ JOURNAL_LAG = DefaultRegistry.gauge(
     "tpu_dra_journal_lag_records",
     "journal records appended since the last compaction (recovery replay "
     "length; bounded by the compaction threshold)")
+JOURNAL_WINDOW_HOLDS = DefaultRegistry.counter(
+    "tpu_dra_journal_window_holds_total",
+    "group-commit windows held by a sync leader: the adaptive barrier "
+    "predicted co-committers from the recent arrival rate and waited a "
+    "bounded window before the fdatasync; must stay 0 under idle or "
+    "strictly sequential load")
+JOURNAL_ROTATIONS = DefaultRegistry.counter(
+    "tpu_dra_journal_rotations_total",
+    "journal segment rotations: a fresh preallocated segment became the "
+    "append target (at compaction, which also retires the old chain, or "
+    "at the size roll that bounds any one segment)")
 
 
 class CheckpointError(Exception):
     pass
+
+
+# ---------------------------------------------------------------------------
+# Binary journal encoding (SURVEY §23)
+# ---------------------------------------------------------------------------
+# The segmented journal frames every record with a fixed-width binary
+# header and a self-describing binary payload — no per-record JSON on
+# the hot path, and recovery validates raw bytes instead of re-
+# serializing a parsed document to recompute its checksum:
+#
+#   segment file := MAGIC(8) record*  zeros-to-preallocation-end
+#   record       := length(u32 LE) crc32(u32 LE) seq(u64 LE) type(u8)
+#                   payload[length]
+#
+# The CRC covers seq + type + payload (packed exactly as written), so a
+# record whose header or body took ANY damage fails closed; an all-zero
+# header is the preallocated tail (the clean end of the segment). The
+# payload is the group-commit delta dict encoded with the tag-length-
+# value codec below — tags cover the full JSON value universe because
+# per-claim ``devices`` records are opaque driver dicts.
+
+SEG_MAGIC = b"TDRJWAL1"
+_SEG_HDR_LEN = len(SEG_MAGIC)
+_REC_HDR = struct.Struct("<IIQB")     # length, crc32, seq, type
+_SEQ_TYPE = struct.Struct("<QB")      # the header fields the crc covers
+_REC_DELTA = 1                        # group-commit delta record
+_MAX_RECORD = 16 * 1024 * 1024        # sanity bound on a framed length
+_I64 = struct.Struct("<q")
+_F64 = struct.Struct("<d")
+_U32 = struct.Struct("<I")
+
+
+def _enc_value(v, out: bytearray) -> None:
+    """Tag-length-value encoder over the JSON value universe. Dict
+    order is preserved as-is: the CRC covers the encoded bytes, so no
+    canonical ordering is needed (unlike the JSON envelope, which had
+    to re-serialize sorted on every read to re-derive the checksum)."""
+    if v is None:
+        out.append(0)
+    elif v is True:
+        out.append(2)
+    elif v is False:
+        out.append(1)
+    elif isinstance(v, int):
+        try:
+            packed = _I64.pack(v)
+        except struct.error:          # beyond i64: decimal-string tag
+            b = str(v).encode()
+            out.append(8)
+            out += _U32.pack(len(b))
+            out += b
+        else:
+            out.append(3)
+            out += packed
+    elif isinstance(v, float):
+        out.append(4)
+        out += _F64.pack(v)
+    elif isinstance(v, str):
+        b = v.encode("utf-8")
+        out.append(5)
+        out += _U32.pack(len(b))
+        out += b
+    elif isinstance(v, (bytes, bytearray)):
+        out.append(9)
+        out += _U32.pack(len(v))
+        out += v
+    elif isinstance(v, (list, tuple)):
+        out.append(6)
+        out += _U32.pack(len(v))
+        for item in v:
+            _enc_value(item, out)
+    elif isinstance(v, dict):
+        out.append(7)
+        out += _U32.pack(len(v))
+        for k, item in v.items():
+            kb = k.encode("utf-8")
+            out += _U32.pack(len(kb))
+            out += kb
+            _enc_value(item, out)
+    else:
+        raise CheckpointError(
+            f"unencodable journal value type {type(v).__name__}")
+
+
+def _dec_value(buf: bytes, off: int):
+    """-> (value, next_offset). Raises on any malformed input; the
+    segment scanner treats that as a torn record (though the CRC gate
+    in front of it makes a decode failure near-unreachable)."""
+    tag = buf[off]
+    off += 1
+    if tag == 0:
+        return None, off
+    if tag == 1:
+        return False, off
+    if tag == 2:
+        return True, off
+    if tag == 3:
+        return _I64.unpack_from(buf, off)[0], off + 8
+    if tag == 4:
+        return _F64.unpack_from(buf, off)[0], off + 8
+    if tag in (5, 8, 9):
+        n = _U32.unpack_from(buf, off)[0]
+        off += 4
+        if off + n > len(buf):
+            raise ValueError("truncated value")
+        raw = buf[off:off + n]
+        if tag == 5:
+            return raw.decode("utf-8"), off + n
+        if tag == 8:
+            return int(raw.decode("ascii")), off + n
+        return bytes(raw), off + n
+    if tag == 6:
+        n = _U32.unpack_from(buf, off)[0]
+        off += 4
+        items = []
+        for _ in range(n):
+            item, off = _dec_value(buf, off)
+            items.append(item)
+        return items, off
+    if tag == 7:
+        n = _U32.unpack_from(buf, off)[0]
+        off += 4
+        d = {}
+        for _ in range(n):
+            kn = _U32.unpack_from(buf, off)[0]
+            off += 4
+            if off + kn > len(buf):
+                raise ValueError("truncated key")
+            k = buf[off:off + kn].decode("utf-8")
+            off += kn
+            d[k], off = _dec_value(buf, off)
+        return d, off
+    raise ValueError(f"bad value tag {tag}")
+
+
+def _frame_record(seq: int, rtype: int, payload: bytes) -> bytes:
+    crc = zlib.crc32(payload, zlib.crc32(_SEQ_TYPE.pack(seq, rtype)))
+    return _REC_HDR.pack(len(payload), crc, seq, rtype) + payload
+
+
+def _scan_segment(buf: bytes):
+    """-> (records [(seq, delta_doc)...], valid_end, clean_tail).
+
+    Walks the framed records from the magic to the first stop: the
+    preallocated zero tail (clean), end-of-file on a record boundary
+    (clean), or a record whose header/CRC/payload fails validation —
+    the torn tail a crash legally shredded (not clean). ``valid_end``
+    is where the next append belongs."""
+    if len(buf) < _SEG_HDR_LEN or buf[:_SEG_HDR_LEN] != SEG_MAGIC:
+        return [], 0, False
+    records = []
+    off = _SEG_HDR_LEN
+    hdr = _REC_HDR
+    while True:
+        if off + hdr.size > len(buf):
+            return records, off, buf.count(0, off) == len(buf) - off
+        length, crc, seq, rtype = hdr.unpack_from(buf, off)
+        if length == 0 and crc == 0 and seq == 0 and rtype == 0:
+            # Preallocated zero tail — the clean end (a real record can
+            # never frame this way: its CRC covers a nonzero seq).
+            return records, off, buf.count(0, off) == len(buf) - off
+        body = off + hdr.size
+        if length > _MAX_RECORD or body + length > len(buf) or seq <= 0:
+            return records, off, False
+        payload = buf[body:body + length]
+        if zlib.crc32(payload,
+                      zlib.crc32(_SEQ_TYPE.pack(seq, rtype))) != crc:
+            return records, off, False
+        try:
+            doc, dend = _dec_value(payload, 0)
+        except (ValueError, IndexError, struct.error,
+                UnicodeDecodeError):
+            return records, off, False
+        if dend != length or not isinstance(doc, dict):
+            return records, off, False
+        if rtype == _REC_DELTA:
+            records.append((seq, doc))
+        # Unknown record types: valid frame, skip the payload —
+        # forward-compatibility for readers one version behind.
+        off = body + length
 
 
 @dataclass
@@ -181,24 +374,58 @@ class CheckpointManager:
     """
 
     SLOT_PAD = 4096
-    # Journal preallocation chunk: appends land inside already-allocated
+    # Segment preallocation chunk: appends land inside already-allocated
     # blocks, so the group fdatasync stays a pure data sync (a growing
     # file would drag block-allocation metadata into every sync — the
     # same cost class the slot scheme's in-place overwrites avoid).
+    # Segments are preallocated this much at creation and extended by
+    # the same chunk when the tail outruns it.
     JOURNAL_ALLOC = 256 * 1024
     # Bounded-lag compaction threshold: recovery replays at most this
     # many journal records over the last compacted slot image, and the
     # journal file size stays bounded. One full-image slot store per
     # LAG appends amortizes to noise on the hot path.
     JOURNAL_COMPACT_LAG = 64
+    # Size roll: a segment whose tail crosses this rotates to a fresh
+    # segment WITHOUT a compaction — bounds any one file even while
+    # compaction is degraded (ENOSPC on the slots), so recovery never
+    # has to chew an unbounded segment.
+    SEGMENT_ROLL = 1024 * 1024
+    # Adaptive group-commit window (SURVEY §23): the sync leader holds
+    # up to this long when the recent arrival rate predicts
+    # co-committers, so coalescing is engineered instead of lucky.
+    # Deadline-capped; never held under idle/sequential load (the
+    # EWMA + concurrency-evidence test in journal_barrier).
+    GROUP_WINDOW_US = 150.0
+    # Hold only when the EWMA inter-append interval is within this many
+    # windows. The factor is deliberately generous: on a GIL-serialized
+    # single-core host a fully saturated pipeline still shows ~1ms
+    # between appends, so a tight factor would never let the window fire
+    # under exactly the load it exists for. Idle safety does NOT depend
+    # on this number — the hold additionally requires concurrency
+    # evidence (a newer append already landed, or a waiter is parked on
+    # the barrier), so strictly sequential traffic never holds no matter
+    # how small its inter-append interval looks.
+    WINDOW_EWMA_FACTOR = 16.0
+    _EWMA_ALPHA = 0.2
 
     def __init__(self, directory: str, filename: str = "checkpoint.json",
-                 journal_compact_lag: Optional[int] = None):
+                 journal_compact_lag: Optional[int] = None,
+                 group_window_us: Optional[float] = None,
+                 segment_roll_bytes: Optional[int] = None):
         os.makedirs(directory, exist_ok=True)
         self._path = os.path.join(directory, filename)
         self._side_paths = (self._path + ".b", self._path + ".c")
-        self._journal_path = self._path + ".journal"
+        # Pre-segmented (JSON line-record) journal: read-only legacy
+        # input to recovery; retired at the first compaction.
+        self._legacy_path = self._path + ".journal"
         self._compact_lag = journal_compact_lag or self.JOURNAL_COMPACT_LAG
+        if group_window_us is None:
+            group_window_us = float(os.environ.get(
+                "TPU_DRA_JOURNAL_WINDOW_US", str(self.GROUP_WINDOW_US)))
+        self._window_s = max(group_window_us, 0.0) * 1e-6
+        self._window_hold_max_s = self._window_s * self.WINDOW_EWMA_FACTOR
+        self._segment_roll = segment_roll_bytes or self.SEGMENT_ROLL
         self._fds: Dict[str, int] = {}
         self._sizes: Dict[str, int] = {}
         # Observability counters (the group-commit regression tripwire,
@@ -218,6 +445,11 @@ class CheckpointManager:
         self.journal_group_syncs: int = 0
         self.journal_compactions: int = 0
         self.journal_lag: int = 0
+        # Adaptive-window observability: holds must stay 0 under
+        # sequential load (the perf tier's never-holds-idle tripwire);
+        # rotations count fresh segments becoming the append target.
+        self.journal_window_holds: int = 0
+        self.journal_rotations: int = 0
         # Seed per-slot seqs from whatever is on disk so a manager that
         # stores before loading (e.g. a tool force-writing a downgrade
         # image) still supersedes stale slots from an earlier process,
@@ -245,26 +477,54 @@ class CheckpointManager:
         self._sync_in_flight = False
         self._synced_seq = 0
         self._appended_seq = 0
-        # True while a journal swap's rename still needs its directory
+        # True while a segment rotation's directory mutation (new
+        # segment dirent, retired unlinks) still needs its directory
         # sync: the next group sync's leader retries it before any
-        # post-swap record may be declared durable (see _swap_journal).
+        # post-rotation record may be declared durable.
         self._dir_dirty = False
-        # Journal recovery scan: find the valid tail, seed _seq past any
-        # journal record so new stores supersede the replay, and count
-        # the replayable lag.
-        records, valid_end = self._read_journal()
+        # Group-commit window state: EWMA of the inter-append interval
+        # (written under _journal_lock; read racily by the leader — a
+        # float read under the GIL) and whether a leader is currently
+        # holding the window (so appends know to notify it).
+        self._last_append_t: Optional[float] = None
+        self._arrival_ewma: Optional[float] = None
+        self._window_holding = False
+        self._barrier_waiters = 0
+        # Journal recovery scan: walk the legacy JSON journal plus the
+        # binary segment chain to find the valid tail, seed _seq past
+        # any journal record so new stores supersede the replay, and
+        # count the replayable lag.
+        records, active_end = self._scan_chain()
         if records:
             self._seq = max(self._seq, max(seq for seq, _ in records))
             best_slot = max(self._slot_seqs.values())
             self.journal_lag = sum(1 for seq, _ in records
                                    if seq > best_slot)
-        existed = os.path.exists(self._journal_path)
-        self._journal_fd = vfs.open_fd(self._journal_path,
-                                       os.O_RDWR | os.O_CREAT, 0o600)
-        if not existed:
-            vfs.fsync_dir(os.path.dirname(self._journal_path))
-        self._journal_tail = valid_end
-        self._journal_alloc = os.fstat(self._journal_fd).st_size
+        seg_files = self._segment_files()
+        if seg_files:
+            self._segments = [idx for idx, _ in seg_files]
+            self._active_seg = self._segments[-1]
+            self._journal_fd = vfs.open_fd(seg_files[-1][1],
+                                           os.O_RDWR | os.O_CREAT, 0o600)
+            self._journal_alloc = os.fstat(self._journal_fd).st_size
+            if active_end < _SEG_HDR_LEN:
+                # The active segment never got (or tore) its magic —
+                # rewrite it in place; appends follow it.
+                self._pwrite_all(self._journal_fd, SEG_MAGIC, 0)
+                active_end = _SEG_HDR_LEN
+            self._journal_tail = active_end
+        else:
+            # First binary-format start (fresh dir, or a legacy-only
+            # dir whose JSON journal stays read-only input): segment 0
+            # becomes the append target, preallocated and with its
+            # dirent made durable up front — the old scheme fsync'd the
+            # fresh journal's dirent here too.
+            self._segments = [0]
+            self._active_seg = 0
+            self._journal_fd = self._create_segment(0)
+            self._journal_alloc = self.JOURNAL_ALLOC
+            self._journal_tail = _SEG_HDR_LEN
+            vfs.fsync_dir(os.path.dirname(self._path))
         self._synced_seq = self._appended_seq = self._seq
         JOURNAL_LAG.set(self.journal_lag)
 
@@ -286,6 +546,69 @@ class CheckpointManager:
             except OSError:
                 pass
             self._journal_fd = None
+
+    # -- segment plumbing ---------------------------------------------------
+
+    def _seg_path(self, idx: int) -> str:
+        return f"{self._path}.wal{idx:08d}"
+
+    def _segment_files(self) -> List[Tuple[int, str]]:
+        """Sorted (index, path) of the on-disk segment chain."""
+        directory = os.path.dirname(self._path)
+        prefix = os.path.basename(self._path) + ".wal"
+        out = []
+        try:
+            names = os.listdir(directory)
+        except FileNotFoundError:
+            return []
+        for name in names:
+            if not name.startswith(prefix):
+                continue
+            try:
+                idx = int(name[len(prefix):])
+            except ValueError:
+                continue
+            out.append((idx, os.path.join(directory, name)))
+        return sorted(out)
+
+    @property
+    def active_segment_path(self) -> str:
+        """The segment currently absorbing appends (tests corrupt its
+        tail to exercise the torn-tail drop)."""
+        return self._seg_path(self._active_seg)
+
+    def journal_segment_paths(self) -> List[str]:
+        return [p for _, p in self._segment_files()]
+
+    @staticmethod
+    def _pwrite_all(fd: int, data: bytes, offset: int) -> None:
+        off = 0
+        while off < len(data):  # POSIX permits short writes
+            n = vfs.pwrite(fd, data[off:], offset + off)
+            if n <= 0:
+                raise CheckpointError(f"short journal write at {offset}")
+            off += n
+
+    def _create_segment(self, idx: int) -> int:
+        """Open a fresh preallocated segment: zeros out to the
+        preallocation chunk (so the first group syncs stay pure data
+        syncs), magic over the head. The caller owns the dirent sync."""
+        fd = vfs.open_fd(self._seg_path(idx),
+                         os.O_RDWR | os.O_CREAT | os.O_TRUNC, 0o600)
+        try:
+            vfs.preallocate(fd, 0, self.JOURNAL_ALLOC)
+            self._pwrite_all(fd, SEG_MAGIC, 0)
+        except BaseException:
+            try:
+                vfs.close_fd(fd)
+            except OSError:
+                pass
+            try:
+                vfs.unlink(self._seg_path(idx))
+            except OSError:
+                pass
+            raise
+        return fd
 
     def _envelope(self, payload: str, seq: int) -> bytes:
         """Checksummed envelope shared by slots and journal records.
@@ -400,19 +723,25 @@ class CheckpointManager:
         self.store(cp, version=version, intent=intent)
 
     # ------------------------------------------------------------------
-    # Append-only journal (SURVEY §14)
+    # Binary segmented journal (SURVEY §14, rebuilt §23)
     # ------------------------------------------------------------------
     # The hot-path replacement for full-image terminal stores: each
-    # prepare/unprepare group commit appends ONE delta record (the
-    # claims it touched), and durability comes from journal_barrier's
-    # leader/follower group fdatasync — concurrent RPCs whose barriers
-    # overlap share a single device sync. The slot files become the
-    # compaction image: once the record lag crosses the bounded-lag
-    # threshold, the full state is stored through the slot scheme and a
-    # fresh journal is swapped in (tmp + rename). Recovery = newest
-    # valid slot image + replay of journal records with seq beyond it,
-    # stopping at the first torn/invalid record (the tail a crash may
-    # legally shred).
+    # prepare/unprepare group commit appends ONE binary delta record
+    # (fixed-width checksummed framing, no per-record JSON), and
+    # durability comes from journal_barrier's leader/follower group
+    # fdatasync — concurrent RPCs whose barriers overlap share a single
+    # device sync, and the leader's adaptive window turns lucky overlap
+    # into engineered coalescing. The journal is a chain of
+    # preallocated segment files (<checkpoint>.walNNNNNNNN): compaction
+    # stores the full image through the slot scheme and RETIRES the old
+    # chain behind a fresh segment (rotation + unlink — no
+    # rewrite-and-rename), and an oversized segment rolls to a fresh
+    # one even when compaction is degraded. Recovery = newest valid
+    # slot image + replay of the legacy JSON journal (pre-segment
+    # format, read-only) then the segment chain in order, stopping at
+    # the first torn/invalid record (the tail a crash may legally
+    # shred) — validated at the binary level, raw bytes against the
+    # framed CRC.
 
     def journal_commit(self, cp: Checkpoint, *, present=(), absent=(),
                        intent: bool = False,
@@ -450,34 +779,45 @@ class CheckpointManager:
         if quarantine:
             delta["quarantine"] = {uid: dict(rec)
                                    for uid, rec in cp.quarantine.items()}
-        payload = json.dumps(delta, sort_keys=True, separators=(",", ":"))
+        payload = bytearray()
+        _enc_value(delta, payload)
+        payload = bytes(payload)
+        now = time.monotonic()
         with self._journal_lock:
             fd = self._ensure_journal_fd()
             self._seq += 1
             seq = self._seq
-            record = self._envelope(payload, seq) + b"\n"
+            record = _frame_record(seq, _REC_DELTA, payload)
             end = self._journal_tail + len(record)
             if end > self._journal_alloc:
                 # Extend the preallocation ahead of the tail so the
                 # group sync never pays block-allocation metadata.
                 grow = max(self.JOURNAL_ALLOC, len(record))
-                vfs.pwrite(fd, b"\0" * grow, self._journal_alloc)
+                vfs.preallocate(fd, self._journal_alloc, grow)
                 self._journal_alloc += grow
-            off = 0
-            while off < len(record):  # POSIX permits short writes
-                n = vfs.pwrite(fd, record[off:],
-                               self._journal_tail + off)
-                if n <= 0:
-                    raise CheckpointError(
-                        f"short journal write at {self._journal_tail}")
-                off += n
+            self._pwrite_all(fd, record, self._journal_tail)
             self._journal_tail = end
             self.journal_appends += 1
             self.journal_lag += 1
             JOURNAL_APPENDS.inc()
             JOURNAL_LAG.set(self.journal_lag)
+            # Arrival-rate EWMA feeding the adaptive group-commit
+            # window: a short recent inter-append interval predicts a
+            # co-committer will land inside a held window.
+            prev = self._last_append_t
+            self._last_append_t = now
+            if prev is not None:
+                dt = now - prev
+                self._arrival_ewma = dt if self._arrival_ewma is None \
+                    else (self._EWMA_ALPHA * dt
+                          + (1.0 - self._EWMA_ALPHA) * self._arrival_ewma)
         with self._sync_cond:
             self._appended_seq = seq
+            if self._window_holding:
+                # A leader is holding the group-commit window for
+                # exactly this append — wake it so the covering sync
+                # can include the record without burning the deadline.
+                self._sync_cond.notify_all()
         # (No checkpoint.corrupt injection here: tearing the appended
         # record would shred the commit's ONLY copy while the RPC still
         # reports success — a torn journal tail is only reachable
@@ -486,23 +826,61 @@ class CheckpointManager:
         # it writes two copies and recovery uses the survivor.)
         if self.journal_lag >= self._compact_lag:
             self._compact(cp)
+        elif self._journal_tail >= self._segment_roll:
+            self._roll_segment()
         return seq
 
-    def journal_barrier(self, token: int) -> None:
+    def journal_barrier(self, token: int, *, urgent: bool = False) -> None:
         """Block until every journal record up to `token` is durable.
         Leader/follower group commit: the first waiter to find no sync
         in flight becomes the leader and issues ONE fdatasync covering
         the whole appended tail; followers whose records that sync
         covers just wait — N concurrent RPCs, 1 device sync. Call
-        WITHOUT holding the data lock, or nothing can coalesce."""
+        WITHOUT holding the data lock, or nothing can coalesce.
+
+        The leader additionally runs the ADAPTIVE GROUP-COMMIT WINDOW
+        (SURVEY §23): when the recent arrival rate predicts a
+        co-committer inside ~one window AND there is live concurrency
+        evidence (records already appended past this token, or waiters
+        queued behind an earlier sync), it holds a bounded,
+        deadline-capped window before issuing the sync so the incoming
+        append shares it. Under idle or strictly sequential load the
+        evidence test fails (a lone caller's own token is always the
+        newest append and nobody waits) and the sync is immediate —
+        the window NEVER taxes the uncontended path. ``urgent=True``
+        (shutdown drain, error-path unwinds) skips the window
+        outright."""
         while True:
             with self._sync_cond:
                 if self._synced_seq >= token:
                     return
                 if self._sync_in_flight:
-                    self._sync_cond.wait()
+                    self._barrier_waiters += 1
+                    try:
+                        self._sync_cond.wait()
+                    finally:
+                        self._barrier_waiters -= 1
                     continue
                 self._sync_in_flight = True
+                if not urgent and self._window_s > 0.0:
+                    ewma = self._arrival_ewma
+                    if (ewma is not None
+                            and ewma <= self._window_hold_max_s
+                            and (self._appended_seq > token
+                                 or self._barrier_waiters > 0)):
+                        self.journal_window_holds += 1
+                        JOURNAL_WINDOW_HOLDS.inc()
+                        self._window_holding = True
+                        deadline = time.monotonic() + self._window_s
+                        while True:
+                            rem = deadline - time.monotonic()
+                            if rem <= 0:
+                                break
+                            # Woken by each append landing inside the
+                            # window; the deadline caps the hold no
+                            # matter how fast they come.
+                            self._sync_cond.wait(rem)
+                        self._window_holding = False
                 target = self._appended_seq
                 dir_dirty = self._dir_dirty
                 with self._journal_lock:
@@ -510,11 +888,12 @@ class CheckpointManager:
             try:
                 vfs.fdatasync(fd)
                 if dir_dirty:
-                    # A journal swap's rename is still awaiting its
-                    # directory sync: without it a crash could recover
-                    # the OLD dirent and lose every post-swap record
-                    # this fdatasync just settled into the new inode.
-                    vfs.fsync_dir(os.path.dirname(self._journal_path))
+                    # A segment rotation's directory mutation is still
+                    # awaiting its sync: without it a crash could
+                    # recover a dirent-less active segment and lose
+                    # every post-rotation record this fdatasync just
+                    # settled into the new inode.
+                    vfs.fsync_dir(os.path.dirname(self._path))
             except BaseException:
                 with self._sync_cond:
                     self._sync_in_flight = False
@@ -534,40 +913,52 @@ class CheckpointManager:
         journal barrier (SURVEY §22): after the drain window finishes
         the last in-flight batch, this settles its records so the next
         incarnation's recovery scan replays a complete tail instead of
-        racing an unsynced one."""
+        racing an unsynced one. Urgent: a drain must not sit out a
+        group-commit window waiting for co-committers that the
+        shutdown already stopped admitting."""
         with self._sync_cond:
             token = self._appended_seq
-        self.journal_barrier(token)
+        self.journal_barrier(token, urgent=True)
 
     def _ensure_journal_fd(self) -> int:
-        """Reopen the journal fd after close() — managers outlive the
-        DeviceState that closed them in test/recovery rebuilds, exactly
-        like the lazily-reopened slot fds. Caller holds _journal_lock.
-        The tail survives (same file, same process); only the
-        allocation is re-read."""
+        """Reopen the active segment's fd after close() — managers
+        outlive the DeviceState that closed them in test/recovery
+        rebuilds, exactly like the lazily-reopened slot fds. Caller
+        holds _journal_lock. The tail survives (same file, same
+        process); only the allocation is re-read."""
         if self._journal_fd is None:
             self._journal_fd = vfs.open_fd(
-                self._journal_path, os.O_RDWR | os.O_CREAT, 0o600)
+                self._seg_path(self._active_seg),
+                os.O_RDWR | os.O_CREAT, 0o600)
             self._journal_alloc = os.fstat(self._journal_fd).st_size
+            if self._journal_alloc < _SEG_HDR_LEN:
+                # Externally truncated/fresh file: restore the magic so
+                # recovery recognizes the segment.
+                self._pwrite_all(self._journal_fd, SEG_MAGIC, 0)
+                self._journal_alloc = _SEG_HDR_LEN
+                self._journal_tail = max(self._journal_tail,
+                                         _SEG_HDR_LEN)
         return self._journal_fd
 
     def _compact(self, cp: Checkpoint) -> None:
         """Bounded-lag compaction: persist the full image through the
-        slot scheme (durable, seq past every journal record), then swap
-        a fresh journal in via tmp + rename. Crash-safe at every step:
-        after the slot store the journal records are stale (seq <= slot
-        seq, recovery skips them), and a swap that never lands just
-        leaves stale records behind. Failure is DEGRADED, not raised —
-        compaction is maintenance; the commit it rode in on already
-        appended, so surfacing an error here would un-report a success.
-        The lag keeps growing and the next append retries."""
+        slot scheme (durable, seq past every journal record), then
+        rotate to a fresh segment and retire the old chain — unlink,
+        not rewrite-and-rename. Crash-safe at every step: after the
+        slot store every journal record is stale (seq <= slot seq,
+        recovery skips them), a rotation that never lands just leaves
+        stale records behind, and a retired segment whose unlink never
+        persisted resurrects only stale records. Failure is DEGRADED,
+        not raised — compaction is maintenance; the commit it rode in
+        on already appended, so surfacing an error here would un-report
+        a success. The lag keeps growing and the next append retries."""
         try:
-            # Injection site: compaction fails (slot ENOSPC, rename
-            # EIO) — the journal must keep absorbing appends and lag
-            # must recover once the fault clears.
+            # Injection site: compaction fails (slot ENOSPC, segment
+            # create EIO) — the journal must keep absorbing appends and
+            # lag must recover once the fault clears.
             FAULTS.check("prepare.journal_compact")
             self.store(cp)
-            self._swap_journal(self._seq)
+            self._retire_segments(self._seq)
             self.journal_compactions += 1
             JOURNAL_COMPACTIONS.inc()
         except Exception:  # noqa: BLE001 — maintenance must not fail
@@ -576,46 +967,37 @@ class CheckpointManager:
             log.warning("journal compaction failed (lag %d, retrying on "
                         "next append)", self.journal_lag, exc_info=True)
 
-    def _swap_journal(self, settled_seq: int) -> None:
-        """Swap a fresh empty journal in (tmp + rename) after a full
-        slot store settled everything up to `settled_seq`. Waits out an
+    def _retire_segments(self, settled_seq: int) -> None:
+        """Rotate to a fresh preallocated segment and retire the whole
+        old chain (plus the legacy JSON journal) after a full slot
+        store settled everything up to `settled_seq`. Waits out an
         in-flight group sync so the old fd is never closed under it.
 
-        The replacement fd is opened on the TMP file BEFORE the rename
-        (the fd follows the inode), so once the rename lands there is no
-        failure window left in which the manager could keep appending to
-        the old, now-unlinked inode — acknowledged commits must never
-        land on an orphan file a crash cannot recover. The rename's own
-        directory sync is allowed to fail: the dirty flag defers it to
-        the next group sync's leader, which must complete it before any
-        post-swap record is declared durable."""
+        The fresh segment is fully created (preallocation + magic)
+        BEFORE the switch, so there is no failure window in which the
+        manager could keep appending to a retired file — and the
+        directory mutations (new dirent, unlinks) may defer their sync:
+        the dirty flag hands it to the next group sync's leader, which
+        must complete it before any post-rotation record is declared
+        durable."""
         with self._sync_cond:
             while self._sync_in_flight:
                 self._sync_cond.wait()
-            tmp = self._journal_path + ".tmp"
-            # Created EMPTY via open_fd so the fresh journal keeps the
-            # 0o600 mode every other journal open uses (write_text
-            # would widen it to 0o644 for the file's whole life).
-            new_fd = vfs.open_fd(
-                tmp, os.O_RDWR | os.O_CREAT | os.O_TRUNC, 0o600)
-            try:
-                vfs.replace(tmp, self._journal_path)
-            except BaseException:
-                # Swap never landed: the old journal stays current and
-                # consistent; just drop the orphan tmp fd.
-                try:
-                    vfs.close_fd(new_fd)
-                except OSError:
-                    pass
-                raise
+            new_idx = self._active_seg + 1
+            new_fd = self._create_segment(new_idx)
             old_fd = self._journal_fd
+            retired = [i for i in self._segments if i != new_idx]
+            self._segments = [new_idx]
+            self._active_seg = new_idx
             self._journal_fd = new_fd
             with self._journal_lock:
-                self._journal_tail = 0
-                self._journal_alloc = 0
+                self._journal_tail = _SEG_HDR_LEN
+                self._journal_alloc = self.JOURNAL_ALLOC
                 self.journal_lag = 0
             self._synced_seq = max(self._synced_seq, settled_seq)
             self._dir_dirty = True
+            self.journal_rotations += 1
+            JOURNAL_ROTATIONS.inc()
             JOURNAL_LAG.set(0)
             self._sync_cond.notify_all()
         if old_fd is not None:
@@ -623,24 +1005,101 @@ class CheckpointManager:
                 vfs.close_fd(old_fd)
             except OSError:
                 pass
+        # Retire the stale chain: every record in it is <= settled_seq,
+        # so a failed (or crash-lost) unlink only resurrects records
+        # recovery skips anyway.
+        for idx in retired:
+            try:
+                vfs.unlink(self._seg_path(idx))
+            except OSError:
+                log.warning("retired segment unlink failed: %s",
+                            self._seg_path(idx), exc_info=True)
         try:
-            vfs.fsync_dir(os.path.dirname(self._journal_path))
+            vfs.unlink(self._legacy_path)
+        except FileNotFoundError:
+            pass
+        except OSError:
+            log.warning("legacy journal unlink failed", exc_info=True)
+        try:
+            vfs.fsync_dir(os.path.dirname(self._path))
             with self._sync_cond:
                 self._dir_dirty = False
         except OSError:
-            log.warning("journal swap dir sync failed; retrying at the "
-                        "next group sync", exc_info=True)
+            log.warning("segment rotation dir sync failed; retrying at "
+                        "the next group sync", exc_info=True)
 
-    def _read_journal(self):
-        """-> ([(seq, delta_doc)...], valid_end_offset). Stops at the
-        first invalid line: a torn tail, preallocated zeros, or garbage
-        — everything after the last valid record is dead weight a crash
-        legally shredded."""
+    def _roll_segment(self) -> None:
+        """Size roll: the active segment outgrew the bound, so settle
+        its tail and continue in a fresh segment WITHOUT a compaction —
+        the old segment's records are still live (no slot image
+        supersedes them), so it stays in the chain until the next
+        compaction retires it. Degraded on failure: appends simply
+        continue in the oversized segment and the next append retries."""
         try:
-            with open(self._journal_path, "rb") as f:
+            with self._sync_cond:
+                while self._sync_in_flight:
+                    self._sync_cond.wait()
+                with self._journal_lock:
+                    if self._journal_tail < self._segment_roll:
+                        return      # a concurrent roll already landed
+                old_fd = self._ensure_rolled_preconditions_locked()
+                # Settle the old tail before abandoning its fd: barrier
+                # tokens for those records must never be vouched for by
+                # a sync on the NEW segment's fd.
+                vfs.fdatasync(old_fd)
+                self.journal_group_syncs += 1
+                JOURNAL_GROUP_SYNCS.inc()
+                self._synced_seq = max(self._synced_seq,
+                                       self._appended_seq)
+                new_idx = self._active_seg + 1
+                new_fd = self._create_segment(new_idx)
+                self._segments.append(new_idx)
+                self._active_seg = new_idx
+                self._journal_fd = new_fd
+                with self._journal_lock:
+                    self._journal_tail = _SEG_HDR_LEN
+                    self._journal_alloc = self.JOURNAL_ALLOC
+                self._dir_dirty = True
+                self.journal_rotations += 1
+                JOURNAL_ROTATIONS.inc()
+                self._sync_cond.notify_all()
+            try:
+                vfs.close_fd(old_fd)
+            except OSError:
+                pass
+            try:
+                vfs.fsync_dir(os.path.dirname(self._path))
+                with self._sync_cond:
+                    self._dir_dirty = False
+            except OSError:
+                log.warning("segment roll dir sync failed; retrying at "
+                            "the next group sync", exc_info=True)
+        except Exception:  # noqa: BLE001 — maintenance must not fail
+            # the commit that triggered the roll.
+            log.warning("segment roll failed (tail %d); retrying on "
+                        "next append", self._journal_tail, exc_info=True)
+
+    def _ensure_rolled_preconditions_locked(self) -> int:
+        """Roll prerequisites (caller holds _sync_cond, no sync in
+        flight): a pending directory sync must land FIRST — the roll is
+        about to bump _synced_seq past records whose segment dirent may
+        not be durable yet — and the fd must be open."""
+        if self._dir_dirty:
+            vfs.fsync_dir(os.path.dirname(self._path))
+            self._dir_dirty = False
+        with self._journal_lock:
+            return self._ensure_journal_fd()
+
+    def _read_legacy_journal(self):
+        """-> [(seq, delta_doc)...] from the pre-segment JSON
+        line-record journal (read-only legacy input; the first
+        compaction retires the file). Stops at the first invalid line:
+        a torn tail, preallocated zeros, or garbage."""
+        try:
+            with open(self._legacy_path, "rb") as f:
                 buf = f.read()
         except FileNotFoundError:
-            return [], 0
+            return []
         records = []
         off = 0
         while True:
@@ -666,7 +1125,36 @@ class CheckpointManager:
                 break
             records.append((seq, doc))
             off = nl + 1
-        return records, off
+        return records
+
+    def _scan_chain(self):
+        """-> ([(seq, delta_doc)...], active_valid_end). The full
+        replayable record stream: legacy JSON journal first (it always
+        predates any binary segment — the first compaction retires it),
+        then the segment chain in index order. The first torn/invalid
+        record drops everything after it — only the chain's true tail
+        can legally tear (crashes append at the end), so the drop is
+        exactly the torn suffix. ``active_valid_end`` is the append
+        offset inside the LAST segment (0 when none exist)."""
+        records = self._read_legacy_journal()
+        active_end = 0
+        broken = False
+        for idx, path in self._segment_files():
+            active_end = 0
+            if broken:
+                continue     # chain already torn: later records dead
+            try:
+                with open(path, "rb") as f:
+                    buf = f.read()
+            except (FileNotFoundError, OSError):
+                broken = True
+                continue
+            segment_records, valid_end, clean = _scan_segment(buf)
+            records.extend(segment_records)
+            active_end = valid_end
+            if not clean:
+                broken = True
+        return records, active_end
 
     def _replay_journal(self, cp: Optional[Checkpoint],
                         base_seq: int) -> Optional[Checkpoint]:
@@ -674,7 +1162,7 @@ class CheckpointManager:
         over `cp`, in append order. Records at or below the base are the
         compaction's leftovers; the torn tail was already dropped by the
         scan."""
-        records, _ = self._read_journal()
+        records, _ = self._scan_chain()
         for seq, doc in records:
             if seq <= base_seq:
                 continue
@@ -792,12 +1280,17 @@ class CheckpointManager:
         # sides of an up/downgrade handle the state, and the v1 view
         # drops non-completed claims by construction (to_v1_doc).
         self.store(cp)
-        if self._journal_tail:
+        if (self._journal_tail > _SEG_HDR_LEN or len(self._segments) > 1
+                or os.path.exists(self._legacy_path)):
+            # Startup is a free compaction point: retire the replayed
+            # chain (and fold a legacy JSON journal into the binary
+            # scheme — the repair store above IS its migrated image).
             try:
-                self._swap_journal(self._seq)
+                self._retire_segments(self._seq)
             except Exception:  # noqa: BLE001 — the repair store above
-                # already made every journal record stale; a failed swap
-                # only leaves dead records to skip on the next load.
-                log.warning("journal swap at startup failed",
+                # already made every journal record stale; a failed
+                # rotation only leaves dead records to skip on the next
+                # load.
+                log.warning("journal rotation at startup failed",
                             exc_info=True)
         return cp

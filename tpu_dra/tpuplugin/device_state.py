@@ -1410,7 +1410,10 @@ class DeviceState:
         the safe direction; a crash merely re-runs the ladder) and the
         next group sync or compaction re-covers the record."""
         try:
-            self._ckpt_mgr.journal_barrier(token)
+            # urgent: quarantine transitions are rare control-path
+            # events — holding the adaptive group-commit window would
+            # add latency with no co-committers to coalesce.
+            self._ckpt_mgr.journal_barrier(token, urgent=True)
         except Exception:  # noqa: BLE001 — safe-direction degradation
             log.warning("quarantine journal sync failed; record may not "
                         "be durable until the next group sync",
