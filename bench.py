@@ -1143,9 +1143,49 @@ def _start_bind_watcher(cluster, stop):
     return bound_q, watcher
 
 
+def _start_hollow_fleet(cluster, node_names, n_watchers, stop):
+    """Kubemark-style hollow-node watcher fleet (ISSUE 18): `n_watchers`
+    threads, each holding a field-selector-scoped pod watch
+    (spec.nodeName=<node>) the way a kubelet does. Under the sharded
+    fan-out these streams are topic-indexed — a node-scoped watcher is
+    never even offered another node's bind events — so the fleet's cost
+    is per-DELIVERED-event, not per-watcher x per-event. Returns
+    (threads, stats) where stats rows are per-watcher dicts of
+    events/bookmarks/errors counts, mutated live."""
+    import threading
+
+    from tpu_dra.k8s import PODS
+
+    stats = [{"events": 0, "bookmarks": 0, "errors": 0}
+             for _ in range(n_watchers)]
+    stride = max(1, len(node_names) // n_watchers)
+
+    def hollow(i, node):
+        st = stats[i]
+        for ev, obj in cluster.watch(
+                PODS, namespace="default", stop=stop,
+                field_selector=f"spec.nodeName={node}"):
+            if ev == "BOOKMARK":
+                st["bookmarks"] += 1
+            elif ev == "ERROR":
+                st["errors"] += 1
+                break
+            else:
+                st["events"] += 1
+
+    threads = []
+    for i in range(n_watchers):
+        node = node_names[(i * stride) % len(node_names)]
+        t = threading.Thread(target=hollow, args=(i, node), daemon=True,
+                             name=f"hollow-{i}")
+        t.start()
+        threads.append(t)
+    return threads, stats
+
+
 def bench_sched_churn(n_nodes: int = None, n_pods: int = None,
                       chips_per_node: int = 4, window: int = None,
-                      workers: int = None):
+                      workers: int = None, hollow_watchers: int = 0):
     """Control-plane churn at scale (ISSUE 3, parallelized in ISSUE 8):
     N fake nodes publishing ResourceSlices, M pod lifecycles (create ->
     template claim -> allocate -> bind -> delete -> claim GC) through
@@ -1186,9 +1226,10 @@ def bench_sched_churn(n_nodes: int = None, n_pods: int = None,
         DEFAULT_SCHED_SELECTOR,
         'device.attributes["tpu.dev"].generation == "v5p"',
     ]
-    seed_sched_inventory(cluster, nodes=n_nodes,
-                         chips_per_node=chips_per_node,
-                         node_fmt="n{i:03d}", selector_exprs=exprs)
+    node_names = seed_sched_inventory(cluster, nodes=n_nodes,
+                                      chips_per_node=chips_per_node,
+                                      node_fmt="n{i:03d}",
+                                      selector_exprs=exprs)
 
     capacity = n_nodes * chips_per_node
     window = min(window or 64, max(1, capacity // 2), n_pods)
@@ -1207,6 +1248,10 @@ def bench_sched_churn(n_nodes: int = None, n_pods: int = None,
     sched.start()
     stop = threading.Event()
     bound_q, _watcher = _start_bind_watcher(cluster, stop)
+    hollow_stats = []
+    if hollow_watchers:
+        _hollow_threads, hollow_stats = _start_hollow_fleet(
+            cluster, node_names, hollow_watchers, stop)
 
     def make_pod(i):
         name = f"churn-{i:05d}"
@@ -1272,9 +1317,74 @@ def bench_sched_churn(n_nodes: int = None, n_pods: int = None,
         "sched_cel_cache_hit_pct": round(
             100.0 * hits / (hits + misses), 2) if (hits + misses) else None,
     }
+    if hollow_watchers:
+        delivered = [s["events"] for s in hollow_stats]
+        out["sched_hollow_watchers"] = hollow_watchers
+        out["sched_hollow_events_total"] = sum(delivered)
+        out["sched_hollow_events_max"] = max(delivered)
+        out["sched_hollow_bookmarks"] = sum(
+            s["bookmarks"] for s in hollow_stats)
+        out["sched_hollow_overflow_errors"] = sum(
+            s["errors"] for s in hollow_stats)
     if not gc_ok:
         out["sched_churn_gc_leak"] = len(
             cluster.list(RESOURCECLAIMS, namespace="default"))
+    return out
+
+
+def bench_sched_scale10k(n_nodes: int = None, n_pods: int = None,
+                         n_watchers: int = None, chips_per_node: int = 4,
+                         baseline_nodes: int = None,
+                         baseline_pods: int = None):
+    """Kubemark-style control-plane scale-out bench (ISSUE 18): a
+    10k-node inventory running 100k pod lifecycles through the real
+    scheduler pool (partitioned claims informer + sharded watch
+    fan-out), with a hollow-node fleet of field-selector-scoped pod
+    watchers riding the stream the way kubelets would. Sizes default
+    from TPU_DRA_BENCH_SCALE10K_NODES/PODS/WATCHERS (10000 / 100000 /
+    100). Reports, prefixed sched_scale10k_*:
+
+    - the full sched_* churn key set at 10k nodes (throughput, p50/p95,
+      full relists — MUST stay 0, shard resyncs, CEL cache);
+    - hollow-fleet isolation: sched_scale10k_hollow_events_max is the
+      busiest node-scoped watcher's delivered-event count — under the
+      topic-indexed fan-out it stays ~pods/nodes-ish, NOT ~2x pods
+      (which is what every scoped watcher saw under the broadcast
+      fan-out this PR replaces); zero watcher-queue overflows;
+    - a SAME-RUN 1000-node baseline (sched_scale10k_baseline_*) and
+      sched_scale10k_throughput_ratio = 10k pps / baseline pps: the
+      cost of scaling nodes 10x, gated >= PERF_SCALE10K_RATIO (default
+      0.5 — within 2x of the 1000-node rate) in hack/perf.sh.
+
+    The baseline runs FIRST and in the same process so the ratio
+    compares like against like (same box, same load, same GIL).
+    """
+    n_nodes = n_nodes if n_nodes is not None else int(
+        os.environ.get("TPU_DRA_BENCH_SCALE10K_NODES", "10000"))
+    n_pods = n_pods if n_pods is not None else int(
+        os.environ.get("TPU_DRA_BENCH_SCALE10K_PODS", "100000"))
+    n_watchers = n_watchers if n_watchers is not None else int(
+        os.environ.get("TPU_DRA_BENCH_SCALE10K_WATCHERS", "100"))
+    baseline_nodes = baseline_nodes if baseline_nodes is not None else int(
+        os.environ.get("TPU_DRA_BENCH_SCALE10K_BASELINE_NODES", "1000"))
+    baseline_pods = baseline_pods if baseline_pods is not None else int(
+        os.environ.get("TPU_DRA_BENCH_SCALE10K_BASELINE_PODS", "5000"))
+
+    base = bench_sched_churn(n_nodes=baseline_nodes, n_pods=baseline_pods,
+                             chips_per_node=chips_per_node)
+    big = bench_sched_churn(n_nodes=n_nodes, n_pods=n_pods,
+                            chips_per_node=chips_per_node,
+                            hollow_watchers=n_watchers)
+    out = {k.replace("sched_", "sched_scale10k_", 1): v
+           for k, v in big.items()}
+    base_pps = base["sched_throughput_pods_per_s"]
+    out["sched_scale10k_baseline_nodes"] = baseline_nodes
+    out["sched_scale10k_baseline_pods"] = baseline_pods
+    out["sched_scale10k_baseline_throughput_pods_per_s"] = base_pps
+    out["sched_scale10k_baseline_pod_to_allocated_p50_ms"] = base[
+        "sched_pod_to_allocated_p50_ms"]
+    out["sched_scale10k_throughput_ratio"] = round(
+        big["sched_throughput_pods_per_s"] / base_pps, 3) if base_pps else None
     return out
 
 
@@ -1811,7 +1921,10 @@ def bench_mfu(jax_probe, steps: int = 10):
         "mfu_matmul_params": int(matmul_params),
         "train_step_s": round(step_s, 4),
         "tokens_per_s": round(tokens_per_step / step_s, 1),
-        "step_tflops_per_s": round(step_tflops, 2),
+        # 4 decimals: the CPU tier's small config can land under 0.005
+        # TFLOP/s on a slow/loaded host, and round(x, 2) flooring it to
+        # 0.0 made the >0 accounting check flake (ISSUE 18 S4).
+        "step_tflops_per_s": round(step_tflops, 4),
     }
     gen = jax_probe["generation"]
     if on_tpu and gen in PEAK_BF16_TFLOPS:
@@ -1950,6 +2063,16 @@ def main():
         })
     except Exception as e:  # noqa: BLE001 — scaled phase is best-effort
         out["sched_scaled_churn_error"] = str(e)
+    try:
+        # 10k-node scale-out phase (ISSUE 18): kubemark-style 100k pod
+        # lifecycles + hollow-node watcher fleet over the sharded watch
+        # fan-out, with a same-run 1000-node baseline for the scaling
+        # ratio. Own isolated section — sizes come from
+        # TPU_DRA_BENCH_SCALE10K_* so CI and overnight runs differ by
+        # env, not by code edits.
+        out.update(bench_sched_scale10k())
+    except Exception as e:  # noqa: BLE001 — scale10k phase is best-effort
+        out["sched_scale10k_error"] = str(e)
     try:
         out.update(bench_topology())
     except Exception as e:  # noqa: BLE001 — topology phase is best-effort

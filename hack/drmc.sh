@@ -17,10 +17,13 @@
 #    gate requires >= 200 distinct interleavings total (--min-schedules)
 #    so a silently shrunken scenario cannot go green by exploring
 #    nothing; a SECOND dedicated run holds the evict-churn scenario
-#    ALONE to >= 200 interleavings (the ISSUE 12 acceptance bar), and a
+#    ALONE to >= 200 interleavings (the ISSUE 12 acceptance bar), a
 #    THIRD holds takeover-resync (deposed-leader commits vs. the HA
 #    takeover's bump-then-resync against the real fencing reactor,
-#    SURVEY §22) to the same >= 200-interleaving floor (ISSUE 16).
+#    SURVEY §22) to the same >= 200-interleaving floor (ISSUE 16), and
+#    a FOURTH holds shard-dispatch (the partitioned informer's bounded
+#    per-shard FIFOs: watcher-queue overflow vs. relist healing vs.
+#    mid-stream stop(), SURVEY §24) to >= 200 interleavings (ISSUE 18).
 # 2. Crash-point enumerator — 100% of the batch-prepare-crash AND
 #    quarantine-crash (chip-quarantine journal ops interleaved with a
 #    claim lifecycle) scenarios' durable ops crashed (clean /
@@ -56,6 +59,11 @@ JAX_PLATFORMS=cpu python -m tpu_dra.analysis.drmc \
 echo ">> drmc: takeover-resync dedicated floor (>= 200 interleavings)"
 JAX_PLATFORMS=cpu python -m tpu_dra.analysis.drmc \
   --scenario takeover-resync --budget 250 --min-schedules 200 \
+  --deadline 120 --skip-crash
+
+echo ">> drmc: shard-dispatch dedicated floor (>= 200 interleavings)"
+JAX_PLATFORMS=cpu python -m tpu_dra.analysis.drmc \
+  --scenario shard-dispatch --budget 250 --min-schedules 200 \
   --deadline 120 --skip-crash
 
 echo ">> drmc tier green"

@@ -565,6 +565,73 @@ if pool["sched_throughput_pods_per_s"] < base_pps:
              "worker pool must never cost more than it buys")
 EOF
 
+echo ">> scale-out churn gates (${PERF_SCALE10K_NODES:-10000} nodes x ${PERF_SCALE10K_PODS:-100000} pods, kubemark-style hollow fleet)"
+# 5. 10k-node scale-out gates (ISSUE 18, SURVEY §24): the kubemark-
+#    style bench — 100k pod lifecycles through the real scheduler pool
+#    on a 10k-node inventory, with PERF_SCALE10K_WATCHERS hollow-node
+#    field-selector watchers riding the sharded watch fan-out. Gates:
+#    - throughput within 2x of the SAME-RUN 1000-node baseline
+#      (ratio >= PERF_SCALE10K_RATIO, default 0.5): scaling nodes 10x
+#      may cost at most half the cluster-wide rate;
+#    - an absolute host-budgeted floor (>= PERF_SCALE10K_MIN_PPS,
+#      default derived from the cpu ref: ~25/cpu_ref pods/s, i.e.
+#      ~130 pods/s on a desktop-class core) so BOTH runs collapsing
+#      together cannot go green on ratio alone;
+#    - zero scheduler full relists at 10k nodes (event-driven, never
+#      poll-and-scan) and zero snapshot-isolation conflicts repaired
+#      by luck — plus zero hollow-watcher queue overflows (the fan-out
+#      must keep per-watcher delivery at scoped volume);
+#    - hollow isolation: the busiest scoped watcher must see < 20% of
+#      the cluster-wide pod event volume (under the old broadcast
+#      fan-out every watcher decoded 100% of it).
+#    Sizes override via PERF_SCALE10K_NODES/PODS/WATCHERS for smaller
+#    CI boxes; BENCH recording rounds run the defaults.
+PERF_SCALE10K_MIN_PPS="${PERF_SCALE10K_MIN_PPS:-$(python -c "
+import sys; print(round(min(400.0, 25.0 / float(sys.argv[1])), 1))" "$PERF_CPU_REF_MS")}"
+JAX_PLATFORMS=cpu TPU_DRA_TPUINFO_BACKEND=fake \
+  TPU_DRA_BENCH_SCALE10K_NODES="${PERF_SCALE10K_NODES:-10000}" \
+  TPU_DRA_BENCH_SCALE10K_PODS="${PERF_SCALE10K_PODS:-100000}" \
+  TPU_DRA_BENCH_SCALE10K_WATCHERS="${PERF_SCALE10K_WATCHERS:-100}" \
+  PERF_SCALE10K_RATIO="${PERF_SCALE10K_RATIO:-0.5}" \
+  PERF_SCALE10K_MIN_PPS="$PERF_SCALE10K_MIN_PPS" \
+  python - <<'EOF'
+import json
+import os
+import sys
+
+import bench
+
+out = bench.bench_sched_scale10k()
+print(json.dumps(out))
+ratio_floor = float(os.environ["PERF_SCALE10K_RATIO"])
+pps_floor = float(os.environ["PERF_SCALE10K_MIN_PPS"])
+pps = out["sched_scale10k_throughput_pods_per_s"]
+ratio = out["sched_scale10k_throughput_ratio"]
+if out["sched_scale10k_full_relists"] != 0:
+    sys.exit(f"REGRESSION: {out['sched_scale10k_full_relists']} full "
+             "relists in the 10k-node churn — the scale-out fan-out "
+             "fell back to poll-and-scan")
+if ratio is None or ratio < ratio_floor:
+    sys.exit(f"REGRESSION: 10k-node throughput {pps} pods/s is "
+             f"{ratio}x the same-run 1000-node baseline "
+             f"{out['sched_scale10k_baseline_throughput_pods_per_s']} "
+             f"(< {ratio_floor}x — ISSUE 18 gate: within 2x)")
+if pps < pps_floor:
+    sys.exit(f"REGRESSION: 10k-node throughput {pps} pods/s under the "
+             f"host-budgeted floor {pps_floor} (cpu-ref-derived)")
+if out["sched_scale10k_hollow_overflow_errors"] != 0:
+    sys.exit(f"REGRESSION: "
+             f"{out['sched_scale10k_hollow_overflow_errors']} hollow "
+             "watchers hit queue-overflow 410 — scoped delivery volume "
+             "exceeded the per-watcher bound")
+total_pod_events = 2 * out["sched_scale10k_churn_pods"]  # bind + delete
+hot = out["sched_scale10k_hollow_events_max"]
+if hot >= 0.2 * total_pod_events:
+    sys.exit(f"REGRESSION: busiest scoped watcher saw {hot} events "
+             f"(>= 20% of {total_pod_events} cluster-wide) — the "
+             "field-selector index degraded toward broadcast fan-out")
+EOF
+
 echo ">> data-plane gates (topology-allocated mesh psum + placement A/B)"
 # ISSUE 10 gates: the psum must run on EVERY chip the driver allocated
 # on the fake multi-host backend (coverage N/N with psum_devices > 1,

@@ -8,9 +8,25 @@ accounting rules it must uphold:
   term (counting it inflated round-2 MFU by ~12%).
 """
 
+import importlib.util
+import os
+
 import pytest
 
 import bench
+
+
+def _cpu_ref_ms() -> float:
+    """The fsync_probe CPU serialization reference for THIS host (hack/
+    is not a package, so load by path). Used to derive timing
+    tolerances that scale with host speed instead of flaking on slow
+    or loaded CI runners."""
+    path = os.path.join(os.path.dirname(__file__), os.pardir,
+                        "hack", "fsync_probe.py")
+    spec = importlib.util.spec_from_file_location("fsync_probe", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.measure_cpu(iters=20)
 
 
 class FakeDevice:
@@ -71,7 +87,14 @@ class TestMfuAccounting:
         probe = {**bench.probe_jax(), "platform": "cpu", "generation": None}
         out = bench.bench_mfu(probe, steps=2)
         assert out["mfu_matmul_params"] == out["mfu_model_params"] - 512 * 128
-        assert out["step_tflops_per_s"] > 0
+        # Host-relative floor (ISSUE 18 S4): the absolute `> 0` bound
+        # flaked once round(x, 2) floored a slow host's tiny-config
+        # throughput to 0.0. Derive the tolerance from the fsync_probe
+        # CPU reference instead: throughput scales ~inversely with the
+        # serialization workload's latency, and the constant leaves
+        # ~4x headroom below what a nominal host measures.
+        floor = min(0.005, 0.001 / max(_cpu_ref_ms(), 1e-6))
+        assert out["step_tflops_per_s"] >= floor
 
     def test_long_context_phase_is_tpu_only(self):
         """The S=8192 flagship config would take minutes on CPU; the

@@ -10,7 +10,10 @@ at every terminal state: no device double-allocation
 acyclic lock-order graph (the witness runs under every schedule).
 ``sched-churn`` drives the MULTI-WORKER WorkQueue pool + sharded
 AllocationIndex pair the parallel scheduler core (SURVEY §15) is built
-on, with an explicit per-key serialization probe; ``batch-prepare``
+on, with an explicit per-key serialization probe; ``shard-dispatch``
+drives the partitioned informer's ShardDispatcher (bounded per-shard
+FIFOs, overflow shedding, relist healing, mid-stream stop()) against
+the same AllocationIndex truth discipline; ``batch-prepare``
 drives concurrent DeviceState prepare/unprepare/health batches. ``racy-index``
 is the deliberately-buggy fixture — an unserialized check-then-act on
 the index — whose violating schedule the tests record and replay.
@@ -37,7 +40,7 @@ import os
 import shutil
 import tempfile
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from tpu_dra.infra.workqueue import RateLimiter, WorkQueue
 
@@ -212,6 +215,169 @@ class SchedChurnScenario:
 def _entries(claim: Dict):
     from tpu_dra.simcluster.scheduler import claim_entries
     return claim_entries(claim)
+
+
+# ---------------------------------------------------------------------------
+# shard-dispatch: the sched-churn family's sharded fan-out probe
+# ---------------------------------------------------------------------------
+
+class ShardDispatchScenario:
+    """The partitioned informer's ShardDispatcher driven as explicit
+    interleaved tasks: a producer offering claim deltas into BOUNDED
+    per-shard FIFOs (cap 1, so overflow is reachable in most orderings),
+    one drainer per shard, a relist task healing dirty shards from the
+    intent record, and a stopper calling the real ``stop()`` mid-stream.
+    This is the overflow-vs-relist-vs-shutdown race surface behind the
+    10k-node fan-out: a shed delta MUST be healed by a shard relist, a
+    relist racing fresh deltas must not resurrect stale state (seq
+    gating — the scheduler's resourceVersion discipline), and a stop()
+    racing live drains must strand nothing. Invariant at every terminal
+    state: after the single-threaded quiesce, applied state == intended
+    state per key, the AllocationIndex matches truth exactly, and no
+    chip is double-booked."""
+
+    name = "shard-dispatch"
+
+    def __init__(self):
+        # Observability for the tests: how many offers shed in the last
+        # run (check() records it) — proves the probe exercises the
+        # overflow path rather than vacuously passing.
+        self._last_overflows = 0
+
+    def build(self, sched) -> Dict:
+        from tpu_dra.k8s.informer import ShardDispatcher
+        from tpu_dra.simcluster.scheduler import AllocationIndex
+
+        index = AllocationIndex(n_shards=2)
+        truth: Dict[str, Dict] = {}
+        # intent[key] = (seq, devices|None): what the apiserver said
+        # last, recorded BEFORE the offer — the watch event exists even
+        # when the dispatch sheds it, which is exactly why a shed must
+        # mark the shard dirty.
+        intent: Dict[str, Tuple[int, Optional[List[str]]]] = {}
+        applied_seq: Dict[str, int] = {}
+        dirty: set = set()
+        truth_lock = threading.Lock()   # witnessed: created under install
+
+        disp = ShardDispatcher(2, cap=1, name="drmc",
+                               on_overflow=lambda sid, why: dirty.add(sid))
+        # Two keys per shard, found deterministically (crc32 is stable).
+        by_shard: Dict[int, List[str]] = {0: [], 1: []}
+        i = 0
+        while any(len(v) < 1 for v in by_shard.values()) or i < 4:
+            k = f"pool-{i}"
+            if len(by_shard[disp.route(k)]) < 2:
+                by_shard[disp.route(k)].append(k)
+            i += 1
+        key_a, key_b = by_shard[0][0], by_shard[1][0]
+
+        def apply_intent(key: str, seq: int,
+                         devices: Optional[List[str]]) -> None:
+            # Seq-gated apply: an old delta drained AFTER a relist (or a
+            # relist re-reading already-applied intent) must be a no-op,
+            # never a regression — the RV discipline in miniature.
+            with truth_lock:
+                if seq <= applied_seq.get(key, 0):
+                    return
+                applied_seq[key] = seq
+                old = truth.pop(key, None)
+                if old is not None:
+                    index.remove(old, force=True)
+                if devices is not None:
+                    claim = _mk_claim(key, devices, seq)
+                    index.apply(claim)
+                    truth[key] = claim
+
+        def delta(key: str, seq: int, devices: Optional[List[str]]):
+            return lambda: apply_intent(key, seq, devices)
+
+        def offer(key: str, seq: int,
+                  devices: Optional[List[str]]) -> None:
+            with truth_lock:
+                intent[key] = (seq, devices)
+            disp.offer(disp.route(key), delta(key, seq, devices))
+
+        def producer() -> None:
+            offer(key_a, 1, ["chip-0"])
+            offer(key_b, 2, ["chip-1"])
+            offer(key_a, 3, ["chip-2"])   # rebind: remove + apply
+            offer(key_b, 4, None)         # unbind
+
+        def drainer(sid: int):
+            def run() -> None:
+                for _ in range(4):
+                    disp.drain_one(sid)
+            return run
+
+        def relist() -> None:
+            # Heal pass racing everything else: clear the flag FIRST so
+            # a shed that lands after our truth read re-dirties the
+            # shard for the terminal heal in check().
+            for sid in (0, 1):
+                if sid in dirty:
+                    dirty.discard(sid)
+                    for key in by_shard[sid]:
+                        rec = intent.get(key)
+                        if rec is not None:
+                            apply_intent(key, rec[0], rec[1])
+
+        def stopper() -> None:
+            disp.stop()
+
+        sched.spawn("producer", producer)
+        sched.spawn("drain0", drainer(0))
+        sched.spawn("drain1", drainer(1))
+        sched.spawn("relist", relist)
+        sched.spawn("stopper", stopper)
+        return {"disp": disp, "index": index, "truth": truth,
+                "intent": intent, "applied_seq": applied_seq,
+                "dirty": dirty, "by_shard": by_shard}
+
+    def check(self, ctx) -> List[str]:
+        from tpu_dra.simcluster.chaos import chip_conflicts
+
+        disp, index, truth = ctx["disp"], ctx["index"], ctx["truth"]
+        self._last_overflows = disp.overflows
+        # Quiesce the way the informer's stop() + scheduler resync
+        # would: drain stranded thunks single-threaded, then run the
+        # shard relist for anything still marked dirty.
+        for sid in (0, 1):
+            while disp.drain_one(sid):
+                pass
+        for sid in sorted(ctx["dirty"]):
+            for key in ctx["by_shard"][sid]:
+                rec = ctx["intent"].get(key)
+                if rec is not None:
+                    seq, devices = rec
+                    if seq > ctx["applied_seq"].get(key, 0):
+                        ctx["applied_seq"][key] = seq
+                        old = truth.pop(key, None)
+                        if old is not None:
+                            index.remove(old, force=True)
+                        if devices is not None:
+                            claim = _mk_claim(key, devices, seq)
+                            index.apply(claim)
+                            truth[key] = claim
+        violations: List[str] = []
+        for key, (seq, devices) in sorted(ctx["intent"].items()):
+            if ctx["applied_seq"].get(key, 0) != seq:
+                violations.append(
+                    f"key {key}: intended seq {seq} never applied "
+                    f"(got {ctx['applied_seq'].get(key, 0)}) — "
+                    "shed delta not healed by relist")
+            have = ([d for _, _, d in _entries(truth[key])]
+                    if key in truth else None)
+            if have != devices:
+                violations.append(
+                    f"key {key}: terminal devices {have} != intended "
+                    f"{devices}")
+        claims = [truth[k] for k in sorted(truth)]
+        violations.extend(index.diff_against(claims))
+        violations.extend(chip_conflicts(claims))
+        return violations
+
+    def cleanup(self, ctx) -> None:
+        ctx["disp"].stop()
 
 
 # ---------------------------------------------------------------------------
@@ -1099,6 +1265,7 @@ class QuarantineCrashScenario:
 
 INTERLEAVING_SCENARIOS = {
     SchedChurnScenario.name: SchedChurnScenario,
+    ShardDispatchScenario.name: ShardDispatchScenario,
     BatchPrepareScenario.name: BatchPrepareScenario,
     EvictChurnScenario.name: EvictChurnScenario,
     TakeoverScenario.name: TakeoverScenario,
@@ -1111,7 +1278,8 @@ INTERLEAVING_SCENARIOS = {
 # negative fixtures: they are SUPPOSED to violate, so they live in
 # tests, not the gate; stale-read-fixed keeps the REVALIDATES protocol
 # dynamically proven).
-GATE_SCENARIOS = (SchedChurnScenario.name, BatchPrepareScenario.name,
+GATE_SCENARIOS = (SchedChurnScenario.name, ShardDispatchScenario.name,
+                  BatchPrepareScenario.name,
                   EvictChurnScenario.name, StaleReadFixedScenario.name,
                   TakeoverScenario.name)
 

@@ -239,6 +239,19 @@ SITES: Dict[str, str] = {
         "ECONNREFUSED); threatens: RPC loss across the restart — the "
         "masking retry must back off and redial within its bound, "
         "never surface the gap to the caller as a failed RPC",
+    "sched.watch_shard_dispatch":
+        "a partitioned informer's shard delta FIFO refuses an offered "
+        "handler dispatch (models the bounded queue at capacity under "
+        "fan-out burst); threatens: allocation-index staleness for that "
+        "shard — the shed delta must surface through the overflow hook "
+        "so the shard is marked dirty and resynced, never silently "
+        "skipped while try_commit keeps allocating against it",
+    "sched.informer_shard_relist":
+        "the scheduler's shard-overflow recovery fails before the "
+        "shard-scoped dirty+resync lands (index lock contention, resync "
+        "enqueue refused); threatens: a shard that lost deltas staying "
+        "clean-looking — the degradation must fall back to marking the "
+        "whole index dirty so the guarded full resync converges it",
 }
 
 # Declared degradations (drflow R15, SURVEY §20): sites whose injected
@@ -270,6 +283,14 @@ DEGRADATIONS: Dict[str, str] = {
     # A failed reconnect dial stays on the bounded backoff-redial path
     # (RetryingFramedClient._reconnect_backoff) — masking, not failing.
     "prepare.reconnect": "backoff",
+    # A refused shard dispatch has ONE sanctioned exit: shed the delta
+    # and report the shard through the overflow hook
+    # (ShardDispatcher._shard_overflow) so the consumer resyncs it.
+    "sched.watch_shard_dispatch": "shard_overflow",
+    # When even the shard-scoped recovery faults, fall back to dirtying
+    # the whole index (scheduler._mark_dirty) — over-resync is safe,
+    # a clean-looking shard that lost deltas is not.
+    "sched.informer_shard_relist": "mark_dirty",
 }
 
 

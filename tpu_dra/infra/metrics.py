@@ -274,8 +274,11 @@ METRICS_CATALOG: Dict[str, str] = {
     "tpu_dra_cd_teardowns_total": "cdcontroller/controller.py",
     "tpu_dra_cd_degraded_total": "cdcontroller/controller.py",
     # k8s/informer.py — watch-stream health: relists forced by stream
-    # failures (drflow R15: the silent relist loop made loud)
+    # failures (drflow R15: the silent relist loop made loud), and
+    # partitioned-dispatch drops (shard FIFO bound or injected fault;
+    # the consumer's overflow hook owns the dirty+resync recovery)
     "tpu_dra_informer_relists_total": "k8s/informer.py",
+    "tpu_dra_informer_shard_overflows_total": "k8s/informer.py",
     # infra/metrics.py — shared control-plane instruments (below)
     "tpu_dra_cel_cache_hits": "infra/metrics.py",
     "tpu_dra_cel_cache_misses": "infra/metrics.py",

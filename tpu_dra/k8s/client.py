@@ -20,6 +20,7 @@ import os
 import random
 import socket
 import ssl
+import sys
 import threading
 import time
 import urllib.parse
@@ -109,19 +110,74 @@ def label_selector_matches(selector: Optional[str], labels: Dict[str, str]) -> b
     return True
 
 
+_intern = sys.intern
+
+
 def json_deepcopy(obj):
     """Deep copy for JSON-shaped API objects (dict/list containers,
     immutable scalars). copy.deepcopy's generic machinery (memo table,
     reduce protocol) dominated the fake apiserver at churn scale — this
     specialized walk is the same isolation at a fraction of the cost.
     Non-JSON containers (a tuple a test tucked into an object) are
-    returned as-is: the API-object contract treats them as values."""
+    returned as-is: the API-object contract treats them as values.
+
+    Dict KEYS are interned: API objects repeat the same field names
+    ("metadata", "resourceVersion", "attributes", ...) across millions
+    of copies at 10k-node churn scale, and interning collapses them to
+    shared singletons — less allocation on the emit hot path and
+    pointer-fast dict probes downstream. Keys only: the name universe
+    is bounded (schema field names), while VALUES (pod names, RVs) grow
+    without bound and would bloat the intern table forever."""
     cls = obj.__class__
     if cls is dict:
-        return {k: json_deepcopy(v) for k, v in obj.items()}
+        return {_intern(k) if k.__class__ is str else k: json_deepcopy(v)
+                for k, v in obj.items()}
     if cls is list:
         return [json_deepcopy(v) for v in obj]
     return obj
+
+
+def parse_field_selector(selector: str) -> Tuple[Tuple[str, ...], str]:
+    """Parse a single-term equality field selector ('spec.nodeName=n5',
+    'metadata.name=x') into ((path, segments...), value). Only one
+    ``path=value`` term is supported — exactly the shape the node-scoped
+    consumers (kubelet pod watches, nodesim) use, and the shape the fake
+    apiserver can index watch registration by. Anything else (set
+    operators, conjunctions) raises ValueError loudly rather than
+    silently matching everything."""
+    if not selector or "=" not in selector or "!=" in selector \
+            or "," in selector:
+        raise ValueError(f"unsupported field selector {selector!r}: only "
+                         "a single 'path=value' equality term is indexed")
+    path, _, value = selector.partition("=")
+    path = path.strip()
+    if not path or not value:
+        raise ValueError(f"unsupported field selector {selector!r}")
+    return tuple(path.split(".")), value.strip()
+
+
+def field_path_value(obj: Dict, path: Tuple[str, ...]) -> Optional[str]:
+    """The object's value at a dotted field path, as a string, or None
+    when absent/non-scalar. Shared by the fake apiserver's emit-side
+    topic extraction and client-side field filtering so both sides of a
+    field-selector watch agree on what a field 'is'."""
+    cur = obj
+    for seg in path:
+        if not isinstance(cur, dict):
+            return None
+        cur = cur.get(seg)
+        if cur is None:
+            return None
+    if isinstance(cur, (dict, list)):
+        return None
+    return cur if isinstance(cur, str) else str(cur)
+
+
+def field_selector_matches(selector: Optional[str], obj: Dict) -> bool:
+    if not selector:
+        return True
+    path, want = parse_field_selector(selector)
+    return field_path_value(obj, path) == want
 
 
 class ApiClient:
@@ -162,8 +218,13 @@ class ApiClient:
               label_selector: Optional[str] = None,
               resource_version: Optional[str] = None,
               stop: Optional[threading.Event] = None,
+              field_selector: Optional[str] = None,
               ) -> Generator[Tuple[str, Dict], None, None]:
-        """Yield (event_type, object): ADDED/MODIFIED/DELETED/BOOKMARK."""
+        """Yield (event_type, object): ADDED/MODIFIED/DELETED/BOOKMARK.
+
+        ``field_selector`` is a single equality term ('spec.nodeName=n5');
+        servers that index watch registration by field (the fake) use it
+        to skip fan-out entirely for non-matching events."""
         raise NotImplementedError
 
 
@@ -294,16 +355,18 @@ class HttpApiClient(ApiClient):
         return out.get("items", []), rv
 
     def watch(self, gvr, namespace=None, label_selector=None,
-              resource_version=None, stop=None):
+              resource_version=None, stop=None, field_selector=None):
         """Streaming watch over a raw socket with our own HTTP/chunked
         parser: connection establishment uses the full client timeout; the
         stream is read with a 1s socket timeout so `stop` is noticed
         promptly, and because ALL partial data lives in our own buffer a
         timed-out read can never desync the chunked framing (which it can
         inside http.client's buffered decoder)."""
-        query = {"watch": "true"}
+        query = {"watch": "true", "allowWatchBookmarks": "true"}
         if label_selector:
             query["labelSelector"] = label_selector
+        if field_selector:
+            query["fieldSelector"] = field_selector
         if resource_version:
             query["resourceVersion"] = resource_version
         parsed = urllib.parse.urlsplit(self._base)
@@ -528,7 +591,7 @@ class RetryingApiClient(ApiClient):
     # -- watch --------------------------------------------------------------
 
     def watch(self, gvr, namespace=None, label_selector=None,
-              resource_version=None, stop=None):
+              resource_version=None, stop=None, field_selector=None):
         rv = resource_version
         failures = 0
         while stop is None or not stop.is_set():
@@ -537,7 +600,8 @@ class RetryingApiClient(ApiClient):
                 FAULTS.check("k8s.api.request", verb="watch")
                 gen = self._inner.watch(
                     gvr, namespace=namespace, label_selector=label_selector,
-                    resource_version=rv, stop=stop)
+                    resource_version=rv, stop=stop,
+                    field_selector=field_selector)
                 for event_type, obj in gen:
                     if FAULTS.fires("k8s.watch.drop"):
                         raise _WatchDropped()

@@ -18,19 +18,48 @@ from typing import Dict, Generator, List, Optional, Tuple
 
 from tpu_dra.k8s.client import (
     AlreadyExistsError, ApiClient, ConflictError, GVR, NotFoundError,
-    json_deepcopy, label_selector_matches,
+    field_path_value, json_deepcopy, label_selector_matches,
+    parse_field_selector,
 )
 from tpu_dra.k8s.resources import now_rfc3339
 
+# A watch registration topic: (gvr_key, field_path|None, field_value|None).
+# (gk, None, None) is the broadcast topic every plain watcher sits on;
+# field-selector watchers sit on (gk, ("spec","nodeName"), "n5") and the
+# emit path only walks the topics an event actually belongs to — a
+# node-scoped watcher is never even iterated for another node's events.
+_Topic = Tuple[str, Optional[Tuple[str, ...]], Optional[str]]
+
 
 class _Watcher:
+    """One watch stream: a BOUNDED queue of (type, obj) items. The fake
+    apiserver never blocks its (lock-holding) emit path on a slow
+    consumer — a full queue marks the stream overflowed, remaining
+    buffered events drain, then the stream ends with 410 so the consumer
+    relists (the real apiserver's too-slow-watcher behavior)."""
+
+    __slots__ = ("gvr_key", "namespace", "selector", "topic", "events",
+                 "closed", "overflowed")
+
     def __init__(self, gvr_key: str, namespace: Optional[str],
-                 selector: Optional[str]):
+                 selector: Optional[str], topic: _Topic, cap: int):
         self.gvr_key = gvr_key
         self.namespace = namespace
         self.selector = selector
-        self.events: "queue.Queue[Tuple[str, Dict]]" = queue.Queue()
+        self.topic = topic
+        self.events: "queue.Queue[Tuple[str, Dict]]" = queue.Queue(maxsize=cap)
         self.closed = False
+        self.overflowed = False
+
+    def offer(self, item: Tuple[str, Dict]) -> bool:
+        if self.overflowed:
+            return False
+        try:
+            self.events.put_nowait(item)
+            return True
+        except queue.Full:
+            self.overflowed = True
+            return False
 
 
 class FakeCluster(ApiClient):
@@ -39,6 +68,9 @@ class FakeCluster(ApiClient):
     # Bounded event log for resourceVersion replay (closes the LIST->WATCH
     # gap a real apiserver closes the same way).
     EVENT_LOG_CAP = 4096
+    # Per-watcher queue bound: past this, the stream is declared too slow
+    # and ended with 410 (drain-then-error) so the consumer relists.
+    WATCH_QUEUE_CAP = 4096
 
     def __init__(self):
         self._lock = threading.RLock()
@@ -53,12 +85,33 @@ class FakeCluster(ApiClient):
         self._rv = itertools.count(1)
         self._last_rv = 0
         self._watchers: List[_Watcher] = []
-        # [(rv, gvr_key, ns, event_type, obj)] — replayed for watches that
-        # resume from an older resourceVersion.
-        self._events: List[Tuple[int, str, str, str, Dict]] = []
+        # topic -> watchers. Emit walks only the topics an event belongs
+        # to (broadcast + one per registered field path with a value on
+        # the object), so fan-out cost scales with MATCHING watchers, not
+        # total watchers — the difference between O(1) and O(10k) per
+        # event once every simulated node runs its own scoped watch.
+        self._watch_index: Dict[_Topic, List[_Watcher]] = {}
+        # gvr_key -> field paths with at least one historical registration
+        # (bounded: the schema-level universe of watched paths). Emit
+        # extracts these paths once per event to compute its topics.
+        self._field_paths: Dict[str, set] = {}
+        # (gvr_key, path) -> global _trimmed_rv when the path was FIRST
+        # registered. Before that point no per-topic watermarks exist for
+        # the path, so a resume from older history must 410 (we cannot
+        # prove the trimmed range held no matching events).
+        self._field_path_since: Dict[Tuple[str, Tuple[str, ...]], int] = {}
+        # [(rv, gvr_key, ns, event_type, obj, topics)] — replayed for
+        # watches that resume from an older resourceVersion. Topics are
+        # precomputed at emit so trim-time watermark upkeep is a lookup.
+        self._events: List[Tuple[int, str, str, str, Dict, List[_Topic]]] = []
         # Highest RV dropped from the bounded log: a resume from at or
-        # below it has a hole and must get 410 Gone, not a silent skip.
+        # below it has a hole and must get 410 Gone, not a silent skip —
+        # UNLESS the watch is field-scoped and the per-topic watermark
+        # below proves no matching event was in the hole (bookmark
+        # semantics: dead ranges are skippable when provably irrelevant).
         self._trimmed_rv = 0
+        # topic -> highest rv of a trimmed event that carried this topic.
+        self._topic_trimmed: Dict[_Topic, int] = {}
         # Hooks for tests: callables (verb, gvr, obj) -> obj|None run before
         # the verb; raising simulates apiserver errors (webhook analog).
         self.reactors = []
@@ -77,28 +130,42 @@ class FakeCluster(ApiClient):
         obj.setdefault("metadata", {})["resourceVersion"] = str(self._last_rv)
 
     def _emit(self, gvr: GVR, ns: str, event_type: str, obj: Dict) -> None:
-        # ONE frozen snapshot per event, shared by the replay log and
-        # every watcher queue (events are read-only by contract — the
-        # informer layer copies before handing objects to mutating
-        # consumers). The previous per-watcher deepcopy made every emit
-        # O(watchers) full copies, which dominated the fake apiserver at
-        # churn scale (5 informers x thousands of lifecycle events).
+        # ONE frozen snapshot per event (single-encode), shared by the
+        # replay log and every watcher queue (multi-enqueue) — events are
+        # read-only by contract; the informer layer copies before handing
+        # objects to mutating consumers. Fan-out walks the topic index,
+        # not the watcher list: the broadcast topic plus one topic per
+        # registered field path the object has a value at. 10k node-scoped
+        # watchers cost this loop exactly one queue append (the one
+        # matching node), not 10k filter evaluations.
         snapshot = json_deepcopy(obj)
         rv = int(obj.get("metadata", {}).get("resourceVersion", "0") or 0)
-        self._events.append((rv, gvr.key, ns, event_type, snapshot))
+        gk = gvr.key
+        topics: List[_Topic] = [(gk, None, None)]
+        for path in self._field_paths.get(gk, ()):
+            val = field_path_value(snapshot, path)
+            if val is not None:
+                topics.append((gk, path, val))
+        self._events.append((rv, gk, ns, event_type, snapshot, topics))
         if len(self._events) > self.EVENT_LOG_CAP:
             cut = len(self._events) - self.EVENT_LOG_CAP
             self._trimmed_rv = max(self._trimmed_rv, self._events[cut - 1][0])
+            for ev in self._events[:cut]:
+                for t in ev[5]:
+                    if t[1] is not None and ev[0] > self._topic_trimmed.get(t, 0):
+                        self._topic_trimmed[t] = ev[0]
             del self._events[:cut]
-        labels = obj.get("metadata", {}).get("labels", {}) or {}
-        for w in list(self._watchers):
-            if w.closed or w.gvr_key != gvr.key:
-                continue
-            if w.namespace and gvr.namespaced and w.namespace != ns:
-                continue
-            if not label_selector_matches(w.selector, labels):
-                continue
-            w.events.put((event_type, snapshot))
+        labels = snapshot.get("metadata", {}).get("labels", {}) or {}
+        item = (event_type, snapshot)
+        for t in topics:
+            for w in self._watch_index.get(t, ()):
+                if w.closed:
+                    continue
+                if w.namespace and gvr.namespaced and w.namespace != ns:
+                    continue
+                if w.selector and not label_selector_matches(w.selector, labels):
+                    continue
+                w.offer(item)
 
     def _run_reactors(self, verb: str, gvr: GVR, obj: Optional[Dict]):
         for r in self.reactors:
@@ -250,12 +317,53 @@ class FakeCluster(ApiClient):
             return (self.list(gvr, namespace, label_selector),
                     str(self._last_rv))
 
+    @staticmethod
+    def _gone_status(message: str) -> Tuple[str, Dict]:
+        return ("ERROR", {
+            "kind": "Status", "apiVersion": "v1", "status": "Failure",
+            "code": 410, "reason": "Expired", "message": message})
+
     def watch(self, gvr, namespace=None, label_selector=None,
-              resource_version=None, stop=None
+              resource_version=None, stop=None, field_selector=None,
               ) -> Generator[Tuple[str, Dict], None, None]:
-        w = _Watcher(gvr.key, namespace if gvr.namespaced else None, label_selector)
-        gone = False
+        """Watch with indexed registration and bookmark semantics.
+
+        A ``field_selector`` ('spec.nodeName=n5') registers the watcher
+        on a single topic: the emit path never iterates it for events
+        whose object has a different value at that path. This suits
+        set-once fields (a pod's nodeName binds once, kubelet-style):
+        an object CREATED without the field only hits the broadcast
+        topic, the MODIFIED that sets it and every later event reach the
+        scoped watcher, and no DELETED is synthesized on a field-value
+        transition away — scoped consumers of mutable fields must use a
+        broadcast watch and filter client-side.
+
+        Resume (``resource_version``) replays retained history after
+        that RV. A broadcast resume below the trim point gets 410 Gone;
+        a field-scoped resume additionally consults the per-topic trim
+        watermark, so it survives log compaction as long as no MATCHING
+        event was trimmed — dead ranges full of other nodes' churn are
+        skipped, not relisted. Field-scoped streams open with a BOOKMARK
+        carrying the current RV so the client's resume point advances
+        past dead history even when no real event matches.
+        """
+        gk = gvr.key
+        ns_scope = namespace if gvr.namespaced else None
+        field = None
+        if field_selector:
+            field = parse_field_selector(field_selector)
+        topic: _Topic = (gk, field[0], field[1]) if field else (gk, None, None)
+        gone: Optional[str] = None
+        w = _Watcher(gk, ns_scope, label_selector, topic,
+                     self.WATCH_QUEUE_CAP)
         with self._lock:
+            if field:
+                # Register the path for emit-side topic extraction. The
+                # watermark floor is the trim point at FIRST registration:
+                # older history never had this topic indexed.
+                self._field_paths.setdefault(gk, set()).add(field[0])
+                self._field_path_since.setdefault(
+                    (gk, field[0]), self._trimmed_rv)
             # Atomically: replay events after resource_version, then go
             # live — no gap in which an event can be lost.
             if resource_version:
@@ -263,42 +371,72 @@ class FakeCluster(ApiClient):
                     since = int(resource_version)
                 except ValueError:
                     since = 0
-                if since < self._trimmed_rv:
-                    # History trimmed past the resume point: events between
-                    # `since` and the oldest retained RV are unrecoverable.
-                    # Real apiserver semantics: 410 Gone, client relists.
-                    gone = True
+                if field:
+                    dead = max(
+                        self._topic_trimmed.get(topic, 0),
+                        self._field_path_since[(gk, field[0])])
                 else:
-                    for rv, gvr_key, ns, event_type, obj in self._events:
-                        if rv <= since or gvr_key != gvr.key:
+                    dead = self._trimmed_rv
+                if since < dead:
+                    # Events between `since` and the oldest retained (or
+                    # provably-relevant) RV are unrecoverable. Real
+                    # apiserver semantics: 410 Gone, client relists.
+                    gone = (f"too old resource version: "
+                            f"{resource_version} ({dead})")
+                else:
+                    for rv, gvr_key, ns, event_type, obj, _t in self._events:
+                        if rv <= since or gvr_key != gk:
                             continue
-                        if (w.namespace and gvr.namespaced
-                                and w.namespace != ns):
+                        if ns_scope and gvr.namespaced and ns_scope != ns:
+                            continue
+                        if field and field_path_value(obj, field[0]) != field[1]:
                             continue
                         labels = obj.get("metadata", {}).get("labels", {}) or {}
                         if not label_selector_matches(label_selector, labels):
                             continue
-                        w.events.put((event_type, json_deepcopy(obj)))
-            if not gone:
+                        # Stored snapshots are frozen (read-only contract)
+                        # — replay shares them, same as live fan-out.
+                        w.offer((event_type, obj))
+            if gone is None:
                 self._watchers.append(w)
-        if gone:
-            yield ("ERROR", {
-                "kind": "Status", "apiVersion": "v1", "status": "Failure",
-                "code": 410, "reason": "Expired",
-                "message": f"too old resource version: {resource_version} "
-                           f"({self._trimmed_rv})"})
+                self._watch_index.setdefault(topic, []).append(w)
+                if field:
+                    # Start-of-stream bookmark (field-scoped streams
+                    # only — broadcast consumers predate bookmarks and
+                    # don't need them): advances the client's resume RV
+                    # to "now" so an idle scoped watcher can later
+                    # resume across ranges trimmed while it was away.
+                    w.offer(("BOOKMARK", {"metadata": {
+                        "resourceVersion": str(self._last_rv)}}))
+        if gone is not None:
+            yield self._gone_status(gone)
             return
         try:
             while stop is None or not stop.is_set():
                 try:
                     yield w.events.get(timeout=0.1)
                 except queue.Empty:
+                    if w.overflowed:
+                        # Buffered events all drained; the stream lost
+                        # later ones. End it the way the real apiserver
+                        # ends a too-slow watch: the client relists.
+                        yield self._gone_status(
+                            "watch queue overflow: events dropped, relist")
+                        return
                     continue
         finally:
             w.closed = True
             with self._lock:
                 if w in self._watchers:
                     self._watchers.remove(w)
+                peers = self._watch_index.get(topic)
+                if peers is not None:
+                    try:
+                        peers.remove(w)
+                    except ValueError:
+                        pass
+                    if not peers:
+                        del self._watch_index[topic]
 
     # -- test conveniences --------------------------------------------------
 

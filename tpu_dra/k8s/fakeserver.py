@@ -150,7 +150,8 @@ class FakeApiServer:
                     selector = (query.get("labelSelector") or [None])[0]
                     if (query.get("watch") or ["false"])[0] == "true":
                         rv = (query.get("resourceVersion") or [None])[0]
-                        return self._watch(gvr, ns, selector, rv)
+                        fsel = (query.get("fieldSelector") or [None])[0]
+                        return self._watch(gvr, ns, selector, rv, fsel)
                     items, rv = outer.cluster.list_with_rv(
                         gvr, namespace=ns, label_selector=selector)
                     return self._send_json(200, {
@@ -160,7 +161,8 @@ class FakeApiServer:
                 except NotFoundError as e:
                     return self._error(404, str(e))
 
-            def _watch(self, gvr, ns, selector, resource_version=None):
+            def _watch(self, gvr, ns, selector, resource_version=None,
+                       field_selector=None):
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Transfer-Encoding", "chunked")
@@ -175,7 +177,8 @@ class FakeApiServer:
                     for event_type, obj in outer.cluster.watch(
                             gvr, namespace=ns, label_selector=selector,
                             resource_version=resource_version,
-                            stop=outer._stop):
+                            stop=outer._stop,
+                            field_selector=field_selector):
                         line = json.dumps({"type": event_type,
                                            "object": obj}) + "\n"
                         write_chunk(line.encode())

@@ -9,14 +9,17 @@ cache the DaemonSet manager uses to see its own writes
 
 from __future__ import annotations
 
+import collections
 import copy
 import logging
 import os
 import random
 import sys
 import threading
+import zlib
 from typing import Callable, Dict, List, Optional, Tuple
 
+from tpu_dra.infra.faults import FAULTS
 from tpu_dra.infra.metrics import DefaultRegistry as _METRICS
 from tpu_dra.k8s.client import ApiClient, GVR
 
@@ -28,6 +31,15 @@ log = logging.getLogger("tpu_dra.informer")
 _RELISTS = _METRICS.counter(
     "tpu_dra_informer_relists_total",
     "informer list/watch stream failures that forced a relist")
+
+# Partitioned-dispatch drops: a shard delta FIFO hit its bound (or the
+# sched.watch_shard_dispatch fault fired) and a handler invocation was
+# shed. The consumer's on_shard_overflow callback owns recovery (the
+# scheduler marks the shard dirty and resyncs); this counter is how a
+# recovery loop that's silently doing all the work gets noticed.
+_SHARD_OVERFLOWS = _METRICS.counter(
+    "tpu_dra_informer_shard_overflows_total",
+    "partitioned informer dispatch drops (queue bound or injected fault)")
 
 
 # Sentinel returned by Informer._set for writes that lost an RV race
@@ -262,21 +274,190 @@ class Lister:
         return objs
 
 
+class ShardDispatcher:
+    """Per-shard bounded delta FIFOs for partitioned handler dispatch.
+
+    The partitioned informer routes each event's handler invocation to
+    the shard of its partition key (crc32, the SAME function as the
+    scheduler's AllocationIndex.shard_of, so informer shard i feeds
+    exactly index shard i) and a dedicated worker drains each FIFO. One
+    slow handler or dirty shard therefore never stalls siblings, and
+    per-KEY ordering is preserved because a key's shard never changes.
+
+    Queues are BOUNDED: ``offer`` never blocks the watch thread. A full
+    shard (or the ``sched.watch_shard_dispatch`` fault) sheds the
+    invocation and reports it through ``on_overflow`` — the consumer
+    owns recovery (the scheduler marks the matching index shard dirty
+    and schedules a resync), mirroring how the fake apiserver ends a
+    too-slow watch with 410.
+
+    ``drain_one`` is the single-step seam: the worker loop is just
+    ``while running: drain_one(sid, timeout)``, and the drmc model
+    checker drives the same method as explicit interleaved tasks.
+    """
+
+    def __init__(self, shards: int, cap: int = 4096,
+                 on_overflow: Optional[Callable[[int, str], None]] = None,
+                 name: str = "informer"):
+        if shards <= 0:
+            raise ValueError("shards must be positive")
+        self._n = shards
+        self._cap = cap
+        self._on_overflow = on_overflow
+        self._name = name
+        self._queues = [collections.deque() for _ in range(shards)]
+        self._conds = [threading.Condition() for _ in range(shards)]
+        self._stopped = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self.overflows = 0
+
+    @staticmethod
+    def shard_of(key: str, shards: int) -> int:
+        return zlib.crc32(key.encode()) % shards
+
+    def route(self, key: str) -> int:
+        return self.shard_of(key, self._n)
+
+    @property
+    def shards(self) -> int:
+        return self._n
+
+    def depth(self, sid: int) -> int:
+        with self._conds[sid]:
+            return len(self._queues[sid])
+
+    # -- producer side ------------------------------------------------------
+
+    def offer(self, sid: int, thunk: Callable[[], None]) -> bool:
+        """Enqueue; returns False (after notifying on_overflow) when the
+        shard FIFO is at its bound or the dispatch fault fires."""
+        q = self._queues[sid]
+        with self._conds[sid]:
+            fired = FAULTS.fires("sched.watch_shard_dispatch")
+            if not fired and len(q) < self._cap:
+                q.append(thunk)
+                self._conds[sid].notify()
+                return True
+            self.overflows += 1
+        _SHARD_OVERFLOWS.inc()
+        # Outside the shard condition: the consumer's recovery callback
+        # may take its own (index) locks.
+        self._shard_overflow(sid, "fault" if fired else "full")
+        return False
+
+    def _shard_overflow(self, sid: int, reason: str) -> None:
+        """The declared degradation of sched.watch_shard_dispatch: shed
+        the delta, hand the shard id to the consumer's recovery hook."""
+        log.debug("%s dispatcher shard %d overflow (%s)",
+                  self._name, sid, reason)
+        if self._on_overflow is not None:
+            try:
+                self._on_overflow(sid, reason)
+            except Exception:  # noqa: BLE001 — recovery hook must not kill the watch
+                import traceback
+                traceback.print_exc()
+
+    # -- consumer side ------------------------------------------------------
+
+    def drain_one(self, sid: int, timeout: Optional[float] = None) -> bool:
+        """Run the shard's next thunk; False if none arrived in time."""
+        q = self._queues[sid]
+        with self._conds[sid]:
+            if not q and timeout:
+                self._conds[sid].wait(timeout)
+            if not q:
+                return False
+            thunk = q.popleft()
+        try:
+            thunk()
+        except Exception:  # noqa: BLE001 — a broken handler must not kill the worker
+            import traceback
+            traceback.print_exc()
+        return True
+
+    def flush(self, timeout: float = 30.0) -> bool:
+        """Barrier: returns once every thunk offered BEFORE the call has
+        run (new offers may land behind the barrier thunks; per-shard
+        FIFO order makes the prefix guarantee exact)."""
+        events = []
+        for sid in range(self._n):
+            ev = threading.Event()
+            with self._conds[sid]:
+                self._queues[sid].append(ev.set)
+                self._conds[sid].notify()
+            events.append(ev)
+        ok = True
+        for ev in events:
+            ok = ev.wait(timeout) and ok
+        return ok
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        self._stopped.clear()
+        self._threads = [
+            threading.Thread(target=self._worker, args=(sid,), daemon=True,
+                             name=f"{self._name}-shard-{sid}")
+            for sid in range(self._n)]
+        for t in self._threads:
+            t.start()
+
+    def _worker(self, sid: int) -> None:
+        while not self._stopped.is_set():
+            self.drain_one(sid, timeout=0.2)
+
+    def stop(self) -> None:
+        self._stopped.set()
+        for sid in range(self._n):
+            with self._conds[sid]:
+                self._conds[sid].notify_all()
+        for t in self._threads:
+            t.join(timeout=2)
+        self._threads = []
+        # Drain leftovers single-threaded so a stop() between offer and
+        # drain doesn't strand handler work (informer stop is ordered
+        # after the watch thread join — no new offers by now).
+        for sid in range(self._n):
+            while self.drain_one(sid):
+                pass
+
+
 class Informer:
     """Single-resource informer. Handlers run on the watch thread; keep them
-    quick and enqueue real work to a WorkQueue (the reference's pattern)."""
+    quick and enqueue real work to a WorkQueue (the reference's pattern).
+
+    With ``partitions=N`` handler dispatch is instead routed through a
+    ShardDispatcher: events are partitioned by ``partition_key`` (crc32
+    of the key, aligned with AllocationIndex.shard_of) onto per-shard
+    bounded FIFOs drained by per-shard workers. The CACHE is still
+    updated on the watch thread (RV-monotonic, single writer); only the
+    handler invocations are partitioned, so per-key handler order is
+    preserved while one slow shard no longer stalls the rest."""
 
     def __init__(self, client: ApiClient, gvr: GVR,
                  namespace: Optional[str] = None,
                  label_selector: Optional[str] = None,
                  field_filter: Optional[Callable[[Dict], bool]] = None,
                  copy_on_read: bool = True,
-                 copy_events: bool = True):
+                 copy_events: bool = True,
+                 partitions: int = 0,
+                 partition_key: Optional[Callable[[Dict], Optional[str]]] = None,
+                 shard_queue_cap: int = 4096,
+                 on_shard_overflow: Optional[Callable[[int, str], None]] = None):
         """copy_on_read=False makes the lister (and get_by_index) return
         views of the cache instead of deepcopies — for hot read-only
         consumers; see Lister. copy_events=False skips the per-dispatch
         deepcopy of handler arguments — handlers then share the cached
-        object and MUST treat it as read-only."""
+        object and MUST treat it as read-only.
+
+        partitions=N routes handler dispatch through a ShardDispatcher
+        of N shards keyed by ``partition_key(obj)`` (falling back to the
+        object's namespace/name key when the extractor returns None), so
+        objects of one partition — e.g. claims of one node pool — are
+        handled strictly in order on one shard while other shards run
+        free. ``on_shard_overflow(shard_id, reason)`` fires when a shard
+        FIFO sheds work (bound hit or injected fault) — the consumer
+        must treat the shard's derived state as dirty and resync."""
         self._client = client
         self._gvr = gvr
         self._namespace = namespace
@@ -284,6 +465,13 @@ class Informer:
         self._field_filter = field_filter
         self._copy_on_read = copy_on_read
         self._copy_events = copy_events
+        self._partition_key = partition_key
+        self._dispatcher: Optional[ShardDispatcher] = None
+        if partitions > 0:
+            self._dispatcher = ShardDispatcher(
+                partitions, cap=shard_queue_cap,
+                on_overflow=on_shard_overflow,
+                name=f"informer-{gvr.plural}")
         self._store: Dict[str, Dict] = {}
         self._lock = threading.RLock()
         self._indexers: Dict[str, Callable[[Dict], List[str]]] = {}
@@ -316,6 +504,8 @@ class Informer:
     # -- lifecycle ----------------------------------------------------------
 
     def start(self) -> None:
+        if self._dispatcher is not None:
+            self._dispatcher.start()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name=f"informer-{self._gvr.plural}")
         self._thread.start()
@@ -327,6 +517,10 @@ class Informer:
             # client's short read timeout); a tight join keeps multi-informer
             # shutdown inside a pod's termination grace period.
             self._thread.join(timeout=2)
+        if self._dispatcher is not None:
+            # After the watch thread: no producer left, so the
+            # dispatcher's final single-threaded drain is complete.
+            self._dispatcher.stop()
 
     def wait_for_sync(self, timeout: float = 10.0) -> bool:
         return self._synced.wait(timeout)
@@ -400,7 +594,33 @@ class Informer:
                 for val in fn(new):
                     idx.setdefault(val, {})[key] = new
 
+    def _partition_of(self, args: Tuple) -> str:
+        """Partition key for a dispatch: try the extractor newest-arg
+        first (update dispatch passes (old, new) — the new object is
+        authoritative, but e.g. a deallocated claim may only reveal its
+        pool in the OLD one), falling back to the object key so every
+        event routes deterministically even without a pool."""
+        if self._partition_key is not None:
+            for a in reversed(args):
+                try:
+                    key = self._partition_key(a)
+                except Exception:  # noqa: BLE001  # drflow: swallow-ok[extractor bug degrades to name-hash routing, which is correct for any key]
+                    key = None
+                if key:
+                    return key
+        return meta_namespace_key(args[-1])
+
     def _dispatch(self, handlers, *args) -> None:
+        if self._dispatcher is not None:
+            sid = self._dispatcher.route(self._partition_of(args))
+            # Shed-on-overflow is handled inside the dispatcher (the
+            # on_shard_overflow hook owns recovery); nothing to do here.
+            self._dispatcher.offer(
+                sid, lambda: self._dispatch_now(handlers, *args))
+            return
+        self._dispatch_now(handlers, *args)
+
+    def _dispatch_now(self, handlers, *args) -> None:
         if not self._copy_events and SHADOW.enabled:
             for a in args:
                 SHADOW.record(a)
@@ -467,6 +687,12 @@ class Informer:
         for obj in objs:
             if self._accepts(obj) and meta_namespace_key(obj) not in stale:
                 self._dispatch(self._add_handlers, obj)
+        if self._dispatcher is not None:
+            # Consumers treat wait_for_sync() as "every initial add has
+            # been HANDLED" (the scheduler's allocation index is built at
+            # sync) — with partitioned dispatch that needs a barrier over
+            # the shard FIFOs, not just the enqueue loop above.
+            self._dispatcher.flush()
         self._synced.set()
 
         for event_type, obj in self._client.watch(
@@ -481,6 +707,11 @@ class Informer:
                 # Gone or any server-side stream error: raise so _run
                 # relists instead of continuing on a stream with a hole.
                 raise RuntimeError(f"watch stream error: {obj}")
+            if event_type == "BOOKMARK":
+                # Resume-progress marker, not an object event: the
+                # retrying client has already advanced its resume RV
+                # from it; nothing to cache or dispatch.
+                continue
             if not self._accepts(obj):
                 continue
             if event_type in ("ADDED", "MODIFIED"):

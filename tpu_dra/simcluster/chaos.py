@@ -625,9 +625,16 @@ def run_matrix(seeds: List[int], n_events: int = 40) -> Dict:
 # optimistic commits (the multi-worker conflict/requeue path) — so the
 # guarded resync fallback AND the parallel core's commit discipline are
 # chaos-tested on the production path.
+# sched.watch_shard_dispatch sheds deltas off the partitioned claims
+# informer's shard FIFOs (the bounded-queue overflow path), and
+# sched.informer_shard_relist faults the recovery hook itself — together
+# they chaos-test the shard-dirty + resync pipeline that heals a shed
+# delta, including its whole-index degradation.
 SCHED_CHAOS_SITES = ("k8s.api.request", "k8s.watch.drop",
                      "sched.watch_event", "sched.index_apply",
                      "sched.shard_apply", "sched.snapshot_commit",
+                     "sched.watch_shard_dispatch",
+                     "sched.informer_shard_relist",
                      "trace.emit")
 
 

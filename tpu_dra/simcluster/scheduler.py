@@ -925,8 +925,24 @@ class Scheduler:
         for name, gvr in (("pods", PODS), ("claims", RESOURCECLAIMS),
                           ("slices", RESOURCESLICES),
                           ("classes", DEVICECLASSES), ("nodes", NODES)):
-            inf[name] = Informer(self._client, gvr,
-                                 copy_on_read=False, copy_events=False)
+            if name == "claims":
+                # The claims informer is PARTITIONED by allocation pool,
+                # with the same crc32-shard function as AllocationIndex:
+                # informer shard i feeds exactly index shard i, so claim
+                # deltas of one node pool apply in order on one FIFO
+                # while other pools' shards run free, and a shed delta
+                # dirties precisely the index shard it would have fed.
+                inf[name] = Informer(
+                    self._client, gvr,
+                    copy_on_read=False, copy_events=False,
+                    partitions=self._index_shards,
+                    partition_key=self._claim_pool,
+                    shard_queue_cap=int(os.environ.get(
+                        "TPU_DRA_SCHED_SHARD_QUEUE_CAP", "4096")),
+                    on_shard_overflow=self._on_informer_shard_overflow)
+            else:
+                inf[name] = Informer(self._client, gvr,
+                                     copy_on_read=False, copy_events=False)
         inf["claims"].add_indexer("owner", self._owner_index)
         inf["slices"].add_indexer("node", self._slice_node_index)
 
@@ -1112,6 +1128,33 @@ class Scheduler:
             return
         if old is not None and claim_entries(old) and not claim_entries(new):
             self._nudge_pending_pods()  # deallocation freed devices
+
+    @staticmethod
+    def _claim_pool(claim: Dict) -> Optional[str]:
+        """Partition key for the partitioned claims informer: the pool
+        of the claim's allocation, i.e. exactly what AllocationIndex
+        shards by — claim deltas ride the informer shard that feeds
+        their index shard. Unallocated claims return None and fall back
+        to the informer's name-hash routing (they carry no entries, so
+        any shard is equally correct for them)."""
+        entries = claim_entries(claim)
+        return entries[0][1] if entries else None
+
+    def _on_informer_shard_overflow(self, shard_id: int, reason: str) -> None:
+        """Recovery hook for a shed claims-informer delta: the shard's
+        slice of the allocation index missed an apply/remove, so mark
+        exactly that index shard dirty (try_commit refuses dirty shards
+        — no allocation can race the gap) and queue the guarded resync.
+        If even this path faults (sched.informer_shard_relist), degrade
+        to dirtying the whole index: over-resync is safe, a clean-
+        looking shard that lost deltas is not."""
+        why = f"informer shard {shard_id} overflow ({reason})"
+        try:
+            FAULTS.check("sched.informer_shard_relist", shard=shard_id)
+            self._index.mark_shard_dirty(shard_id, why)
+            self._enqueue_resync(why)
+        except FaultInjected:
+            self._mark_dirty(why)
 
     def _on_claim_deleted(self, claim: Dict) -> None:
         if self._drop_event("resourceclaims"):
